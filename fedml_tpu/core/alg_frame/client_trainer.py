@@ -1,0 +1,61 @@
+"""Engine-agnostic client trainer ABC.
+
+Parity with reference ``core/alg_frame/client_trainer.py:6-45``: stateless
+operator with ``get/set_model_params`` + ``train`` and before/after hooks; the
+after-hook applies local DP noise when enabled.  In this framework the model
+parameters are a JAX pytree and concrete trainers are thin shells over pure
+jitted train functions (see fedml_tpu/ml/trainer/).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any
+
+
+class ClientTrainer(ABC):
+    def __init__(self, model: Any, args: Any):
+        self.model = model
+        self.id = 0
+        self.args = args
+        self.local_train_dataset = None
+        self.local_test_dataset = None
+        self.local_sample_number = 0
+        self.rng = None
+
+    def set_id(self, trainer_id: int) -> None:
+        self.id = trainer_id
+
+    def is_main_process(self) -> bool:
+        return True
+
+    @abstractmethod
+    def get_model_params(self) -> Any:
+        ...
+
+    @abstractmethod
+    def set_model_params(self, model_parameters: Any) -> None:
+        ...
+
+    def update_dataset(self, local_train_dataset, local_test_dataset, local_sample_number) -> None:
+        self.local_train_dataset = local_train_dataset
+        self.local_test_dataset = local_test_dataset
+        self.local_sample_number = local_sample_number
+
+    def on_before_local_training(self, train_data, device, args) -> None:
+        """Hook: runs before local epochs (reference :34-36)."""
+
+    @abstractmethod
+    def train(self, train_data, device, args) -> Any:
+        ...
+
+    def on_after_local_training(self, train_data, device, args) -> None:
+        """Hook: applies LOCAL DP noise when enabled (reference :38-42)."""
+        from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_local_dp_enabled():
+            self.set_model_params(dp.add_local_noise(self.get_model_params()))
+
+    def test(self, test_data, device, args) -> Any:
+        return None

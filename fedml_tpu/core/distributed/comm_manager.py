@@ -1,0 +1,129 @@
+"""Node runtime: handler registry + backend factory.
+
+Parity with reference ``core/distributed/fedml_comm_manager.py:10-135``
+(``FedMLCommManager``): every server/client manager subclasses this, registers
+per-message-type handlers, and calls :meth:`run` to enter the transport's
+receive loop.  The backend factory dispatches on ``args.backend``; the TPU
+rebuild's backends are LOOPBACK (in-process), GRPC (DCN message plane) and an
+MQTT+S3 emulation (file-blob data plane) — NCCL/MPI collective traffic has no
+backend here because on TPU it is in-program XLA collectives
+(see fedml_tpu/simulation/xla/).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+from ...constants import (
+    FEDML_BACKEND_GRPC,
+    FEDML_BACKEND_LOOPBACK,
+    FEDML_BACKEND_MQTT_S3,
+    FEDML_BACKEND_MQTT_S3_MNN,
+)
+from .communication.base_com_manager import BaseCommunicationManager, Observer
+from .communication.message import Message
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLCommManager(Observer):
+    def __init__(self, args, comm=None, rank: int = 0, size: int = 0, backend: str = "LOOPBACK"):
+        self.args = args
+        self.size = int(size)
+        self.rank = int(rank)
+        self.backend = backend
+        self.comm = comm
+        self.com_manager: Optional[BaseCommunicationManager] = None
+        self.message_handler_dict: Dict[str, Callable[[Message], None]] = {}
+        self._init_manager()
+
+    # -- lifecycle ----------------------------------------------------------
+    def run(self) -> None:
+        """Enter the receive loop (blocks; reference ``fedml_comm_manager.py:24``)."""
+        self.register_message_receive_handlers()
+        assert self.com_manager is not None
+        self.com_manager.handle_receive_message()
+        logger.info("comm manager %s/%s done", self.rank, self.size)
+
+    def run_async(self) -> threading.Thread:
+        """Native addition: run the receive loop on a daemon thread so many
+        node runtimes can cohabit one test process."""
+        t = threading.Thread(target=self.run, daemon=True, name=f"comm-rank{self.rank}")
+        t.start()
+        return t
+
+    def finish(self) -> None:
+        """Stop the transport (reference ``fedml_comm_manager.py:61-76``)."""
+        if self.com_manager is not None:
+            self.com_manager.stop_receive_message()
+
+    # -- messaging ----------------------------------------------------------
+    def get_sender_id(self) -> int:
+        return self.rank
+
+    def send_message(self, message: Message) -> None:
+        assert self.com_manager is not None
+        self.com_manager.send_message(message)
+
+    def register_message_receive_handler(
+        self, msg_type: str, handler_callback_func: Callable[[Message], None]
+    ) -> None:
+        self.message_handler_dict[str(msg_type)] = handler_callback_func
+
+    def register_message_receive_handlers(self) -> None:
+        """Subclasses register their per-round handlers here."""
+
+    # Observer
+    def receive_message(self, msg_type: str, msg_params: Message) -> None:
+        handler = self.message_handler_dict.get(str(msg_type))
+        if handler is None:
+            logger.debug("rank %s: no handler for msg_type=%s", self.rank, msg_type)
+            return
+        handler(msg_params)
+
+    # -- backend factory (reference ``fedml_comm_manager.py:78-134``) -------
+    def _init_manager(self) -> None:
+        backend = (self.backend or FEDML_BACKEND_LOOPBACK).upper()
+        run_id = str(getattr(self.args, "run_id", "0"))
+        if backend == FEDML_BACKEND_LOOPBACK:
+            from .communication.loopback import LoopbackCommManager
+
+            self.com_manager = LoopbackCommManager(channel=run_id, rank=self.rank, size=self.size)
+        elif backend == FEDML_BACKEND_GRPC:
+            try:
+                from .communication.grpc.grpc_comm_manager import GRPCCommManager
+            except ImportError as e:
+                raise NotImplementedError(
+                    "GRPC backend module not available in this build"
+                ) from e
+
+            base_port = int(getattr(self.args, "grpc_base_port", 8890))
+            ip_config = getattr(self.args, "grpc_ipconfig_path", None)
+            self.com_manager = GRPCCommManager(
+                host=getattr(self.args, "grpc_host", "127.0.0.1"),
+                port=base_port + self.rank,
+                ip_config=ip_config,
+                client_id=self.rank,
+                client_num=self.size,
+                base_port=base_port,
+            )
+        elif backend in (FEDML_BACKEND_MQTT_S3, FEDML_BACKEND_MQTT_S3_MNN):
+            try:
+                from .communication.mqtt_s3.mqtt_s3_comm_manager import MqttS3CommManager
+            except ImportError as e:
+                raise NotImplementedError(
+                    "MQTT_S3 backend module not available in this build"
+                ) from e
+
+            self.com_manager = MqttS3CommManager(
+                args=self.args,
+                topic=run_id,
+                client_rank=self.rank,
+                client_num=self.size,
+                mnn_mode=(backend == FEDML_BACKEND_MQTT_S3_MNN),
+            )
+        else:
+            raise ValueError(f"unsupported comm backend: {self.backend!r}")
+        self.com_manager.add_observer(self)

@@ -1,0 +1,41 @@
+"""Transport interface + observer callback.
+
+Parity with reference ``core/distributed/communication/base_com_manager.py:7-26``
+and ``observer.py``.  Every backend (loopback / gRPC / MQTT-emu / ...) implements
+``BaseCommunicationManager``; node runtimes register an ``Observer`` whose
+``receive_message`` is invoked on the receive loop's thread.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from .message import Message
+
+
+class Observer(ABC):
+    @abstractmethod
+    def receive_message(self, msg_type: str, msg_params: Message) -> None:
+        ...
+
+
+class BaseCommunicationManager(ABC):
+    @abstractmethod
+    def send_message(self, msg: Message) -> None:
+        ...
+
+    @abstractmethod
+    def add_observer(self, observer: Observer) -> None:
+        ...
+
+    @abstractmethod
+    def remove_observer(self, observer: Observer) -> None:
+        ...
+
+    @abstractmethod
+    def handle_receive_message(self) -> None:
+        """Enter the receive loop (blocks until stopped)."""
+
+    @abstractmethod
+    def stop_receive_message(self) -> None:
+        ...

@@ -1,0 +1,98 @@
+"""Typed key-value message envelope.
+
+Contract parity with the reference ``core/distributed/communication/message.py:5-83``:
+``msg_type`` / ``sender`` / ``receiver`` header keys plus an open params dict
+carrying ``model_params`` (an in-memory pytree) or ``model_params_url`` (a blob
+reference for the control/data-split transports).  JSON serialization excludes
+tensor payloads; binary transports pickle the whole params dict instead.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+
+class Message:
+    MSG_ARG_KEY_OPERATION = "operation"
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+
+    MSG_OPERATION_SEND = "send"
+    MSG_OPERATION_RECEIVE = "receive"
+    MSG_OPERATION_BROADCAST = "broadcast"
+    MSG_OPERATION_REDUCE = "reduce"
+
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+
+    def __init__(self, type: str = "default", sender_id: int = 0, receiver_id: int = 0):
+        self.type = str(type)
+        self.sender_id = sender_id
+        self.receiver_id = receiver_id
+        self.msg_params: Dict[str, Any] = {
+            Message.MSG_ARG_KEY_TYPE: str(type),
+            Message.MSG_ARG_KEY_SENDER: sender_id,
+            Message.MSG_ARG_KEY_RECEIVER: receiver_id,
+        }
+
+    # -- construction -------------------------------------------------------
+    def init(self, msg_params: Dict[str, Any]) -> None:
+        self.msg_params = msg_params
+        self._sync_header()
+
+    def init_from_json_string(self, json_string: str) -> None:
+        self.init(json.loads(json_string))
+
+    def init_from_json_object(self, json_object: Dict[str, Any]) -> None:
+        self.init(json_object)
+
+    def _sync_header(self) -> None:
+        self.type = str(self.msg_params.get(Message.MSG_ARG_KEY_TYPE, self.type))
+        self.sender_id = self.msg_params.get(Message.MSG_ARG_KEY_SENDER, self.sender_id)
+        self.receiver_id = self.msg_params.get(Message.MSG_ARG_KEY_RECEIVER, self.receiver_id)
+
+    # -- accessors ----------------------------------------------------------
+    def get_sender_id(self) -> int:
+        return self.sender_id
+
+    def get_receiver_id(self) -> int:
+        return self.receiver_id
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    add = add_params
+
+    def get_params(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def get_type(self) -> str:
+        return str(self.msg_params[Message.MSG_ARG_KEY_TYPE])
+
+    # -- serialization ------------------------------------------------------
+    def to_json(self) -> str:
+        """JSON for control-plane transports; tensor payloads must ride the
+        data plane (cf. reference MQTT+S3 split, SURVEY.md §2.2)."""
+        safe = {}
+        for k, v in self.msg_params.items():
+            try:
+                json.dumps(v)
+            except (TypeError, ValueError):
+                continue
+            safe[k] = v
+        return json.dumps(safe)
+
+    def get_content(self) -> str:
+        return f"{self.get_type()}: {self.msg_params}"
+
+    def __repr__(self) -> str:  # pragma: no cover
+        keys = list(self.msg_params.keys())
+        return (
+            f"Message(type={self.type!r}, sender={self.sender_id}, "
+            f"receiver={self.receiver_id}, keys={keys})"
+        )

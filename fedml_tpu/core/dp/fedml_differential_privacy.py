@@ -1,0 +1,92 @@
+"""Singleton DP engine gating central (cdp) / local (ldp) noise.
+
+Parity with reference ``core/dp/fed_privacy_mechanism.py:21-46``: enabled by
+``enable_dp`` + ``dp_type in {cdp, ldp}`` + ``mechanism_type in
+{gaussian, laplace}``; central noise is added after aggregation on the server,
+local noise after local training on the client.  Noise generation uses a
+threaded ``jax.random`` key so runs are reproducible given ``random_seed``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from .budget_accountant import BudgetAccountant
+from .mechanisms import create_mechanism
+
+DP_TYPE_CENTRAL = "cdp"
+DP_TYPE_LOCAL = "ldp"
+
+
+class FedMLDifferentialPrivacy:
+    _instance: Optional["FedMLDifferentialPrivacy"] = None
+
+    def __init__(self):
+        self.is_dp_enabled = False
+        self.dp_type: Optional[str] = None
+        self.mechanism = None
+        self.accountant: Optional[BudgetAccountant] = None
+        self.epsilon = None
+        self.delta = None
+        self._key = jax.random.PRNGKey(0)
+
+    @classmethod
+    def get_instance(cls) -> "FedMLDifferentialPrivacy":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def init(self, args: Any) -> None:
+        if not getattr(args, "enable_dp", False):
+            self.is_dp_enabled = False
+            return
+        self.is_dp_enabled = True
+        self.dp_type = str(getattr(args, "dp_type", DP_TYPE_CENTRAL)).lower().strip()
+        if self.dp_type not in (DP_TYPE_CENTRAL, DP_TYPE_LOCAL):
+            raise ValueError(f"dp_type must be 'cdp' or 'ldp', got {self.dp_type!r}")
+        self.epsilon = float(getattr(args, "epsilon", 1.0))
+        self.delta = float(getattr(args, "delta", 1e-5))
+        sensitivity = float(getattr(args, "sensitivity", 1.0))
+        mechanism_type = str(getattr(args, "mechanism_type", "gaussian")).lower()
+        self.mechanism = create_mechanism(mechanism_type, self.epsilon, self.delta, sensitivity)
+        budget = getattr(args, "privacy_budget", None)
+        if budget is None:
+            self.accountant = BudgetAccountant(float("inf"), 1.0)
+        elif isinstance(budget, (int, float)):
+            self.accountant = BudgetAccountant(float(budget), 1.0)
+        elif isinstance(budget, (list, tuple)) and len(budget) == 2:
+            self.accountant = BudgetAccountant(float(budget[0]), float(budget[1]))
+        else:
+            raise ValueError(
+                f"privacy_budget must be a scalar epsilon or (epsilon, delta) pair, got {budget!r}"
+            )
+        self._key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 7919)
+
+    def is_local_dp_enabled(self) -> bool:
+        return self.is_dp_enabled and self.dp_type == DP_TYPE_LOCAL
+
+    def is_global_dp_enabled(self) -> bool:
+        return self.is_dp_enabled and self.dp_type == DP_TYPE_CENTRAL
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def add_noise(self, tree: Any) -> Any:
+        if self.mechanism is None:
+            raise RuntimeError("DP engine not initialized")
+        if self.accountant is not None:
+            # Laplace is pure epsilon-DP: never charges delta.
+            from .mechanisms import Laplace
+
+            delta = 0.0 if isinstance(self.mechanism, Laplace) else self.delta
+            self.accountant.spend(self.epsilon, delta)
+        return self.mechanism.add_noise(tree, self._next_key())
+
+    def add_local_noise(self, local_grad: Any) -> Any:
+        return self.add_noise(local_grad)
+
+    def add_global_noise(self, global_model: Any) -> Any:
+        return self.add_noise(global_model)

@@ -1,0 +1,130 @@
+"""Attack simulations as pure functions over client updates.
+
+Counterparts of reference ``core/security/attack/*.py`` (8 modules), rebuilt
+on pytrees + ``jax.random``:
+
+* byzantine (zero / random / flip modes) — ``byzantine_attack.py``
+* label flipping (poison a dataset's labels) — ``label_flipping_attack.py``
+* model replacement / scaled backdoor push — ``backdoor_attack.py`` core step
+* gradient inversion (DLG-style reconstruction by gradient matching)
+  — ``dlg_attack.py`` / ``invert_gradient_attack.py``
+* revealing labels from gradients (sign heuristic on the last-layer grad)
+  — ``revealing_labels_from_gradients_attack.py``
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+Pytree = Any
+Updates = List[Tuple[float, Pytree]]
+
+
+# ---------------------------------------------------------------------------
+# Byzantine
+# ---------------------------------------------------------------------------
+def byzantine_attack(
+    updates: Updates,
+    global_params: Pytree,
+    byzantine_idxs: Sequence[int],
+    mode: str,
+    key: jax.Array,
+) -> Updates:
+    """Corrupt the updates at ``byzantine_idxs``.
+
+    Modes (reference byzantine_attack.py): ``zero`` — zero update; ``random``
+    — gaussian garbage; ``flip`` — push away from the global model
+    (g - (x - g)).
+    """
+    out = list(updates)
+    for j, i in enumerate(byzantine_idxs):
+        n, p = updates[i]
+        if mode == "zero":
+            bad = jax.tree_util.tree_map(jnp.zeros_like, p)
+        elif mode == "random":
+            leaves, treedef = jax.tree_util.tree_flatten(p)
+            keys = jax.random.split(jax.random.fold_in(key, j), len(leaves))
+            bad = jax.tree_util.tree_unflatten(
+                treedef,
+                [jax.random.normal(k, jnp.shape(l), dtype=jnp.result_type(l, jnp.float32)) for l, k in zip(leaves, keys)],
+            )
+        elif mode == "flip":
+            bad = jax.tree_util.tree_map(lambda g, x: 2.0 * g - x, global_params, p)
+        else:
+            raise ValueError(f"unknown byzantine mode {mode!r}")
+        out[i] = (n, bad)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Label flipping (data poisoning)
+# ---------------------------------------------------------------------------
+def flip_labels(labels: jnp.ndarray, src: int, dst: int) -> jnp.ndarray:
+    return jnp.where(labels == src, dst, labels)
+
+
+# ---------------------------------------------------------------------------
+# Model replacement (scaled malicious push; backdoor core step)
+# ---------------------------------------------------------------------------
+def model_replacement(
+    malicious_params: Pytree, global_params: Pytree, scale: float
+) -> Pytree:
+    """x_adv = g + scale * (x_mal - g): survives averaging with 1/scale dilution."""
+    return jax.tree_util.tree_map(
+        lambda g, x: g + scale * (x - g), global_params, malicious_params
+    )
+
+
+# ---------------------------------------------------------------------------
+# Gradient inversion (DLG): reconstruct a batch by matching gradients
+# ---------------------------------------------------------------------------
+def invert_gradient(
+    grad_fn: Callable[[jnp.ndarray, jnp.ndarray], Pytree],
+    target_grads: Pytree,
+    x_shape: Tuple[int, ...],
+    y_logits_shape: Tuple[int, ...],
+    key: jax.Array,
+    steps: int = 100,
+    lr: float = 0.1,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Minimize ||grad_fn(x, softmax(y)) - target||^2 over dummy (x, y).
+
+    ``grad_fn`` maps (inputs, soft labels) -> parameter-gradient pytree of the
+    victim model at the intercepted step.  One fused jitted Adam-free loop
+    (plain GD with cosine-ish decay) — enough to demonstrate leakage, matching
+    the role of reference dlg_attack.py.
+    """
+    kx, ky = jax.random.split(key)
+    x0 = jax.random.normal(kx, x_shape)
+    y0 = jax.random.normal(ky, y_logits_shape)
+    tvec, _ = ravel_pytree(target_grads)
+
+    def loss(xy):
+        x, y = xy
+        g = grad_fn(x, jax.nn.softmax(y, axis=-1))
+        gvec, _ = ravel_pytree(g)
+        return jnp.sum((gvec - tvec) ** 2)
+
+    @jax.jit
+    def run(x0, y0):
+        def body(i, xy):
+            g = jax.grad(loss)(xy)
+            step = lr * (0.5 + 0.5 * jnp.cos(jnp.pi * i / steps))
+            return (xy[0] - step * g[0], xy[1] - step * g[1])
+
+        return jax.lax.fori_loop(0, steps, body, (x0, y0))
+
+    return run(x0, y0)
+
+
+# ---------------------------------------------------------------------------
+# Revealing labels from gradients (sign heuristic)
+# ---------------------------------------------------------------------------
+def reveal_labels_from_gradients(last_layer_bias_grad: jnp.ndarray) -> jnp.ndarray:
+    """Classes present in a cross-entropy batch have negative bias-gradient
+    entries (iDLG observation) — return indices sorted by most-negative."""
+    return jnp.argsort(last_layer_bias_grad)

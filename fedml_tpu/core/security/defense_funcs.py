@@ -1,0 +1,239 @@
+"""Robust-aggregation defense math as pure functions over client updates.
+
+Each defense takes ``updates: List[(sample_num, params_pytree)]`` and either
+filters the list (before-aggregation defenses) or replaces the aggregation
+rule (on-aggregation defenses).  Distance-based rules ravel each pytree to one
+vector (``jax.flatten_util.ravel_pytree``) and compute the full pairwise
+distance matrix in one XLA call — the TPU-friendly restatement of the
+reference's per-layer Python loops (``core/security/defense/*.py``).
+
+Implemented rules and their reference counterparts (SURVEY.md §2.3):
+Krum / multi-Krum (krum_defense.py), coordinate-wise median + trimmed mean
+(coordinate_wise_median_defense.py, coordinate_wise_trimmed_mean_defense.py),
+geometric median a.k.a. RFA (geometric_median_defense.py), norm-difference
+clipping (norm_diff_clipping_defense.py), centered clip / CClip
+(cclip_defense.py), weak DP (weak_dp_defense.py), SLSGD (slsgd_defense.py),
+FoolsGold (foolsgold_defense.py), robust learning rate (robust_learning_rate_defense.py),
+Bulyan (bulyan_defense.py), three-sigma outlier removal, Soteria and WBC are
+in their class wrappers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from ..aggregate import tree_scale, tree_sub, tree_add, weighted_mean
+
+Pytree = Any
+Updates = List[Tuple[float, Pytree]]
+
+
+def _ravel_all(updates: Sequence[Tuple[float, Pytree]]):
+    """-> (matrix [n_clients, dim], unravel_fn, sample_nums)."""
+    vecs, unravel = [], None
+    for _, p in updates:
+        v, unravel = ravel_pytree(p)
+        vecs.append(v)
+    return jnp.stack(vecs, axis=0), unravel, jnp.asarray([float(n) for n, _ in updates])
+
+
+def pairwise_sq_dists(mat: jnp.ndarray) -> jnp.ndarray:
+    """[n, d] -> [n, n] squared euclidean distances, one fused XLA matmul."""
+    sq = jnp.sum(mat * mat, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (mat @ mat.T)
+    return jnp.maximum(d2, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Krum / multi-Krum
+# ---------------------------------------------------------------------------
+def krum_scores(mat: jnp.ndarray, byzantine_num: int) -> jnp.ndarray:
+    n = mat.shape[0]
+    d2 = pairwise_sq_dists(mat)
+    d2 = d2 + jnp.diag(jnp.full((n,), jnp.inf))
+    k = max(n - byzantine_num - 2, 1)
+    nearest = jnp.sort(d2, axis=1)[:, :k]
+    return jnp.sum(nearest, axis=1)
+
+
+def krum(updates: Updates, byzantine_num: int, multi: bool = False, krum_param_m: int = 1) -> Updates:
+    mat, _, _ = _ravel_all(updates)
+    scores = krum_scores(mat, byzantine_num)
+    m = max(int(krum_param_m), 1) if multi else 1
+    chosen = jnp.argsort(scores)[:m]
+    return [updates[int(i)] for i in chosen]
+
+
+# ---------------------------------------------------------------------------
+# Coordinate-wise median / trimmed mean
+# ---------------------------------------------------------------------------
+def coordinate_wise_median(updates: Updates) -> Pytree:
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *[p for _, p in updates])
+    return jax.tree_util.tree_map(lambda x: jnp.median(x, axis=0), stacked)
+
+
+def coordinate_wise_trimmed_mean(updates: Updates, trim_ratio: float) -> Pytree:
+    n = len(updates)
+    k = int(n * float(trim_ratio))
+    return _trimmed_mean_count(updates, k)
+
+
+def _trimmed_mean_count(updates: Updates, k: int) -> Pytree:
+    """Trim ``k`` updates per coordinate per end, then average the rest."""
+    n = len(updates)
+    k = max(0, min(int(k), (n - 1) // 2))
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *[p for _, p in updates])
+
+    def _leaf(x):
+        x = jnp.sort(x, axis=0)
+        return jnp.mean(x[k : n - k], axis=0)
+
+    return jax.tree_util.tree_map(_leaf, stacked)
+
+
+# ---------------------------------------------------------------------------
+# Geometric median (RFA) via Weiszfeld iterations
+# ---------------------------------------------------------------------------
+def geometric_median(updates: Updates, max_iter: int = 10, eps: float = 1e-8) -> Pytree:
+    mat, unravel, nums = _ravel_all(updates)
+    w = nums / jnp.sum(nums)
+
+    def body(_, z):
+        dist = jnp.linalg.norm(mat - z[None, :], axis=1)
+        inv = w / jnp.maximum(dist, eps)
+        return (inv[:, None] * mat).sum(axis=0) / jnp.sum(inv)
+
+    z = jax.lax.fori_loop(0, max_iter, body, (w[:, None] * mat).sum(axis=0))
+    return unravel(z)
+
+
+# ---------------------------------------------------------------------------
+# Clipping family
+# ---------------------------------------------------------------------------
+def norm_diff_clipping(updates: Updates, global_params: Pytree, norm_bound: float) -> Updates:
+    """Clip each client's delta from the global model to norm <= bound
+    (reference norm_diff_clipping_defense.py)."""
+    g_vec, unravel = ravel_pytree(global_params)
+    out: Updates = []
+    for n, p in updates:
+        v, _ = ravel_pytree(p)
+        diff = v - g_vec
+        nrm = jnp.linalg.norm(diff)
+        scale = jnp.minimum(1.0, norm_bound / jnp.maximum(nrm, 1e-12))
+        out.append((n, unravel(g_vec + diff * scale)))
+    return out
+
+
+def cclip(updates: Updates, global_params: Pytree, tau: float = 10.0, n_iter: int = 1) -> Pytree:
+    """Centered clipping (Karimireddy et al.): iterate v <- v + mean(clip(x_i - v, tau))."""
+    mat, unravel, nums = _ravel_all(updates)
+    w = nums / jnp.sum(nums)
+    v, _ = ravel_pytree(global_params)
+
+    def body(_, v):
+        diff = mat - v[None, :]
+        nrm = jnp.linalg.norm(diff, axis=1, keepdims=True)
+        scale = jnp.minimum(1.0, tau / jnp.maximum(nrm, 1e-12))
+        return v + jnp.sum(w[:, None] * diff * scale, axis=0)
+
+    return unravel(jax.lax.fori_loop(0, n_iter, body, v))
+
+
+def weak_dp(aggregated: Pytree, stddev: float, key: jax.Array) -> Pytree:
+    from ..dp.mechanisms import _add_noise_tree
+
+    return _add_noise_tree(
+        aggregated, key, lambda k, shape: stddev * jax.random.normal(k, shape)
+    )
+
+
+# ---------------------------------------------------------------------------
+# SLSGD: trimmed-mean + momentum toward current global model
+# ---------------------------------------------------------------------------
+def slsgd(updates: Updates, global_params: Pytree, trim_count: int, alpha: float) -> Pytree:
+    """``trim_count`` is an integer count of gradients trimmed per end
+    (reference slsgd_defense.py's ``b``), NOT a fraction."""
+    agg = _trimmed_mean_count(updates, trim_count)
+    return tree_add(tree_scale(global_params, 1.0 - alpha), tree_scale(agg, alpha))
+
+
+# ---------------------------------------------------------------------------
+# FoolsGold: contribution-similarity re-weighting
+# ---------------------------------------------------------------------------
+def foolsgold_weights(history_mat: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """[n, d] aggregate historical updates -> per-client learning weights."""
+    norms = jnp.linalg.norm(history_mat, axis=1, keepdims=True)
+    normed = history_mat / jnp.maximum(norms, eps)
+    cs = normed @ normed.T - jnp.eye(history_mat.shape[0])
+    maxcs = jnp.max(cs, axis=1)
+    # pardoning: when maxcs[i] < maxcs[j], rescale cs[i, j] by maxcs[i]/maxcs[j]
+    # so honest clients (low max-similarity) are pardoned, sybils are not
+    scaled = cs * jnp.minimum(1.0, (maxcs[:, None] / jnp.maximum(maxcs[None, :], eps)))
+    wv = 1.0 - jnp.max(scaled, axis=1)
+    wv = jnp.clip(wv, 0.0, 1.0)
+    wv = wv / jnp.maximum(jnp.max(wv), eps)
+    wv = jnp.where(wv == 1.0, 0.99, wv)
+    logits = jnp.log(jnp.clip(wv / jnp.maximum(1.0 - wv, eps), eps, None)) + 0.5
+    return jnp.clip(logits, 0.0, 1.0)
+
+
+def foolsgold(updates: Updates, history_mat: jnp.ndarray) -> Pytree:
+    mat, unravel, _ = _ravel_all(updates)
+    wv = foolsgold_weights(history_mat)
+    wv = wv / jnp.maximum(jnp.sum(wv), 1e-12)
+    return unravel(jnp.sum(wv[:, None] * mat, axis=0))
+
+
+# ---------------------------------------------------------------------------
+# Robust learning rate (sign-agreement threshold)
+# ---------------------------------------------------------------------------
+def robust_learning_rate(updates: Updates, global_params: Pytree, threshold: int) -> Pytree:
+    g_vec, unravel = ravel_pytree(global_params)
+    deltas = []
+    nums = []
+    for n, p in updates:
+        v, _ = ravel_pytree(p)
+        deltas.append(v - g_vec)
+        nums.append(float(n))
+    dmat = jnp.stack(deltas, 0)
+    w = jnp.asarray(nums)
+    w = w / jnp.sum(w)
+    sign_agreement = jnp.abs(jnp.sum(jnp.sign(dmat), axis=0))
+    lr = jnp.where(sign_agreement >= threshold, 1.0, -1.0)
+    avg_delta = jnp.sum(w[:, None] * dmat, axis=0)
+    return unravel(g_vec + lr * avg_delta)
+
+
+# ---------------------------------------------------------------------------
+# Bulyan: multi-Krum selection + trimmed aggregation
+# ---------------------------------------------------------------------------
+def bulyan(updates: Updates, byzantine_num: int) -> Pytree:
+    n = len(updates)
+    theta = max(n - 2 * byzantine_num, 1)
+    mat, unravel, _ = _ravel_all(updates)
+    scores = krum_scores(mat, byzantine_num)
+    sel = jnp.argsort(scores)[:theta]
+    sel_mat = mat[sel]
+    beta = max(theta - 2 * byzantine_num, 1)
+    med = jnp.median(sel_mat, axis=0)
+    dist = jnp.abs(sel_mat - med[None, :])
+    order = jnp.argsort(dist, axis=0)[:beta]
+    closest = jnp.take_along_axis(sel_mat, order, axis=0)
+    return unravel(jnp.mean(closest, axis=0))
+
+
+# ---------------------------------------------------------------------------
+# Three-sigma / norm-outlier filtering (used by several wrappers)
+# ---------------------------------------------------------------------------
+def three_sigma_filter(updates: Updates, global_params: Pytree) -> Updates:
+    mat, _, _ = _ravel_all(updates)
+    g_vec, _ = ravel_pytree(global_params)
+    arr = jnp.linalg.norm(mat - g_vec[None, :], axis=1)
+    mu, sigma = jnp.mean(arr), jnp.std(arr)
+    mask = jnp.abs(arr - mu) <= 3.0 * sigma + 1e-12
+    keep = [i for i, ok in enumerate(mask.tolist()) if ok]
+    return [updates[i] for i in keep] or updates

@@ -1,0 +1,103 @@
+"""Attack dispatcher singleton.
+
+Parity with reference ``core/security/fedml_attacker.py:7-40`` — gated by
+``enable_attack`` + ``attack_type``; the server's ``on_before_aggregation``
+calls ``attack_model`` to inject Byzantine behaviour into the collected
+updates, and data loaders call ``poison_data`` for label-flipping.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from . import attack_funcs as A
+from .constants import (
+    ATTACK_METHOD_BYZANTINE_ATTACK,
+    ATTACK_METHOD_LABEL_FLIPPING,
+    ATTACK_METHOD_MODEL_REPLACEMENT,
+)
+
+logger = logging.getLogger(__name__)
+
+_MODEL_ATTACKS = {ATTACK_METHOD_BYZANTINE_ATTACK, ATTACK_METHOD_MODEL_REPLACEMENT}
+_DATA_ATTACKS = {ATTACK_METHOD_LABEL_FLIPPING}
+
+
+class FedMLAttacker:
+    _attacker_instance: Optional["FedMLAttacker"] = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLAttacker":
+        if cls._attacker_instance is None:
+            cls._attacker_instance = cls()
+        return cls._attacker_instance
+
+    def __init__(self):
+        self.is_enabled = False
+        self.attack_type: Optional[str] = None
+        self.args = None
+        self._key = jax.random.PRNGKey(23)
+
+    def init(self, args: Any) -> None:
+        if not getattr(args, "enable_attack", False):
+            self.is_enabled = False
+            return
+        self.args = args
+        self.is_enabled = True
+        self.attack_type = str(args.attack_type).strip()
+        self._key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 2027)
+        logger.info("attack enabled: %s", self.attack_type)
+
+    def is_attack_enabled(self) -> bool:
+        return self.is_enabled
+
+    def is_model_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in _MODEL_ATTACKS
+
+    def is_data_poisoning_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in _DATA_ATTACKS
+
+    def get_byzantine_idxs(self, num_clients: int) -> List[int]:
+        k = int(getattr(self.args, "byzantine_client_num", 1))
+        rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)))
+        return sorted(rng.choice(num_clients, size=min(k, num_clients), replace=False).tolist())
+
+    # -- hooks ---------------------------------------------------------------
+    def attack_model(
+        self, raw_client_grad_list: List[Tuple[float, Any]], extra_auxiliary_info: Any = None
+    ) -> List[Tuple[float, Any]]:
+        if not self.is_model_attack():
+            return raw_client_grad_list
+        idxs = self.get_byzantine_idxs(len(raw_client_grad_list))
+        self._key, sub = jax.random.split(self._key)
+        if self.attack_type == ATTACK_METHOD_BYZANTINE_ATTACK:
+            return A.byzantine_attack(
+                raw_client_grad_list,
+                extra_auxiliary_info,
+                idxs,
+                mode=str(getattr(self.args, "attack_mode", "random")),
+                key=sub,
+            )
+        if self.attack_type == ATTACK_METHOD_MODEL_REPLACEMENT:
+            scale = float(getattr(self.args, "attack_scale", 10.0))
+            out = list(raw_client_grad_list)
+            for i in idxs:
+                n, p = out[i]
+                out[i] = (n, A.model_replacement(p, extra_auxiliary_info, scale))
+            return out
+        return raw_client_grad_list
+
+    def poison_data(self, labels):
+        if not self.is_data_poisoning_attack():
+            return labels
+        return np.asarray(
+            A.flip_labels(
+                labels,
+                int(getattr(self.args, "original_class", 1)),
+                int(getattr(self.args, "target_class", 7)),
+            )
+        )

@@ -1,0 +1,50 @@
+"""Test harness: force an 8-device virtual CPU mesh regardless of outer env.
+
+This is how "multi-node" is tested without hardware (SURVEY.md §4 implication):
+every sharding/collective test runs over 8 virtual devices on one host; the
+driver separately dry-runs the multi-chip path via __graft_entry__.
+
+The outer environment pins JAX_PLATFORMS=axon (a single tunneled TPU chip)
+and a sitecustomize imports jax before this file runs, so setting env vars is
+not enough: we must also update jax.config and deregister the axon backend
+factory (its PJRT init can block the whole process if the tunnel is busy —
+unit tests must never touch it).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - jax internals may move
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Security/DP singletons are process-global; isolate tests."""
+    yield
+    from fedml_tpu.core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+    from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+    from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+    FedMLDifferentialPrivacy._instance = None
+    FedMLAttacker._attacker_instance = None
+    FedMLDefender._defender_instance = None
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,149 @@
+"""Tests for DP mechanisms/accountant and attack/defense dispatchers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.dp.budget_accountant import BudgetAccountant
+from fedml_tpu.core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from fedml_tpu.core.dp.mechanisms import Gaussian, Laplace
+from fedml_tpu.core.security import defense_funcs as F
+from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+
+def _params(scale):
+    return {"w": jnp.full((4, 4), float(scale)), "b": jnp.full((4,), float(scale))}
+
+
+class TestDP:
+    def test_gaussian_sigma_formula(self):
+        g = Gaussian(epsilon=1.0, delta=1e-5, sensitivity=2.0)
+        assert g.sigma == pytest.approx(np.sqrt(2 * np.log(1.25 / 1e-5)) * 2.0, rel=1e-9)
+
+    def test_noise_changes_params_reproducibly(self):
+        g = Gaussian(epsilon=1.0, delta=1e-5)
+        k = jax.random.PRNGKey(0)
+        a = g.add_noise(_params(0.0), k)
+        b = g.add_noise(_params(0.0), k)
+        assert float(jnp.abs(a["w"]).sum()) > 0
+        np.testing.assert_allclose(a["w"], b["w"])
+
+    def test_laplace_scale(self):
+        l = Laplace(epsilon=2.0, sensitivity=1.0)
+        assert l.scale == 0.5
+
+    def test_accountant_exhausts(self):
+        acc = BudgetAccountant(epsilon=1.0, delta=1e-4)
+        acc.spend(0.5, 1e-5)
+        acc.spend(0.5, 1e-5)
+        with pytest.raises(RuntimeError):
+            acc.spend(0.1, 0.0)
+
+    def test_singleton_ldp_gate(self):
+        class Args:
+            enable_dp = True
+            dp_type = "ldp"
+            epsilon = 1.0
+            delta = 1e-5
+            mechanism_type = "gaussian"
+            random_seed = 0
+
+        dp = FedMLDifferentialPrivacy.get_instance()
+        dp.init(Args())
+        assert dp.is_local_dp_enabled() and not dp.is_global_dp_enabled()
+        noised = dp.add_local_noise(_params(1.0))
+        assert float(jnp.abs(noised["w"] - 1.0).sum()) > 0
+
+
+class TestDefenses:
+    def _updates(self, n=6, bad=None):
+        ups = [(1.0, _params(1.0 + 0.01 * i)) for i in range(n)]
+        if bad is not None:
+            ups[bad] = (1.0, _params(100.0))
+        return ups
+
+    def test_krum_excludes_outlier(self):
+        ups = self._updates(bad=2)
+        kept = F.krum(ups, byzantine_num=1)
+        assert len(kept) == 1
+        assert float(kept[0][1]["w"][0, 0]) < 10
+
+    def test_median_robust_to_outlier(self):
+        med = F.coordinate_wise_median(self._updates(bad=0))
+        assert float(med["w"][0, 0]) < 2
+
+    def test_trimmed_mean(self):
+        tm = F.coordinate_wise_trimmed_mean(self._updates(bad=1), trim_ratio=0.2)
+        assert float(tm["w"][0, 0]) < 2
+
+    def test_geometric_median_close_to_cluster(self):
+        gm = F.geometric_median(self._updates(bad=5), max_iter=50)
+        assert abs(float(gm["w"][0, 0]) - 1.0) < 0.5
+
+    def test_norm_clipping_bounds_delta(self):
+        glob = _params(0.0)
+        clipped = F.norm_diff_clipping(self._updates(bad=3), glob, norm_bound=1.0)
+        for _, p in clipped:
+            vec = jnp.concatenate([p["w"].ravel(), p["b"].ravel()])
+            assert float(jnp.linalg.norm(vec)) <= 1.0 + 1e-4
+
+    def test_bulyan_robust(self):
+        out = F.bulyan(self._updates(n=9, bad=4), byzantine_num=1)
+        assert float(out["w"][0, 0]) < 2
+
+    def test_defender_dispatch_krum(self):
+        class Args:
+            enable_defense = True
+            defense_type = "krum"
+            byzantine_client_num = 1
+            random_seed = 0
+
+        d = FedMLDefender.get_instance()
+        d.init(Args())
+        assert d.is_defense_enabled() and d.is_defense_before_aggregation()
+        kept = d.defend_before_aggregation(self._updates(bad=1), _params(0.0))
+        assert len(kept) == 1
+
+    def test_defender_dispatch_geo_median(self):
+        class Args:
+            enable_defense = True
+            defense_type = "geometric_median"
+            random_seed = 0
+
+        d = FedMLDefender.get_instance()
+        d.init(Args())
+        out = d.defend_on_aggregation(self._updates(bad=0), extra_auxiliary_info=_params(0.0))
+        assert abs(float(out["w"][0, 0]) - 1.0) < 0.5
+
+
+class TestAttacks:
+    def test_byzantine_zero_mode(self):
+        class Args:
+            enable_attack = True
+            attack_type = "byzantine"
+            attack_mode = "zero"
+            byzantine_client_num = 2
+            random_seed = 0
+
+        a = FedMLAttacker.get_instance()
+        a.init(Args())
+        assert a.is_model_attack()
+        ups = [(1.0, _params(1.0)) for _ in range(5)]
+        out = a.attack_model(ups, _params(0.0))
+        zeroed = sum(1 for _, p in out if float(jnp.abs(p["w"]).sum()) == 0)
+        assert zeroed == 2
+
+    def test_label_flip(self):
+        class Args:
+            enable_attack = True
+            attack_type = "label_flipping"
+            original_class = 1
+            target_class = 7
+            random_seed = 0
+
+        a = FedMLAttacker.get_instance()
+        a.init(Args())
+        y = np.array([0, 1, 2, 1])
+        np.testing.assert_array_equal(a.poison_data(y), [0, 7, 2, 7])
