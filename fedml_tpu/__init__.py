@@ -1,0 +1,94 @@
+"""fedml_tpu — a TPU-native federated / distributed learning framework.
+
+Brand-new implementation of the capability surface of FedML (reference
+``python/fedml/__init__.py``), designed for JAX/XLA/pjit/pallas on TPU:
+
+* **Simulation ("Parrot")**: in-process loop (sp) or the XLA in-mesh
+  simulator — clients sharded over a ``jax.sharding.Mesh``, aggregation via
+  ``lax.psum`` over ICI (successor of the reference's MPI/NCCL simulators).
+* **Cross-silo ("Octopus")**: host-side gRPC/loopback message plane driving
+  the same round protocol; intra-silo parallelism is a pjit mesh, not DDP.
+* **Cross-device ("Beehive")**: server runtime + device protocol harness.
+* core/: comm kernel, DP, security (attacks/defenses), MPC (SecAgg), topology,
+  scheduling, MLOps-style observability.
+
+Public API parity: ``fedml_tpu.init``, ``fedml_tpu.run_simulation``,
+``fedml_tpu.run_cross_silo_server/client``, ``fedml_tpu.FedMLRunner``,
+``fedml_tpu.data.load``, ``fedml_tpu.model.create``, ``device.get_device``.
+"""
+
+from __future__ import annotations
+
+import logging
+import random as _random
+
+import numpy as _np
+
+__version__ = "0.1.0"
+
+from . import constants  # noqa: F401
+from .arguments import Arguments, load_arguments
+from .runner import FedMLRunner  # noqa: F401
+
+_logger = logging.getLogger(__name__)
+
+
+def init(args: Arguments | None = None, should_init_logs: bool = True) -> Arguments:
+    """Bootstrap (reference ``__init__.py:27-93``): load config, seed RNGs,
+    init security/DP singletons, per-platform setup."""
+    if args is None:
+        args = load_arguments()
+    if should_init_logs:
+        logging.basicConfig(
+            level=logging.INFO, format="[%(asctime)s %(name)s] %(message)s"
+        )
+
+    seed = int(getattr(args, "random_seed", 0))
+    _random.seed(seed)
+    _np.random.seed(seed)
+
+    from .core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+    from .core.security.fedml_attacker import FedMLAttacker
+    from .core.security.fedml_defender import FedMLDefender
+
+    FedMLAttacker.get_instance().init(args)
+    FedMLDefender.get_instance().init(args)
+    FedMLDifferentialPrivacy.get_instance().init(args)
+
+    if not hasattr(args, "client_id_list"):
+        # reference update_client_id_list (:265): synthesize [1..N]
+        n = int(getattr(args, "client_num_in_total", 0) or 0)
+        args.client_id_list = list(range(1, n + 1))
+    _logger.info("fedml_tpu %s initialized (training_type=%s backend=%s)",
+                 __version__, getattr(args, "training_type", None), getattr(args, "backend", None))
+    return args
+
+
+def run_simulation(backend: str = "sp") -> None:
+    """One-liner (reference ``launch_simulation.py:9``)."""
+    from . import data as _data_mod
+    from . import device as _device_mod
+    from . import models as _models_mod
+    from .constants import FEDML_TRAINING_PLATFORM_SIMULATION
+
+    args = load_arguments(FEDML_TRAINING_PLATFORM_SIMULATION, backend)
+    args.training_type = FEDML_TRAINING_PLATFORM_SIMULATION
+    args.backend = getattr(args, "backend", None) or backend
+    args = init(args)
+    device = _device_mod.get_device(args)
+    dataset, output_dim = _data_mod.data_loader.load(args)
+    model = _models_mod.hub.create(args, output_dim)
+    runner = FedMLRunner(args, device, dataset, model)
+    runner.run()
+
+
+def run_cross_silo_server() -> None:
+    from .launch_cross_silo import run_cross_silo
+
+    run_cross_silo(role="server")
+
+
+def run_cross_silo_client() -> None:
+    from .launch_cross_silo import run_cross_silo
+
+    run_cross_silo(role="client")

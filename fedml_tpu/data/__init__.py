@@ -1,0 +1,2 @@
+from . import data_loader
+from .data_loader import load, load_centralized

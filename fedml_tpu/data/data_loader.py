@@ -1,0 +1,144 @@
+"""Federated data loading: ``load(args)`` -> (dataset, class_num).
+
+API parity with reference ``data/data_loader.py:234`` (``fedml.data.load``):
+returns the 8-tuple the runtimes consume::
+
+    [train_data_num, test_data_num, train_data_global, test_data_global,
+     train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
+     class_num]
+
+Differences from the reference, by design:
+* data are numpy ``(x, y)`` array pairs, not torch DataLoaders — the TPU
+  engine batches/pads on device (ml/engine/train.py);
+* zero-egress: if real files exist under ``args.data_cache_dir`` they are
+  parsed (MNIST idx / CIFAR pickle / LEAF json), else shape-faithful
+  synthetic data is generated (data/synthetic.py) and
+  ``dataset_is_synthetic=True`` is set on args;
+* partitioning is explicit: ``partition_method`` hetero (Dirichlet LDA,
+  ``partition_alpha``) / homo — same keys as the reference configs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..core.data.noniid_partition import (
+    homo_partition,
+    non_iid_partition_with_dirichlet_distribution,
+    quantity_skew_partition,
+)
+from . import loaders, synthetic
+
+logger = logging.getLogger(__name__)
+
+# dataset key -> (num_classes, feature_shape, default train/test sizes, kind)
+DATASET_SPECS: Dict[str, Dict[str, Any]] = {
+    "mnist": dict(classes=10, shape=(28, 28, 1), train=60000, test=10000, kind="image"),
+    "femnist": dict(classes=62, shape=(28, 28, 1), train=80000, test=10000, kind="image"),
+    "fashionmnist": dict(classes=10, shape=(28, 28, 1), train=60000, test=10000, kind="image"),
+    "cifar10": dict(classes=10, shape=(32, 32, 3), train=50000, test=10000, kind="image"),
+    "cifar100": dict(classes=100, shape=(32, 32, 3), train=50000, test=10000, kind="image"),
+    "fed_cifar100": dict(classes=100, shape=(32, 32, 3), train=50000, test=10000, kind="image"),
+    "cinic10": dict(classes=10, shape=(32, 32, 3), train=90000, test=90000, kind="image"),
+    "shakespeare": dict(classes=90, shape=(80,), train=40000, test=4000, kind="nwp", vocab=90),
+    "fed_shakespeare": dict(classes=90, shape=(80,), train=40000, test=4000, kind="nwp", vocab=90),
+    "stackoverflow_nwp": dict(classes=10004, shape=(20,), train=50000, test=5000, kind="nwp", vocab=10004),
+    "stackoverflow_lr": dict(classes=500, shape=(10004,), train=50000, test=5000, kind="taglr"),
+    "synthetic": dict(classes=10, shape=(60,), train=9600, test=2400, kind="feature"),
+    "synthetic_1_1": dict(classes=10, shape=(60,), train=9600, test=2400, kind="feature"),
+}
+
+
+def _generate(spec: Dict[str, Any], n: int, seed: int, scale_override: int = 0):
+    kind = spec["kind"]
+    n = int(scale_override or n)
+    if kind in ("image", "feature"):
+        return synthetic.make_classification(n, spec["classes"], tuple(spec["shape"]), seed=seed)
+    if kind == "nwp":
+        return synthetic.make_next_token_corpus(n, int(spec["shape"][0]), spec["vocab"], seed=seed)
+    if kind == "taglr":
+        x, y = synthetic.make_classification(n, spec["classes"], (64,), seed=seed)
+        # sparse bag-of-words style expansion
+        rngl = np.random.RandomState(seed + 1)
+        proj = rngl.randn(64, spec["shape"][0]).astype(np.float32)
+        return (x @ proj > 1.0).astype(np.float32), y
+    raise ValueError(kind)
+
+
+def load_centralized(args) -> Dict[str, Any]:
+    """-> dict(x_train, y_train, x_test, y_test, class_num, input_shape)."""
+    name = str(getattr(args, "dataset", "mnist")).lower()
+    if name not in DATASET_SPECS:
+        raise ValueError(f"unknown dataset {name!r}; known: {sorted(DATASET_SPECS)}")
+    spec = DATASET_SPECS[name]
+    cache = getattr(args, "data_cache_dir", None)
+    seed = int(getattr(args, "random_seed", 0))
+    real = loaders.try_load_real(name, cache) if cache else None
+    if real is not None:
+        x_train, y_train, x_test, y_test = real
+        args.dataset_is_synthetic = False
+        logger.info("loaded real %s from %s", name, cache)
+    else:
+        scale = int(getattr(args, "synthetic_train_size", 0))
+        x_train, y_train = _generate(spec, spec["train"], seed, scale)
+        x_test, y_test = _generate(spec, spec["test"], seed + 10_000, scale // 5 if scale else 0)
+        args.dataset_is_synthetic = True
+        logger.info("generated synthetic %s (no cached files under %r)", name, cache)
+    return dict(
+        x_train=x_train,
+        y_train=y_train,
+        x_test=x_test,
+        y_test=y_test,
+        class_num=spec["classes"],
+        input_shape=tuple(x_train.shape[1:]),
+    )
+
+
+def load(args) -> Tuple[list, int]:
+    """Reference-shaped federated load (``data_loader.py:234``)."""
+    data = load_centralized(args)
+    client_num = int(getattr(args, "client_num_in_total", 1))
+    method = str(getattr(args, "partition_method", "hetero")).lower()
+    alpha = float(getattr(args, "partition_alpha", 0.5))
+    seed = int(getattr(args, "random_seed", 0))
+    y_train, y_test = data["y_train"], data["y_test"]
+
+    if method in ("hetero", "noniid", "dirichlet"):
+        # NWP labels are sequences; partition those by sequence-mean token bucket
+        part_labels = y_train if y_train.ndim == 1 else (y_train.mean(axis=1) % data["class_num"]).astype(int)
+        train_map = non_iid_partition_with_dirichlet_distribution(
+            part_labels, client_num, data["class_num"], alpha, seed=seed
+        )
+    elif method in ("homo", "iid"):
+        train_map = homo_partition(len(y_train), client_num, seed=seed)
+    elif method == "quantity_skew":
+        train_map = quantity_skew_partition(len(y_train), client_num, alpha, seed=seed)
+    else:
+        raise ValueError(f"unknown partition_method {method!r}")
+    test_map = homo_partition(len(y_test), client_num, seed=seed + 1)
+
+    x_train, x_test = data["x_train"], data["x_test"]
+    train_data_local_dict = {}
+    test_data_local_dict = {}
+    train_data_local_num_dict = {}
+    for i in range(client_num):
+        tr_idx, te_idx = train_map[i], test_map[i]
+        train_data_local_dict[i] = (x_train[tr_idx], y_train[tr_idx])
+        test_data_local_dict[i] = (x_test[te_idx], y_test[te_idx])
+        train_data_local_num_dict[i] = int(len(tr_idx))
+
+    dataset = [
+        len(y_train),
+        len(y_test),
+        (x_train, y_train),
+        (x_test, y_test),
+        train_data_local_num_dict,
+        train_data_local_dict,
+        test_data_local_dict,
+        data["class_num"],
+    ]
+    return dataset, data["class_num"]

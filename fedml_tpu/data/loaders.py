@@ -1,0 +1,139 @@
+"""Parsers for locally-cached real dataset files (no downloads — zero egress).
+
+Covers the on-disk formats the reference's loaders consume
+(``data/MNIST/data_loader.py`` LEAF json, ``data/cifar10/…`` python pickle
+batches, idx-ubyte) so that if a user mounts real data under
+``data_cache_dir`` the pipelines train on it transparently.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import pickle
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def _find(root: str, *names: str) -> Optional[str]:
+    for dirpath, _, files in os.walk(root):
+        for n in names:
+            if n in files:
+                return os.path.join(dirpath, n)
+            for f in files:
+                if f == n + ".gz":
+                    return os.path.join(dirpath, f)
+    return None
+
+
+def load_mnist_idx(root: str) -> Optional[Arrays]:
+    paths = [
+        _find(root, "train-images-idx3-ubyte"),
+        _find(root, "train-labels-idx1-ubyte"),
+        _find(root, "t10k-images-idx3-ubyte"),
+        _find(root, "t10k-labels-idx1-ubyte"),
+    ]
+    if any(p is None for p in paths):  # partial cache -> synthetic fallback
+        return None
+    xt = _read_idx(paths[0]).astype(np.float32) / 255.0
+    yt = _read_idx(paths[1]).astype(np.int32)
+    xe = _read_idx(paths[2]).astype(np.float32) / 255.0
+    ye = _read_idx(paths[3]).astype(np.int32)
+    return xt[..., None], yt, xe[..., None], ye
+
+
+def load_leaf_json(root: str) -> Optional[Arrays]:
+    """LEAF format: train/*.json + test/*.json with users/user_data."""
+    tr_dir, te_dir = os.path.join(root, "train"), os.path.join(root, "test")
+    if not (os.path.isdir(tr_dir) and os.path.isdir(te_dir)):
+        return None
+
+    def _collect(d):
+        xs, ys = [], []
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".json"):
+                continue
+            with open(os.path.join(d, fn)) as f:
+                blob = json.load(f)
+            for u in blob.get("users", []):
+                ud = blob["user_data"][u]
+                xs.append(np.asarray(ud["x"], dtype=np.float32))
+                ys.append(np.asarray(ud["y"], dtype=np.int32))
+        if not xs:
+            return None
+        return np.concatenate(xs, 0), np.concatenate(ys, 0)
+
+    tr = _collect(tr_dir)
+    te = _collect(te_dir)
+    if tr is None or te is None:
+        return None
+    xt, yt = tr
+    xe, ye = te
+    if xt.ndim == 2 and xt.shape[1] == 784:
+        xt = xt.reshape(-1, 28, 28, 1)
+        xe = xe.reshape(-1, 28, 28, 1)
+    return xt, yt, xe, ye
+
+
+def load_cifar_pickle(root: str, coarse100: bool = False) -> Optional[Arrays]:
+    batches = []
+    test = None
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            if f.startswith("data_batch") or f in ("train",):
+                batches.append(os.path.join(dirpath, f))
+            elif f in ("test_batch", "test"):
+                test = os.path.join(dirpath, f)
+    if not batches or test is None:
+        return None
+
+    def _load(path):
+        with open(path, "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+        key = b"fine_labels" if b"fine_labels" in d else b"labels"
+        y = np.asarray(d[key], dtype=np.int32)
+        return x, y
+
+    xs, ys = zip(*[_load(b) for b in sorted(batches)])
+    xt, yt = np.concatenate(xs), np.concatenate(ys)
+    xe, ye = _load(test)
+    return xt, yt, xe, ye
+
+
+def try_load_real(name: str, cache_dir: str) -> Optional[Arrays]:
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return None
+    sub = os.path.join(cache_dir, name)
+    roots = [sub, cache_dir]
+    for root in roots:
+        if not os.path.isdir(root):
+            continue
+        if name in ("mnist", "fashionmnist"):
+            out = load_mnist_idx(root) or load_leaf_json(root)
+        elif name == "femnist":
+            out = load_leaf_json(root)
+        elif name.startswith("cifar") or name in ("cinic10", "fed_cifar100"):
+            out = load_cifar_pickle(root, coarse100="100" in name)
+        elif name in ("shakespeare", "fed_shakespeare", "stackoverflow_nwp", "stackoverflow_lr"):
+            out = load_leaf_json(root)
+        else:
+            out = None
+        if out is not None:
+            return out
+    return None

@@ -1,0 +1,21 @@
+"""Device discovery (reference ``device/device.py`` + ``get_jax_device``).
+
+On TPU the interesting object is not a single device but the mesh; this
+returns the default jax device for eager host work and exposes mesh helpers
+via fedml_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def get_device(args=None):
+    devices = jax.devices()
+    dev = devices[0]
+    logger.info("jax devices: %d x %s (using %s)", len(devices), dev.platform, dev)
+    return dev

@@ -1,0 +1,56 @@
+"""Default ServerAggregator implementation.
+
+Parity with reference ``ml/aggregator/default_aggregator.py`` — holds the
+global flax variables, evaluates with the jitted eval closure.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.alg_frame.server_aggregator import ServerAggregator
+from ..engine.train import make_eval_fn, pad_to
+
+
+class DefaultServerAggregator(ServerAggregator):
+    def __init__(self, model, args):
+        super().__init__(model, args)
+        self.module = model
+        self.variables = None
+        self._eval_fn = make_eval_fn(model)
+        self._eval_batch = int(getattr(args, "eval_batch_size", 256))
+
+    def get_model_params(self) -> Any:
+        return self.variables
+
+    def set_model_params(self, model_parameters: Any) -> None:
+        self.variables = model_parameters
+
+    def test(self, test_data, device, args):
+        """test_data: (x, y) arrays -> dict(test_correct, test_loss, test_total)."""
+        x, y = test_data
+        b = self._eval_batch
+        n = len(y)
+        steps = max(1, -(-n // b))
+        loss_sum = correct = total = 0.0
+        for s in range(steps):
+            xs = jnp.asarray(x[s * b : (s + 1) * b])
+            ys = jnp.asarray(y[s * b : (s + 1) * b])
+            m = jnp.ones((xs.shape[0],), jnp.float32)
+            if xs.shape[0] < b:  # pad tail batch to keep one compiled shape
+                pad_n = b - xs.shape[0]
+                xs = pad_to(xs, b)
+                ys = pad_to(ys, b)
+                m = jnp.concatenate([m, jnp.zeros((pad_n,), jnp.float32)])
+            l, c, t = self._eval_fn(self.variables, xs, ys, m)
+            loss_sum += float(l)
+            correct += float(c)
+            total += float(t)
+        return {
+            "test_correct": correct,
+            "test_loss": loss_sum,
+            "test_total": max(total, 1.0),
+        }

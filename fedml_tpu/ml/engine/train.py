@@ -1,0 +1,179 @@
+"""Functional training engine: jitted local-training and eval closures.
+
+This is the TPU-native replacement for the reference's eager per-batch torch
+loops (``ml/trainer/my_model_trainer_classification.py:15-137``).  Local
+training is ONE compiled XLA program: ``lax.scan`` over epochs, nested scan
+over steps, per-epoch on-device shuffling, padding masked out of the loss.
+The same compiled function serves every client with the same padded shape —
+no per-client recompiles (the shape-bucketing that makes FL's ragged clients
+XLA-friendly, cf. SURVEY.md §7 "hard parts").
+
+Model state convention: a flax ``variables`` dict ``{"params": ...,
+["batch_stats": ...]}``.  Both collections are aggregated by FedAvg (matching
+torch ``state_dict`` averaging, which includes BN running stats).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Pytree = Any
+
+
+class LocalTrainResult(NamedTuple):
+    variables: Pytree
+    loss: jnp.ndarray  # mean masked loss over the run
+    seen: jnp.ndarray  # number of (valid) samples processed
+
+
+def make_optimizer(args) -> optax.GradientTransformation:
+    """Client optimizer factory (reference trainer's SGD/Adam switch)."""
+    name = str(getattr(args, "client_optimizer", "sgd")).lower()
+    lr = float(getattr(args, "learning_rate", 0.01))
+    wd = float(getattr(args, "weight_decay", 0.0))
+    momentum = float(getattr(args, "momentum", 0.0))
+    if name == "sgd":
+        tx = optax.sgd(lr, momentum=momentum if momentum > 0 else None)
+    elif name == "adam":
+        tx = optax.adam(lr)
+    elif name == "adamw":
+        tx = optax.adamw(lr, weight_decay=wd)
+    else:
+        raise ValueError(f"unknown client_optimizer {name!r}")
+    if wd > 0 and name in ("sgd", "adam"):
+        tx = optax.chain(optax.add_decayed_weights(wd), tx)
+    return tx
+
+
+def softmax_ce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray):
+    """Masked CE.  Handles both [B] labels and [B, L] per-token labels (NWP):
+    a per-example mask [B] broadcasts over trailing label axes."""
+    per = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    mask = mask.reshape(mask.shape + (1,) * (per.ndim - mask.ndim))
+    total = jnp.sum(per * mask)
+    count = jnp.maximum(jnp.sum(jnp.broadcast_to(mask, per.shape)), 1.0)
+    return total / count, (total, count)
+
+
+def make_local_train_fn(
+    module,
+    args,
+    batch_size: int,
+    padded_n: int,
+    epochs: Optional[int] = None,
+    has_dropout: bool = True,
+) -> Callable[[Pytree, jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array], LocalTrainResult]:
+    """Build the jitted local-training closure.
+
+    Returned fn: ``(variables, x [padded_n,...], y [padded_n], n_valid, rng)
+    -> LocalTrainResult``.  Data must be valid-first; indices >= n_valid are
+    padding and masked out of loss/gradients.
+    """
+    tx = make_optimizer(args)
+    epochs = int(epochs if epochs is not None else getattr(args, "epochs", 1))
+    steps_per_epoch = max(1, -(-padded_n // batch_size))
+
+    def loss_fn(params, other_vars, bx, by, bmask, rng):
+        variables = dict(other_vars, params=params)
+        mutable = [k for k in other_vars.keys()]
+        rngs = {"dropout": rng} if has_dropout else None
+        if mutable:
+            logits, updated = module.apply(
+                variables, bx, train=True, rngs=rngs, mutable=mutable
+            )
+        else:
+            logits = module.apply(variables, bx, train=True, rngs=rngs)
+            updated = {}
+        loss, _ = softmax_ce_loss(logits, by, bmask)
+        return loss, updated
+
+    def train(variables, x, y, n_valid, rng) -> LocalTrainResult:
+        params = variables["params"]
+        other = {k: v for k, v in variables.items() if k != "params"}
+        opt_state = tx.init(params)
+        n_valid = jnp.asarray(n_valid, jnp.int32)
+
+        def epoch_body(carry, ek):
+            params, other, opt_state, loss_sum, cnt_sum = carry
+            perm = jax.random.permutation(jax.random.fold_in(ek, 0), padded_n)
+
+            def step_body(c, sk_i):
+                params, other, opt_state, lsum, csum = c
+                sk, i = sk_i
+                idx = jax.lax.dynamic_slice_in_dim(perm, i * batch_size, batch_size)
+                bx = jnp.take(x, idx, axis=0)
+                by = jnp.take(y, idx, axis=0)
+                bmask = (idx < n_valid).astype(jnp.float32)
+                (loss, updated), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, other, bx, by, bmask, sk
+                )
+                # Zero the step entirely if the batch is all padding.
+                any_valid = jnp.sum(bmask) > 0
+                updates, new_opt = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                params = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(any_valid, new, old), new_params, params
+                )
+                opt_state = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(any_valid, new, old), new_opt, opt_state
+                )
+                if updated:
+                    other = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(any_valid, new, old), updated, other
+                    )
+                return (params, other, opt_state, lsum + loss * jnp.sum(bmask), csum + jnp.sum(bmask)), None
+
+            step_keys = jax.random.split(jax.random.fold_in(ek, 1), steps_per_epoch)
+            (params, other, opt_state, loss_sum, cnt_sum), _ = jax.lax.scan(
+                step_body,
+                (params, other, opt_state, loss_sum, cnt_sum),
+                (step_keys, jnp.arange(steps_per_epoch)),
+            )
+            return (params, other, opt_state, loss_sum, cnt_sum), None
+
+        epoch_keys = jax.random.split(rng, epochs)
+        (params, other, opt_state, loss_sum, cnt_sum), _ = jax.lax.scan(
+            epoch_body, (params, other, opt_state, 0.0, 0.0), epoch_keys
+        )
+        out_vars = dict(other, params=params)
+        return LocalTrainResult(out_vars, loss_sum / jnp.maximum(cnt_sum, 1.0), cnt_sum)
+
+    return jax.jit(train)
+
+
+def make_eval_fn(module) -> Callable:
+    """Jitted masked eval: ``(variables, x, y, mask) -> (loss_sum, correct, count)``."""
+
+    @jax.jit
+    def evaluate(variables, x, y, mask):
+        logits = module.apply(variables, x, train=False)
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        pred = jnp.argmax(logits, axis=-1)
+        mask = mask.astype(jnp.float32)
+        mask = mask.reshape(mask.shape + (1,) * (per.ndim - mask.ndim))
+        full = jnp.broadcast_to(mask, per.shape)
+        return (
+            jnp.sum(per * full),
+            jnp.sum((pred == y).astype(jnp.float32) * full),
+            jnp.sum(full),
+        )
+
+    return evaluate
+
+
+def pad_to(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Pad axis 0 to length n (repeat-edge padding keeps dtypes/shapes sane)."""
+    if x.shape[0] >= n:
+        return x[:n]
+    pad = [(0, n - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad, mode="edge")
+
+
+def init_variables(module, sample_input: jnp.ndarray, seed: int = 0) -> Pytree:
+    variables = module.init(jax.random.PRNGKey(seed), sample_input, train=False)
+    return dict(variables)

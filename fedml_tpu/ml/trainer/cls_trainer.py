@@ -1,0 +1,74 @@
+"""Classification ClientTrainer over the jitted engine.
+
+Parity with reference ``ml/trainer/my_model_trainer_classification.py:15-137``
+(``ModelTrainerCLS``): same role, but ``train`` delegates to ONE compiled XLA
+program per padded shape (ml/engine/train.py) instead of an eager batch loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.alg_frame.client_trainer import ClientTrainer
+from ..engine.train import make_eval_fn, make_local_train_fn, pad_to
+
+
+class ModelTrainerCLS(ClientTrainer):
+    def __init__(self, model, args):
+        super().__init__(model, args)
+        self.module = model
+        self.variables = None
+        self._train_fns: Dict[Tuple[int, int], Any] = {}  # (padded_n, bs) -> fn
+        self._eval_fn = make_eval_fn(model)
+        self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+
+    def get_model_params(self):
+        return self.variables
+
+    def set_model_params(self, model_parameters):
+        self.variables = model_parameters
+
+    def _fn_for(self, padded_n: int, batch_size: int):
+        key = (padded_n, batch_size)
+        if key not in self._train_fns:
+            self._train_fns[key] = make_local_train_fn(
+                self.module, self.args, batch_size, padded_n
+            )
+        return self._train_fns[key]
+
+    @staticmethod
+    def padded_size(n: int, batch_size: int) -> int:
+        """Round client size up to a bucket (next multiple of batch_size and
+        power-of-two-ish) so few distinct shapes are compiled."""
+        n = max(n, batch_size)
+        bucket = batch_size
+        while bucket < n:
+            bucket *= 2
+        return bucket
+
+    def train(self, train_data, device, args):
+        x, y = train_data
+        n = len(y)
+        bs = int(getattr(args, "batch_size", 32))
+        padded_n = self.padded_size(n, bs)
+        fn = self._fn_for(padded_n, bs)
+        self.rng, sub = jax.random.split(self.rng)
+        xp = pad_to(jnp.asarray(x), padded_n)
+        yp = pad_to(jnp.asarray(y), padded_n)
+        result = fn(self.variables, xp, yp, n, sub)
+        self.variables = result.variables
+        return result
+
+    def test(self, test_data, device, args):
+        x, y = test_data
+        xs, ys = jnp.asarray(x), jnp.asarray(y)
+        m = jnp.ones((xs.shape[0],), jnp.float32)
+        l, c, t = self._eval_fn(self.variables, xs, ys, m)
+        return {
+            "test_correct": float(c),
+            "test_loss": float(l),
+            "test_total": float(t),
+        }

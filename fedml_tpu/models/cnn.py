@@ -1,0 +1,53 @@
+"""CNN zoo slice (flax.linen).
+
+Counterparts of reference ``model/cv/cnn.py``:
+* ``CNN_DropOut`` — the FedAvg-paper 2conv+2fc CNN used for (Fed)EMNIST
+  (``only_digits`` switches 10 vs 62 classes), reference ``cnn.py:6-76``.
+* ``CNN_WEB`` — small MNIST CNN.
+NHWC layout + channels-last convs (TPU-native; XLA tiles these onto the MXU).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNN_DropOut(nn.Module):
+    only_digits: bool = True
+    num_classes: int = 0  # 0 -> derive from only_digits (10/62)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:  # [B, H, W] -> [B, H, W, 1]
+            x = x[..., None]
+        x = nn.Conv(32, (3, 3), padding="VALID", name="conv2d_1")(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="VALID", name="conv2d_2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, name="dense_1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        head = self.num_classes or (10 if self.only_digits else 62)
+        return nn.Dense(head, name="dense_2")(x)
+
+
+class CNN_WEB(nn.Module):
+    """Compact MNIST CNN (reference cnn.py:79-119 analog)."""
+
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(self.output_dim)(x)

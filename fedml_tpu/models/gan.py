@@ -1,0 +1,31 @@
+"""MNIST GAN pair — counterpart of reference ``model/cv/generator.py`` /
+``discriminator.py`` (used by the FedGAN algorithm, simulation/mpi/fedgan/)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MNISTGenerator(nn.Module):
+    latent_dim: int = 100
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        x = nn.relu(nn.Dense(7 * 7 * 128, name="fc")(z))
+        x = x.reshape((z.shape[0], 7, 7, 128))
+        x = nn.ConvTranspose(64, (4, 4), strides=(2, 2), padding="SAME", name="deconv1")(x)
+        x = nn.relu(x)
+        x = nn.ConvTranspose(1, (4, 4), strides=(2, 2), padding="SAME", name="deconv2")(x)
+        return nn.tanh(x)
+
+
+class MNISTDiscriminator(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.leaky_relu(nn.Conv(64, (4, 4), strides=(2, 2), padding="SAME", name="conv1")(x), 0.2)
+        x = nn.leaky_relu(nn.Conv(128, (4, 4), strides=(2, 2), padding="SAME", name="conv2")(x), 0.2)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(1, name="head")(x)

@@ -1,0 +1,87 @@
+"""Model factory keyed on (model, dataset).
+
+Parity with reference ``model/model_hub.py:20-85`` (``fedml.model.create``):
+same model-name keys, flax modules instead of torch.  Returns an
+uninitialized ``nn.Module``; parameter init happens in the trainer via
+``ml.engine.train.init_variables`` (functional — no eager weights here).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import flax.linen as nn
+
+logger = logging.getLogger(__name__)
+
+
+def create(args: Any, output_dim: int) -> nn.Module:
+    name = str(getattr(args, "model", "lr")).lower()
+    dataset = str(getattr(args, "dataset", "")).lower()
+
+    if name in ("lr", "logistic_regression"):
+        from .linear import LogisticRegression
+
+        return LogisticRegression(output_dim=output_dim)
+    if name in ("cnn", "cnn_dropout"):
+        from .cnn import CNN_DropOut
+
+        return CNN_DropOut(only_digits=(output_dim <= 10), num_classes=output_dim)
+    if name in ("cnn_web",):
+        from .cnn import CNN_WEB
+
+        return CNN_WEB(output_dim=output_dim)
+    if name in ("resnet20",):
+        from .resnet import resnet20
+
+        return resnet20(num_classes=output_dim, norm=_norm(args))
+    if name in ("resnet56",):
+        from .resnet import resnet56
+
+        return resnet56(num_classes=output_dim, norm=_norm(args))
+    if name in ("resnet18", "resnet18_gn"):
+        from .resnet import resnet18_gn
+
+        return resnet18_gn(num_classes=output_dim)
+    if name in ("mobilenet", "mobilenet_v1"):
+        from .mobilenet import MobileNetV1
+
+        return MobileNetV1(num_classes=output_dim)
+    if name in ("mobilenet_v3",):
+        from .mobilenet import MobileNetV3Small
+
+        return MobileNetV3Small(num_classes=output_dim)
+    if name in ("rnn", "rnn_fedavg", "rnn_originalfedavg"):
+        from .rnn import RNN_OriginalFedAvg
+
+        return RNN_OriginalFedAvg(vocab_size=max(output_dim, 90))
+    if name in ("rnn_fedshakespeare",):
+        from .rnn import RNN_FedShakespeare
+
+        return RNN_FedShakespeare(vocab_size=max(output_dim, 90))
+    if name in ("rnn_stackoverflow", "rnn_nwp"):
+        from .rnn import RNN_StackOverFlow
+
+        return RNN_StackOverFlow(vocab_size=output_dim)
+    if name in ("lstm", "lstm_tagpred"):
+        from .rnn import RNN_OriginalFedAvg
+
+        return RNN_OriginalFedAvg(vocab_size=max(output_dim, 90))
+    if name in ("transformer", "fedtransformer"):
+        from .transformer import TransformerLM, TransformerConfig
+
+        return TransformerLM(TransformerConfig(vocab_size=max(output_dim, 256)))
+    if name in ("vgg11", "vgg16"):
+        from .vgg import VGG
+
+        return VGG(num_classes=output_dim, depth=int(name[3:]))
+    if name in ("gan", "mnist_gan"):
+        from .gan import MNISTGenerator
+
+        return MNISTGenerator()
+    raise ValueError(f"unknown model {name!r} for dataset {dataset!r}")
+
+
+def _norm(args: Any) -> str:
+    return str(getattr(args, "model_norm", "gn")).lower()

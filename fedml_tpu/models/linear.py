@@ -1,0 +1,21 @@
+"""Linear model zoo slice (flax.linen).
+
+Counterpart of reference ``model/linear/lr.py`` (LogisticRegression used by
+the MNIST+LR benchmark row, BENCHMARK_simulation.md:5).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """Flattens input and applies one Dense layer; softmax lives in the loss."""
+
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.output_dim, name="linear")(x)

@@ -1,0 +1,137 @@
+"""MobileNet family (flax.linen).
+
+Counterparts of reference ``model/cv/mobilenet.py`` (MobileNetV1, the
+CIFAR benchmark rows BENCHMARK_MPI.md:104-106) and ``mobilenet_v3.py``.
+Depthwise convs via ``feature_group_count`` — XLA lowers these to efficient
+TPU convolutions.  GroupNorm default for FL friendliness (see resnet.py).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _DWSeparable(nn.Module):
+    filters: int
+    stride: int = 1
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), strides=(self.stride, self.stride), padding="SAME",
+                    feature_group_count=in_ch, use_bias=False, name="dw")(x)
+        x = _norm_layer(self.norm, "dw_norm", train)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False, name="pw")(x)
+        x = _norm_layer(self.norm, "pw_norm", train)(x)
+        return nn.relu(x)
+
+
+def _norm_layer(norm: str, name: str, train: bool):
+    if norm == "bn":
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name)
+    return nn.GroupNorm(num_groups=None, group_size=8, name=name)
+
+
+class MobileNetV1(nn.Module):
+    num_classes: int = 10
+    width: float = 1.0
+    norm: str = "gn"
+    small_images: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        w = lambda c: max(8, int(c * self.width))
+        stride0 = 1 if self.small_images else 2
+        x = nn.Conv(w(32), (3, 3), strides=(stride0, stride0), padding="SAME",
+                    use_bias=False, name="conv_init")(x)
+        x = _norm_layer(self.norm, "norm_init", train)(x)
+        x = nn.relu(x)
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1)]
+        for i, (c, s) in enumerate(cfg):
+            x = _DWSeparable(w(c), s, self.norm, name=f"block{i}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="classifier")(x)
+
+
+class _SEBlock(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(c // self.reduce, 8))(s))
+        s = nn.hard_sigmoid(nn.Dense(c)(s))
+        return x * s[:, None, None, :]
+
+
+class _MBV3Block(nn.Module):
+    expand: int
+    filters: int
+    kernel: int
+    stride: int
+    use_se: bool
+    act: str  # "relu" | "hswish"
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        act = nn.relu if self.act == "relu" else nn.hard_swish
+        inp = x
+        c_in = x.shape[-1]
+        x = nn.Conv(self.expand, (1, 1), use_bias=False)(x)
+        x = _norm_layer(self.norm, "expand_norm", train)(x)
+        x = act(x)
+        x = nn.Conv(self.expand, (self.kernel, self.kernel), strides=(self.stride, self.stride),
+                    padding="SAME", feature_group_count=self.expand, use_bias=False)(x)
+        x = _norm_layer(self.norm, "dw_norm", train)(x)
+        x = act(x)
+        if self.use_se:
+            x = _SEBlock()(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        x = _norm_layer(self.norm, "project_norm", train)(x)
+        if self.stride == 1 and c_in == self.filters:
+            x = x + inp
+        return x
+
+
+class MobileNetV3Small(nn.Module):
+    num_classes: int = 10
+    norm: str = "gn"
+    small_images: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        stride0 = 1 if self.small_images else 2
+        x = nn.Conv(16, (3, 3), strides=(stride0, stride0), padding="SAME", use_bias=False)(x)
+        x = _norm_layer(self.norm, "norm_init", train)(x)
+        x = nn.hard_swish(x)
+        cfg = [  # expand, filters, kernel, stride, se, act
+            (16, 16, 3, 2, True, "relu"),
+            (72, 24, 3, 2, False, "relu"),
+            (88, 24, 3, 1, False, "relu"),
+            (96, 40, 5, 2, True, "hswish"),
+            (240, 40, 5, 1, True, "hswish"),
+            (240, 40, 5, 1, True, "hswish"),
+            (120, 48, 5, 1, True, "hswish"),
+            (144, 48, 5, 1, True, "hswish"),
+            (288, 96, 5, 2, True, "hswish"),
+            (576, 96, 5, 1, True, "hswish"),
+            (576, 96, 5, 1, True, "hswish"),
+        ]
+        for i, (e, f, k, s, se, act) in enumerate(cfg):
+            x = _MBV3Block(e, f, k, s, se, act, self.norm, name=f"block{i}")(x, train=train)
+        x = nn.Conv(576, (1, 1), use_bias=False)(x)
+        x = _norm_layer(self.norm, "norm_head", train)(x)
+        x = nn.hard_swish(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.hard_swish(nn.Dense(1024)(x))
+        return nn.Dense(self.num_classes, name="classifier")(x)

@@ -1,0 +1,115 @@
+"""CIFAR-style ResNets (flax.linen).
+
+Counterparts of reference ``model/cv/resnet.py`` (ResNet-20/32/44/56 for
+CIFAR, used by the headline benchmark CIFAR-10 ResNet-56 93.19 IID,
+BENCHMARK_MPI.md:101) and ``model/cv/resnet_gn.py`` (ResNet-18 + GroupNorm
+for fed_cifar100, BENCHMARK_MPI.md:51).
+
+TPU-first notes: NHWC layout, 3x3 convs XLA maps straight onto the MXU;
+``norm='gn'`` keeps the model purely functional (no mutable batch stats),
+which is also the FL-correct choice (BN running stats average badly across
+non-IID clients — the reason the reference ships a GN variant).  ``norm='bn'``
+is supported for strict parity; its ``batch_stats`` collection is carried in
+the model state and sample-weight-averaged like parameters.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _norm(norm: str, name: str, train: bool):
+    if norm == "bn":
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name)
+    if norm == "gn":
+        return nn.GroupNorm(num_groups=None, group_size=16, name=name)
+    raise ValueError(f"unknown norm {norm!r}")
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.stride, self.stride),
+                    padding="SAME", use_bias=False, name="conv1")(x)
+        y = _norm(self.norm, "norm1", train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False, name="conv2")(y)
+        y = _norm(self.norm, "norm2", train)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), strides=(self.stride, self.stride),
+                               use_bias=False, name="proj")(residual)
+            residual = _norm(self.norm, "norm_proj", train)(residual)
+        return nn.relu(y + residual)
+
+
+class CifarResNet(nn.Module):
+    """3-stage CIFAR ResNet: depth = 6n+2 (n blocks/stage, 16/32/64 filters)."""
+
+    num_blocks: int  # n: 3 -> ResNet-20, 9 -> ResNet-56
+    num_classes: int = 10
+    norm: str = "gn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv_init")(x)
+        x = _norm(self.norm, "norm_init", train)(x)
+        x = nn.relu(x)
+        for stage, filters in enumerate((16, 32, 64)):
+            for block in range(self.num_blocks):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(filters, stride, self.norm,
+                               name=f"stage{stage}_block{block}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="classifier")(x)
+
+
+class ResNet18(nn.Module):
+    """ImageNet-style ResNet-18, GroupNorm default (fed_cifar100 row)."""
+
+    num_classes: int = 100
+    norm: str = "gn"
+    small_images: bool = True  # CIFAR: 3x3 stem, no max-pool
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        if self.small_images:
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, name="conv_init")(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME", use_bias=False,
+                        name="conv_init")(x)
+        x = _norm(self.norm, "norm_init", train)(x)
+        x = nn.relu(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, filters in enumerate((64, 128, 256, 512)):
+            for block in range(2):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(filters, stride, self.norm,
+                               name=f"stage{stage}_block{block}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="classifier")(x)
+
+
+def resnet20(num_classes: int = 10, norm: str = "gn") -> CifarResNet:
+    return CifarResNet(num_blocks=3, num_classes=num_classes, norm=norm)
+
+
+def resnet56(num_classes: int = 10, norm: str = "gn") -> CifarResNet:
+    return CifarResNet(num_blocks=9, num_classes=num_classes, norm=norm)
+
+
+def resnet18_gn(num_classes: int = 100) -> ResNet18:
+    return ResNet18(num_classes=num_classes, norm="gn")

@@ -1,0 +1,57 @@
+"""RNN zoo (flax.linen LSTMs).
+
+Counterparts of reference ``model/nlp/rnn.py``:
+* ``RNN_OriginalFedAvg`` — 2-layer LSTM char model (shakespeare LEAF,
+  BENCHMARK_simulation.md:8)
+* ``RNN_FedShakespeare`` — Google fed_shakespeare variant (:9)
+* ``RNN_StackOverFlow`` — 1-LSTM + 2-FC next-word-prediction model (:10)
+
+Sequences are scanned with ``nn.RNN`` (lax.scan under jit — static shapes,
+TPU-friendly).  Input [B, L] int tokens -> logits [B, L, vocab].
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNN_OriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embedding_dim, name="embed")(x)
+        x = nn.RNN(nn.LSTMCell(self.hidden_size), name="lstm1")(x)
+        x = nn.RNN(nn.LSTMCell(self.hidden_size), name="lstm2")(x)
+        return nn.Dense(self.vocab_size, name="head")(x)
+
+
+class RNN_FedShakespeare(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embedding_dim, name="embed")(x)
+        x = nn.RNN(nn.LSTMCell(self.hidden_size), name="lstm1")(x)
+        x = nn.RNN(nn.LSTMCell(self.hidden_size), name="lstm2")(x)
+        return nn.Dense(self.vocab_size, name="head")(x)
+
+
+class RNN_StackOverFlow(nn.Module):
+    """1 LSTM + 2 FC (reference rnn.py StackOverflow NWP model)."""
+
+    vocab_size: int = 10004
+    embedding_dim: int = 96
+    hidden_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embedding_dim, name="embed")(x)
+        x = nn.RNN(nn.LSTMCell(self.hidden_size), name="lstm")(x)
+        x = nn.Dense(self.embedding_dim, name="fc1")(x)
+        return nn.Dense(self.vocab_size, name="fc2")(x)

@@ -1,0 +1,30 @@
+"""VGG (flax.linen) — counterpart of reference ``model/cv/vgg.py``."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFG = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    num_classes: int = 10
+    depth: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        for i, v in enumerate(_CFG[self.depth]):
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.relu(nn.Conv(int(v), (3, 3), padding="SAME", name=f"conv{i}")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.relu(nn.Dense(512, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, name="classifier")(x)

@@ -1,0 +1,51 @@
+"""Runner dispatch: (training_type, backend, role) -> runner with .run().
+
+Parity with reference ``runner.py:14-123`` (``FedMLRunner``).
+"""
+
+from __future__ import annotations
+
+from .constants import (
+    FEDML_TRAINING_PLATFORM_CROSS_DEVICE,
+    FEDML_TRAINING_PLATFORM_CROSS_SILO,
+    FEDML_TRAINING_PLATFORM_SIMULATION,
+)
+
+
+class FedMLRunner:
+    def __init__(self, args, device, dataset, model, client_trainer=None, server_aggregator=None):
+        self.args = args
+        training_type = str(getattr(args, "training_type", FEDML_TRAINING_PLATFORM_SIMULATION))
+        if training_type == FEDML_TRAINING_PLATFORM_SIMULATION:
+            self.runner = self._init_simulation_runner(args, device, dataset, model)
+        elif training_type == FEDML_TRAINING_PLATFORM_CROSS_SILO:
+            self.runner = self._init_cross_silo_runner(
+                args, device, dataset, model, client_trainer, server_aggregator
+            )
+        elif training_type == FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
+            self.runner = self._init_cross_device_runner(args, device, dataset, model, server_aggregator)
+        else:
+            raise ValueError(f"unknown training_type {training_type!r}")
+
+    def _init_simulation_runner(self, args, device, dataset, model):
+        from .simulation.simulator import create_simulator
+
+        return create_simulator(args, device, dataset, model)
+
+    def _init_cross_silo_runner(self, args, device, dataset, model, client_trainer, server_aggregator):
+        role = str(getattr(args, "role", "client"))
+        if role == "server":
+            from .cross_silo.server.server import Server
+
+            return Server(args, device, dataset, model, server_aggregator)
+        from .cross_silo.client.client import Client
+
+        return Client(args, device, dataset, model, client_trainer)
+
+    def _init_cross_device_runner(self, args, device, dataset, model, server_aggregator):
+        from .cross_device.server import ServerDevice
+
+        return ServerDevice(args, device, dataset, model, server_aggregator)
+
+    def run(self):
+        return self.runner.run()

@@ -1,0 +1,51 @@
+"""Simulator dispatch.
+
+Parity with reference ``simulation/simulator.py`` (SimulatorSingleProcess /
+SimulatorMPI / SimulatorNCCL): backend "sp" runs the in-process python round
+loop; "XLA" (also accepted: "MPI", "NCCL" — their TPU-native successor) runs
+the sharded in-mesh simulator (simulation/xla/) where clients live on a
+device mesh and aggregation is a psum over ICI.
+"""
+
+from __future__ import annotations
+
+from ..constants import (
+    FEDML_SIMULATION_TYPE_MPI,
+    FEDML_SIMULATION_TYPE_NCCL,
+    FEDML_SIMULATION_TYPE_SP,
+    FEDML_SIMULATION_TYPE_XLA,
+)
+
+
+class SimulatorSingleProcess:
+    def __init__(self, args, device, dataset, model):
+        opt = str(getattr(args, "federated_optimizer", "FedAvg"))
+        from .sp import create_sp_algorithm
+
+        self.fl_trainer = create_sp_algorithm(opt, args, device, dataset, model)
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
+class SimulatorXLA:
+    def __init__(self, args, device, dataset, model):
+        from .xla.fed_sim import XLASimulator
+
+        self.sim = XLASimulator(args, dataset, model)
+
+    def run(self):
+        return self.sim.train()
+
+
+def create_simulator(args, device, dataset, model):
+    backend = str(getattr(args, "backend", FEDML_SIMULATION_TYPE_SP))
+    if backend == FEDML_SIMULATION_TYPE_SP:
+        return SimulatorSingleProcess(args, device, dataset, model)
+    if backend in (
+        FEDML_SIMULATION_TYPE_XLA,
+        FEDML_SIMULATION_TYPE_MPI,
+        FEDML_SIMULATION_TYPE_NCCL,
+    ):
+        return SimulatorXLA(args, device, dataset, model)
+    raise ValueError(f"unknown simulation backend {backend!r}")

@@ -1,0 +1,74 @@
+"""Single-process algorithm registry (reference ``simulation/sp/*`` dirs)."""
+
+from __future__ import annotations
+
+
+def create_sp_algorithm(optimizer: str, args, device, dataset, model):
+    try:
+        return _dispatch(optimizer, args, device, dataset, model)
+    except ImportError as e:
+        raise NotImplementedError(
+            f"federated_optimizer {optimizer!r} is registered but its module is "
+            f"not available in this build: {e}"
+        ) from e
+
+
+def _dispatch(optimizer: str, args, device, dataset, model):
+    opt = optimizer.lower()
+    if opt == "fedavg":
+        from .fedavg.fedavg_api import FedAvgAPI
+
+        return FedAvgAPI(args, device, dataset, model)
+    if opt == "fedopt":
+        from .fedopt.fedopt_api import FedOptAPI
+
+        return FedOptAPI(args, device, dataset, model)
+    if opt == "fedprox":
+        from .fedprox.fedprox_api import FedProxAPI
+
+        return FedProxAPI(args, device, dataset, model)
+    if opt == "fednova":
+        from .fednova.fednova_api import FedNovaAPI
+
+        return FedNovaAPI(args, device, dataset, model)
+    if opt == "fedsgd":
+        from .fedsgd.fedsgd_api import FedSGDAPI
+
+        return FedSGDAPI(args, device, dataset, model)
+    if opt == "scaffold":
+        from .scaffold.scaffold_api import ScaffoldAPI
+
+        return ScaffoldAPI(args, device, dataset, model)
+    if opt == "feddyn":
+        from .feddyn.feddyn_api import FedDynAPI
+
+        return FedDynAPI(args, device, dataset, model)
+    if opt == "hierarchicalfl":
+        from .hierarchical_fl.hier_api import HierarchicalFLAPI
+
+        return HierarchicalFLAPI(args, device, dataset, model)
+    if opt == "decentralized_fl":
+        from .decentralized.decentralized_api import DecentralizedFLAPI
+
+        return DecentralizedFLAPI(args, device, dataset, model)
+    if opt == "turbo_aggregate":
+        from .turboaggregate.ta_api import TurboAggregateAPI
+
+        return TurboAggregateAPI(args, device, dataset, model)
+    if opt == "classical_vertical":
+        from .classical_vertical_fl.vfl_api import VerticalFLAPI
+
+        return VerticalFLAPI(args, device, dataset, model)
+    if opt == "split_nn":
+        from .split_nn.split_nn_api import SplitNNAPI
+
+        return SplitNNAPI(args, device, dataset, model)
+    if opt == "async_fedavg":
+        from .async_fedavg.async_fedavg_api import AsyncFedAvgAPI
+
+        return AsyncFedAvgAPI(args, device, dataset, model)
+    if opt == "fedgan":
+        from .fedgan.fedgan_api import FedGanAPI
+
+        return FedGanAPI(args, device, dataset, model)
+    raise ValueError(f"unknown federated_optimizer {optimizer!r}")

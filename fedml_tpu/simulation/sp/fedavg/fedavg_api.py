@@ -1,0 +1,151 @@
+"""Single-process FedAvg simulator.
+
+Round-protocol parity with reference ``simulation/sp/fedavg/fedavg_api.py``:
+per-round seeded client sampling (:125-133), ``client_num_per_round`` client
+slots re-bound to sampled data (:86-101), sample-weighted aggregation
+(:142-157), periodic test on all clients (:111-118).  The local training loop
+itself is the compiled engine (ml/engine/train.py) — one XLA program per
+padded shape, shared by all clients.
+
+Server-side hooks (attacker injection, defense, central DP) run exactly where
+the reference runs them: between collection and aggregation.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ....core.aggregate import FedMLAggOperator
+from ....core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from ....core.security.fedml_attacker import FedMLAttacker
+from ....core.security.fedml_defender import FedMLDefender
+from ....ml.aggregator.default_aggregator import DefaultServerAggregator
+from ....ml.engine.train import init_variables
+from ....ml.trainer.cls_trainer import ModelTrainerCLS
+from ....utils.metrics import MetricsLogger
+
+logger = logging.getLogger(__name__)
+
+
+class Client:
+    """A reusable client slot (reference fedavg_api.py Client)."""
+
+    def __init__(self, client_idx, local_training_data, local_test_data, local_sample_number, args, trainer):
+        self.client_idx = client_idx
+        self.local_training_data = local_training_data
+        self.local_test_data = local_test_data
+        self.local_sample_number = local_sample_number
+        self.args = args
+        self.trainer = trainer
+
+    def update_local_dataset(self, client_idx, local_training_data, local_test_data, local_sample_number):
+        self.client_idx = client_idx
+        self.local_training_data = local_training_data
+        self.local_test_data = local_test_data
+        self.local_sample_number = local_sample_number
+        self.trainer.set_id(client_idx)
+
+    def train(self, w_global):
+        self.trainer.set_model_params(w_global)
+        self.trainer.on_before_local_training(self.local_training_data, None, self.args)
+        self.trainer.train(self.local_training_data, None, self.args)
+        self.trainer.on_after_local_training(self.local_training_data, None, self.args)
+        return self.trainer.get_model_params()
+
+    def local_test(self, use_test_set: bool):
+        data = self.local_test_data if use_test_set else self.local_training_data
+        return self.trainer.test(data, None, self.args)
+
+
+class FedAvgAPI:
+    def __init__(self, args, device, dataset, model):
+        self.args = args
+        self.device = device
+        (
+            self.train_global_num,
+            self.test_global_num,
+            self.train_data_global,
+            self.test_data_global,
+            self.train_data_local_num_dict,
+            self.train_data_local_dict,
+            self.test_data_local_dict,
+            self.class_num,
+        ) = dataset
+        self.module = model
+        sample = jax.numpy.asarray(self.train_data_global[0][:1])
+        self.w_global = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
+
+        self.trainer = ModelTrainerCLS(model, args)
+        self.aggregator = DefaultServerAggregator(model, args)
+        self.aggregator.set_model_params(self.w_global)
+
+        self.client_list: List[Client] = []
+        self._setup_clients()
+        self.metrics = MetricsLogger(args)
+        self.round_times: List[float] = []
+
+    def _setup_clients(self):
+        for client_idx in range(int(self.args.client_num_per_round)):
+            c = Client(
+                client_idx,
+                self.train_data_local_dict[client_idx],
+                self.test_data_local_dict[client_idx],
+                self.train_data_local_num_dict[client_idx],
+                self.args,
+                self.trainer,
+            )
+            self.client_list.append(c)
+
+    def _client_sampling(self, round_idx: int) -> List[int]:
+        total, per_round = int(self.args.client_num_in_total), int(self.args.client_num_per_round)
+        if total == per_round:
+            return list(range(total))
+        np.random.seed(round_idx)  # reference parity: reproducible per round
+        return np.random.choice(range(total), per_round, replace=False).tolist()
+
+    def train(self) -> Dict[str, Any]:
+        comm_round = int(self.args.comm_round)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        last_metrics: Dict[str, Any] = {}
+        for round_idx in range(comm_round):
+            t0 = time.time()
+            client_indexes = self._client_sampling(round_idx)
+            logger.info("round %d: clients %s", round_idx, client_indexes)
+            w_locals: List[Tuple[float, Any]] = []
+            for slot, idx in enumerate(client_indexes):
+                client = self.client_list[slot]
+                client.update_local_dataset(
+                    idx,
+                    self.train_data_local_dict[idx],
+                    self.test_data_local_dict[idx],
+                    self.train_data_local_num_dict[idx],
+                )
+                w = client.train(self.w_global)
+                w_locals.append((float(client.local_sample_number), w))
+
+            # server hooks: attack injection -> defense -> aggregate -> DP
+            w_locals = self.aggregator.on_before_aggregation(w_locals)
+            self.w_global = self.aggregator.aggregate(w_locals)
+            self.w_global = self.aggregator.on_after_aggregation(self.w_global)
+            self.aggregator.set_model_params(self.w_global)
+
+            dt = time.time() - t0
+            self.round_times.append(dt)
+            self.metrics.log({"round": round_idx, "round_time_s": round(dt, 4)})
+            if round_idx % freq == 0 or round_idx == comm_round - 1:
+                last_metrics = self._test_global(round_idx)
+        return last_metrics
+
+    def _test_global(self, round_idx: int) -> Dict[str, Any]:
+        stats = self.aggregator.test(self.test_data_global, self.device, self.args)
+        acc = stats["test_correct"] / stats["test_total"]
+        loss = stats["test_loss"] / stats["test_total"]
+        out = {"round": round_idx, "test_acc": round(float(acc), 4), "test_loss": round(float(loss), 4)}
+        self.metrics.log(out)
+        logger.info("eval: %s", out)
+        return out
