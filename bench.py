@@ -1,0 +1,82 @@
+"""North-star benchmark: FedAvg ResNet-56 CIFAR-10, 100 simulated clients,
+Parrot-XLA simulator (BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value = local-training samples/sec/chip (the throughput half of the
+north-star; accuracy parity is covered by the test suite on real data when
+mounted).  vs_baseline divides by A100_NCCL_SPS — the single-A100 NCCL
+-simulator throughput for ResNet-56/CIFAR-10 b=64 fp32.  The reference
+publishes no wall-clock numbers (BASELINE.md), so this constant is an
+estimate from public A100 ResNet-56 training benchmarks; the >=8x-on-16-chips
+target from BASELINE.json corresponds to vs_baseline >= 0.5 per chip.
+
+Runs on the real TPU chip (default env). Main thread, single process — the
+axon tunnel is not thread-safe (see .claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+A100_NCCL_SPS = 2000.0  # estimated single-A100 NCCL-simulator samples/s
+
+
+def main() -> None:
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+    n_chips = len(jax.devices())
+    args = Arguments.from_dict(
+        {
+            "common_args": {"training_type": "simulation", "random_seed": 0, "run_id": "bench"},
+            "data_args": {
+                "dataset": "cifar10",
+                "data_cache_dir": "./fedml_data",
+                "partition_method": "hetero",
+                "partition_alpha": 0.5,
+            },
+            "model_args": {"model": "resnet56"},
+            "train_args": {
+                "federated_optimizer": "FedAvg",
+                "client_num_in_total": 100,
+                "client_num_per_round": min(100, max(8, n_chips * 8)) if n_chips > 1 else 8,
+                "comm_round": 3,
+                "epochs": 1,
+                "batch_size": 64,
+                "client_optimizer": "sgd",
+                "learning_rate": 0.001,
+            },
+            "validation_args": {"frequency_of_the_test": 0},  # 0 disables eval
+            "comm_args": {"backend": "XLA"},
+        }
+    ).validate()
+    args = fedml_tpu.init(args, should_init_logs=False)
+    from fedml_tpu import data, models
+
+    dataset, out_dim = data.load(args)
+    model = models.create(args, out_dim)
+    sim = XLASimulator(args, dataset, model)
+    sim.train()
+
+    sps = sim.throughput()["samples_per_sec"]  # compile round excluded
+    sps_per_chip = sps / max(n_chips, 1)
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_resnet56_cifar10_100clients_samples_per_sec_per_chip",
+                "value": round(sps_per_chip, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(sps_per_chip / A100_NCCL_SPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ __version__ = "0.1.0"
 from . import constants  # noqa: F401
 from .arguments import Arguments, load_arguments
 from .runner import FedMLRunner  # noqa: F401
+from . import data, device, models  # noqa: E402,F401  (public parity: fedml.data/.model/.device)
 
 _logger = logging.getLogger(__name__)
 

@@ -1,0 +1,17 @@
+"""Per-round client sampling (reference ``fedavg_api.py:125-133`` parity).
+
+Seeded by round index so every simulator backend (sp / XLA / distributed)
+draws the SAME client schedule for a given round — the property the reference
+relies on for reproducibility, kept in one place here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def client_sampling(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> np.ndarray:
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total)
+    np.random.seed(round_idx)
+    return np.random.choice(range(client_num_in_total), client_num_per_round, replace=False)

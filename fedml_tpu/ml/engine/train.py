@@ -68,7 +68,20 @@ def make_local_train_fn(
     epochs: Optional[int] = None,
     has_dropout: bool = True,
 ) -> Callable[[Pytree, jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array], LocalTrainResult]:
-    """Build the jitted local-training closure.
+    """Jitted local-training closure (see :func:`build_local_train`)."""
+    return jax.jit(build_local_train(module, args, batch_size, padded_n, epochs, has_dropout))
+
+
+def build_local_train(
+    module,
+    args,
+    batch_size: int,
+    padded_n: int,
+    epochs: Optional[int] = None,
+    has_dropout: bool = True,
+) -> Callable[[Pytree, jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array], LocalTrainResult]:
+    """Build the PURE local-training function (not jitted — composable inside
+    shard_map/scan in the XLA simulator).
 
     Returned fn: ``(variables, x [padded_n,...], y [padded_n], n_valid, rng)
     -> LocalTrainResult``.  Data must be valid-first; indices >= n_valid are
@@ -143,7 +156,7 @@ def make_local_train_fn(
         out_vars = dict(other, params=params)
         return LocalTrainResult(out_vars, loss_sum / jnp.maximum(cnt_sum, 1.0), cnt_sum)
 
-    return jax.jit(train)
+    return train
 
 
 def make_eval_fn(module) -> Callable:

@@ -1,0 +1,50 @@
+"""Device-mesh helpers.
+
+TPU-native successor of the reference's process-group plumbing
+(``ml/engine/torch_process_group_manager.py``, NCCL/gloo init in
+``simulation/nccl/base_framework/common.py:106-122``): on TPU there is no
+process group to boot — a ``jax.sharding.Mesh`` over ``jax.devices()`` is the
+communicator, and XLA compiles the collectives onto ICI.
+
+Axis conventions (constants.py): client / dp / fsdp / tp / sp / pp / ep.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(axis_sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    dev_array = mesh_utils.create_device_mesh(tuple(axis_sizes), devices=devices[:n])
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def create_fl_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the 'client' axis — the Parrot-XLA simulator's layout."""
+    devices = jax.devices()
+    n = int(n_devices or len(devices))
+    return create_mesh((n,), ("client",), devices)
+
+
+def create_train_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
+                      devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """dp x tp x sp mesh for the distributed trainer ("Cheetah" successor)."""
+    return create_mesh((dp, tp, sp), ("dp", "tp", "sp"), devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

@@ -102,11 +102,11 @@ class FedAvgAPI:
             self.client_list.append(c)
 
     def _client_sampling(self, round_idx: int) -> List[int]:
-        total, per_round = int(self.args.client_num_in_total), int(self.args.client_num_per_round)
-        if total == per_round:
-            return list(range(total))
-        np.random.seed(round_idx)  # reference parity: reproducible per round
-        return np.random.choice(range(total), per_round, replace=False).tolist()
+        from ....core.sampling import client_sampling
+
+        return client_sampling(
+            round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
+        ).tolist()
 
     def train(self) -> Dict[str, Any]:
         comm_round = int(self.args.comm_round)

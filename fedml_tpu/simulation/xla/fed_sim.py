@@ -1,0 +1,280 @@
+"""Parrot-XLA: the in-mesh federated-learning simulator (north-star component).
+
+TPU-native successor of the reference's NCCL simulator
+(``simulation/nccl/base_framework/``): there, rank-0 Server broadcasts the
+global model over torch.distributed, per-GPU LocalAggregators sequentially
+simulate their scheduled clients (``LocalAggregator.py:69-124``) and reduce
+into the server (``common.py:196-210``).  Here the whole round collapses into
+ONE compiled XLA program over a ``Mesh``:
+
+* broadcast  -> implicit replication of the global variables;
+* per-GPU LocalAggregator loop -> per-device ``lax.scan`` over the clients
+  assigned to that mesh slot (client axis sharded with shard_map);
+* local SGD epochs -> nested compiled scan (ml/engine/train.build_local_train);
+* ``fedml_nccl_reduce`` -> weighted on-device accumulation + ``lax.psum``
+  over the 'client' axis riding ICI;
+* the Server/LocalAggregator role split disappears: no host round-trips
+  inside a round, weights never leave HBM.
+
+Client heterogeneity under static shapes: all clients pad to one bucket
+(max client size rounded up); padded samples are masked from loss/updates;
+rounds whose sampled-client count doesn't fill devices evenly pad with
+weight-0 dummy clients.  Static greedy balancing of clients->devices by
+sample count (core/schedule) minimizes the padding waste.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.7 (check_vma kwarg)
+except ImportError:  # pragma: no cover - legacy jax uses check_rep instead
+    from functools import partial as _partial
+
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+        )
+
+from ...core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from ...core.security.fedml_attacker import FedMLAttacker
+from ...core.security.fedml_defender import FedMLDefender
+from ...ml.aggregator.default_aggregator import DefaultServerAggregator
+from ...ml.engine.train import build_local_train, init_variables
+from ...parallel.mesh import create_fl_mesh
+from ...utils.metrics import MetricsLogger
+
+logger = logging.getLogger(__name__)
+
+
+class XLASimulator:
+    def __init__(self, args, dataset, model, mesh: Mesh = None):
+        self.args = args
+        (
+            self.train_num,
+            self.test_num,
+            self.train_global,
+            self.test_global,
+            self.local_num_dict,
+            self.local_train_dict,
+            _local_test_dict,
+            self.class_num,
+        ) = dataset
+        self.module = model
+        self.mesh = mesh if mesh is not None else create_fl_mesh()
+        self.n_dev = self.mesh.devices.size
+
+        self.num_clients = int(args.client_num_in_total)
+        self.clients_per_round = int(args.client_num_per_round)
+        self.batch_size = int(getattr(args, "batch_size", 32))
+
+        # The in-mesh fast path aggregates on device and never materializes
+        # per-client updates on the host, so host-side attack/defense hooks and
+        # local DP cannot run here yet — fail loudly instead of silently
+        # reporting clean-FedAvg results for a robustness experiment.
+        attacker = FedMLAttacker.get_instance()
+        defender = FedMLDefender.get_instance()
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if attacker.is_attack_enabled() or defender.is_defense_enabled() or dp.is_local_dp_enabled():
+            raise NotImplementedError(
+                "attack/defense/local-DP hooks need per-client updates on the host; "
+                "use backend 'sp' for robustness experiments (central DP 'cdp' IS "
+                "supported on the XLA backend)"
+            )
+
+        self._pack_data()
+        sample = jnp.asarray(self.train_global[0][:1])
+        self.variables = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
+        self._build_round_fn()
+
+        self.aggregator = DefaultServerAggregator(model, args)
+        self.metrics = MetricsLogger(args)
+        self.round_times: List[float] = []
+        self.samples_per_round: List[int] = []
+        self.samples_trained = 0
+        self._rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 11)
+
+    # ------------------------------------------------------------------
+    # data packing: one global HBM-resident array + per-client index table
+    # ------------------------------------------------------------------
+    def _pack_data(self):
+        """Concatenate client shards into one HBM-resident array pair and
+        record each client's contiguous row range in an index table — so a
+        round's client data is a pure on-device gather (no host transfers)."""
+        b = self.batch_size
+        counts = np.array([self.local_num_dict[i] for i in range(self.num_clients)], np.int32)
+        self.max_client_n = int(counts.max())
+        self.padded_n = max(b, -(-self.max_client_n // b) * b)
+        xs, ys = [], []
+        idx = np.zeros((self.num_clients, self.padded_n), np.int32)
+        cursor = 0
+        for i in range(self.num_clients):
+            xi, yi = self.local_train_dict[i]
+            n = len(yi)
+            xs.append(np.asarray(xi))
+            ys.append(np.asarray(yi))
+            if n > 0:
+                idx[i, :n] = np.arange(cursor, cursor + n, dtype=np.int32)
+                idx[i, n:] = cursor  # padding rows (masked out by counts)
+            cursor += n
+        self.client_idx = jnp.asarray(idx)
+        self.client_counts = jnp.asarray(counts)
+        self.x_all = jnp.asarray(np.concatenate(xs, 0))
+        self.y_all = jnp.asarray(np.concatenate(ys, 0))
+        logger.info(
+            "packed %d clients (max_n=%d padded_n=%d) data %s into HBM",
+            self.num_clients, self.max_client_n, self.padded_n, self.x_all.shape,
+        )
+
+    # ------------------------------------------------------------------
+    # the compiled round
+    # ------------------------------------------------------------------
+    def _build_round_fn(self):
+        mesh = self.mesh
+        local_train = build_local_train(
+            self.module, self.args, self.batch_size, self.padded_n
+        )
+
+        def per_device(variables, x_all, y_all, idx_l, counts_l, rngs_l):
+            # idx_l: [C/n_dev, padded_n]; counts_l: [C/n_dev]; rngs_l: [C/n_dev, 2]
+            zeros = jax.tree_util.tree_map(
+                lambda v: jnp.zeros_like(v, dtype=jnp.float32), variables
+            )
+
+            def train_one(carry, inp):
+                acc, wsum, lsum = carry
+                idx_row, n_i, rng = inp
+                x = jnp.take(x_all, idx_row, axis=0)
+                y = jnp.take(y_all, idx_row, axis=0)
+                result = local_train(variables, x, y, n_i, rng)
+                w = n_i.astype(jnp.float32)
+                acc = jax.tree_util.tree_map(
+                    lambda a, p: a + w * p.astype(jnp.float32), acc, result.variables
+                )
+                return (acc, wsum + w, lsum + result.loss * w), None
+
+            (acc, wsum, lsum), _ = jax.lax.scan(
+                train_one, (zeros, 0.0, 0.0), (idx_l, counts_l, rngs_l)
+            )
+            # the "fedml_nccl_reduce": one psum over ICI
+            acc = jax.lax.psum(acc, "client")
+            wsum = jax.lax.psum(wsum, "client")
+            lsum = jax.lax.psum(lsum, "client")
+            new_global = jax.tree_util.tree_map(
+                lambda a, v: (a / jnp.maximum(wsum, 1e-9)).astype(v.dtype), acc, variables
+            )
+            return new_global, lsum / jnp.maximum(wsum, 1e-9)
+
+        self._round_fn = jax.jit(
+            shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(P(), P(), P(), P("client"), P("client"), P("client")),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+
+    def _schedule(self, sampled: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Greedy balance sampled clients across devices by sample count
+        (successor of core/schedule SeqTrainScheduler for the static case).
+        Returns (client_ids [C_pad], is_real [C_pad]) laid out so that
+        reshape(n_dev, -1) gives each device its contiguous schedule."""
+        counts = np.asarray(self.client_counts)[sampled]
+        per_dev = -(-len(sampled) // self.n_dev)
+        buckets: List[List[int]] = [[] for _ in range(self.n_dev)]
+        loads = np.zeros(self.n_dev)
+        for c in sampled[np.argsort(-counts)]:
+            d = int(np.argmin(loads + (np.array([len(b) for b in buckets]) >= per_dev) * 1e18))
+            buckets[d].append(int(c))
+            loads[d] += self.local_num_dict[int(c)]
+        ids, real = [], []
+        for b in buckets:
+            pad = per_dev - len(b)
+            ids.extend(b + [0] * pad)
+            real.extend([1] * len(b) + [0] * pad)
+        return np.asarray(ids, np.int32), np.asarray(real, np.int32)
+
+    def _client_sampling(self, round_idx: int) -> np.ndarray:
+        from ...core.sampling import client_sampling
+
+        return client_sampling(round_idx, self.num_clients, self.clients_per_round)
+
+    def train(self) -> Dict[str, Any]:
+        comm_round = int(self.args.comm_round)
+        freq = int(getattr(self.args, "frequency_of_the_test", 10))
+        eval_enabled = freq > 0  # freq <= 0 disables eval (throughput benches)
+        last: Dict[str, Any] = {}
+        for round_idx in range(comm_round):
+            t0 = time.time()
+            sampled = self._client_sampling(round_idx)
+            ids, real = self._schedule(sampled)
+            counts = np.where(real > 0, np.asarray(self.client_counts)[ids], 0)
+            self._rng, sub = jax.random.split(self._rng)
+            rngs = jax.random.split(jax.random.fold_in(sub, round_idx), len(ids))
+            idx_rows = self.client_idx[jnp.asarray(ids)]
+            self.variables, mean_loss = self._round_fn(
+                self.variables,
+                self.x_all,
+                self.y_all,
+                idx_rows,
+                jnp.asarray(counts),
+                rngs,
+            )
+            # host-side hooks (attack/defense need per-client updates and run
+            # in the host path; central DP applies here)
+            dp = FedMLDifferentialPrivacy.get_instance()
+            if dp.is_global_dp_enabled():
+                self.variables = dp.add_global_noise(self.variables)
+            jax.block_until_ready(self.variables)
+            dt = time.time() - t0
+            self.round_times.append(dt)
+            epochs = int(getattr(self.args, "epochs", 1))
+            self.samples_per_round.append(int(counts.sum()) * epochs)
+            self.samples_trained += int(counts.sum()) * epochs
+            self.metrics.log(
+                {"round": round_idx, "round_time_s": round(dt, 4), "train_loss": float(mean_loss)}
+            )
+            if eval_enabled and (round_idx % freq == 0 or round_idx == comm_round - 1):
+                last = self._test_global(round_idx)
+        return last
+
+    def _test_global(self, round_idx: int) -> Dict[str, Any]:
+        self.aggregator.set_model_params(self.variables)
+        stats = self.aggregator.test(self.test_global, None, self.args)
+        out = {
+            "round": round_idx,
+            "test_acc": round(stats["test_correct"] / stats["test_total"], 4),
+            "test_loss": round(stats["test_loss"] / stats["test_total"], 4),
+        }
+        self.metrics.log(out)
+        logger.info("eval: %s", out)
+        return out
+
+    # exposed for benchmarking
+    def throughput(self) -> Dict[str, float]:
+        """Steady-state throughput: round 0 (compile) excluded from ALL three
+        metrics when more than one round ran."""
+        if len(self.round_times) > 1:
+            times = self.round_times[1:]
+            samples = sum(self.samples_per_round[1:])
+        else:
+            times = self.round_times
+            samples = sum(self.samples_per_round)
+        total_t = max(sum(times), 1e-9)
+        return {
+            "rounds_per_sec": len(times) / total_t,
+            "mean_round_s": total_t / len(times),
+            "samples_per_sec": samples / total_t,
+        }
