@@ -53,17 +53,25 @@ DATASET_SPECS: Dict[str, Dict[str, Any]] = {
 }
 
 
-def _generate(spec: Dict[str, Any], n: int, seed: int, scale_override: int = 0):
+def _generate(spec: Dict[str, Any], n: int, seed: int, scale_override: int = 0,
+              proto_seed: int = 0):
     kind = spec["kind"]
     n = int(scale_override or n)
     if kind in ("image", "feature"):
-        return synthetic.make_classification(n, spec["classes"], tuple(spec["shape"]), seed=seed)
+        return synthetic.make_classification(
+            n, spec["classes"], tuple(spec["shape"]), seed=seed, proto_seed=proto_seed
+        )
     if kind == "nwp":
-        return synthetic.make_next_token_corpus(n, int(spec["shape"][0]), spec["vocab"], seed=seed)
+        return synthetic.make_next_token_corpus(
+            n, int(spec["shape"][0]), spec["vocab"], seed=seed, proto_seed=proto_seed
+        )
     if kind == "taglr":
-        x, y = synthetic.make_classification(n, spec["classes"], (64,), seed=seed)
-        # sparse bag-of-words style expansion
-        rngl = np.random.RandomState(seed + 1)
+        x, y = synthetic.make_classification(
+            n, spec["classes"], (64,), seed=seed, proto_seed=proto_seed
+        )
+        # sparse bag-of-words style expansion; projection is part of the
+        # "distribution" so it derives from proto_seed (shared train/test)
+        rngl = np.random.RandomState(proto_seed + 1)
         proj = rngl.randn(64, spec["shape"][0]).astype(np.float32)
         return (x @ proj > 1.0).astype(np.float32), y
     raise ValueError(kind)
@@ -84,8 +92,10 @@ def load_centralized(args) -> Dict[str, Any]:
         logger.info("loaded real %s from %s", name, cache)
     else:
         scale = int(getattr(args, "synthetic_train_size", 0))
-        x_train, y_train = _generate(spec, spec["train"], seed, scale)
-        x_test, y_test = _generate(spec, spec["test"], seed + 10_000, scale // 5 if scale else 0)
+        x_train, y_train = _generate(spec, spec["train"], seed, scale, proto_seed=seed)
+        x_test, y_test = _generate(
+            spec, spec["test"], seed + 10_000, scale // 5 if scale else 0, proto_seed=seed
+        )
         args.dataset_is_synthetic = True
         logger.info("generated synthetic %s (no cached files under %r)", name, cache)
     return dict(

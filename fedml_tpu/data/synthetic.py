@@ -24,12 +24,18 @@ def make_classification(
     seed: int = 0,
     noise: float = 0.35,
     dirichlet_label_skew: float = 0.0,
+    proto_seed: int = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Class-prototype + gaussian-noise images/features, labels uniform (or
-    Dir-skewed when ``dirichlet_label_skew`` > 0)."""
+    Dir-skewed when ``dirichlet_label_skew`` > 0).
+
+    ``proto_seed`` fixes the class prototypes independently of the sample
+    seed so train and test splits share one distribution (pass the same
+    proto_seed with different ``seed``)."""
     rng = np.random.RandomState(seed)
+    proto_rng = np.random.RandomState(seed if proto_seed is None else proto_seed)
     dim = int(np.prod(feature_shape))
-    protos = rng.randn(num_classes, dim).astype(np.float32)
+    protos = proto_rng.randn(num_classes, dim).astype(np.float32)
     # low-frequency structure: smooth prototypes so convs have something to find
     if len(feature_shape) >= 2:
         h, w = feature_shape[0], feature_shape[1]
@@ -69,13 +75,15 @@ def make_sequence_classification(
 
 
 def make_next_token_corpus(
-    n: int, seq_len: int, vocab_size: int, seed: int = 0
+    n: int, seq_len: int, vocab_size: int, seed: int = 0, proto_seed: int = None
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Markov-chain token streams for next-word-prediction tasks: x=[n,L],
-    y=[n,L] (x shifted by one)."""
+    y=[n,L] (x shifted by one).  ``proto_seed`` fixes the transition matrix
+    (the "language") independently of the sampled sequences."""
     rng = np.random.RandomState(seed)
+    proto_rng = np.random.RandomState(seed if proto_seed is None else proto_seed)
     # sparse row-stochastic transition matrix with strong structure
-    trans = rng.dirichlet(np.full(vocab_size, 0.05), size=vocab_size)
+    trans = proto_rng.dirichlet(np.full(vocab_size, 0.05), size=vocab_size)
     seqs = np.empty((n, seq_len + 1), dtype=np.int32)
     state = rng.randint(0, vocab_size, size=n)
     seqs[:, 0] = state

@@ -29,6 +29,7 @@ class LocalTrainResult(NamedTuple):
     variables: Pytree
     loss: jnp.ndarray  # mean masked loss over the run
     seen: jnp.ndarray  # number of (valid) samples processed
+    steps: Any = 0.0  # effective optimizer steps (FedNova tau_i)
 
 
 def make_optimizer(args) -> optax.GradientTransformation:
@@ -79,17 +80,32 @@ def build_local_train(
     padded_n: int,
     epochs: Optional[int] = None,
     has_dropout: bool = True,
-) -> Callable[[Pytree, jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array], LocalTrainResult]:
+    grad_hook: Optional[Callable] = None,
+) -> Callable[..., LocalTrainResult]:
     """Build the PURE local-training function (not jitted — composable inside
     shard_map/scan in the XLA simulator).
 
-    Returned fn: ``(variables, x [padded_n,...], y [padded_n], n_valid, rng)
-    -> LocalTrainResult``.  Data must be valid-first; indices >= n_valid are
-    padding and masked out of loss/gradients.
+    Returned fn: ``(variables, x [padded_n,...], y [padded_n], n_valid, rng,
+    extra=None) -> LocalTrainResult``.  Data must be valid-first; indices >=
+    n_valid are padding and masked out of loss/gradients.
+
+    ``grad_hook(grads, params, anchor, extra) -> grads`` runs per step, where
+    ``anchor`` is the round-start params.  This one hook expresses the local
+    variants of the algorithm zoo: FedProx (g + mu*(p - anchor)), SCAFFOLD
+    (g - c_i + c from ``extra``), FedDyn (g - h_i + alpha*(p - anchor)) —
+    cf. reference fedprox/fednova trainer subclasses (SURVEY.md §2.5).
+    ``args.proximal_mu`` > 0 installs the FedProx hook automatically.
     """
     tx = make_optimizer(args)
     epochs = int(epochs if epochs is not None else getattr(args, "epochs", 1))
     steps_per_epoch = max(1, -(-padded_n // batch_size))
+
+    mu = float(getattr(args, "proximal_mu", 0.0) or 0.0)
+    if grad_hook is None and mu > 0:
+        def grad_hook(grads, params, anchor, extra):  # noqa: F811 - FedProx
+            return jax.tree_util.tree_map(
+                lambda g, p, a: g + mu * (p - a), grads, params, anchor
+            )
 
     def loss_fn(params, other_vars, bx, by, bmask, rng):
         variables = dict(other_vars, params=params)
@@ -105,18 +121,19 @@ def build_local_train(
         loss, _ = softmax_ce_loss(logits, by, bmask)
         return loss, updated
 
-    def train(variables, x, y, n_valid, rng) -> LocalTrainResult:
+    def train(variables, x, y, n_valid, rng, extra=None) -> LocalTrainResult:
         params = variables["params"]
+        anchor = params
         other = {k: v for k, v in variables.items() if k != "params"}
         opt_state = tx.init(params)
         n_valid = jnp.asarray(n_valid, jnp.int32)
 
         def epoch_body(carry, ek):
-            params, other, opt_state, loss_sum, cnt_sum = carry
+            params, other, opt_state, loss_sum, cnt_sum, step_cnt = carry
             perm = jax.random.permutation(jax.random.fold_in(ek, 0), padded_n)
 
             def step_body(c, sk_i):
-                params, other, opt_state, lsum, csum = c
+                params, other, opt_state, lsum, csum, scnt = c
                 sk, i = sk_i
                 idx = jax.lax.dynamic_slice_in_dim(perm, i * batch_size, batch_size)
                 bx = jnp.take(x, idx, axis=0)
@@ -125,6 +142,8 @@ def build_local_train(
                 (loss, updated), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                     params, other, bx, by, bmask, sk
                 )
+                if grad_hook is not None:
+                    grads = grad_hook(grads, params, anchor, extra)
                 # Zero the step entirely if the batch is all padding.
                 any_valid = jnp.sum(bmask) > 0
                 updates, new_opt = tx.update(grads, opt_state, params)
@@ -139,22 +158,25 @@ def build_local_train(
                     other = jax.tree_util.tree_map(
                         lambda new, old: jnp.where(any_valid, new, old), updated, other
                     )
-                return (params, other, opt_state, lsum + loss * jnp.sum(bmask), csum + jnp.sum(bmask)), None
+                scnt = scnt + any_valid.astype(jnp.float32)
+                return (params, other, opt_state, lsum + loss * jnp.sum(bmask), csum + jnp.sum(bmask), scnt), None
 
             step_keys = jax.random.split(jax.random.fold_in(ek, 1), steps_per_epoch)
-            (params, other, opt_state, loss_sum, cnt_sum), _ = jax.lax.scan(
+            (params, other, opt_state, loss_sum, cnt_sum, step_cnt), _ = jax.lax.scan(
                 step_body,
-                (params, other, opt_state, loss_sum, cnt_sum),
+                (params, other, opt_state, loss_sum, cnt_sum, step_cnt),
                 (step_keys, jnp.arange(steps_per_epoch)),
             )
-            return (params, other, opt_state, loss_sum, cnt_sum), None
+            return (params, other, opt_state, loss_sum, cnt_sum, step_cnt), None
 
         epoch_keys = jax.random.split(rng, epochs)
-        (params, other, opt_state, loss_sum, cnt_sum), _ = jax.lax.scan(
-            epoch_body, (params, other, opt_state, 0.0, 0.0), epoch_keys
+        (params, other, opt_state, loss_sum, cnt_sum, step_cnt), _ = jax.lax.scan(
+            epoch_body, (params, other, opt_state, 0.0, 0.0, 0.0), epoch_keys
         )
         out_vars = dict(other, params=params)
-        return LocalTrainResult(out_vars, loss_sum / jnp.maximum(cnt_sum, 1.0), cnt_sum)
+        return LocalTrainResult(
+            out_vars, loss_sum / jnp.maximum(cnt_sum, 1.0), cnt_sum, step_cnt
+        )
 
     return train
 

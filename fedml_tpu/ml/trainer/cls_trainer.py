@@ -17,10 +17,11 @@ from ..engine.train import make_eval_fn, make_local_train_fn, pad_to
 
 
 class ModelTrainerCLS(ClientTrainer):
-    def __init__(self, model, args):
+    def __init__(self, model, args, grad_hook=None):
         super().__init__(model, args)
         self.module = model
         self.variables = None
+        self.grad_hook = grad_hook  # per-step gradient transform (FedProx/SCAFFOLD/FedDyn)
         self._train_fns: Dict[Tuple[int, int], Any] = {}  # (padded_n, bs) -> fn
         self._eval_fn = make_eval_fn(model)
         self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
@@ -34,8 +35,12 @@ class ModelTrainerCLS(ClientTrainer):
     def _fn_for(self, padded_n: int, batch_size: int):
         key = (padded_n, batch_size)
         if key not in self._train_fns:
-            self._train_fns[key] = make_local_train_fn(
-                self.module, self.args, batch_size, padded_n
+            from ..engine.train import build_local_train
+
+            self._train_fns[key] = jax.jit(
+                build_local_train(
+                    self.module, self.args, batch_size, padded_n, grad_hook=self.grad_hook
+                )
             )
         return self._train_fns[key]
 
@@ -49,7 +54,7 @@ class ModelTrainerCLS(ClientTrainer):
             bucket *= 2
         return bucket
 
-    def train(self, train_data, device, args):
+    def train(self, train_data, device, args, extra=None):
         x, y = train_data
         n = len(y)
         bs = int(getattr(args, "batch_size", 32))
@@ -58,8 +63,9 @@ class ModelTrainerCLS(ClientTrainer):
         self.rng, sub = jax.random.split(self.rng)
         xp = pad_to(jnp.asarray(x), padded_n)
         yp = pad_to(jnp.asarray(y), padded_n)
-        result = fn(self.variables, xp, yp, n, sub)
+        result = fn(self.variables, xp, yp, n, sub, extra)
         self.variables = result.variables
+        self.last_result = result
         return result
 
     def test(self, test_data, device, args):

@@ -128,10 +128,7 @@ class FedAvgAPI:
                 w = client.train(self.w_global)
                 w_locals.append((float(client.local_sample_number), w))
 
-            # server hooks: attack injection -> defense -> aggregate -> DP
-            w_locals = self.aggregator.on_before_aggregation(w_locals)
-            self.w_global = self.aggregator.aggregate(w_locals)
-            self.w_global = self.aggregator.on_after_aggregation(self.w_global)
+            self.w_global = self.server_update(w_locals)
             self.aggregator.set_model_params(self.w_global)
 
             dt = time.time() - t0
@@ -140,6 +137,13 @@ class FedAvgAPI:
             if round_idx % freq == 0 or round_idx == comm_round - 1:
                 last_metrics = self._test_global(round_idx)
         return last_metrics
+
+    def server_update(self, w_locals: List[Tuple[float, Any]]) -> Any:
+        """Aggregation step with hooks at reference positions; the override
+        point for the algorithm zoo (FedOpt/FedNova/... subclass this)."""
+        w_locals = self.aggregator.on_before_aggregation(w_locals)
+        w_global = self.aggregator.aggregate(w_locals)
+        return self.aggregator.on_after_aggregation(w_global)
 
     def _test_global(self, round_idx: int) -> Dict[str, Any]:
         stats = self.aggregator.test(self.test_data_global, self.device, self.args)

@@ -1,0 +1,69 @@
+"""FedDyn: dynamic regularization (Acar et al.).
+
+Beyond-reference algorithm: each client keeps a lagrangian-style state h_i;
+the local gradient is g - h_i + alpha*(w - w_global) (the engine's grad_hook
+with extra=h_i), after training h_i <- h_i - alpha*(w_i - w_global), and the
+server average subtracts the population-mean h over alpha.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+from ....core.aggregate import tree_zeros_like, weighted_mean
+from ....ml.trainer.cls_trainer import ModelTrainerCLS
+from ..fedavg.fedavg_api import FedAvgAPI
+
+
+class FedDynAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model):
+        super().__init__(args, device, dataset, model)
+        self.alpha = float(getattr(args, "feddyn_alpha", 0.01))
+        alpha = self.alpha
+
+        def hook(grads, params, anchor, extra):
+            return jax.tree_util.tree_map(
+                lambda g, h, p, a: g - h + alpha * (p - a), grads, extra, params, anchor
+            )
+
+        self.trainer = ModelTrainerCLS(model, args, grad_hook=hook)
+        self.client_list = []
+        self._setup_clients()
+        self.h_clients: Dict[int, Any] = {}
+        self.h_mean = tree_zeros_like(self.w_global["params"])
+
+    def _setup_clients(self):
+        super()._setup_clients()
+        for c in self.client_list:
+            c.train = self._client_train(c)
+
+    def _client_train(self, client):
+        def run(w_global):
+            cid = client.client_idx
+            h_i = self.h_clients.get(cid)
+            if h_i is None:
+                h_i = tree_zeros_like(w_global["params"])
+            self.trainer.set_model_params(w_global)
+            res = self.trainer.train(client.local_training_data, None, self.args, extra=h_i)
+            self.h_clients[cid] = jax.tree_util.tree_map(
+                lambda h, wi, wg: h - self.alpha * (wi - wg),
+                h_i, res.variables["params"], w_global["params"],
+            )
+            return res.variables
+
+        return run
+
+    def server_update(self, w_locals: List[Tuple[float, Any]]) -> Any:
+        w_locals = self.aggregator.on_before_aggregation(w_locals)
+        avg = weighted_mean(w_locals)
+        if self.h_clients:
+            n_total = float(self.args.client_num_in_total)
+            self.h_mean = jax.tree_util.tree_map(
+                lambda *xs: sum(xs) / n_total, *self.h_clients.values()
+            )
+        new_params = jax.tree_util.tree_map(
+            lambda p, h: p - h / self.alpha, avg["params"], self.h_mean
+        )
+        return self.aggregator.on_after_aggregation(dict(avg, params=new_params))

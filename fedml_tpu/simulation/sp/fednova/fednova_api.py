@@ -1,0 +1,64 @@
+"""FedNova: normalized averaging (Wang et al.).
+
+Parity with reference ``simulation/sp/fednova`` / ``mpi/fednova``: each
+client's cumulative update is normalized by its effective local step count
+tau_i before averaging, removing objective inconsistency under heterogeneous
+local work:  w <- w - tau_eff * sum_i p_i * d_i,  d_i = (w - w_i) / tau_i,
+tau_eff = sum_i p_i * tau_i.  tau_i comes from the engine
+(LocalTrainResult.steps — masked steps actually taken).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+
+from ....core.aggregate import tree_scale, tree_sum
+from ..fedavg.fedavg_api import FedAvgAPI
+
+
+class FedNovaAPI(FedAvgAPI):
+    def _collect_tau(self) -> float:
+        res = getattr(self.trainer, "last_result", None)
+        return float(res.steps) if res is not None else 1.0
+
+    def server_update(self, w_locals: List[Tuple[float, Any]]) -> Any:
+        # taus recorded in collection order == w_locals order (shared trainer);
+        # pair them BEFORE the defense filter so a filtered subset keeps the
+        # right tau for each surviving update
+        tau_by_id = {id(w): t for (_, w), t in zip(w_locals, self._round_taus)}
+        w_locals = self.aggregator.on_before_aggregation(w_locals)
+        taus = [tau_by_id.get(id(w), 1.0) for _, w in w_locals]
+        total_n = sum(n for n, _ in w_locals)
+        ps = [n / total_n for n, _ in w_locals]
+        tau_eff = sum(p * t for p, t in zip(ps, taus))
+        normalized = []
+        for (n, w_i), p, tau in zip(w_locals, ps, taus):
+            d_i = jax.tree_util.tree_map(
+                lambda g, wi: (g - wi) / max(tau, 1.0), self.w_global, w_i
+            )
+            normalized.append(tree_scale(d_i, p))
+        d = tree_sum(normalized)
+        new_global = jax.tree_util.tree_map(
+            lambda g, di: g - tau_eff * di, self.w_global, d
+        )
+        return self.aggregator.on_after_aggregation(new_global)
+
+    # capture tau right after each client's training by wrapping the slot call
+    def _setup_clients(self):
+        super()._setup_clients()
+        api = self
+        for c in self.client_list:
+            orig_train = c.train
+
+            def wrapped(w_global, _orig=orig_train, _c=c):
+                out = _orig(w_global)
+                api._round_taus.append(api._collect_tau())
+                return out
+
+            c.train = wrapped
+
+    def _client_sampling(self, round_idx):
+        self._round_taus: List[float] = []
+        return super()._client_sampling(round_idx)

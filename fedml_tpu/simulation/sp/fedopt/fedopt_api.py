@@ -1,0 +1,58 @@
+"""FedOpt: server-side adaptive optimization (Reddi et al.).
+
+Capability parity with reference ``simulation/sp/fedopt/fedopt_api.py``:
+clients run plain local SGD; the server treats the weighted-average client
+delta as a pseudo-gradient and applies a server optimizer
+(``server_optimizer`` ∈ sgd/adam/yogi/adagrad, ``server_lr``, ``server_momentum``).
+Implemented with optax over the params pytree (non-param collections, e.g.
+batch_stats, are plainly averaged).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import optax
+
+from ....core.aggregate import weighted_mean
+from ..fedavg.fedavg_api import FedAvgAPI
+
+
+def make_server_optimizer(args) -> optax.GradientTransformation:
+    name = str(getattr(args, "server_optimizer", "adam")).lower()
+    lr = float(getattr(args, "server_lr", 1e-1))
+    momentum = float(getattr(args, "server_momentum", 0.9))
+    if name == "sgd":
+        return optax.sgd(lr, momentum=momentum if momentum > 0 else None)
+    if name == "adam":
+        return optax.adam(lr, b1=0.9, b2=0.99, eps=1e-3)
+    if name == "yogi":
+        return optax.yogi(lr, b1=0.9, b2=0.99, eps=1e-3)
+    if name == "adagrad":
+        return optax.adagrad(lr)
+    raise ValueError(f"unknown server_optimizer {name!r}")
+
+
+class FedOptAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model):
+        super().__init__(args, device, dataset, model)
+        self._server_tx = make_server_optimizer(args)
+        self._server_opt_state = self._server_tx.init(self.w_global["params"])
+
+        @jax.jit
+        def apply_server_update(params, opt_state, avg_params):
+            pseudo_grad = jax.tree_util.tree_map(lambda p, a: p - a, params, avg_params)
+            updates, opt_state = self._server_tx.update(pseudo_grad, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply_server_update = apply_server_update
+
+    def server_update(self, w_locals: List[Tuple[float, Any]]) -> Any:
+        w_locals = self.aggregator.on_before_aggregation(w_locals)
+        avg = weighted_mean(w_locals)
+        params, self._server_opt_state = self._apply_server_update(
+            self.w_global["params"], self._server_opt_state, avg["params"]
+        )
+        new_global = dict(avg, params=params)
+        return self.aggregator.on_after_aggregation(new_global)

@@ -1,0 +1,18 @@
+"""FedProx: FedAvg + proximal term in the local objective (Li et al.).
+
+Parity with reference ``simulation/mpi/fedprox/``: the client loss gains
+mu/2 * ||w - w_global||^2.  Here that is the engine's ``grad_hook``
+(g + mu*(w - anchor)), installed automatically when ``args.proximal_mu`` > 0
+— see ml/engine/train.build_local_train.
+"""
+
+from __future__ import annotations
+
+from ..fedavg.fedavg_api import FedAvgAPI
+
+
+class FedProxAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model):
+        if not float(getattr(args, "proximal_mu", 0.0) or 0.0):
+            args.proximal_mu = 0.1  # sensible default when FedProx selected
+        super().__init__(args, device, dataset, model)

@@ -1,0 +1,63 @@
+"""Hierarchical FL (reference ``simulation/sp/hierarchical_fl``, 244 LoC):
+two-level averaging — clients -> group aggregation every round, group models
+-> global average every ``group_comm_round`` rounds.  Maps onto the
+hierarchical cross-silo scenario (silo = group).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ....core.aggregate import weighted_mean
+from ..fedavg.fedavg_api import FedAvgAPI
+
+logger = logging.getLogger(__name__)
+
+
+class HierarchicalFLAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model):
+        super().__init__(args, device, dataset, model)
+        self.group_num = int(getattr(args, "group_num", 2))
+        self.group_comm_round = int(getattr(args, "group_comm_round", 2))
+        rng = np.random.RandomState(int(getattr(args, "random_seed", 0)))
+        ids = rng.permutation(int(args.client_num_in_total))
+        self.groups = np.array_split(ids, self.group_num)
+        # each group's current model starts at global
+        self.group_models: List[Any] = [self.w_global for _ in range(self.group_num)]
+
+    def train(self) -> Dict[str, Any]:
+        comm_round = int(self.args.comm_round)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        per_group = max(1, int(self.args.client_num_per_round) // self.group_num)
+        slot = self.client_list[0]
+        last: Dict[str, Any] = {}
+        for round_idx in range(comm_round):
+            for g, members in enumerate(self.groups):
+                rng = np.random.RandomState(
+                    int(getattr(self.args, "random_seed", 0)) * 100003 + round_idx * 131 + g
+                )
+                chosen = rng.choice(members, min(per_group, len(members)), replace=False)
+                w_locals: List[Tuple[float, Any]] = []
+                for cid in chosen:
+                    cid = int(cid)
+                    slot.update_local_dataset(
+                        cid,
+                        self.train_data_local_dict[cid],
+                        self.test_data_local_dict[cid],
+                        self.train_data_local_num_dict[cid],
+                    )
+                    w = slot.train(self.group_models[g])
+                    w_locals.append((float(slot.local_sample_number), w))
+                self.group_models[g] = weighted_mean(w_locals)
+            if (round_idx + 1) % self.group_comm_round == 0:
+                sizes = [float(sum(self.train_data_local_num_dict[int(c)] for c in m)) for m in self.groups]
+                self.w_global = weighted_mean(list(zip(sizes, self.group_models)))
+                self.w_global = self.aggregator.on_after_aggregation(self.w_global)
+                self.aggregator.set_model_params(self.w_global)
+                self.group_models = [self.w_global for _ in range(self.group_num)]
+            if round_idx % freq == 0 or round_idx == comm_round - 1:
+                last = self._test_global(round_idx)
+        return last

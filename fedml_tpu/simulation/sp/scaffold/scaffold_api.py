@@ -1,0 +1,75 @@
+"""SCAFFOLD: stochastic controlled averaging (Karimireddy et al.).
+
+Beyond-reference algorithm (constant registered in fedml_tpu.constants):
+per-client control variates c_i and server control c correct client drift:
+the local step uses g - c_i + c (the engine's grad_hook with
+extra=(c_i, c)); after K local steps, c_i^+ = c_i - c + (w_g - w_i)/(K*lr),
+and the server updates w and c from the aggregated deltas.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+from ....core.aggregate import tree_sub, tree_sum, tree_zeros_like
+from ....ml.trainer.cls_trainer import ModelTrainerCLS
+from ..fedavg.fedavg_api import FedAvgAPI
+
+
+def _scaffold_hook(grads, params, anchor, extra):
+    c_i, c = extra
+    return jax.tree_util.tree_map(lambda g, ci, cg: g - ci + cg, grads, c_i, c)
+
+
+class ScaffoldAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model):
+        super().__init__(args, device, dataset, model)
+        # swap in a grad-hooked trainer and rebind the client slots to it
+        self.trainer = ModelTrainerCLS(model, args, grad_hook=_scaffold_hook)
+        self.client_list = []
+        self._setup_clients()
+        self.lr = float(getattr(args, "learning_rate", 0.01))
+        self.c_server = tree_zeros_like(self.w_global["params"])
+        self.c_clients: Dict[int, Any] = {}
+
+    def _setup_clients(self):
+        super()._setup_clients()
+        for c in self.client_list:
+            c.train = self._client_train(c)
+
+    def _client_train(self, client):
+        def run(w_global):
+            cid = client.client_idx
+            c_i = self.c_clients.get(cid)
+            if c_i is None:
+                c_i = tree_zeros_like(w_global["params"])
+            self.trainer.set_model_params(w_global)
+            res = self.trainer.train(
+                client.local_training_data, None, self.args, extra=(c_i, self.c_server)
+            )
+            K = max(float(res.steps), 1.0)
+            new_ci = jax.tree_util.tree_map(
+                lambda ci, cg, wg, wi: ci - cg + (wg - wi) / (K * self.lr),
+                c_i, self.c_server, w_global["params"], res.variables["params"],
+            )
+            self._round_dc.append(tree_sub(new_ci, c_i))
+            self.c_clients[cid] = new_ci
+            return res.variables
+
+        return run
+
+    def _client_sampling(self, round_idx):
+        self._round_dc: List[Any] = []
+        return super()._client_sampling(round_idx)
+
+    def server_update(self, w_locals: List[Tuple[float, Any]]) -> Any:
+        new_global = super().server_update(w_locals)
+        if self._round_dc:  # c <- c + (1/N) * sum_i dc_i
+            dc = tree_sum(self._round_dc)
+            scale = 1.0 / float(self.args.client_num_in_total)
+            self.c_server = jax.tree_util.tree_map(
+                lambda c, d: c + scale * d, self.c_server, dc
+            )
+        return new_global
