@@ -1,0 +1,5 @@
+from .broker import LocalBroker, BrokerClient
+from .blob_store import BlobStore
+from .mqtt_s3_comm_manager import MqttS3CommManager
+
+__all__ = ["LocalBroker", "BrokerClient", "BlobStore", "MqttS3CommManager"]

@@ -1,0 +1,41 @@
+"""File-backed blob store — the S3 data plane of the MQTT+S3 transport.
+
+Parity with reference ``core/distributed/communication/s3/remote_storage.py``
+(``S3Storage.write_model``/``read_model``): model pytrees never ride the
+control plane; they are written as blobs and the control message carries
+``model_params_url``.  Backed by a shared directory (NFS/local disk); the URL
+scheme is ``file://``.  A real S3 backend would slot in behind the same two
+methods (boto3 is deliberately not a dependency).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import uuid
+from typing import Any
+
+from ..serialization import device_get_tree
+
+
+class BlobStore:
+    def __init__(self, root: str | None = None):
+        self.root = root or os.path.join(tempfile.gettempdir(), "fedml_tpu_blobs")
+        os.makedirs(self.root, exist_ok=True)
+
+    def write_model(self, key: str, pytree: Any) -> str:
+        """Write and return a ``file://`` URL (reference ``remote_storage.py:42``)."""
+        name = f"{key}-{uuid.uuid4().hex}.pkl"
+        path = os.path.join(self.root, name)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(device_get_tree(pytree), f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)  # atomic publish
+        return f"file://{path}"
+
+    def read_model(self, url: str) -> Any:
+        """Read back a blob by URL (reference ``remote_storage.py:63``)."""
+        assert url.startswith("file://"), f"unsupported blob url {url!r}"
+        with open(url[len("file://"):], "rb") as f:
+            return pickle.load(f)

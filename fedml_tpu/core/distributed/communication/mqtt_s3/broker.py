@@ -1,0 +1,222 @@
+"""Minimal MQTT-style pub/sub broker over TCP (control plane).
+
+The reference's production transport is a hosted MQTT broker
+(``core/distributed/communication/mqtt/mqtt_manager.py``).  paho-mqtt is not in
+this image, and a hosted broker is an external dependency anyway — so the
+rebuild ships its own tiny broker implementing the slice of MQTT the FL
+protocol actually uses:
+
+* topic publish/subscribe with trailing-``#`` prefix wildcards,
+* QoS0 delivery,
+* last-will messages published when a client's socket dies without a clean
+  DISCONNECT (liveness parity with the reference's last-will/active-status
+  topics, ``mqtt_s3_multi_clients_comm_manager.py:325-352``).
+
+Wire format: 4-byte big-endian length + pickled dict frames.  The broker is a
+plain threaded TCP server so true multi-process cross-silo runs work on one
+host or across hosts.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def topic_matches(pattern: str, topic: str) -> bool:
+    if pattern.endswith("#"):
+        return topic.startswith(pattern[:-1])
+    return pattern == topic
+
+
+class LocalBroker:
+    """Threaded TCP pub/sub broker. ``LocalBroker().start()`` → ``.port``."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server_sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        # conn -> (subscriptions, last_will)
+        self._clients: Dict[socket.socket, Tuple[List[str], Optional[dict]]] = {}
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "LocalBroker":
+        self._server_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server_sock.bind((self.host, self.port))
+        self.port = self._server_sock.getsockname()[1]
+        self._server_sock.listen(128)
+        self._running = True
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="broker-accept")
+        self._thread.start()
+        logger.info("local broker on %s:%s", self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            for conn in list(self._clients):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._clients.clear()
+
+    # -- internals ----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        assert self._server_sock is not None
+        while self._running:
+            try:
+                conn, _ = self._server_sock.accept()
+            except OSError:
+                break
+            with self._lock:
+                self._clients[conn] = ([], None)
+            threading.Thread(
+                target=self._client_loop, args=(conn,), daemon=True, name="broker-client"
+            ).start()
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        clean = False
+        while self._running:
+            frame = _recv_frame(conn)
+            if frame is None:
+                break
+            op = frame.get("op")
+            if op == "SUB":
+                with self._lock:
+                    subs, will = self._clients.get(conn, ([], None))
+                    subs.append(str(frame["topic"]))
+                    self._clients[conn] = (subs, will)
+            elif op == "UNSUB":
+                with self._lock:
+                    subs, will = self._clients.get(conn, ([], None))
+                    subs = [s for s in subs if s != str(frame["topic"])]
+                    self._clients[conn] = (subs, will)
+            elif op == "PUB":
+                self._publish(str(frame["topic"]), frame.get("payload"))
+            elif op == "WILL":
+                with self._lock:
+                    subs, _ = self._clients.get(conn, ([], None))
+                    self._clients[conn] = (subs, {"topic": str(frame["topic"]), "payload": frame.get("payload")})
+            elif op == "DISCONNECT":
+                clean = True
+                break
+        # fire last will on unclean death (MQTT parity)
+        with self._lock:
+            _, will = self._clients.pop(conn, ([], None))
+        try:
+            conn.close()
+        except OSError:
+            pass
+        if not clean and will is not None and self._running:
+            self._publish(will["topic"], will["payload"])
+
+    def _publish(self, topic: str, payload) -> None:
+        with self._lock:
+            targets = [
+                c for c, (subs, _) in self._clients.items()
+                if any(topic_matches(p, topic) for p in subs)
+            ]
+        dead = []
+        for c in targets:
+            try:
+                _send_frame(c, {"op": "MSG", "topic": topic, "payload": payload})
+            except OSError:
+                dead.append(c)
+        for c in dead:
+            with self._lock:
+                self._clients.pop(c, None)
+
+
+class BrokerClient:
+    """Client for :class:`LocalBroker` with paho-like callback semantics."""
+
+    def __init__(self, host: str, port: int, on_message: Callable[[str, object], None]):
+        self._sock = socket.create_connection((host, port), timeout=30)
+        self._sock.settimeout(None)
+        self.on_message = on_message
+        self._running = True
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._recv_loop, daemon=True, name="broker-recv")
+        self._thread.start()
+
+    def subscribe(self, topic: str) -> None:
+        with self._lock:
+            _send_frame(self._sock, {"op": "SUB", "topic": topic})
+
+    def unsubscribe(self, topic: str) -> None:
+        with self._lock:
+            _send_frame(self._sock, {"op": "UNSUB", "topic": topic})
+
+    def publish(self, topic: str, payload) -> None:
+        with self._lock:
+            _send_frame(self._sock, {"op": "PUB", "topic": topic, "payload": payload})
+
+    def set_last_will(self, topic: str, payload) -> None:
+        with self._lock:
+            _send_frame(self._sock, {"op": "WILL", "topic": topic, "payload": payload})
+
+    def disconnect(self) -> None:
+        self._running = False
+        try:
+            with self._lock:
+                _send_frame(self._sock, {"op": "DISCONNECT"})
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _recv_loop(self) -> None:
+        while self._running:
+            frame = _recv_frame(self._sock)
+            if frame is None:
+                break
+            if frame.get("op") == "MSG":
+                try:
+                    self.on_message(str(frame["topic"]), frame.get("payload"))
+                except Exception:
+                    logger.exception("broker client on_message raised")

@@ -1,0 +1,150 @@
+"""MQTT+S3 transport: control plane over the pub/sub broker, tensor payloads
+over the blob store.
+
+Parity with reference ``mqtt_s3_multi_clients_comm_manager.py:20-352``:
+
+* per-pair topics ``fedml_{run_id}_{sender}_{receiver}``; each rank subscribes
+  to ``fedml_{run_id}_*_{rank}`` (prefix wildcard),
+* any ``model_params`` value in an outbound message is swapped for a
+  ``model_params_url`` blob reference before publish (control/data split,
+  reference ``:214-284``); inbound messages hydrate the blob back so handlers
+  always see in-memory pytrees (reference ``:182-208``),
+* last-will + active-status topics for liveness (reference ``:325-352``).
+
+MNN mode (``mnn_mode=True``) keeps the blob as a *file path* in the message
+(``model_params_file``) instead of hydrating it — matching the reference's
+mqtt_s3_mnn variant where the payload is a serialized model file consumed by
+the mobile runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+from typing import List
+
+from ..base_com_manager import BaseCommunicationManager, Observer
+from ..message import Message
+from .blob_store import BlobStore
+from .broker import BrokerClient
+
+logger = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+class MqttS3CommManager(BaseCommunicationManager):
+    def __init__(
+        self,
+        args=None,
+        topic: str = "fedml",
+        client_rank: int = 0,
+        client_num: int = 0,
+        mnn_mode: bool = False,
+    ):
+        self.run_id = str(topic)
+        self.rank = int(client_rank)
+        self.client_num = int(client_num)
+        self.mnn_mode = bool(mnn_mode)
+        host = str(getattr(args, "mqtt_host", "127.0.0.1"))
+        port = int(getattr(args, "mqtt_port", 0))
+        if port == 0:
+            raise ValueError(
+                "MQTT_S3 backend needs args.mqtt_port (start a "
+                "fedml_tpu...mqtt_s3.broker.LocalBroker and pass its port)"
+            )
+        blob_root = getattr(args, "s3_blob_root", None)
+        self.blob_store = BlobStore(blob_root)
+        self._observers: List[Observer] = []
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._running = False
+
+        self._client = BrokerClient(host, port, self._on_broker_message)
+        # liveness parity: last-will marks this rank offline if the socket dies
+        self._client.set_last_will(
+            self._status_topic(), json.dumps({"rank": self.rank, "status": "OFFLINE"})
+        )
+        self._client.subscribe(self._recv_pattern())
+
+    # -- topics -------------------------------------------------------------
+    def _topic(self, sender: int, receiver: int) -> str:
+        return f"fedml_{self.run_id}_{sender}_{receiver}"
+
+    def _recv_pattern(self) -> str:
+        # trailing-# prefix wildcard; precise receiver filtering happens in
+        # _on_broker_message (topic tail parse)
+        return f"fedml_{self.run_id}_#"
+
+    def _status_topic(self) -> str:
+        return f"fedml_{self.run_id}_status"
+
+    # -- BaseCommunicationManager -------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        params = dict(msg.get_params())
+        model_params = params.pop(Message.MSG_ARG_KEY_MODEL_PARAMS, None)
+        if model_params is not None:
+            key = f"{self.run_id}-r{self.rank}-{msg.get_type()}"
+            url = self.blob_store.write_model(key, model_params)
+            params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = url
+        topic = self._topic(int(msg.get_sender_id()), int(msg.get_receiver_id()))
+        self._client.publish(topic, params)
+
+    def broadcast_status(self, status: str) -> None:
+        self._client.publish(
+            self._status_topic(), json.dumps({"rank": self.rank, "status": status})
+        )
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        ready = Message(type="connection_ready", sender_id=self.rank, receiver_id=self.rank)
+        self._notify(ready)
+        while self._running:
+            item = self._inbox.get()
+            if item is _STOP:
+                break
+            self._notify(item)
+        self._client.disconnect()
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._inbox.put(_STOP)
+
+    # -- internals ----------------------------------------------------------
+    def _on_broker_message(self, topic: str, payload) -> None:
+        if topic == self._status_topic():
+            return  # status topic is observed by managers via their own sub
+        # topic = fedml_{run_id}_{sender}_{receiver}
+        parts = topic.rsplit("_", 2)
+        if len(parts) != 3:
+            return
+        try:
+            receiver = int(parts[2])
+        except ValueError:
+            return
+        if receiver != self.rank:
+            return
+        params = dict(payload)
+        url = params.get(Message.MSG_ARG_KEY_MODEL_PARAMS_URL)
+        if url is not None and not self.mnn_mode:
+            # hydrate data plane (reference mqtt_s3...:182-208)
+            params[Message.MSG_ARG_KEY_MODEL_PARAMS] = self.blob_store.read_model(url)
+        msg = Message()
+        msg.init(params)
+        self._inbox.put(msg)
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            try:
+                obs.receive_message(msg.get_type(), msg)
+            except Exception:
+                logger.exception(
+                    "mqtt_s3 rank %s: handler for msg_type=%r raised", self.rank, msg.get_type()
+                )

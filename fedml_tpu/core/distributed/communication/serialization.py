@@ -1,0 +1,33 @@
+"""Host-side payload serialization helpers shared by the wire backends.
+
+The reference pickles torch state dicts straight onto the wire (grpc backend
+``grpc_comm_manager.py:78-108``, mqtt_s3 S3 pickle).  Here payloads are jax
+pytrees whose leaves may be live device buffers; ``device_get_tree`` converts
+them to host numpy before pickling so (a) no device handle is ever serialized
+and (b) transfers happen once, explicitly.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+
+def device_get_tree(obj: Any) -> Any:
+    """Return ``obj`` with every jax.Array leaf replaced by host numpy."""
+    import jax
+
+    def _leaf(x):
+        if isinstance(x, jax.Array):
+            return jax.device_get(x)
+        return x
+
+    return jax.tree_util.tree_map(_leaf, obj)
+
+
+def dumps(obj: Any) -> bytes:
+    return pickle.dumps(device_get_tree(obj), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,40 @@
+"""MPC primitives for secure aggregation.
+
+Re-implementation of the capability of reference ``core/mpc/secagg.py`` (395
+LoC) and ``core/mpc/lightsecagg.py`` (205 LoC) with vectorized integer field
+arithmetic: the prime is kept below 2**31 so products fit int64 exactly —
+`np.int64`/`jnp.int64` lanes, no Python bignum loops (TPU int path; cf.
+SURVEY.md §7 "SecAgg in finite fields on TPU").
+"""
+
+from .field import (
+    FIELD_PRIME,
+    lagrange_basis_at,
+    mod_inverse,
+    mod_matmul,
+)
+from .secagg import (
+    BGW_decoding,
+    BGW_encoding,
+    LCC_decoding_with_points,
+    LCC_encoding_with_points,
+    generate_additive_shares,
+    my_pk_gen,
+    my_key_agreement,
+    transform_finite_to_tensor,
+    transform_tensor_to_finite,
+)
+from .lightsecagg import (
+    mask_encoding,
+    compute_aggregate_encoded_mask,
+    aggregate_mask_reconstruction,
+)
+
+__all__ = [
+    "FIELD_PRIME", "mod_inverse", "mod_matmul", "lagrange_basis_at",
+    "transform_tensor_to_finite", "transform_finite_to_tensor",
+    "generate_additive_shares", "BGW_encoding", "BGW_decoding",
+    "LCC_encoding_with_points", "LCC_decoding_with_points",
+    "my_pk_gen", "my_key_agreement",
+    "mask_encoding", "compute_aggregate_encoded_mask", "aggregate_mask_reconstruction",
+]

@@ -1,0 +1,75 @@
+"""LightSecAgg mask encoding / aggregate-mask reconstruction.
+
+Capability parity with reference ``core/mpc/lightsecagg.py:97-146``
+(``mask_encoding`` / aggregate-mask recovery): each client LCC-encodes its
+local random mask into N sub-masks (tolerating up to ``d`` dropouts given
+privacy threshold ``t``); the server reconstructs only the *sum* of surviving
+clients' masks from any ``u = t + k`` surviving encoded shares — individual
+masks stay hidden.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .field import FIELD_PRIME, _as_field
+from .secagg import LCC_decoding_with_points, LCC_encoding_with_points
+
+
+def _split_points(n: int, t: int, u: int, p=FIELD_PRIME):
+    """alpha (data/noise interpolation) and beta (share evaluation) points.
+    k = u - t data chunks, t noise chunks, n shares."""
+    alphas = np.arange(1, u + 1, dtype=np.int64)           # k data + t noise
+    betas = np.arange(u + 1, u + n + 1, dtype=np.int64)    # n evaluation points
+    return alphas, betas
+
+
+def mask_encoding(
+    d: int, n: int, t: int, u: int, local_mask: np.ndarray, rng: np.random.Generator, p=FIELD_PRIME
+) -> np.ndarray:
+    """Encode a client's length-``d`` mask into ``n`` sub-masks.
+
+    Parity with reference ``lightsecagg.py:97-123``: pad the mask to k=u-t
+    equal chunks, append t uniform noise chunks, LCC-encode at n points.
+    Returns [n, d//k padded] — row j goes to client j.
+    """
+    k = u - t
+    chunk = -(-d // k)  # ceil
+    mask = _as_field(local_mask, p).reshape(-1)
+    padded = np.zeros(chunk * k, dtype=np.int64)
+    padded[:d] = mask[:d]
+    data = padded.reshape(k, chunk)
+    noise = rng.integers(0, int(p), size=(t, chunk), dtype=np.int64)
+    X = np.concatenate([data, noise], axis=0)  # [u, chunk]
+    alphas, betas = _split_points(n, t, u, p)
+    return LCC_encoding_with_points(X, alphas, betas, p)  # [n, chunk]
+
+
+def compute_aggregate_encoded_mask(
+    encoded_mask_rows: Dict[int, np.ndarray], surviving: Sequence[int], p=FIELD_PRIME
+) -> np.ndarray:
+    """Each surviving client j sums the encoded rows it received from all
+    surviving peers (reference ``compute_aggregate_encoded_mask``)."""
+    acc = None
+    for cid in surviving:
+        row = _as_field(encoded_mask_rows[cid], p)
+        acc = row if acc is None else (acc + row) % p
+    return acc
+
+
+def aggregate_mask_reconstruction(
+    agg_encoded: Dict[int, np.ndarray], t: int, u: int, d: int, p=FIELD_PRIME
+) -> np.ndarray:
+    """Server-side: from >= u aggregate-encoded points (keyed by client id,
+    1-based), decode the sum of surviving masks (reference :126-146)."""
+    ids = sorted(agg_encoded.keys())[:u]
+    n_total = max(ids)
+    k = u - t
+    _, betas_all = _split_points(n_total, t, u, p)
+    eval_betas = np.array([betas_all[i - 1] for i in ids], dtype=np.int64)
+    F = np.stack([_as_field(agg_encoded[i], p) for i in ids], axis=0)  # [u, chunk]
+    target_alphas = np.arange(1, k + 1, dtype=np.int64)  # data chunks only
+    decoded = LCC_decoding_with_points(F, eval_betas, target_alphas, p)  # [k, chunk]
+    return decoded.reshape(-1)[:d]

@@ -1,0 +1,142 @@
+"""Secure-aggregation primitives.
+
+Capability parity with reference ``core/mpc/secagg.py`` (quantization :351,
+additive sharing :316, BGW :164/:192, LCC :213/:297, key agreement :329-343)
+— rebuilt on the vectorized int64 field ops in :mod:`.field`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from .field import FIELD_PRIME, _as_field, lagrange_basis_at, mod_inverse, mod_pow
+
+# ---------------------------------------------------------------------------
+# fixed-point quantization into the field (reference :345-395)
+# ---------------------------------------------------------------------------
+def transform_tensor_to_finite(x: np.ndarray, p=FIELD_PRIME, q_bits: int = 16) -> np.ndarray:
+    """Float -> field residues: round(x * 2^q) mapped symmetrically into [0, p).
+
+    Negative values land in the upper half of the field (two's-complement
+    style), exactly as the reference's ``my_q`` transform.
+    """
+    scale = np.int64(1) << q_bits
+    q = np.round(np.asarray(x, dtype=np.float64) * float(scale)).astype(np.int64)
+    return np.mod(q, p)
+
+
+def transform_finite_to_tensor(z: np.ndarray, p=FIELD_PRIME, q_bits: int = 16) -> np.ndarray:
+    """Field residues -> float, undoing :func:`transform_tensor_to_finite`."""
+    z = _as_field(z, p)
+    half = (int(p) - 1) // 2
+    signed = np.where(z > half, z - p, z).astype(np.float64)
+    return signed / float(np.int64(1) << q_bits)
+
+
+# ---------------------------------------------------------------------------
+# additive secret sharing (reference Gen_Additive_SS :316)
+# ---------------------------------------------------------------------------
+def generate_additive_shares(secret: np.ndarray, n_shares: int, rng: np.random.Generator, p=FIELD_PRIME) -> np.ndarray:
+    """Split ``secret`` (field residues) into n shares summing to it mod p.
+    Returns array [n_shares, *secret.shape]."""
+    secret = _as_field(secret, p)
+    shares = rng.integers(0, int(p), size=(n_shares - 1,) + secret.shape, dtype=np.int64)
+    last = np.mod(secret - shares.sum(axis=0), p)
+    return np.concatenate([shares, last[None]], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# BGW (Shamir) threshold sharing (reference :164-212)
+# ---------------------------------------------------------------------------
+def BGW_encoding(secret: np.ndarray, n: int, t: int, rng: np.random.Generator, p=FIELD_PRIME) -> np.ndarray:
+    """Degree-t Shamir shares for n parties; party i evaluates at alpha=i+1.
+    secret: [...]; returns [n, ...]."""
+    secret = _as_field(secret, p)
+    coeffs = rng.integers(0, int(p), size=(t,) + secret.shape, dtype=np.int64)
+    alphas = np.arange(1, n + 1, dtype=np.int64)
+    shares = np.empty((n,) + secret.shape, dtype=np.int64)
+    for i, a in enumerate(alphas):
+        acc = secret.copy()
+        apow = np.int64(1)
+        for d in range(t):
+            apow = (apow * a) % p
+            acc = (acc + coeffs[d] * apow) % p
+        shares[i] = acc
+    return shares
+
+
+def BGW_decoding(shares: np.ndarray, alphas: np.ndarray, p=FIELD_PRIME) -> np.ndarray:
+    """Reconstruct the secret (evaluate at 0) from >= t+1 shares taken at
+    ``alphas``.  shares: [k, ...]."""
+    U = lagrange_basis_at(_as_field(alphas, p), _as_field(alphas, p), np.zeros(1, dtype=np.int64), p)  # [1, k]
+    k = shares.shape[0]
+    flat = shares.reshape(k, -1).astype(np.int64) % p
+    out = np.zeros(flat.shape[1], dtype=np.int64)
+    for j in range(k):
+        out = (out + U[0, j] * flat[j]) % p
+    return out.reshape(shares.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Lagrange Coded Computing (reference LCC_encoding_with_points :213,
+# LCC_decoding_with_points :297)
+# ---------------------------------------------------------------------------
+def LCC_encoding_with_points(X: np.ndarray, alphas: np.ndarray, betas: np.ndarray, p=FIELD_PRIME) -> np.ndarray:
+    """Encode K data chunks X[k] (interpolation values at alphas) onto
+    evaluation points betas.  X: [K, ...]; returns [N, ...] with N=len(betas)."""
+    alphas = _as_field(alphas, p)
+    betas = _as_field(betas, p)
+    U = lagrange_basis_at(alphas, alphas, betas, p)  # [N, K]
+    K = X.shape[0]
+    flat = _as_field(X, p).reshape(K, -1)
+    out = np.zeros((betas.shape[0], flat.shape[1]), dtype=np.int64)
+    for j in range(K):
+        out = (out + U[:, j : j + 1] * flat[j : j + 1, :]) % p
+    return out.reshape((betas.shape[0],) + X.shape[1:])
+
+
+def LCC_decoding_with_points(F: np.ndarray, eval_betas: np.ndarray, target_alphas: np.ndarray, p=FIELD_PRIME) -> np.ndarray:
+    """Decode: given polynomial values F[i] at eval_betas, recover values at
+    target_alphas.  F: [R, ...] with R >= deg+1."""
+    U = lagrange_basis_at(_as_field(eval_betas, p), _as_field(eval_betas, p), _as_field(target_alphas, p), p)
+    R = F.shape[0]
+    flat = _as_field(F, p).reshape(R, -1)
+    out = np.zeros((U.shape[0], flat.shape[1]), dtype=np.int64)
+    for j in range(R):
+        out = (out + U[:, j : j + 1] * flat[j : j + 1, :]) % p
+    return out.reshape((U.shape[0],) + F.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# DH-style key agreement (reference my_pk_gen / my_key_agreement :329-343)
+# ---------------------------------------------------------------------------
+def my_pk_gen(sk: int, p=FIELD_PRIME, g: int = 3) -> int:
+    return int(mod_pow(np.int64(g), int(sk), p))
+
+
+def my_key_agreement(my_sk: int, their_pk: int, p=FIELD_PRIME) -> int:
+    return int(mod_pow(np.int64(their_pk), int(my_sk), p))
+
+
+# ---------------------------------------------------------------------------
+# pairwise-mask SecAgg helpers (protocol layer used by cross_silo/secagg)
+# ---------------------------------------------------------------------------
+def pairwise_mask(shape: Tuple[int, ...], seed: int, p=FIELD_PRIME) -> np.ndarray:
+    """Deterministic field-mask from a shared seed (PRG expansion of the
+    agreed key — the reference uses the same np.random construction)."""
+    rng = np.random.default_rng(seed & 0x7FFFFFFF)
+    return rng.integers(0, int(p), size=shape, dtype=np.int64)
+
+
+def mask_model_update(z: np.ndarray, self_id: int, peer_keys: dict, p=FIELD_PRIME) -> np.ndarray:
+    """Add +mask(i,j) for j>i and -mask(j,i) for j<i: masks cancel in the sum
+    over all clients (classic Bonawitz-style pairwise cancellation)."""
+    out = _as_field(z, p)
+    for peer, key in peer_keys.items():
+        if peer == self_id:
+            continue
+        m = pairwise_mask(z.shape, key, p)
+        out = (out + m) % p if peer > self_id else (out - m) % p
+    return out

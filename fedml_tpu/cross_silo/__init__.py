@@ -1,0 +1,20 @@
+"""Cross-silo FL ("Octopus"): host message plane + round state machines.
+
+Parity with reference ``python/fedml/cross_silo/`` (SURVEY.md §2.6, §3.4):
+the server waits for every silo's ONLINE handshake, pushes init config, then
+runs the collect→aggregate→test→sample→sync round loop; each client silo
+trains locally and reports.  Transport is any registered CommManager backend
+(LOOPBACK for tests, GRPC for DCN, MQTT_S3 for broker+blob deployments).
+
+TPU-native deviation: the reference's intra-silo acceleration is torch DDP
+via torchrun-spawned slave processes (``fedml_client_slave_manager.py``,
+``process_group_manager.py``).  Here a silo is ONE process whose local batch
+is sharded over the silo's jax devices with a `Mesh` — no slave processes, no
+process groups; XLA inserts the gradient all-reduce (ICI) that DDP would do
+with NCCL (see client/trainer_dist_adapter.py).
+"""
+
+from .client.client import Client
+from .server.server import Server
+
+__all__ = ["Client", "Server"]

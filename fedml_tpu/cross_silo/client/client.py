@@ -1,0 +1,34 @@
+"""Cross-silo client facade (reference ``cross_silo/client/fedml_client.py`` +
+``client_initializer.py``)."""
+
+from __future__ import annotations
+
+from .fedml_client_master_manager import ClientMasterManager
+from .trainer_dist_adapter import TrainerDistAdapter
+
+
+class Client:
+    def __init__(self, args, device, dataset, model, model_trainer=None):
+        self.args = args
+        (
+            train_data_num,
+            test_data_num,
+            train_data_global,
+            test_data_global,
+            train_data_local_num_dict,
+            train_data_local_dict,
+            test_data_local_dict,
+            class_num,
+        ) = dataset
+        client_rank = int(getattr(args, "rank", 1))
+        adapter = TrainerDistAdapter(
+            args, device, client_rank, model, train_data_num,
+            train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
+            model_trainer,
+        )
+        backend = str(getattr(args, "backend", "LOOPBACK"))
+        size = int(getattr(args, "client_num_in_total", 1)) + 1
+        self.manager = ClientMasterManager(args, adapter, rank=client_rank, size=size, backend=backend)
+
+    def run(self):
+        self.manager.run()

@@ -1,0 +1,90 @@
+"""Cross-silo client state machine (silo rank-0 process).
+
+Parity with reference ``cross_silo/client/fedml_client_master_manager.py:17-157``:
+ONLINE handshake on connection-ready, init-config consumption, per-round
+train→report, FINISH teardown.  The reference's ``sync_process_group``
+broadcast to intra-silo slaves does not exist here — intra-silo parallelism
+is mesh sharding inside this process (see trainer_dist_adapter.py).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ...core.distributed.comm_manager import FedMLCommManager
+from ...core.distributed.communication.message import Message
+from ..message_define import MyMessage
+
+logger = logging.getLogger(__name__)
+
+
+class ClientMasterManager(FedMLCommManager):
+    def __init__(self, args, trainer_dist_adapter, comm=None, rank: int = 0, size: int = 0, backend: str = "LOOPBACK"):
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer_dist_adapter = trainer_dist_adapter
+        self.num_rounds = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+        self.rank = int(rank)
+        self.has_sent_online_msg = False
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler("connection_ready", self.handle_message_connection_ready)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.handle_message_check_status
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_message_receive_model_from_server
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish
+        )
+
+    # -- handlers -----------------------------------------------------------
+    def handle_message_connection_ready(self, msg: Message) -> None:
+        if not self.has_sent_online_msg:
+            self.has_sent_online_msg = True
+            self.send_client_status(0, MyMessage.CLIENT_STATUS_ONLINE)
+
+    def handle_message_check_status(self, msg: Message) -> None:
+        self.send_client_status(0, MyMessage.CLIENT_STATUS_ONLINE)
+
+    def handle_message_init(self, msg: Message) -> None:
+        global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        self.round_idx = 0
+        self.trainer_dist_adapter.update_dataset(client_index)
+        self.trainer_dist_adapter.set_model_params(global_model_params)
+        self.__train()
+
+    def handle_message_receive_model_from_server(self, msg: Message) -> None:
+        global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx + 1))
+        self.trainer_dist_adapter.update_dataset(client_index)
+        self.trainer_dist_adapter.set_model_params(global_model_params)
+        self.__train()
+
+    def handle_message_finish(self, msg: Message) -> None:
+        logger.info("client rank %d: FINISH", self.rank)
+        self.finish()
+
+    # -- actions ------------------------------------------------------------
+    def send_client_status(self, receive_id: int, status: str) -> None:
+        m = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, receive_id)
+        m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
+        self.send_message(m)
+
+    def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:
+        m = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, receive_id)
+        m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
+        m.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
+        self.send_message(m)
+
+    def __train(self) -> None:
+        logger.info("client rank %d: train round %d (silo idx %d)",
+                    self.rank, self.round_idx, self.trainer_dist_adapter.client_index)
+        weights, local_sample_num = self.trainer_dist_adapter.train(self.round_idx)
+        self.send_model_to_server(0, weights, local_sample_num)

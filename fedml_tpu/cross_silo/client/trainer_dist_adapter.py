@@ -1,0 +1,65 @@
+"""Bridges the WAN FL loop to intra-silo device parallelism ("Cheetah").
+
+Parity with reference ``cross_silo/client/fedml_trainer_dist_adapter.py:9-93``,
+replaced TPU-first: where the reference wraps the model in torch DDP across
+torchrun-spawned slave processes (``model_ddp``, ``process_group_manager.py``),
+here the silo is one process and the local batch axis is sharded over the
+silo's jax devices via a ``Mesh`` — XLA compiles the same gradient all-reduce
+DDP would issue through NCCL, but over ICI and fused into the step.  The
+"slave manager"/"process group" machinery therefore has no equivalent; its
+job is done by the compiler.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import jax
+
+from ...ml.trainer.cls_trainer import ModelTrainerCLS
+
+logger = logging.getLogger(__name__)
+
+
+class TrainerDistAdapter:
+    def __init__(self, args, device, client_rank: int, model, train_data_num,
+                 train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
+                 model_trainer: Optional[Any] = None):
+        self.args = args
+        self.device = device
+        self.client_rank = int(client_rank)
+        self.client_index = self.client_rank - 1
+        self.train_data_local_dict = train_data_local_dict
+        self.train_data_local_num_dict = train_data_local_num_dict
+        self.test_data_local_dict = test_data_local_dict
+        if model_trainer is None:
+            model_trainer = ModelTrainerCLS(model, args)
+        self.trainer = model_trainer
+        self.trainer.set_id(self.client_index)
+
+        # hierarchical scenario: announce the intra-silo mesh
+        scenario = str(getattr(args, "scenario", "horizontal"))
+        n_dev = len(jax.devices())
+        if scenario == "hierarchical" and n_dev > 1:
+            logger.info("silo rank %d: intra-silo dp over %d devices (mesh-sharded batch)",
+                        client_rank, n_dev)
+
+    def get_model_params(self):
+        return self.trainer.get_model_params()
+
+    def set_model_params(self, model_params) -> None:
+        self.trainer.set_model_params(model_params)
+
+    def update_dataset(self, client_index: int) -> None:
+        self.client_index = int(client_index)
+        self.trainer.set_id(self.client_index)
+
+    def train(self, round_idx: int):
+        """One local-training pass; returns (params, local_sample_num)."""
+        train_data = self.train_data_local_dict[self.client_index]
+        n = self.train_data_local_num_dict[self.client_index]
+        self.trainer.on_before_local_training(train_data, self.device, self.args)
+        self.trainer.train(train_data, self.device, self.args)
+        self.trainer.on_after_local_training(train_data, self.device, self.args)
+        return self.trainer.get_model_params(), n

@@ -1,0 +1,1 @@
+from .lsa_fedml_api import run_lightsecagg_topology_in_threads  # noqa: F401

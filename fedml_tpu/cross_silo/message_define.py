@@ -1,0 +1,38 @@
+"""Cross-silo message contract.
+
+Same message-type/argument vocabulary as the reference
+(``cross_silo/server/message_define.py`` + ``client/message_define.py``) so
+protocol traces are comparable side by side.
+"""
+
+
+class MyMessage:
+    # server -> client
+    MSG_TYPE_S2C_INIT_CONFIG = 1
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = 2
+    MSG_TYPE_S2C_CHECK_CLIENT_STATUS = 6
+    MSG_TYPE_S2C_FINISH = 7
+
+    # client -> server
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
+    MSG_TYPE_C2S_SEND_STATS_TO_SERVER = 4
+    MSG_TYPE_C2S_CLIENT_STATUS = 5
+
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+
+    MSG_ARG_KEY_TRAIN_CORRECT = "train_correct"
+    MSG_ARG_KEY_TRAIN_ERROR = "train_error"
+    MSG_ARG_KEY_TRAIN_NUM = "train_num_sample"
+
+    CLIENT_STATUS_OFFLINE = "OFFLINE"
+    CLIENT_STATUS_IDLE = "IDLE"
+    CLIENT_STATUS_ONLINE = "ONLINE"

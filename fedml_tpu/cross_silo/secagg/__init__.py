@@ -1,0 +1,1 @@
+from .sa_fedml_api import run_secagg_topology_in_threads  # noqa: F401

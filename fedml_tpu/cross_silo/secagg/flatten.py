@@ -1,0 +1,34 @@
+"""Pytree <-> flat-field-vector conversion for secure aggregation.
+
+The MPC plane works on one flat int64 residue vector per client; these
+helpers bridge model pytrees to that plane (the reference operates on ordered
+torch state_dicts; a flat vector is the same idea, engine-free).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import numpy as np
+
+from ...core.mpc.secagg import transform_finite_to_tensor, transform_tensor_to_finite
+
+
+def flatten_to_finite(params: Any, q_bits: int = 16) -> Tuple[np.ndarray, Any, list]:
+    """-> (field_vector, treedef, [leaf shapes])."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [np.shape(l) for l in leaves]
+    flat = np.concatenate([np.ravel(np.asarray(l, dtype=np.float64)) for l in leaves]) if leaves else np.zeros(0)
+    return transform_tensor_to_finite(flat, q_bits=q_bits), treedef, shapes
+
+
+def unflatten_from_finite(z: np.ndarray, treedef, shapes, q_bits: int = 16, dtype=np.float32) -> Any:
+    flat = transform_finite_to_tensor(z, q_bits=q_bits).astype(dtype)
+    leaves = []
+    off = 0
+    for shp in shapes:
+        n = int(np.prod(shp)) if shp else 1
+        leaves.append(flat[off : off + n].reshape(shp))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, leaves)

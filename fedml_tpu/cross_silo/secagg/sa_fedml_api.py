@@ -1,0 +1,226 @@
+"""Cross-silo FedAvg with pairwise-mask secure aggregation.
+
+Scenario parity with reference ``cross_silo/secagg/`` (sa_fedml_api.py,
+sa_fedml_server_manager.py, sa_fedml_client_manager.py, ~1100 LoC): the server
+NEVER sees an individual client update — clients quantize their params into
+the prime field, add pairwise masks derived from DH-agreed keys (Bonawitz
+et al. cancellation), and the server field-sums the masked vectors; the masks
+cancel and the dequantized mean becomes the next global model.
+
+Round protocol:
+  S2C INIT (participant table + global model)
+  C2S PUBLIC_KEY  -> server collects, S2C BROADCAST_PUBLIC_KEYS
+  client: local train -> quantize -> pairwise-mask -> C2S MASKED_MODEL
+  server: field-sum, dequantize, weight by samples -> S2C SYNC / FINISH
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...core.distributed.comm_manager import FedMLCommManager
+from ...core.distributed.communication.message import Message
+from ...core.mpc.field import FIELD_PRIME
+from ...core.mpc.secagg import mask_model_update, my_key_agreement, my_pk_gen
+from ...ml.engine.train import init_variables
+from ...ml.trainer.cls_trainer import ModelTrainerCLS
+from .flatten import flatten_to_finite, unflatten_from_finite
+from .sa_message_define import SAMessage
+
+logger = logging.getLogger(__name__)
+
+Q_BITS = 16
+
+
+class SecAggServerManager(FedMLCommManager):
+    def __init__(self, args, dataset, model, backend: str = "LOOPBACK"):
+        client_num = int(getattr(args, "client_num_in_total", 1))
+        super().__init__(args, rank=0, size=client_num + 1, backend=backend)
+        (_, _, _, self.test_global, _, _, _, _) = dataset
+        self.module = model
+        self.client_num = client_num
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+        import jax.numpy as jnp
+
+        sample = jnp.asarray(self.test_global[0][:1])
+        self.global_params = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
+        self.online: Dict[int, bool] = {}
+        self.pk_table: Dict[int, int] = {}
+        self.masked: Dict[int, np.ndarray] = {}
+        self.sample_nums: Dict[int, float] = {}
+        self.treedef = None
+        self.shapes = None
+        self.eval_history: List[Dict[str, Any]] = []
+        self._eval_fn = None
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler("connection_ready", self._on_ready)
+        self.register_message_receive_handler(SAMessage.MSG_TYPE_C2S_CLIENT_STATUS, self._on_status)
+        self.register_message_receive_handler(SAMessage.MSG_TYPE_C2S_PUBLIC_KEY, self._on_pk)
+        self.register_message_receive_handler(SAMessage.MSG_TYPE_C2S_MASKED_MODEL, self._on_masked)
+
+    def _on_ready(self, msg: Message) -> None:
+        pass  # clients announce themselves
+
+    def _on_status(self, msg: Message) -> None:
+        self.online[int(msg.get_sender_id())] = True
+        if len(self.online) == self.client_num and self.round_idx == 0 and not self.pk_table:
+            self._send_init()
+
+    def _send_init(self) -> None:
+        for cid in range(1, self.client_num + 1):
+            m = Message(SAMessage.MSG_TYPE_S2C_INIT_CONFIG, 0, cid)
+            m.add_params(SAMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_params)
+            m.add_params(SAMessage.MSG_ARG_KEY_CLIENT_INDEX, cid - 1)
+            m.add_params(SAMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            self.send_message(m)
+
+    def _on_pk(self, msg: Message) -> None:
+        self.pk_table[int(msg.get_sender_id())] = int(msg.get(SAMessage.MSG_ARG_KEY_PUBLIC_KEY))
+        if len(self.pk_table) == self.client_num:
+            for cid in range(1, self.client_num + 1):
+                m = Message(SAMessage.MSG_TYPE_S2C_BROADCAST_PUBLIC_KEYS, 0, cid)
+                m.add_params(SAMessage.MSG_ARG_KEY_PK_TABLE, dict(self.pk_table))
+                self.send_message(m)
+
+    def _on_masked(self, msg: Message) -> None:
+        sender = int(msg.get_sender_id())
+        self.masked[sender] = np.asarray(msg.get(SAMessage.MSG_ARG_KEY_MASKED_VECTOR))
+        self.sample_nums[sender] = float(msg.get(SAMessage.MSG_ARG_KEY_NUM_SAMPLES))
+        if self.treedef is None:
+            self.treedef = msg.get("treedef")
+            self.shapes = msg.get("shapes")
+        if len(self.masked) < self.client_num:
+            return
+        # field-sum: pairwise masks cancel (server never unmasked an individual)
+        total = np.zeros_like(next(iter(self.masked.values())))
+        for v in self.masked.values():
+            total = np.mod(total + v, FIELD_PRIME)
+        # clients pre-scale by n_i/N, so the field sum IS the weighted mean
+        self.global_params = unflatten_from_finite(total, self.treedef, self.shapes, q_bits=Q_BITS)
+        self.masked.clear()
+        self.pk_table.clear()
+        self.eval_history.append(self._evaluate())
+        self.round_idx += 1
+        if self.round_idx >= self.round_num:
+            for cid in range(1, self.client_num + 1):
+                self.send_message(Message(SAMessage.MSG_TYPE_S2C_FINISH, 0, cid))
+            self.finish()
+            return
+        for cid in range(1, self.client_num + 1):
+            m = Message(SAMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, cid)
+            m.add_params(SAMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_params)
+            m.add_params(SAMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            self.send_message(m)
+
+    def _evaluate(self) -> Dict[str, Any]:
+        from ...ml.engine.train import make_eval_fn
+
+        import jax.numpy as jnp
+
+        if self._eval_fn is None:
+            self._eval_fn = make_eval_fn(self.module)
+        x, y = self.test_global
+        xs, ys = jnp.asarray(x), jnp.asarray(y)
+        m = jnp.ones((xs.shape[0],), jnp.float32)
+        l, c, t = self._eval_fn(self.global_params, xs, ys, m)
+        out = {"round": self.round_idx, "test_acc": round(float(c) / max(float(t), 1.0), 4),
+               "test_loss": round(float(l) / max(float(t), 1.0), 4)}
+        logger.info("secagg eval: %s", out)
+        return out
+
+
+class SecAggClientManager(FedMLCommManager):
+    def __init__(self, args, dataset, model, rank: int, backend: str = "LOOPBACK"):
+        client_num = int(getattr(args, "client_num_in_total", 1))
+        super().__init__(args, rank=rank, size=client_num + 1, backend=backend)
+        (_, _, _, _, self.train_num_dict, self.train_dict, _, _) = dataset
+        self.args = args
+        self.client_num = client_num
+        self.trainer = ModelTrainerCLS(model, args)
+        self.client_index = rank - 1
+        self.sk = int(np.random.default_rng(1000 + rank).integers(2, 2**30))
+        self.total_samples = float(sum(self.train_num_dict[i] for i in range(client_num)))
+        self._sent_online = False
+        self._pending_train: Optional[dict] = None
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler("connection_ready", self._on_ready)
+        self.register_message_receive_handler(SAMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_init)
+        self.register_message_receive_handler(SAMessage.MSG_TYPE_S2C_BROADCAST_PUBLIC_KEYS, self._on_pks)
+        self.register_message_receive_handler(SAMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self._on_sync)
+        self.register_message_receive_handler(SAMessage.MSG_TYPE_S2C_FINISH, lambda m: self.finish())
+
+    def _on_ready(self, msg: Message) -> None:
+        if not self._sent_online:
+            self._sent_online = True
+            m = Message(SAMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+            m.add_params(SAMessage.MSG_ARG_KEY_CLIENT_STATUS, "ONLINE")
+            self.send_message(m)
+
+    def _on_init(self, msg: Message) -> None:
+        self.client_index = int(msg.get(SAMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        self._train_and_stash(msg.get(SAMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        self._send_pk()
+
+    def _on_sync(self, msg: Message) -> None:
+        self._train_and_stash(msg.get(SAMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        self._send_pk()
+
+    def _send_pk(self) -> None:
+        m = Message(SAMessage.MSG_TYPE_C2S_PUBLIC_KEY, self.rank, 0)
+        m.add_params(SAMessage.MSG_ARG_KEY_PUBLIC_KEY, my_pk_gen(self.sk))
+        self.send_message(m)
+
+    def _train_and_stash(self, global_params) -> None:
+        self.trainer.set_model_params(global_params)
+        train_data = self.train_dict[self.client_index]
+        n = float(self.train_num_dict[self.client_index])
+        self.trainer.on_before_local_training(train_data, None, self.args)
+        self.trainer.train(train_data, None, self.args)
+        self.trainer.on_after_local_training(train_data, None, self.args)
+        # pre-scale by n_i / N so the server's field-sum is the weighted mean
+        import jax
+
+        w = self.trainer.get_model_params()
+        scaled = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float64) * (n / self.total_samples), w)
+        z, treedef, shapes = flatten_to_finite(scaled, q_bits=Q_BITS)
+        self._pending_train = {"z": z, "treedef": treedef, "shapes": shapes, "n": n}
+
+    def _on_pks(self, msg: Message) -> None:
+        pk_table = {int(k): int(v) for k, v in msg.get(SAMessage.MSG_ARG_KEY_PK_TABLE).items()}
+        assert self._pending_train is not None
+        peer_keys = {
+            peer: my_key_agreement(self.sk, pk)
+            for peer, pk in pk_table.items() if peer != self.rank
+        }
+        masked = mask_model_update(self._pending_train["z"], self.rank, peer_keys)
+        m = Message(SAMessage.MSG_TYPE_C2S_MASKED_MODEL, self.rank, 0)
+        m.add_params(SAMessage.MSG_ARG_KEY_MASKED_VECTOR, masked)
+        m.add_params(SAMessage.MSG_ARG_KEY_NUM_SAMPLES, self._pending_train["n"])
+        m.add_params("treedef", self._pending_train["treedef"])
+        m.add_params("shapes", self._pending_train["shapes"])
+        self.send_message(m)
+
+
+def run_secagg_topology_in_threads(args, dataset_fn, model_fn, backend: str = "LOOPBACK"):
+    """Test/demo harness: server + N clients in threads; returns eval history."""
+    dataset, out_dim = dataset_fn(args)
+    model = model_fn(args, out_dim)
+    server = SecAggServerManager(args, dataset, model, backend=backend)
+    clients = [
+        SecAggClientManager(args, dataset, model_fn(args, out_dim), rank=r, backend=backend)
+        for r in range(1, int(args.client_num_in_total) + 1)
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    return server.eval_history

@@ -1,0 +1,23 @@
+"""SecAgg message vocabulary (reference ``cross_silo/secagg/message_defined.py``)."""
+
+
+class SAMessage:
+    # server -> client
+    MSG_TYPE_S2C_INIT_CONFIG = "sa_init"
+    MSG_TYPE_S2C_BROADCAST_PUBLIC_KEYS = "sa_pks"
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "sa_sync"
+    MSG_TYPE_S2C_FINISH = "sa_finish"
+
+    # client -> server
+    MSG_TYPE_C2S_PUBLIC_KEY = "sa_pk"
+    MSG_TYPE_C2S_MASKED_MODEL = "sa_masked_model"
+    MSG_TYPE_C2S_CLIENT_STATUS = "sa_status"
+
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_MASKED_VECTOR = "masked_vector"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_PUBLIC_KEY = "public_key"
+    MSG_ARG_KEY_PK_TABLE = "pk_table"
+    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"

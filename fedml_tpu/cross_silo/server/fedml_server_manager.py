@@ -1,0 +1,136 @@
+"""Cross-silo server round state machine.
+
+Parity with reference ``cross_silo/server/fedml_server_manager.py:12-207``:
+wait for every client's ONLINE status, push init config (round-0 model +
+assigned client index), then per round: collect models → aggregate → test →
+select next participants → sync model; after the final round send FINISH and
+stop.  Message vocabulary in :mod:`..message_define`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ...core.distributed.comm_manager import FedMLCommManager
+from ...core.distributed.communication.message import Message
+from ..message_define import MyMessage
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLServerManager(FedMLCommManager):
+    def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0, backend: str = "LOOPBACK"):
+        super().__init__(args, comm, client_rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.args.round_idx = 0
+        self.client_num = int(client_num)
+        self.client_online_status: Dict[int, bool] = {}
+        self.is_initialized = False
+        self.client_id_list_in_this_round: List[int] = []
+        self.data_silo_index_of_client: Dict[int, int] = {}
+        self.eval_history: List[Dict[str, Any]] = []
+
+    # -- lifecycle ----------------------------------------------------------
+    def run(self) -> None:
+        super().run()
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler("connection_ready", self.handle_message_connection_ready)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.handle_message_client_status_update
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_message_receive_model_from_client
+        )
+
+    # -- handlers -----------------------------------------------------------
+    def handle_message_connection_ready(self, msg: Message) -> None:
+        # Probe all clients for status (reference sends CHECK_CLIENT_STATUS
+        # until every silo reports ONLINE, fedml_server_manager.py:58-79).
+        for client_id in range(1, self.client_num + 1):
+            m = Message(MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.rank, client_id)
+            self.send_message(m)
+
+    def handle_message_client_status_update(self, msg: Message) -> None:
+        status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        sender = int(msg.get_sender_id())
+        if status == MyMessage.CLIENT_STATUS_ONLINE:
+            self.client_online_status[sender] = True
+        logger.info("client %s status=%s (%d/%d online)", sender, status,
+                    sum(self.client_online_status.values()), self.client_num)
+        if not self.is_initialized and all(
+            self.client_online_status.get(cid, False) for cid in range(1, self.client_num + 1)
+        ):
+            self.is_initialized = True
+            self.send_init_msg()
+
+    def send_init_msg(self) -> None:
+        """Round-0 kick-off (reference send_message_init_config :182)."""
+        self.client_id_list_in_this_round = self.aggregator.client_selection(
+            self.args.round_idx, list(range(1, self.client_num + 1)),
+            int(getattr(self.args, "client_num_per_round", self.client_num)),
+        )
+        self.data_silo_index_of_client = dict(zip(
+            self.client_id_list_in_this_round,
+            self.aggregator.data_silo_selection(
+                self.args.round_idx,
+                int(getattr(self.args, "client_num_in_total", self.client_num)),
+                len(self.client_id_list_in_this_round),
+            ),
+        ))
+        global_model = self.aggregator.get_global_model_params()
+        for client_id in self.client_id_list_in_this_round:
+            m = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
+            m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
+            m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
+            m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+            self.send_message(m)
+
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        sender = int(msg.get_sender_id())
+        model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        self.aggregator.add_local_trained_result(
+            self.client_id_list_in_this_round.index(sender), model_params, local_sample_number
+        )
+        if not self.aggregator.check_whether_all_receive():
+            return
+        self.aggregator.aggregate()
+        freq = int(getattr(self.args, "frequency_of_the_test", 1) or 0)
+        if freq and (self.args.round_idx % freq == 0 or self.args.round_idx == self.round_num - 1):
+            self.eval_history.append(
+                self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+            )
+
+        self.args.round_idx += 1
+        if self.args.round_idx >= self.round_num:
+            self.send_finish_msg()
+            self.finish()
+            return
+
+        # next round participants + model sync (reference :202)
+        self.client_id_list_in_this_round = self.aggregator.client_selection(
+            self.args.round_idx, list(range(1, self.client_num + 1)),
+            int(getattr(self.args, "client_num_per_round", self.client_num)),
+        )
+        self.data_silo_index_of_client = dict(zip(
+            self.client_id_list_in_this_round,
+            self.aggregator.data_silo_selection(
+                self.args.round_idx,
+                int(getattr(self.args, "client_num_in_total", self.client_num)),
+                len(self.client_id_list_in_this_round),
+            ),
+        ))
+        global_model = self.aggregator.get_global_model_params()
+        for client_id in self.client_id_list_in_this_round:
+            m = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
+            m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
+            m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
+            m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+            self.send_message(m)
+
+    def send_finish_msg(self) -> None:
+        for client_id in range(1, self.client_num + 1):
+            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))

@@ -1,0 +1,47 @@
+"""Cross-silo server facade (reference ``cross_silo/server/fedml_server.py`` +
+``server_initializer.py``): builds aggregator + manager and runs the loop."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ml.aggregator.default_aggregator import DefaultServerAggregator
+from ...ml.engine.train import init_variables
+from .fedml_aggregator import FedMLAggregator
+from .fedml_server_manager import FedMLServerManager
+
+
+class Server:
+    def __init__(self, args, device, dataset, model, server_aggregator=None):
+        self.args = args
+        (
+            train_data_num,
+            test_data_num,
+            train_data_global,
+            test_data_global,
+            train_data_local_num_dict,
+            train_data_local_dict,
+            test_data_local_dict,
+            class_num,
+        ) = dataset
+        if server_aggregator is None:
+            server_aggregator = DefaultServerAggregator(model, args)
+        if server_aggregator.get_model_params() is None:
+            sample = jnp.asarray(train_data_global[0][:1])
+            server_aggregator.set_model_params(
+                init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
+            )
+        worker_num = int(getattr(args, "client_num_per_round", getattr(args, "client_num_in_total", 1)))
+        aggregator = FedMLAggregator(
+            test_data_global, train_data_global, train_data_num, worker_num,
+            device, args, server_aggregator,
+        )
+        backend = str(getattr(args, "backend", "LOOPBACK"))
+        client_num = int(getattr(args, "client_num_in_total", worker_num))
+        self.server_manager = FedMLServerManager(
+            args, aggregator, client_rank=0, client_num=client_num, backend=backend
+        )
+
+    def run(self):
+        self.server_manager.run()
+        return self.server_manager.eval_history

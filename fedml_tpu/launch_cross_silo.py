@@ -1,0 +1,21 @@
+"""One-line cross-silo launchers (reference ``launch_cross_silo_horizontal.py``)."""
+
+from __future__ import annotations
+
+
+def run_cross_silo(role: str = "client"):
+    import fedml_tpu
+    from fedml_tpu import data as _data, device as _device, models as _models
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu.constants import FEDML_TRAINING_PLATFORM_CROSS_SILO
+    from fedml_tpu.runner import FedMLRunner
+
+    args = load_arguments(FEDML_TRAINING_PLATFORM_CROSS_SILO)
+    args.training_type = FEDML_TRAINING_PLATFORM_CROSS_SILO
+    args.role = role
+    args = fedml_tpu.init(args)
+    device = _device.get_device(args)
+    dataset, output_dim = _data.load(args)
+    model = _models.create(args, output_dim)
+    runner = FedMLRunner(args, device, dataset, model)
+    return runner.run()

@@ -1,0 +1,99 @@
+"""E2E secure-aggregation scenario tests (reference
+smoke_test_cross_silo_lightsecagg_linux.yml analog, in-process)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+
+
+def _args(run_id: str, n_clients: int = 3, rounds: int = 2):
+    return Arguments.from_dict({
+        "common_args": {"training_type": "cross_silo", "random_seed": 0, "run_id": run_id},
+        "data_args": {"dataset": "synthetic", "data_cache_dir": "", "partition_method": "homo",
+                      "synthetic_train_size": 240},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": n_clients,
+            "client_num_per_round": n_clients,
+            "comm_round": rounds,
+            "epochs": 1,
+            "batch_size": 16,
+            "client_optimizer": "sgd",
+            "learning_rate": 0.1,
+        },
+        "validation_args": {"frequency_of_the_test": 1},
+        "comm_args": {"backend": "LOOPBACK"},
+    }).validate()
+
+
+def _dataset_fn(args):
+    return fedml_tpu.data.load(args)
+
+
+def _model_fn(args, out_dim):
+    return fedml_tpu.models.create(args, out_dim)
+
+
+def test_secagg_cross_silo():
+    LoopbackHub.reset()
+    args = fedml_tpu.init(_args("sa-1"), should_init_logs=False)
+    from fedml_tpu.cross_silo.secagg import run_secagg_topology_in_threads
+
+    history = run_secagg_topology_in_threads(args, _dataset_fn, _model_fn)
+    assert len(history) == 2
+    assert history[-1]["test_acc"] > 0.2  # learns despite masking
+
+
+def test_secagg_matches_plain_fedavg():
+    """Masked aggregation must equal plain weighted FedAvg up to quantization."""
+    LoopbackHub.reset()
+    args = fedml_tpu.init(_args("sa-2", n_clients=2, rounds=1), should_init_logs=False)
+    from fedml_tpu.cross_silo.secagg import run_secagg_topology_in_threads
+
+    history = run_secagg_topology_in_threads(args, _dataset_fn, _model_fn)
+
+    # plain SP FedAvg with identical config/seeds
+    LoopbackHub.reset()
+    args2 = fedml_tpu.init(_args("sa-2b", n_clients=2, rounds=1), should_init_logs=False)
+    args2.training_type = "simulation"
+    args2.backend = "sp"
+    dataset, out_dim = fedml_tpu.data.load(args2)
+    model = fedml_tpu.models.create(args2, out_dim)
+    from fedml_tpu.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+
+    api = FedAvgAPI(args2, None, dataset, model)
+    plain = api.train()
+    # same data, same seed, same rounds -> accuracies should be very close
+    assert abs(history[-1]["test_acc"] - plain["test_acc"]) < 0.05
+
+
+def test_lightsecagg_no_dropout():
+    LoopbackHub.reset()
+    args = fedml_tpu.init(_args("lsa-1"), should_init_logs=False)
+    args.lsa_privacy_t = 1
+    args.lsa_threshold_u = 2
+    from fedml_tpu.cross_silo.lightsecagg import run_lightsecagg_topology_in_threads
+
+    history = run_lightsecagg_topology_in_threads(args, _dataset_fn, _model_fn)
+    assert len(history) == 2
+    assert history[-1]["test_acc"] > 0.2
+
+
+def test_lightsecagg_with_dropout():
+    """Client 2 drops after the sub-mask exchange; aggregation still completes
+    from the surviving 2 of 3 clients (u=2)."""
+    LoopbackHub.reset()
+    args = fedml_tpu.init(_args("lsa-2", rounds=1), should_init_logs=False)
+    args.lsa_privacy_t = 1
+    args.lsa_threshold_u = 2
+    from fedml_tpu.cross_silo.lightsecagg import run_lightsecagg_topology_in_threads
+
+    history = run_lightsecagg_topology_in_threads(args, _dataset_fn, _model_fn, drop_ranks=[2])
+    assert len(history) == 1
+    assert history[-1]["test_acc"] > 0.15
