@@ -44,6 +44,12 @@ def init(args: Arguments | None = None, should_init_logs: bool = True) -> Argume
             level=logging.INFO, format="[%(asctime)s %(name)s] %(message)s"
         )
 
+    from .core import mlops as _mlops
+
+    _mlops.pre_setup(args)
+    if getattr(args, "using_mlops", False):
+        _mlops.init(args)
+
     seed = int(getattr(args, "random_seed", 0))
     _random.seed(seed)
     _np.random.seed(seed)

@@ -1,0 +1,22 @@
+"""Low-level API package (reference ``core/__init__.py`` parity): the
+distributed kernel, algorithm frame, privacy/security, MPC, scheduling and
+MLOps subsystems, re-exported for user code."""
+
+from .aggregate import FedMLAggOperator
+from .alg_frame.client_trainer import ClientTrainer
+from .alg_frame.params import Params
+from .alg_frame.server_aggregator import ServerAggregator
+from .distributed.comm_manager import FedMLCommManager
+from .distributed.communication.message import Message
+from .distributed.flow import FedMLAlgorithmFlow, FedMLExecutor
+
+__all__ = [
+    "FedMLAggOperator",
+    "ClientTrainer",
+    "Params",
+    "ServerAggregator",
+    "FedMLCommManager",
+    "Message",
+    "FedMLAlgorithmFlow",
+    "FedMLExecutor",
+]

@@ -10,6 +10,8 @@ from typing import Any, Dict
 
 
 class Params:
+    KEY_MODEL_PARAMS = "model_params"
+
     def __init__(self, **kwargs: Any):
         self.__dict__["_store"]: Dict[str, Any] = dict(kwargs)
 

@@ -1,0 +1,13 @@
+"""FedMLAlgorithmFlow DSL: declarative message-driven algorithm graphs.
+
+Parity with reference ``core/distributed/flow/`` (``fedml_flow.py:20``,
+``fedml_executor.py``): users subclass :class:`FedMLExecutor`, register task
+methods as a linear flow with :meth:`FedMLAlgorithmFlow.add_flow`, and the
+runtime executes the chain across nodes, shipping each task's returned
+``Params`` to the node(s) owning the next task.
+"""
+
+from .fedml_executor import FedMLExecutor
+from .fedml_flow import FedMLAlgorithmFlow
+
+__all__ = ["FedMLExecutor", "FedMLAlgorithmFlow"]

@@ -1,0 +1,34 @@
+"""Flow node: owns an id, a neighbor list, and the current task params.
+
+Parity with reference ``core/distributed/flow/fedml_executor.py``."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...alg_frame.params import Params
+
+
+class FedMLExecutor:
+    def __init__(self, id: int, neighbor_id_list: List[int]):
+        self.id = int(id)
+        self.neighbor_id_list = [int(i) for i in neighbor_id_list]
+        self._params: Optional[Params] = None
+
+    def get_id(self) -> int:
+        return self.id
+
+    def set_id(self, id: int) -> None:
+        self.id = int(id)
+
+    def get_neighbor_id_list(self) -> List[int]:
+        return self.neighbor_id_list
+
+    def set_neighbor_id_list(self, ids: List[int]) -> None:
+        self.neighbor_id_list = [int(i) for i in ids]
+
+    def get_params(self) -> Optional[Params]:
+        return self._params
+
+    def set_params(self, params: Optional[Params]) -> None:
+        self._params = params

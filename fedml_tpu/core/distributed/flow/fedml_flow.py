@@ -1,0 +1,192 @@
+"""The flow runtime: a linear task chain executed across message-passing nodes.
+
+Behavioral parity with reference ``core/distributed/flow/fedml_flow.py``:
+
+* ``add_flow(name, ExecutorCls.task)`` appends a task; the *class that defined
+  the method* decides which nodes run it (every node holds one live executor).
+* ``build()`` freezes the chain and computes each entry's successor.
+* ``run()`` starts with a neighbor liveness handshake (check/report status,
+  reference ``fedml_flow.py:41-52``); once all neighbors are online, the node
+  owning flow 0 starts the chain.
+* A task returns ``Params`` to advance (shipped to the next task's nodes) or
+  ``None`` to hold (e.g. a server aggregation task waiting for more clients).
+* After the last entry the flow broadcasts FINISH and all nodes shut down.
+
+Implementation is new: built on this repo's ``FedMLCommManager`` contract, so
+it runs over loopback (unit tests), gRPC, or the MQTT-style backend unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from ...alg_frame.params import Params
+from ..comm_manager import FedMLCommManager
+from ..communication.message import Message
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _FlowEntry:
+    idx: int
+    name: str
+    task: Callable
+    owner_cls: str  # class name that defined the task method
+    tag: str = "FLOW_TAG_ONCE"
+
+
+class FedMLAlgorithmFlow(FedMLCommManager):
+    ONCE = "FLOW_TAG_ONCE"
+    FINISH = "FLOW_TAG_FINISH"
+
+    MSG_TYPE_FLOW = "flow_execute"
+    MSG_TYPE_FINISH = "flow_finish"
+    MSG_TYPE_CHECK_STATUS = "flow_check_node_status"
+    MSG_TYPE_REPORT_STATUS = "flow_report_node_status"
+
+    ARG_FLOW_IDX = "flow_idx"
+    ARG_FLOW_PARAMS = "flow_params"
+
+    def __init__(self, args, executor):
+        self.executor = executor
+        self.flows: List[_FlowEntry] = []
+        self._built = False
+        self._ready = threading.Event()
+        self._online_neighbors: set = set()
+        self._finished = threading.Event()
+        rank = executor.get_id()
+        size = len(executor.get_neighbor_id_list()) + 1
+        backend = str(getattr(args, "backend", "LOOPBACK"))
+        super().__init__(args, comm=None, rank=rank, size=size, backend=backend)
+
+    # -- DSL ----------------------------------------------------------------
+    def add_flow(self, flow_name: str, executor_task: Callable, flow_tag: str = ONCE) -> None:
+        assert not self._built, "add_flow after build()"
+        owner = _defining_class_name(executor_task)
+        self.flows.append(
+            _FlowEntry(len(self.flows), str(flow_name), executor_task, owner, str(flow_tag))
+        )
+
+    def build(self) -> None:
+        assert self.flows, "empty flow"
+        self._built = True
+        logger.info(
+            "flow built: %s", [(f.idx, f.name, f.owner_cls) for f in self.flows]
+        )
+
+    # -- comm wiring ---------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler("connection_ready", self._handle_connection_ready)
+        self.register_message_receive_handler(self.MSG_TYPE_CHECK_STATUS, self._handle_check_status)
+        self.register_message_receive_handler(self.MSG_TYPE_REPORT_STATUS, self._handle_report_status)
+        self.register_message_receive_handler(self.MSG_TYPE_FLOW, self._handle_flow_message)
+        self.register_message_receive_handler(self.MSG_TYPE_FINISH, self._handle_finish)
+
+    def _handle_connection_ready(self, _msg: Message) -> None:
+        for nid in self.executor.get_neighbor_id_list():
+            msg = Message(self.MSG_TYPE_CHECK_STATUS, self.rank, nid)
+            self.send_message(msg)
+
+    def _handle_check_status(self, msg: Message) -> None:
+        reply = Message(self.MSG_TYPE_REPORT_STATUS, self.rank, msg.get_sender_id())
+        self.send_message(reply)
+        # a neighbor probing us proves it is alive too
+        self._mark_online(msg.get_sender_id())
+
+    def _handle_report_status(self, msg: Message) -> None:
+        self._mark_online(msg.get_sender_id())
+
+    def _mark_online(self, neighbor_id: int) -> None:
+        self._online_neighbors.add(int(neighbor_id))
+        if not self._ready.is_set() and self._online_neighbors >= set(
+            self.executor.get_neighbor_id_list()
+        ):
+            self._ready.set()
+            logger.info("rank %s: all neighbors online", self.rank)
+            self._on_ready_to_run_flow()
+
+    def _on_ready_to_run_flow(self) -> None:
+        if self._owns(self.flows[0]):
+            self._execute_chain(0, Params())
+
+    # -- execution -----------------------------------------------------------
+    def _owns(self, entry: _FlowEntry) -> bool:
+        return any(c.__name__ == entry.owner_cls for c in type(self.executor).__mro__)
+
+    def _handle_flow_message(self, msg: Message) -> None:
+        idx = int(msg.get(self.ARG_FLOW_IDX))
+        params = Params(**(msg.get(self.ARG_FLOW_PARAMS) or {}))
+        entry = self.flows[idx]
+        if not self._owns(entry):
+            logger.debug("rank %s: ignoring flow %s for %s", self.rank, entry.name, entry.owner_cls)
+            return
+        self._execute_chain(idx, params)
+
+    def _execute_chain(self, idx: int, params: Params) -> None:
+        while True:
+            entry = self.flows[idx]
+            logger.debug("rank %s executes flow[%d] %s", self.rank, idx, entry.name)
+            self.executor.set_params(params)
+            result = entry.task(self.executor)
+            if entry.tag == self.FINISH:
+                self._broadcast_finish()
+                return
+            if result is None:
+                # Hold: the task is waiting for more inputs (e.g. an aggregator
+                # with straggler clients pending). A terminal task that returns
+                # None must carry flow_tag=FINISH; holding on the final entry
+                # with no FINISH tag is almost certainly a bug — warn.
+                if idx + 1 >= len(self.flows):
+                    logger.warning(
+                        "rank %s: final flow %r returned None without FINISH tag; holding",
+                        self.rank, entry.name,
+                    )
+                return
+            nxt = idx + 1
+            if nxt >= len(self.flows):
+                self._broadcast_finish()
+                return
+            params = result if isinstance(result, Params) else Params()
+            if self._owns(self.flows[nxt]):
+                idx = nxt  # local pass (reference _pass_message_locally)
+                continue
+            payload = params.to_dict()
+            for nid in self.executor.get_neighbor_id_list():
+                msg = Message(self.MSG_TYPE_FLOW, self.rank, nid)
+                msg.add_params(self.ARG_FLOW_IDX, nxt)
+                msg.add_params(self.ARG_FLOW_PARAMS, payload)
+                self.send_message(msg)
+            return
+
+    # -- shutdown ------------------------------------------------------------
+    def _broadcast_finish(self) -> None:
+        for nid in self.executor.get_neighbor_id_list():
+            self.send_message(Message(self.MSG_TYPE_FINISH, self.rank, nid))
+        self._shutdown()
+
+    def _handle_finish(self, _msg: Message) -> None:
+        self._shutdown()
+
+    def _shutdown(self) -> None:
+        if not self._finished.is_set():
+            self._finished.set()
+            logger.info("rank %s: flow finished", self.rank)
+            self.finish()
+
+    def wait_finished(self, timeout: Optional[float] = None) -> bool:
+        return self._finished.wait(timeout)
+
+
+def _defining_class_name(func: Callable) -> str:
+    qual = getattr(func, "__qualname__", "")
+    if "." in qual:
+        owner = qual.rsplit(".", 2)[-2]
+        if not owner.startswith("<"):  # reject <locals>/<lambda>
+            return owner
+    raise ValueError(
+        f"flow task {func!r} must be an executor-class method (Cls.method)"
+    )

@@ -1,0 +1,153 @@
+"""MLOps facade: the ``fedml_tpu.core.mlops`` one-stop API.
+
+Parity with the reference's 834-line facade ``core/mlops/__init__.py``
+(``event`` :134, ``log`` :152, ``log_round_info`` :410, status reporters,
+``log_sys_perf`` :400): module-level functions backed by a process-global
+context configured by ``init(args)``.  Everything is a no-op until
+``init`` runs, so library code can call these unconditionally (same
+contract as the reference's ``using_mlops`` gating)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from .mlops_metrics import MLOpsMetrics
+from .mlops_profiler_event import MLOpsProfilerEvent
+from .mlops_runtime_log import MLOpsRuntimeLog
+from .mlops_runtime_log_daemon import MLOpsRuntimeLogDaemon
+from .mlops_status import ClientStatus, MLOpsStatus, ServerStatus
+from .sinks import BrokerSink, FanoutSink, InMemorySink, JsonlFileSink
+from .system_stats import SysStats
+
+__all__ = [
+    "MLOpsMetrics", "MLOpsProfilerEvent", "MLOpsRuntimeLog",
+    "MLOpsRuntimeLogDaemon", "MLOpsStatus", "ClientStatus", "ServerStatus",
+    "SysStats", "FanoutSink", "InMemorySink", "JsonlFileSink", "BrokerSink",
+    "pre_setup", "init", "finish", "event", "log", "log_round_info",
+    "log_training_status", "log_aggregation_status", "log_sys_perf",
+    "log_aggregated_model_info", "log_client_model_info", "enabled", "sink",
+]
+
+_lock = threading.Lock()
+_ctx: Dict[str, Any] = {"enabled": False}
+
+
+def enabled() -> bool:
+    return bool(_ctx.get("enabled"))
+
+
+def sink() -> Optional[FanoutSink]:
+    return _ctx.get("sink")
+
+
+def pre_setup(args: Any) -> None:
+    """Stage args before transports exist (mirrors reference pre_setup)."""
+    _ctx["args"] = args
+
+
+def init(args: Any, sink_obj: Optional[FanoutSink] = None) -> None:
+    """Enable the bus. Sinks: always JSONL under ``log_file_dir`` (when set);
+    a broker sink when ``args.mlops_broker_host/port`` are set; plus any
+    caller-provided sink (tests use InMemorySink)."""
+    with _lock:
+        run_id = str(getattr(args, "run_id", "0"))
+        edge_id = int(getattr(args, "rank", 0) or 0)
+        fan = sink_obj if sink_obj is not None else FanoutSink()
+        log_dir = getattr(args, "log_file_dir", None)
+        if log_dir:
+            fan.add(JsonlFileSink(os.path.join(log_dir, f"mlops_{run_id}_{edge_id}.jsonl")))
+        host = getattr(args, "mlops_broker_host", None)
+        port = getattr(args, "mlops_broker_port", None)
+        if host and port:
+            fan.add(BrokerSink(host, int(port), run_id))
+        _ctx.update(
+            enabled=True,
+            args=args,
+            run_id=run_id,
+            edge_id=edge_id,
+            sink=fan,
+            metrics=MLOpsMetrics(run_id, edge_id, fan),
+            profiler=MLOpsProfilerEvent(run_id, edge_id, fan),
+            log_daemon=None,
+        )
+
+
+def start_log_daemon(log_path: str) -> Optional[MLOpsRuntimeLogDaemon]:
+    if not enabled():
+        return None
+    daemon = MLOpsRuntimeLogDaemon(
+        log_path, _ctx["sink"], _ctx["run_id"], _ctx["edge_id"]
+    ).start()
+    _ctx["log_daemon"] = daemon
+    return daemon
+
+
+def finish() -> None:
+    with _lock:
+        daemon = _ctx.get("log_daemon")
+        if daemon is not None:
+            daemon.stop()
+        fan = _ctx.get("sink")
+        if fan is not None:
+            fan.close()
+        MLOpsStatus.get_instance().reset()  # terminal states must not leak into the next run
+        _ctx.clear()
+        _ctx["enabled"] = False
+
+
+# -- facade calls (no-ops until init) --------------------------------------
+
+def event(event_name: str, event_started: bool = True, event_value: Any = None) -> None:
+    if not enabled():
+        return
+    prof: MLOpsProfilerEvent = _ctx["profiler"]
+    if event_started:
+        prof.log_event_started(event_name, event_value)
+    else:
+        prof.log_event_ended(event_name, event_value)
+
+
+def log(metrics: Dict[str, Any]) -> None:
+    if not enabled():
+        return
+    _ctx["metrics"].report_train_metrics(metrics)
+
+
+def log_round_info(total_rounds: int, round_idx: int) -> None:
+    if not enabled():
+        return
+    _ctx["metrics"].report_round_info(total_rounds, round_idx)
+
+
+def log_training_status(status: str, edge_id: Optional[int] = None) -> None:
+    if not enabled():
+        return
+    _ctx["metrics"].report_client_training_status(
+        edge_id if edge_id is not None else _ctx["edge_id"], status
+    )
+
+
+def log_aggregation_status(status: str) -> None:
+    if not enabled():
+        return
+    _ctx["metrics"].report_server_training_status(status)
+
+
+def log_sys_perf(stats: Optional[Dict[str, Any]] = None) -> None:
+    if not enabled():
+        return
+    _ctx["metrics"].report_sys_perf(stats)
+
+
+def log_aggregated_model_info(round_idx: int, model_url: str) -> None:
+    if not enabled():
+        return
+    _ctx["metrics"].report_aggregated_model_info(round_idx, model_url)
+
+
+def log_client_model_info(round_idx: int, model_url: str) -> None:
+    if not enabled():
+        return
+    _ctx["metrics"].report_client_model_info(round_idx, model_url)

@@ -1,0 +1,58 @@
+"""Run telemetry reporter: training/aggregation status, round progress,
+model artifacts, metrics.
+
+Parity with reference ``core/mlops/mlops_metrics.py`` (``MLOpsMetrics``
+publishing to platform MQTT topics): same report surface, records routed to
+the configured sinks and mirrored into the status FSM."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .mlops_status import MLOpsStatus
+from .sinks import FanoutSink
+
+
+class MLOpsMetrics:
+    def __init__(self, run_id: str = "0", edge_id: int = 0, sink: Optional[FanoutSink] = None):
+        self.run_id = str(run_id)
+        self.edge_id = int(edge_id)
+        self.sink = sink if sink is not None else FanoutSink()
+
+    def _emit(self, topic: str, payload: Dict[str, Any]) -> None:
+        self.sink.emit(topic, {"run_id": self.run_id, "edge_id": self.edge_id, **payload})
+
+    # -- status ------------------------------------------------------------
+    def report_client_training_status(self, edge_id: int, status: str) -> None:
+        MLOpsStatus.get_instance().set_client_status(edge_id, status)
+        self._emit("client_status", {"edge_id": edge_id, "status": status})
+
+    def report_server_training_status(self, status: str) -> None:
+        MLOpsStatus.get_instance().set_server_status(self.edge_id, status)
+        self._emit("server_status", {"status": status})
+
+    # -- round progress ----------------------------------------------------
+    def report_round_info(self, total_rounds: int, round_idx: int) -> None:
+        self._emit("round_info", {"total_rounds": total_rounds, "round_idx": round_idx})
+
+    # -- metrics -----------------------------------------------------------
+    def report_train_metrics(self, metrics: Dict[str, Any]) -> None:
+        self._emit("train_metric", dict(metrics))
+
+    def report_aggregation_metrics(self, metrics: Dict[str, Any]) -> None:
+        self._emit("agg_metric", dict(metrics))
+
+    # -- artifacts ---------------------------------------------------------
+    def report_aggregated_model_info(self, round_idx: int, model_url: str) -> None:
+        self._emit("aggregated_model", {"round_idx": round_idx, "model_url": model_url})
+
+    def report_client_model_info(self, round_idx: int, model_url: str) -> None:
+        self._emit("client_model", {"round_idx": round_idx, "model_url": model_url})
+
+    # -- system ------------------------------------------------------------
+    def report_sys_perf(self, stats: Optional[Dict[str, Any]] = None) -> None:
+        if stats is None:
+            from .system_stats import SysStats
+
+            stats = SysStats().produce_info()
+        self._emit("sys_perf", stats)

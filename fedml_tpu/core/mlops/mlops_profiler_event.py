@@ -1,0 +1,70 @@
+"""Span-style profiler events for the run timeline.
+
+Parity with reference ``core/mlops/mlops_profiler_event.py:9``
+(``MLOpsProfilerEvent``: start/end events with run/edge ids to MQTT + wandb):
+start/end pairs go to the sinks with wall-clock durations; on-device time is
+the domain of ``jax.profiler``, so ``trace()`` additionally opens a
+``jax.profiler.TraceAnnotation`` making FL-protocol spans visible inside
+XLA/TensorBoard traces."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Optional
+
+from .sinks import FanoutSink
+
+
+class MLOpsProfilerEvent:
+    def __init__(self, run_id: str = "0", edge_id: int = 0, sink: Optional[FanoutSink] = None):
+        self.run_id = str(run_id)
+        self.edge_id = int(edge_id)
+        self.sink = sink if sink is not None else FanoutSink()
+        self._open: Dict[str, float] = {}
+
+    def log_event_started(self, event_name: str, event_value: Any = None) -> None:
+        self._open[event_name] = time.time()
+        self.sink.emit(
+            "event",
+            {
+                "run_id": self.run_id,
+                "edge_id": self.edge_id,
+                "event": event_name,
+                "phase": "started",
+                "value": event_value,
+            },
+        )
+
+    def log_event_ended(self, event_name: str, event_value: Any = None) -> None:
+        t0 = self._open.pop(event_name, None)
+        self.sink.emit(
+            "event",
+            {
+                "run_id": self.run_id,
+                "edge_id": self.edge_id,
+                "event": event_name,
+                "phase": "ended",
+                "value": event_value,
+                "duration_s": round(time.time() - t0, 6) if t0 is not None else None,
+            },
+        )
+
+    @contextlib.contextmanager
+    def trace(self, event_name: str):
+        """Span context: sink event pair + XLA trace annotation."""
+        ann = None
+        try:
+            import jax.profiler
+
+            ann = jax.profiler.TraceAnnotation(event_name)
+            ann.__enter__()
+        except Exception:  # pragma: no cover - profiler unavailable
+            ann = None
+        self.log_event_started(event_name)
+        try:
+            yield self
+        finally:
+            self.log_event_ended(event_name)
+            if ann is not None:
+                ann.__exit__(None, None, None)

@@ -1,0 +1,67 @@
+"""Structured run logging: prefix every record with run/role/rank.
+
+Parity with reference ``core/mlops/mlops_runtime_log.py`` (``MLOpsRuntimeLog``
+formatter + excepthook install); writes to stderr and, when
+``tracking_args.log_file_dir`` is set, to ``fedml_run_<run_id>_<rank>.log``
+— the file the log daemon tails."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Any, Optional
+
+
+class MLOpsFormatter(logging.Formatter):
+    def __init__(self, run_id: str = "0", rank: int = 0, role: str = "client"):
+        super().__init__(
+            fmt="[FedML-{role} run:{run} rank:{rank}] %(asctime)s "
+            "[%(levelname)s] [%(filename)s:%(lineno)d] %(message)s".format(
+                role=role, run=run_id, rank=rank
+            )
+        )
+
+
+class MLOpsRuntimeLog:
+    _instance: Optional["MLOpsRuntimeLog"] = None
+
+    def __init__(self, args: Any = None):
+        self.args = args
+        self.run_id = str(getattr(args, "run_id", "0"))
+        self.rank = int(getattr(args, "rank", 0) or 0)
+        self.role = str(getattr(args, "role", "client"))
+        self.log_path: Optional[str] = None
+
+    @classmethod
+    def get_instance(cls, args: Any = None) -> "MLOpsRuntimeLog":
+        if cls._instance is None:
+            cls._instance = cls(args)
+        return cls._instance
+
+    def init_logs(self, level: int = logging.INFO) -> None:
+        fmt = MLOpsFormatter(self.run_id, self.rank, self.role)
+        root = logging.getLogger()
+        root.setLevel(level)
+        stream = logging.StreamHandler(sys.stderr)
+        stream.setFormatter(fmt)
+        root.addHandler(stream)
+        log_dir = getattr(self.args, "log_file_dir", None)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self.log_path = os.path.join(
+                log_dir, f"fedml_run_{self.run_id}_{self.rank}.log"
+            )
+            fh = logging.FileHandler(self.log_path)
+            fh.setFormatter(fmt)
+            root.addHandler(fh)
+        sys.excepthook = self._excepthook
+
+    @staticmethod
+    def _excepthook(exc_type, exc_value, exc_tb) -> None:
+        if issubclass(exc_type, KeyboardInterrupt):
+            sys.__excepthook__(exc_type, exc_value, exc_tb)
+            return
+        logging.getLogger().critical(
+            "uncaught exception", exc_info=(exc_type, exc_value, exc_tb)
+        )

@@ -1,0 +1,88 @@
+"""Log-shipping daemon: tail the run log file and push chunks to a sink.
+
+Parity with reference ``core/mlops/mlops_runtime_log_daemon.py:14,276``
+(``MLOpsRuntimeLogProcessor`` tailing the log file and POSTing chunks to the
+platform log server): same tail/chunk/ship loop, with the HTTP POST replaced
+by the pluggable sink bus (offline-first; a broker sink gives live remote
+tailing)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+from .sinks import FanoutSink
+
+
+class MLOpsRuntimeLogDaemon:
+    def __init__(
+        self,
+        log_path: str,
+        sink: Optional[FanoutSink] = None,
+        run_id: str = "0",
+        rank: int = 0,
+        chunk_lines: int = 100,
+        poll_interval_s: float = 1.0,
+    ):
+        self.log_path = log_path
+        self.sink = sink if sink is not None else FanoutSink()
+        self.run_id = str(run_id)
+        self.rank = int(rank)
+        self.chunk_lines = int(chunk_lines)
+        self.poll_interval_s = float(poll_interval_s)
+        self.lines_shipped = 0
+        self._offset = 0
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MLOpsRuntimeLogDaemon":
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="mlops-log-daemon")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.flush()
+
+    def flush(self) -> None:
+        for chunk in iter(self._read_chunk, None):
+            self._ship(chunk)
+
+    def _loop(self) -> None:
+        while self._running:
+            chunk = self._read_chunk()
+            if chunk:
+                self._ship(chunk)
+            else:
+                time.sleep(self.poll_interval_s)
+
+    def _read_chunk(self) -> Optional[List[str]]:
+        if not os.path.exists(self.log_path):
+            return None
+        with open(self.log_path, "r", errors="replace") as f:
+            f.seek(self._offset)
+            lines: List[str] = []
+            while len(lines) < self.chunk_lines:
+                line = f.readline()
+                if not line or not line.endswith("\n"):
+                    break  # partial line: wait for the writer to finish it
+                lines.append(line.rstrip("\n"))
+            self._offset = f.tell()
+        return lines or None
+
+    def _ship(self, lines: List[str]) -> None:
+        self.sink.emit(
+            "log_chunk",
+            {
+                "run_id": self.run_id,
+                "rank": self.rank,
+                "first_line": self.lines_shipped,
+                "lines": lines,
+            },
+        )
+        self.lines_shipped += len(lines)

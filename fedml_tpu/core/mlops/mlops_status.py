@@ -1,0 +1,94 @@
+"""Client/server run-status finite state machine.
+
+Parity with reference ``core/mlops/mlops_status.py`` + the status constants
+in ``cli/*/constants.py``: a run moves through a fixed lifecycle; illegal
+transitions raise so protocol bugs surface in tests instead of dashboards."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class ClientStatus:
+    IDLE = "IDLE"
+    INITIALIZING = "INITIALIZING"
+    TRAINING = "TRAINING"
+    STOPPING = "STOPPING"
+    KILLED = "KILLED"
+    FAILED = "FAILED"
+    FINISHED = "FINISHED"
+
+
+class ServerStatus:
+    IDLE = "IDLE"
+    STARTING = "STARTING"
+    RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
+    KILLED = "KILLED"
+    FAILED = "FAILED"
+    FINISHED = "FINISHED"
+
+
+_CLIENT_EDGES = {
+    ClientStatus.IDLE: {ClientStatus.INITIALIZING, ClientStatus.KILLED, ClientStatus.FAILED},
+    ClientStatus.INITIALIZING: {ClientStatus.TRAINING, ClientStatus.STOPPING, ClientStatus.KILLED, ClientStatus.FAILED},
+    ClientStatus.TRAINING: {ClientStatus.TRAINING, ClientStatus.STOPPING, ClientStatus.FINISHED, ClientStatus.KILLED, ClientStatus.FAILED},
+    ClientStatus.STOPPING: {ClientStatus.KILLED, ClientStatus.FINISHED, ClientStatus.FAILED},
+    ClientStatus.KILLED: set(),
+    ClientStatus.FAILED: set(),
+    ClientStatus.FINISHED: set(),
+}
+
+_SERVER_EDGES = {
+    ServerStatus.IDLE: {ServerStatus.STARTING, ServerStatus.KILLED, ServerStatus.FAILED},
+    ServerStatus.STARTING: {ServerStatus.RUNNING, ServerStatus.STOPPING, ServerStatus.KILLED, ServerStatus.FAILED},
+    ServerStatus.RUNNING: {ServerStatus.RUNNING, ServerStatus.STOPPING, ServerStatus.FINISHED, ServerStatus.KILLED, ServerStatus.FAILED},
+    ServerStatus.STOPPING: {ServerStatus.KILLED, ServerStatus.FINISHED, ServerStatus.FAILED},
+    ServerStatus.KILLED: set(),
+    ServerStatus.FAILED: set(),
+    ServerStatus.FINISHED: set(),
+}
+
+
+class MLOpsStatus:
+    """Singleton registry of the latest reported status per (role, id)."""
+
+    _instance: Optional["MLOpsStatus"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._status: Dict[Tuple[str, int], str] = {}
+
+    @classmethod
+    def get_instance(cls) -> "MLOpsStatus":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def _set(self, role: str, node_id: int, status: str, edges, initial: str) -> None:
+        with self._lock:
+            cur = self._status.get((role, node_id), initial)
+            if status != cur and status not in edges[cur]:
+                raise ValueError(f"illegal {role} status transition {cur} -> {status}")
+            self._status[(role, node_id)] = status
+
+    def set_client_status(self, client_id: int, status: str) -> None:
+        self._set("client", client_id, status, _CLIENT_EDGES, ClientStatus.IDLE)
+
+    def set_server_status(self, server_id: int, status: str) -> None:
+        self._set("server", server_id, status, _SERVER_EDGES, ServerStatus.IDLE)
+
+    def get_client_status(self, client_id: int) -> str:
+        with self._lock:
+            return self._status.get(("client", client_id), ClientStatus.IDLE)
+
+    def get_server_status(self, server_id: int) -> str:
+        with self._lock:
+            return self._status.get(("server", server_id), ServerStatus.IDLE)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._status.clear()

@@ -1,0 +1,95 @@
+"""Pluggable event/metric sinks for the MLOps bus.
+
+The reference publishes metrics/events/status over MQTT to the hosted
+platform and logs to wandb (``core/mlops/mlops_metrics.py``,
+``mlops_profiler_event.py``).  This rebuild is offline-first: every record
+goes to one or more local sinks; a broker-backed sink provides the same
+"live telemetry over pub/sub" shape using the in-tree broker when a run
+configures one (zero external dependencies)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Sink:
+    def emit(self, topic: str, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemorySink(Sink):
+    """Test/introspection sink: keeps (topic, record) tuples in memory."""
+
+    def __init__(self):
+        self.records: List[tuple] = []
+        self._lock = threading.Lock()
+
+    def emit(self, topic: str, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self.records.append((topic, dict(record)))
+
+    def by_topic(self, topic: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r for t, r in self.records if t == topic]
+
+
+class JsonlFileSink(Sink):
+    """Append-only JSONL file, one stream per run (the durable sink)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._fh = open(path, "a")
+        self._lock = threading.Lock()
+
+    def emit(self, topic: str, record: Dict[str, Any]) -> None:
+        line = json.dumps({"topic": topic, **record})
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+
+class BrokerSink(Sink):
+    """Publishes records to the in-tree pub/sub broker (MQTT-reporting
+    parity): topic ``fedml_mlops/<run_id>/<kind>``."""
+
+    def __init__(self, host: str, port: int, run_id: str):
+        from ..distributed.communication.mqtt_s3.broker import BrokerClient
+
+        self.run_id = str(run_id)
+        self._client = BrokerClient(host, int(port), on_message=lambda t, p: None)
+
+    def emit(self, topic: str, record: Dict[str, Any]) -> None:
+        self._client.publish(f"fedml_mlops/{self.run_id}/{topic}", dict(record))
+
+    def close(self) -> None:
+        self._client.disconnect()
+
+
+class FanoutSink(Sink):
+    def __init__(self, sinks: Optional[List[Sink]] = None):
+        self.sinks = list(sinks or [])
+
+    def add(self, sink: Sink) -> None:
+        self.sinks.append(sink)
+
+    def emit(self, topic: str, record: Dict[str, Any]) -> None:
+        rec = dict(record)
+        rec.setdefault("ts", round(time.time(), 3))
+        for s in self.sinks:
+            s.emit(topic, rec)
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()

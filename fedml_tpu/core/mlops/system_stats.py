@@ -1,0 +1,93 @@
+"""Host + accelerator utilization snapshots.
+
+Parity with reference ``core/mlops/system_stats.py`` (``SysStats`` via
+psutil/gpustat): CPU, memory, disk, network, process stats — plus the TPU
+twist: per-device HBM usage from ``jax`` memory stats instead of gpustat."""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List
+
+try:
+    import psutil  # optional in this image
+except ImportError:  # pragma: no cover
+    psutil = None
+
+
+def _proc_meminfo() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, v = line.split(":", 1)
+                out[k.strip()] = int(v.strip().split()[0]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+class SysStats:
+    def __init__(self, process_id: int = None):
+        self.process_id = process_id if process_id is not None else os.getpid()
+        self._proc = psutil.Process(self.process_id) if psutil else None
+
+    def produce_info(self) -> Dict[str, Any]:
+        info: Dict[str, Any] = {"ts": round(time.time(), 3), "pid": self.process_id}
+        if psutil:
+            vm = psutil.virtual_memory()
+            info.update(
+                cpu_utilization=psutil.cpu_percent(interval=None),
+                system_memory_total=vm.total,
+                system_memory_used=vm.used,
+                system_memory_utilization=vm.percent,
+                process_memory_in_use=self._proc.memory_info().rss,
+                process_cpu_threads_in_use=self._proc.num_threads(),
+            )
+            try:
+                du = psutil.disk_usage("/")
+                info.update(disk_utilization=du.percent)
+            except OSError:
+                pass
+        else:  # /proc fallback keeps the schema populated without psutil
+            mi = _proc_meminfo()
+            total = mi.get("MemTotal", 0)
+            avail = mi.get("MemAvailable", 0)
+            info.update(
+                system_memory_total=total,
+                system_memory_used=max(total - avail, 0),
+                system_memory_utilization=round(100.0 * (total - avail) / total, 2) if total else 0.0,
+                cpu_utilization=_loadavg_percent(),
+            )
+        info["devices"] = self.device_stats()
+        return info
+
+    @staticmethod
+    def device_stats() -> List[Dict[str, Any]]:
+        """Per-accelerator HBM stats (jax memory_stats; empty on CPU)."""
+        out: List[Dict[str, Any]] = []
+        try:
+            import jax
+
+            for d in jax.devices():
+                ms = d.memory_stats() if hasattr(d, "memory_stats") else None
+                if ms:
+                    out.append(
+                        {
+                            "device": str(d),
+                            "bytes_in_use": ms.get("bytes_in_use", 0),
+                            "bytes_limit": ms.get("bytes_limit", 0),
+                            "peak_bytes_in_use": ms.get("peak_bytes_in_use", 0),
+                        }
+                    )
+        except Exception:  # pragma: no cover - no jax / no backend
+            pass
+        return out
+
+
+def _loadavg_percent() -> float:
+    try:
+        return round(100.0 * os.getloadavg()[0] / max(os.cpu_count() or 1, 1), 2)
+    except OSError:  # pragma: no cover
+        return 0.0

@@ -1,0 +1,17 @@
+"""Heterogeneity-aware client→device scheduling.
+
+Parity with reference ``core/schedule/`` (``seq_train_scheduler.py:9-50``
+``SeqTrainScheduler.DP_schedule``; ``runtime_estimate.py:16`` ``t_sample_fit``):
+assign per-client workloads to compute slots so the slowest slot (makespan)
+is minimized, using a fitted linear per-sample runtime model.
+
+TPU-first differences: the schedule is *static per round* — it decides the
+layout of the ``lax.scan``-over-clients inside the compiled round program
+(simulation/xla/fed_sim.py), so the output is a dense [n_dev, per_dev]
+client-id matrix with a validity mask rather than ragged Python lists.
+"""
+
+from .runtime_estimate import RuntimeEstimator, linear_fit
+from .seq_train_scheduler import SeqTrainScheduler
+
+__all__ = ["RuntimeEstimator", "linear_fit", "SeqTrainScheduler"]

@@ -1,0 +1,139 @@
+"""Flow DSL: the reference's canonical 1-server/2-client flow
+(core/distributed/flow/test_fedml_flow.py shape) over the loopback backend,
+all nodes in one process."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core import FedMLAlgorithmFlow, FedMLExecutor, Params
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+
+
+class _Args:
+    def __init__(self, **kw):
+        self.backend = "LOOPBACK"
+        self.run_id = "flow-test"
+        self.__dict__.update(kw)
+
+
+class FlowClient(FedMLExecutor):
+    def __init__(self, args):
+        super().__init__(id=args.rank, neighbor_id_list=[0])
+        self.trained = 0
+
+    def handle_init_global_model(self):
+        received = self.get_params()
+        params = Params()
+        params.add(Params.KEY_MODEL_PARAMS, received.get(Params.KEY_MODEL_PARAMS))
+        return params
+
+    def local_training(self):
+        self.trained += 1
+        w = np.asarray(self.get_params().get(Params.KEY_MODEL_PARAMS))
+        params = Params()
+        params.add(Params.KEY_MODEL_PARAMS, w + 1.0)
+        return params
+
+
+class FlowServer(FedMLExecutor):
+    def __init__(self, args, client_num=2):
+        super().__init__(id=args.rank, neighbor_id_list=list(range(1, client_num + 1)))
+        self.client_num = client_num
+        self.client_count = 0
+        self.acc = None
+        self.rounds_done = 0
+        self.final_called = threading.Event()
+
+    def init_global_model(self):
+        params = Params()
+        params.add(Params.KEY_MODEL_PARAMS, np.zeros(3))
+        return params
+
+    def server_aggregate(self):
+        w = np.asarray(self.get_params().get(Params.KEY_MODEL_PARAMS))
+        self.acc = w if self.acc is None else self.acc + w
+        self.client_count += 1
+        if self.client_count < self.client_num:
+            return None  # hold until all clients reported
+        mean = self.acc / self.client_num
+        self.client_count = 0
+        self.acc = None
+        self.rounds_done += 1
+        params = Params()
+        params.add(Params.KEY_MODEL_PARAMS, mean)
+        return params
+
+    def final_eval(self):
+        self.final_called.set()
+
+    def server_aggregate_then_finish(self):
+        result = self.server_aggregate()
+        if result is None:
+            return None  # hold: stragglers pending
+        self.final_called.set()
+        return result
+
+
+@pytest.mark.parametrize("comm_round", [1, 3])
+def test_flow_fedavg_roundtrip(comm_round):
+    LoopbackHub.reset()
+    server = FlowServer(_Args(rank=0))
+    clients = [FlowClient(_Args(rank=r)) for r in (1, 2)]
+
+    flows = []
+    for executor in [server] + clients:
+        flow = FedMLAlgorithmFlow(_Args(rank=executor.get_id()), executor)
+        flow.add_flow("init_global_model", FlowServer.init_global_model)
+        flow.add_flow("handle_init", FlowClient.handle_init_global_model)
+        for _ in range(comm_round):
+            flow.add_flow("local_training", FlowClient.local_training)
+            flow.add_flow("server_aggregate", FlowServer.server_aggregate)
+        flow.add_flow("final_eval", FlowServer.final_eval, flow_tag=FedMLAlgorithmFlow.FINISH)
+        flow.build()
+        flows.append(flow)
+
+    threads = [f.run_async() for f in flows]
+    for f in flows:
+        assert f.wait_finished(timeout=30), "flow did not finish"
+    for t in threads:
+        t.join(timeout=10)
+
+    assert server.final_called.is_set()
+    assert server.rounds_done == comm_round
+    for c in clients:
+        assert c.trained == comm_round
+
+
+def test_flow_aggregate_as_last_entry_holds_until_all_clients():
+    """A None-returning (holding) aggregator as the final untagged entry must
+    NOT finish the flow after the first client report (code-review finding)."""
+    LoopbackHub.reset()
+    server = FlowServer(_Args(rank=0))
+    clients = [FlowClient(_Args(rank=r)) for r in (1, 2)]
+    flows = []
+    for executor in [server] + clients:
+        flow = FedMLAlgorithmFlow(_Args(rank=executor.get_id()), executor)
+        flow.add_flow("init_global_model", FlowServer.init_global_model)
+        flow.add_flow("handle_init", FlowClient.handle_init_global_model)
+        flow.add_flow("local_training", FlowClient.local_training)
+        # server_aggregate returns Params once all clients reported; tag it
+        # FINISH so completion (not premature first-report) ends the flow
+        flow.add_flow("server_aggregate", FlowServer.server_aggregate_then_finish)
+        flow.build()
+        flows.append(flow)
+    threads = [f.run_async() for f in flows]
+    for f in flows:
+        assert f.wait_finished(timeout=30)
+    for t in threads:
+        t.join(timeout=10)
+    assert server.rounds_done == 1  # both clients were aggregated, not one
+
+
+def test_flow_task_must_be_method():
+    LoopbackHub.reset()
+    server = FlowServer(_Args(rank=0))
+    flow = FedMLAlgorithmFlow(_Args(rank=0), server)
+    with pytest.raises(ValueError):
+        flow.add_flow("bad", lambda: None)

@@ -1,0 +1,58 @@
+"""core/schedule: runtime fitting + makespan scheduling (reference
+core/schedule/seq_train_scheduler.py + runtime_estimate.py parity)."""
+
+import numpy as np
+
+from fedml_tpu.core.schedule import RuntimeEstimator, SeqTrainScheduler, linear_fit
+
+
+def test_linear_fit_recovers_line():
+    x = np.array([10, 20, 40, 80])
+    y = 0.5 * x + 3
+    a, b, err = linear_fit(x, y)
+    assert abs(a - 0.5) < 1e-9 and abs(b - 3) < 1e-6 and err < 1e-9
+
+
+def test_linear_fit_degenerate():
+    a, b, err = linear_fit([5.0], [2.0])
+    assert a == 0.0 and b == 2.0
+
+
+def test_estimator_predict():
+    est = RuntimeEstimator(4)
+    assert est.predict(0, 100) is None and not est.has_model()
+    for n in (100, 200, 400):
+        est.record(0, n, 0.01 * n + 1.0)
+    assert abs(est.predict(2, 300) - 4.0) < 1e-6  # uniform devices pool obs
+    assert est.fit_error() < 1e-9
+
+
+def test_schedule_balances_makespan():
+    sched = SeqTrainScheduler(4)
+    sizes = [100, 100, 100, 100, 1, 1, 1, 1]
+    ids, mask, makespan = sched.schedule(list(range(8)), sizes)
+    assert ids.shape == (4, 2) and mask.sum() == 8
+    loads = (np.vectorize(lambda c: sizes[c])(ids) * mask).sum(1)
+    assert loads.max() == 101  # one big + one small per slot is optimal
+
+    # every client appears exactly once
+    assert sorted(ids[mask.astype(bool)].tolist()) == list(range(8))
+
+
+def test_schedule_pads_uneven():
+    sched = SeqTrainScheduler(4)
+    ids, mask, _ = sched.schedule([7, 9, 11], [5, 6, 7])
+    assert ids.shape == (4, 1)
+    assert mask.sum() == 3  # one padding slot
+
+
+def test_schedule_uses_runtime_model():
+    # per-client fixed cost dominates -> balanced COUNTS beat balanced samples
+    est = RuntimeEstimator(2)
+    for n in (10, 1000):
+        est.record(0, n, 10.0 + 0.001 * n)  # b=10s, a=1ms/sample
+    sched = SeqTrainScheduler(2, estimator=est)
+    sizes = [1000, 500, 500, 1, 1, 1]
+    ids, mask, makespan = sched.schedule(list(range(6)), sizes)
+    counts_per_dev = mask.sum(1)
+    assert counts_per_dev.max() == 3  # 3+3 split, not samples-only 1+5
