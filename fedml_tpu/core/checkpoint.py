@@ -1,0 +1,138 @@
+"""Round checkpoint / resume.
+
+The reference has NO general round-checkpointing — only per-round artifact
+uploads to S3/MLOps (``core/mlops/__init__.py:351-399``
+``log_aggregated_model_info`` / ``log_client_model_info``) and the MNN global
+model file (``cross_device/server_mnn/fedml_aggregator.py:38``).  SURVEY.md §5
+calls for the rebuild to add proper checkpoint/restore of
+``(global params, round_idx, rng, optimizer state)`` — this module is that.
+
+Design: one directory per run, one ``ckpt_<step>.msgpack`` per saved round
+(flax msgpack serialization — restores to numpy leaves without needing a
+target pytree), a JSON sidecar with step metadata, atomic tmp+rename writes
+so a crash mid-save never corrupts the latest checkpoint, and a keep-last-N
+retention policy.  Device arrays are pulled to host numpy on save; callers
+``jax.device_put`` (or just feed into jit) on restore.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+logger = logging.getLogger(__name__)
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+
+
+def _to_host(tree: Any) -> Any:
+    """Pull every array leaf to host numpy (msgpack can't see device arrays)."""
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+class CheckpointManager:
+    """Save/restore a state pytree keyed by integer step (FL round index)."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = max(int(keep), 1)
+        os.makedirs(directory, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{step}.msgpack")
+
+    def all_steps(self) -> List[int]:
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _CKPT_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save/restore --------------------------------------------------------
+    def save(self, step: int, state: Any, metadata: Optional[Dict[str, Any]] = None) -> str:
+        """Atomically write ``state`` for ``step``; prunes old checkpoints."""
+        payload = serialization.msgpack_serialize(_to_host(state))
+        path = self._path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+        meta = {"step": int(step), "time": time.time()}
+        if metadata:
+            meta.update(metadata)
+        meta_tmp = path + ".json.tmp"
+        with open(meta_tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(meta_tmp, path + ".json")
+        self._prune()
+        logger.info("checkpoint saved: %s", path)
+        return path
+
+    def restore(self, step: Optional[int] = None) -> Tuple[int, Any]:
+        """Restore ``(step, state)``; latest step when ``step`` is None.
+
+        Raises ``FileNotFoundError`` when the directory holds no checkpoint.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        with open(self._path(step), "rb") as f:
+            state = serialization.msgpack_restore(f.read())
+        return int(step), state
+
+    def metadata(self, step: int) -> Dict[str, Any]:
+        try:
+            with open(self._path(step) + ".json") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"step": step}
+
+    def _prune(self) -> None:
+        steps = self.all_steps()
+        for old in steps[: -self.keep]:
+            for suffix in ("", ".json"):
+                try:
+                    os.remove(self._path(old) + suffix)
+                except FileNotFoundError:
+                    pass
+
+
+def maybe_checkpointer(args: Any) -> Optional[CheckpointManager]:
+    """Build a CheckpointManager from config, or None when disabled.
+
+    Config keys (train_args): ``checkpoint_dir`` (enables), ``checkpoint_keep``
+    (default 3), ``checkpoint_frequency`` (rounds between saves, default 1).
+    """
+    directory = getattr(args, "checkpoint_dir", None)
+    if not directory:
+        return None
+    return CheckpointManager(str(directory), keep=int(getattr(args, "checkpoint_keep", 3)))
+
+
+def checkpoint_frequency(args: Any) -> int:
+    return max(int(getattr(args, "checkpoint_frequency", 1)), 1)

@@ -42,6 +42,10 @@ class _FlowEntry:
 class FedMLAlgorithmFlow(FedMLCommManager):
     ONCE = "FLOW_TAG_ONCE"
     FINISH = "FLOW_TAG_FINISH"
+    # Explicit hold sentinel: return this from a task to wait for more inputs.
+    # Unlike a bare None it also holds FINISH-tagged tasks (straggler-waiting
+    # terminal aggregators).
+    HOLD = object()
 
     MSG_TYPE_FLOW = "flow_execute"
     MSG_TYPE_FINISH = "flow_finish"
@@ -132,19 +136,20 @@ class FedMLAlgorithmFlow(FedMLCommManager):
             logger.debug("rank %s executes flow[%d] %s", self.rank, idx, entry.name)
             self.executor.set_params(params)
             result = entry.task(self.executor)
-            if entry.tag == self.FINISH:
-                self._broadcast_finish()
-                return
-            if result is None:
-                # Hold: the task is waiting for more inputs (e.g. an aggregator
-                # with straggler clients pending). A terminal task that returns
-                # None must carry flow_tag=FINISH; holding on the final entry
-                # with no FINISH tag is almost certainly a bug — warn.
+            # Hold contract: HOLD always holds (works on FINISH-tagged tasks —
+            # e.g. a terminal aggregator waiting on stragglers); a bare None
+            # holds only on untagged tasks, so a FINISH-tagged task with no
+            # return value (the common "final_eval" idiom) still finishes.
+            hold = result is self.HOLD or (result is None and entry.tag != self.FINISH)
+            if hold:
                 if idx + 1 >= len(self.flows):
-                    logger.warning(
-                        "rank %s: final flow %r returned None without FINISH tag; holding",
+                    logger.debug(
+                        "rank %s: final flow %r holding; it finishes once it returns a result",
                         self.rank, entry.name,
                     )
+                return
+            if entry.tag == self.FINISH:
+                self._broadcast_finish()
                 return
             nxt = idx + 1
             if nxt >= len(self.flows):

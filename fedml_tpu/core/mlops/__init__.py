@@ -52,6 +52,12 @@ def init(args: Any, sink_obj: Optional[FanoutSink] = None) -> None:
     a broker sink when ``args.mlops_broker_host/port`` are set; plus any
     caller-provided sink (tests use InMemorySink)."""
     with _lock:
+        old = _ctx.get("sink")
+        if old is not None:  # re-entrant init: release the previous fan's
+            try:  # file handle / broker connection before replacing it
+                old.close()
+            except Exception:
+                pass
         run_id = str(getattr(args, "run_id", "0"))
         edge_id = int(getattr(args, "rank", 0) or 0)
         fan = sink_obj if sink_obj is not None else FanoutSink()

@@ -68,9 +68,14 @@ class MLOpsRuntimeLogDaemon:
             f.seek(self._offset)
             lines: List[str] = []
             while len(lines) < self.chunk_lines:
+                pos = f.tell()
                 line = f.readline()
                 if not line or not line.endswith("\n"):
-                    break  # partial line: wait for the writer to finish it
+                    # Partial line: rewind to before it so the next poll
+                    # re-reads the whole line once the writer finishes it
+                    # (f.tell() here is already past the partial bytes).
+                    f.seek(pos)
+                    break
                 lines.append(line.rstrip("\n"))
             self._offset = f.tell()
         return lines or None

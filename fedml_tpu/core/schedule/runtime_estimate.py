@@ -72,5 +72,24 @@ class RuntimeEstimator:
         a, b, _ = self._fits[key]
         return max(a * n_samples + b, 0.0)
 
+    def predict_marginal(self, device_id: int, n_samples: int) -> Optional[float]:
+        """Marginal (size-dependent) seconds ``a·n`` WITHOUT the intercept.
+
+        The intercept absorbs per-observation fixed overhead (dispatch, eval,
+        collectives) that is paid once per round, not once per client — so
+        relative per-client costs for scheduling must exclude it, or every
+        client costs ~b and load balancing degenerates to count-balancing.
+        Returns None when no model exists or the fitted slope is non-positive
+        (degenerate fit — caller should fall back to sample counts)."""
+        if self._dirty:
+            self._fit()
+        key = 0 if self.uniform_devices else int(device_id)
+        if key not in self._fits:
+            return None
+        a, _, _ = self._fits[key]
+        if a <= 0.0:
+            return None
+        return a * n_samples
+
     def has_model(self) -> bool:
         return bool(self._obs)

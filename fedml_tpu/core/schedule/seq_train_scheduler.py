@@ -45,7 +45,11 @@ class SeqTrainScheduler:
         counts for those rather than mispredicting with device 0's fit."""
         est = self.estimator
         if est is not None and est.has_model() and est.uniform_devices:
-            costs = [est.predict(0, int(s)) for s in sizes]
+            # Marginal cost only: the fitted intercept is whole-round fixed
+            # overhead (observations are round wall times), identical across
+            # assignments — charging it per client would swamp a·n and reduce
+            # LPT to count-balancing.
+            costs = [est.predict_marginal(0, int(s)) for s in sizes]
             if all(c is not None for c in costs):
                 return np.asarray(costs, np.float64)
         return np.asarray(sizes, np.float64)

@@ -57,6 +57,7 @@ class TrainerDistAdapter:
 
     def train(self, round_idx: int):
         """One local-training pass; returns (params, local_sample_num)."""
+        self.trainer.round_idx = int(round_idx)  # advance the per-round RNG stream
         train_data = self.train_data_local_dict[self.client_index]
         n = self.train_data_local_num_dict[self.client_index]
         self.trainer.on_before_local_training(train_data, self.device, self.args)

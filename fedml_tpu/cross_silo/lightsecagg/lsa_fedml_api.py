@@ -203,6 +203,8 @@ class LightSecAggClientManager(FedMLCommManager):
     def _on_round(self, msg: Message) -> None:
         global_params = msg.get("model_params")
         n, t, u = int(msg.get("lsa_n")), int(msg.get("lsa_t")), int(msg.get("lsa_u"))
+        # advance the trainer's per-round RNG stream (one call per round)
+        self.trainer.round_idx = int(getattr(self.trainer, "round_idx", -1)) + 1
         self.trainer.set_model_params(global_params)
         train_data = self.train_dict[self.client_index]
         self.trainer.train(train_data, None, self.args)

@@ -178,6 +178,8 @@ class SecAggClientManager(FedMLCommManager):
         self.send_message(m)
 
     def _train_and_stash(self, global_params) -> None:
+        # advance the trainer's per-round RNG stream (one call per round)
+        self.trainer.round_idx = int(getattr(self.trainer, "round_idx", -1)) + 1
         self.trainer.set_model_params(global_params)
         train_data = self.train_dict[self.client_index]
         n = float(self.train_num_dict[self.client_index])

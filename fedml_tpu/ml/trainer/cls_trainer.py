@@ -24,7 +24,11 @@ class ModelTrainerCLS(ClientTrainer):
         self.grad_hook = grad_hook  # per-step gradient transform (FedProx/SCAFFOLD/FedDyn)
         self._train_fns: Dict[Tuple[int, int], Any] = {}  # (padded_n, bs) -> fn
         self._eval_fn = make_eval_fn(model)
+        # Base key is never advanced: per-call keys are fold_in(round, client)
+        # so the stream is a pure function of (seed, round_idx, client id) and
+        # checkpoint-resume replays it exactly (no stateful split counter).
         self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        self.round_idx = 0
 
     def get_model_params(self):
         return self.variables
@@ -60,7 +64,9 @@ class ModelTrainerCLS(ClientTrainer):
         bs = int(getattr(args, "batch_size", 32))
         padded_n = self.padded_size(n, bs)
         fn = self._fn_for(padded_n, bs)
-        self.rng, sub = jax.random.split(self.rng)
+        sub = jax.random.fold_in(
+            jax.random.fold_in(self.rng, int(self.round_idx)), int(self.id or 0)
+        )
         xp = pad_to(jnp.asarray(x), padded_n)
         yp = pad_to(jnp.asarray(y), padded_n)
         result = fn(self.variables, xp, yp, n, sub, extra)

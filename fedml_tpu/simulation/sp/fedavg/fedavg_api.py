@@ -109,11 +109,22 @@ class FedAvgAPI:
         ).tolist()
 
     def train(self) -> Dict[str, Any]:
+        from ....core.checkpoint import checkpoint_frequency, maybe_checkpointer
+
         comm_round = int(self.args.comm_round)
         freq = int(getattr(self.args, "frequency_of_the_test", 5))
         last_metrics: Dict[str, Any] = {}
-        for round_idx in range(comm_round):
+        ckpt = maybe_checkpointer(self.args)
+        start_round = 0
+        if ckpt is not None and ckpt.latest_step() is not None:
+            step, state = ckpt.restore()
+            self.restore_checkpoint_state(state)
+            self.aggregator.set_model_params(self.w_global)
+            start_round = step + 1
+            logger.info("resumed from checkpoint round %d", step)
+        for round_idx in range(start_round, comm_round):
             t0 = time.time()
+            self.trainer.round_idx = round_idx  # deterministic per-round RNG stream
             client_indexes = self._client_sampling(round_idx)
             logger.info("round %d: clients %s", round_idx, client_indexes)
             w_locals: List[Tuple[float, Any]] = []
@@ -134,9 +145,22 @@ class FedAvgAPI:
             dt = time.time() - t0
             self.round_times.append(dt)
             self.metrics.log({"round": round_idx, "round_time_s": round(dt, 4)})
+            if ckpt is not None and (
+                round_idx % checkpoint_frequency(self.args) == 0 or round_idx == comm_round - 1
+            ):
+                ckpt.save(round_idx, self.checkpoint_state())
             if round_idx % freq == 0 or round_idx == comm_round - 1:
                 last_metrics = self._test_global(round_idx)
         return last_metrics
+
+    def checkpoint_state(self) -> Dict[str, Any]:
+        """Full server-side state to persist; algorithm subclasses MUST extend
+        with their own state (SCAFFOLD control variates, FedOpt moments, ...)
+        or a resumed run silently diverges from an uninterrupted one."""
+        return {"w_global": self.w_global}
+
+    def restore_checkpoint_state(self, state: Dict[str, Any]) -> None:
+        self.w_global = state["w_global"]
 
     def server_update(self, w_locals: List[Tuple[float, Any]]) -> Any:
         """Aggregation step with hooks at reference positions; the override

@@ -55,6 +55,17 @@ class FedDynAPI(FedAvgAPI):
 
         return run
 
+    def checkpoint_state(self):
+        state = super().checkpoint_state()
+        state["h_mean"] = self.h_mean
+        state["h_clients"] = {str(k): v for k, v in self.h_clients.items()}
+        return state
+
+    def restore_checkpoint_state(self, state):
+        super().restore_checkpoint_state(state)
+        self.h_mean = state["h_mean"]
+        self.h_clients = {int(k): v for k, v in state.get("h_clients", {}).items()}
+
     def server_update(self, w_locals: List[Tuple[float, Any]]) -> Any:
         w_locals = self.aggregator.on_before_aggregation(w_locals)
         avg = weighted_mean(w_locals)

@@ -48,6 +48,23 @@ class FedOptAPI(FedAvgAPI):
 
         self._apply_server_update = apply_server_update
 
+    def checkpoint_state(self):
+        from flax import serialization
+
+        state = super().checkpoint_state()
+        # optax states are namedtuple pytrees; persist as a flax state dict so
+        # msgpack round-trips, and rebuild onto the live structure on restore
+        state["server_opt_state"] = serialization.to_state_dict(self._server_opt_state)
+        return state
+
+    def restore_checkpoint_state(self, state):
+        from flax import serialization
+
+        super().restore_checkpoint_state(state)
+        self._server_opt_state = serialization.from_state_dict(
+            self._server_opt_state, state["server_opt_state"]
+        )
+
     def server_update(self, w_locals: List[Tuple[float, Any]]) -> Any:
         w_locals = self.aggregator.on_before_aggregation(w_locals)
         avg = weighted_mean(w_locals)

@@ -60,6 +60,18 @@ class ScaffoldAPI(FedAvgAPI):
 
         return run
 
+    def checkpoint_state(self):
+        state = super().checkpoint_state()
+        state["c_server"] = self.c_server
+        # msgpack keys must be strings
+        state["c_clients"] = {str(k): v for k, v in self.c_clients.items()}
+        return state
+
+    def restore_checkpoint_state(self, state):
+        super().restore_checkpoint_state(state)
+        self.c_server = state["c_server"]
+        self.c_clients = {int(k): v for k, v in state.get("c_clients", {}).items()}
+
     def _client_sampling(self, round_idx):
         self._round_dc: List[Any] = []
         return super()._client_sampling(round_idx)

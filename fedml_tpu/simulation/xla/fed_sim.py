@@ -204,11 +204,21 @@ class XLASimulator:
         return client_sampling(round_idx, self.num_clients, self.clients_per_round)
 
     def train(self) -> Dict[str, Any]:
+        from ...core.checkpoint import checkpoint_frequency, maybe_checkpointer
+
         comm_round = int(self.args.comm_round)
         freq = int(getattr(self.args, "frequency_of_the_test", 10))
         eval_enabled = freq > 0  # freq <= 0 disables eval (throughput benches)
         last: Dict[str, Any] = {}
-        for round_idx in range(comm_round):
+        ckpt = maybe_checkpointer(self.args)
+        start_round = 0
+        if ckpt is not None and ckpt.latest_step() is not None:
+            step, state = ckpt.restore()
+            self.variables = state["variables"]
+            self._rng = jnp.asarray(state["rng"])
+            start_round = step + 1
+            logger.info("resumed from checkpoint round %d", step)
+        for round_idx in range(start_round, comm_round):
             t0 = time.time()
             sampled = self._client_sampling(round_idx)
             ids, real = self._schedule(sampled)
@@ -250,6 +260,10 @@ class XLASimulator:
             from ...core import mlops
 
             mlops.log_round_info(comm_round, round_idx)
+            if ckpt is not None and (
+                round_idx % checkpoint_frequency(self.args) == 0 or round_idx == comm_round - 1
+            ):
+                ckpt.save(round_idx, {"variables": self.variables, "rng": self._rng})
             if eval_enabled and (round_idx % freq == 0 or round_idx == comm_round - 1):
                 last = self._test_global(round_idx)
         return last

@@ -1,0 +1,127 @@
+"""Checkpoint/resume subsystem (core/checkpoint.py) — the aux capability
+SURVEY.md §5 flags as missing in the reference and required in the rebuild."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.checkpoint import CheckpointManager
+
+
+class TestCheckpointManager:
+    def test_roundtrip_pytree(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        state = {
+            "w": {"dense": {"kernel": jnp.arange(6.0).reshape(2, 3)}},
+            "rng": jnp.array([0, 7], jnp.uint32),
+            "note": 3,
+        }
+        mgr.save(4, state, metadata={"run": "t"})
+        step, restored = mgr.restore()
+        assert step == 4
+        np.testing.assert_allclose(restored["w"]["dense"]["kernel"], np.arange(6.0).reshape(2, 3))
+        np.testing.assert_array_equal(restored["rng"], [0, 7])
+        assert mgr.metadata(4)["run"] == "t"
+
+    def test_retention_keeps_last_n(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for step in range(5):
+            mgr.save(step, {"x": np.float32(step)})
+        assert mgr.all_steps() == [3, 4]
+        _, state = mgr.restore(3)
+        assert float(state["x"]) == 3.0
+
+    def test_restore_empty_raises(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+
+
+def _args(tmp_path, comm_round):
+    return Arguments.from_dict(
+        {
+            "common_args": {"training_type": "simulation", "random_seed": 0, "run_id": "ck"},
+            "data_args": {
+                "dataset": "mnist",
+                "data_cache_dir": "",
+                "partition_method": "homo",
+                "synthetic_train_size": 320,
+            },
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "federated_optimizer": "FedAvg",
+                "client_num_in_total": 4,
+                "client_num_per_round": 2,
+                "comm_round": comm_round,
+                "epochs": 1,
+                "batch_size": 32,
+                "client_optimizer": "sgd",
+                "learning_rate": 0.1,
+                "checkpoint_dir": str(tmp_path / "ckpts"),
+            },
+            "validation_args": {"frequency_of_the_test": 100},
+            "comm_args": {"backend": "sp"},
+        }
+    ).validate()
+
+
+class TestSimulatorResume:
+    def test_sp_resume_matches_straight_run(self, tmp_path):
+        """2 rounds + resume for 2 more == 4 straight rounds (bitwise params)."""
+        from fedml_tpu.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+
+        def build(comm_round, subdir):
+            args = _args(tmp_path / subdir, comm_round)
+            args = fedml_tpu.init(args, should_init_logs=False)
+            from fedml_tpu import data, models
+
+            dataset, out_dim = data.load(args)
+            model = models.create(args, out_dim)
+            return args, FedAvgAPI(args, None, dataset, model)
+
+        args_a, api_straight = build(4, "a")
+        api_straight.train()
+
+        args_b, api_part1 = build(2, "b")
+        api_part1.train()
+        _, api_part2 = build(4, "b")  # same dir -> auto-resume at round 2
+        api_part2.train()
+
+        import jax
+
+        flat_a = jax.tree_util.tree_leaves(api_straight.w_global)
+        flat_b = jax.tree_util.tree_leaves(api_part2.w_global)
+        for xa, xb in zip(flat_a, flat_b):
+            np.testing.assert_allclose(np.asarray(xa), np.asarray(xb), rtol=1e-6, atol=1e-6)
+
+    def test_fedopt_resume_restores_server_optimizer_state(self, tmp_path):
+        """Server Adam moments must survive resume (checkpoint_state hook)."""
+        from fedml_tpu.simulation.sp.fedopt.fedopt_api import FedOptAPI
+
+        def build(comm_round, subdir):
+            args = _args(tmp_path / subdir, comm_round)
+            args.federated_optimizer = "FedOpt"
+            args.server_optimizer = "adam"
+            args = fedml_tpu.init(args, should_init_logs=False)
+            from fedml_tpu import data, models
+
+            dataset, out_dim = data.load(args)
+            model = models.create(args, out_dim)
+            return FedOptAPI(args, None, dataset, model)
+
+        api_straight = build(4, "a")
+        api_straight.train()
+
+        build(2, "b").train()
+        api_resumed = build(4, "b")
+        api_resumed.train()
+
+        import jax
+
+        for xa, xb in zip(
+            jax.tree_util.tree_leaves(api_straight.w_global),
+            jax.tree_util.tree_leaves(api_resumed.w_global),
+        ):
+            np.testing.assert_allclose(np.asarray(xa), np.asarray(xb), rtol=1e-5, atol=1e-6)
