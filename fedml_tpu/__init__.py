@@ -99,3 +99,13 @@ def run_cross_silo_client() -> None:
     from .launch_cross_silo import run_cross_silo
 
     run_cross_silo(role="client")
+
+
+def run_device_server():
+    """Cross-device (Beehive) server one-liner (reference ``run_mnn_server``)."""
+    from .launch_cross_device import run_device_server as _run
+
+    return _run()
+
+
+run_mnn_server = run_device_server

@@ -1,0 +1,13 @@
+"""Cross-device FL (the reference's Beehive pillar, ``cross_device/``).
+
+Python server + edge devices exchanging *serialized model files* — the role
+the MNN graph file plays in the reference (``cross_device/server_mnn/``).
+Here the edge interchange format is FTEM (``edge_model.py``), a flat binary
+tensor container that both this server and the native C++ edge runtime
+(``native/``) read and write.
+"""
+
+from .edge_model import load_edge_model, save_edge_model
+from .server import ServerDevice
+
+__all__ = ["ServerDevice", "save_edge_model", "load_edge_model"]

@@ -1,0 +1,116 @@
+"""FTEM — the edge model interchange file.
+
+Role of the serialized MNN graph file in the reference Beehive stack
+(``cross_device/server_mnn/fedml_aggregator.py:38``
+``get_global_model_params_file``; mobile side
+``android/fedmlsdk/MobileNN/``): the unit of model exchange between server
+and device is a FILE, not an in-memory pytree, because the device runtime is
+not Python.  FTEM is deliberately trivial to parse from C (the native edge
+trainer in ``native/`` reads/writes it):
+
+    magic   4 bytes  b"FTEM"
+    version u32      1
+    count   u32      number of tensors
+    per tensor:
+        name_len u32, name utf-8 (``/``-joined pytree path)
+        dtype    u8   (0 = float32, 1 = int32)
+        ndim     u32, dims u32[ndim]
+        data     raw little-endian bytes (C order)
+
+All integers little-endian.  Tensors are written in sorted-name order so the
+file is a canonical function of its contents.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict
+
+import numpy as np
+
+MAGIC = b"FTEM"
+VERSION = 1
+_DTYPES = {0: np.float32, 1: np.int32}
+_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1}
+
+
+def flatten_params(tree: Any) -> Dict[str, np.ndarray]:
+    """Nested-dict pytree -> flat ``{"a/b/c": ndarray}`` (float leaves cast to
+    f32, int leaves to i32 — the edge runtime's two dtypes)."""
+    import jax
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        arr = np.asarray(leaf)
+        arr = arr.astype(np.int32) if np.issubdtype(arr.dtype, np.integer) else arr.astype(np.float32)
+        flat[name] = arr
+    return flat
+
+
+def unflatten_params(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Inverse of :func:`flatten_params` for dict pytrees."""
+    out: Dict[str, Any] = {}
+    for name, arr in flat.items():
+        node = out
+        parts = name.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return out
+
+
+def save_edge_model(path: str, params: Any) -> str:
+    """Write a pytree (or an already-flat name->array dict) as an FTEM file."""
+    flat = params if _is_flat(params) else flatten_params(params)
+    with open(path + ".tmp", "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<II", VERSION, len(flat)))
+        for name in sorted(flat):
+            arr = np.ascontiguousarray(flat[name])
+            code = _DTYPE_CODES.get(arr.dtype)
+            if code is None:
+                arr = arr.astype(np.float32)
+                code = 0
+            nb = name.encode("utf-8")
+            f.write(struct.pack("<I", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<BI", code, arr.ndim))
+            f.write(struct.pack(f"<{arr.ndim}I", *arr.shape))
+            f.write(arr.tobytes())
+    import os
+
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def load_edge_model(path: str) -> Dict[str, np.ndarray]:
+    """Read an FTEM file back to a flat name->array dict."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != MAGIC:
+        raise ValueError(f"{path}: not an FTEM file")
+    version, count = struct.unpack_from("<II", data, 4)
+    if version != VERSION:
+        raise ValueError(f"{path}: unsupported FTEM version {version}")
+    off = 12
+    flat: Dict[str, np.ndarray] = {}
+    for _ in range(count):
+        (name_len,) = struct.unpack_from("<I", data, off)
+        off += 4
+        name = data[off : off + name_len].decode("utf-8")
+        off += name_len
+        code, ndim = struct.unpack_from("<BI", data, off)
+        off += 5
+        dims = struct.unpack_from(f"<{ndim}I", data, off)
+        off += 4 * ndim
+        dtype = np.dtype(_DTYPES[code]).newbyteorder("<")
+        count = int(np.prod(dims, dtype=np.int64))  # prod(()) == 1 covers scalars
+        arr = np.frombuffer(data, dtype=dtype, count=count, offset=off)
+        off += count * dtype.itemsize
+        flat[name] = arr.reshape(dims).astype(_DTYPES[code])
+    return flat
+
+
+def _is_flat(obj: Any) -> bool:
+    return isinstance(obj, dict) and all(isinstance(v, np.ndarray) for v in obj.values())

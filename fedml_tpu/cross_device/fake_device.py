@@ -1,0 +1,159 @@
+"""Fake edge device: the Python stand-in for a phone.
+
+Parity with reference ``python/tests/android_protocol_test/`` (the harness
+that drives the Android message protocol from Python): a numpy-only client
+that speaks the full cross-device round protocol — ONLINE handshake, model
+FILE download, on-device training, model FILE upload.  Deliberately uses no
+JAX: devices run the native edge runtime (``native/``), and this harness
+emulates exactly that boundary (FTEM files in, FTEM files out).
+
+Training supports the edge model family (logistic regression / one-hidden
+-layer MLP, reference MobileNN trains LeNet-class models): plain softmax-CE
+SGD written in numpy.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.distributed.comm_manager import FedMLCommManager
+from ..core.distributed.communication.message import Message
+from .edge_model import load_edge_model, save_edge_model
+from .message_define import MNNMessage
+
+logger = logging.getLogger(__name__)
+
+
+def _softmax(z: np.ndarray) -> np.ndarray:
+    z = z - z.max(axis=1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def train_numpy(
+    flat: Dict[str, np.ndarray],
+    x: np.ndarray,
+    y: np.ndarray,
+    lr: float = 0.1,
+    epochs: int = 1,
+    batch_size: int = 32,
+    seed: int = 0,
+) -> Dict[str, np.ndarray]:
+    """SGD on a dense stack (kernel/bias pairs, relu between): the numpy twin
+    of the native edge trainer's loop."""
+    layers = _dense_stack(flat)
+    x = x.reshape(x.shape[0], -1).astype(np.float64)
+    y = np.asarray(y, np.int64)
+    rng = np.random.RandomState(seed)
+    params = [(flat[k].astype(np.float64), flat[b].astype(np.float64)) for k, b in layers]
+    for _ in range(int(epochs)):
+        order = rng.permutation(len(y))
+        for s in range(0, len(y), batch_size):
+            idx = order[s : s + batch_size]
+            xb, yb = x[idx], y[idx]
+            # forward
+            acts = [xb]
+            for li, (W, b) in enumerate(params):
+                z = acts[-1] @ W + b
+                acts.append(np.maximum(z, 0.0) if li < len(params) - 1 else z)
+            probs = _softmax(acts[-1])
+            g = probs
+            g[np.arange(len(yb)), yb] -= 1.0
+            g /= len(yb)
+            # backward
+            for li in reversed(range(len(params))):
+                W, b = params[li]
+                gW = acts[li].T @ g
+                gb = g.sum(axis=0)
+                if li > 0:
+                    g = (g @ W.T) * (acts[li] > 0)
+                params[li] = (W - lr * gW, b - lr * gb)
+    out = dict(flat)
+    for (kname, bname), (W, b) in zip(layers, params):
+        out[kname] = W.astype(np.float32)
+        out[bname] = b.astype(np.float32)
+    return out
+
+
+def _dense_stack(flat: Dict[str, np.ndarray]):
+    """Order the kernel/bias pairs by matching input/output dims."""
+    pairs = []
+    for name in sorted(flat):
+        if name.endswith("/kernel") and flat[name].ndim == 2:
+            bias = name[: -len("kernel")] + "bias"
+            if bias in flat:
+                pairs.append((name, bias))
+    if not pairs:
+        raise ValueError("edge trainer supports dense stacks (kernel/bias pairs) only")
+    # chain them: find the pair order where out-dim(i) == in-dim(i+1)
+    ordered = [pairs.pop(0)]
+    changed = True
+    while pairs and changed:
+        changed = False
+        for p in list(pairs):
+            if flat[p[0]].shape[0] == flat[ordered[-1][0]].shape[1]:
+                ordered.append(p)
+                pairs.remove(p)
+                changed = True
+            elif flat[p[0]].shape[1] == flat[ordered[0][0]].shape[0]:
+                ordered.insert(0, p)
+                pairs.remove(p)
+                changed = True
+    return ordered + pairs
+
+
+class FakeDeviceManager(FedMLCommManager):
+    """One fake phone; give it a (x, y) shard and run it on a thread."""
+
+    def __init__(self, args, rank: int, train_data: Tuple[np.ndarray, np.ndarray],
+                 client_num: int, backend: str = "LOOPBACK", upload_dir: Optional[str] = None):
+        super().__init__(args, None, rank, client_num + 1, backend)
+        self.x, self.y = train_data
+        self.upload_dir = upload_dir or tempfile.mkdtemp(prefix=f"fedml_tpu_dev{rank}_")
+        os.makedirs(self.upload_dir, exist_ok=True)
+        self.rounds_trained = 0
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MNNMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self._on_check_status
+        )
+        self.register_message_receive_handler(
+            MNNMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_model
+        )
+        self.register_message_receive_handler(
+            MNNMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self._on_model
+        )
+        self.register_message_receive_handler(
+            MNNMessage.MSG_TYPE_S2C_FINISH, lambda m: self.finish()
+        )
+
+    def _on_check_status(self, msg: Message) -> None:
+        m = Message(MNNMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_STATUS, MNNMessage.CLIENT_STATUS_ONLINE)
+        self.send_message(m)
+
+    def _on_model(self, msg: Message) -> None:
+        model_file = msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE)
+        round_idx = int(msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX) or 0)
+        flat = load_edge_model(model_file)
+        trained = train_numpy(
+            flat,
+            self.x,
+            self.y,
+            lr=float(getattr(self.args, "learning_rate", 0.1)),
+            epochs=int(getattr(self.args, "epochs", 1)),
+            batch_size=int(getattr(self.args, "batch_size", 32)),
+            seed=round_idx * 1000 + self.rank,
+        )
+        out_path = os.path.join(self.upload_dir, f"model_r{round_idx}_c{self.rank}.ftem")
+        save_edge_model(out_path, trained)
+        self.rounds_trained += 1
+        m = Message(MNNMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, out_path)
+        m.add_params(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES, int(len(self.y)))
+        self.send_message(m)

@@ -1,0 +1,122 @@
+"""Cross-device server aggregator: file-in, file-out.
+
+Parity with reference ``cross_device/server_mnn/fedml_aggregator.py:17-141``:
+clients upload serialized model files; the aggregator weighted-averages the
+tensor dicts (``:59``), writes the new global model as a file for
+distribution (``get_global_model_params_file`` ``:38``), and evaluates the
+global model with the server-side runtime (``:141``) — here the flax module
+on TPU instead of the MNN python runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..ml.aggregator.default_aggregator import DefaultServerAggregator
+from ..ml.engine.train import init_variables
+from .edge_model import flatten_params, load_edge_model, save_edge_model, unflatten_params
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLAggregator:
+    def __init__(self, args, model, test_global, worker_num: int, model_dir: Optional[str] = None):
+        self.args = args
+        self.module = model
+        self.test_global = test_global
+        self.worker_num = int(worker_num)
+        self.model_dir = model_dir or os.path.join(
+            tempfile.gettempdir(), f"fedml_tpu_edge_{getattr(args, 'run_id', '0')}"
+        )
+        os.makedirs(self.model_dir, exist_ok=True)
+
+        import jax.numpy as jnp
+
+        sample = jnp.asarray(test_global[0][:1])
+        self.variables = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
+        self._eval = DefaultServerAggregator(model, args)
+
+        self.model_file_dict: Dict[int, str] = {}
+        self.sample_num_dict: Dict[int, float] = {}
+        self.flag_client_model_uploaded_dict: Dict[int, bool] = {}
+        self.eval_history: List[Dict[str, Any]] = []
+
+    # -- file plane ----------------------------------------------------------
+    def get_global_model_params_file(self, round_idx: int) -> str:
+        """Serialize the current global model for device download
+        (reference ``fedml_aggregator.py:38``).  Keeps only the latest two
+        rounds' files (devices may still be downloading round N-1)."""
+        path = os.path.join(self.model_dir, f"global_model_r{round_idx}.ftem")
+        save_edge_model(path, self.variables)
+        stale = os.path.join(self.model_dir, f"global_model_r{round_idx - 2}.ftem")
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+        return path
+
+    def set_global_model_params_from_file(self, path: str) -> None:
+        self.variables = unflatten_params(load_edge_model(path))
+
+    # -- collection (reference :44-58) ---------------------------------------
+    def add_local_trained_result(self, index: int, model_file: str, sample_num: float) -> None:
+        self.model_file_dict[index] = str(model_file)
+        self.sample_num_dict[index] = float(sample_num)
+        self.flag_client_model_uploaded_dict[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        if len(self.flag_client_model_uploaded_dict) < self.worker_num:
+            return False
+        for idx in range(self.worker_num):
+            if not self.flag_client_model_uploaded_dict.get(idx, False):
+                return False
+        self.flag_client_model_uploaded_dict = {}
+        return True
+
+    # -- aggregation (reference :59-115) -------------------------------------
+    def aggregate(self) -> Dict[str, np.ndarray]:
+        total = sum(self.sample_num_dict[i] for i in range(self.worker_num)) or 1.0
+        acc: Dict[str, np.ndarray] = {}
+        for i in range(self.worker_num):
+            flat = load_edge_model(self.model_file_dict[i])
+            w = self.sample_num_dict[i] / total
+            for name, arr in flat.items():
+                contrib = arr.astype(np.float64) * w
+                acc[name] = contrib if name not in acc else acc[name] + contrib
+        # preserve integer leaves (e.g. step counters) by casting back to the
+        # current global dtype template (round first: a float64 weighted sum
+        # of equal ints lands epsilon below the true value and astype truncates)
+        template = flatten_params(self.variables)
+        merged = {}
+        for name in acc:
+            dt = template[name].dtype if name in template else np.dtype(np.float32)
+            v = np.rint(acc[name]) if np.issubdtype(dt, np.integer) else acc[name]
+            merged[name] = v.astype(dt)
+        self.variables = unflatten_params(merged)
+        # uploads are consumed — delete them or a long run fills the disk
+        for path in self.model_file_dict.values():
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self.model_file_dict = {}
+        self.sample_num_dict = {}
+        return merged
+
+    # -- eval (reference :141 test_on_server_for_all_clients) ----------------
+    def test_on_server_for_all_clients(self, round_idx: int) -> Dict[str, Any]:
+        self._eval.set_model_params(self.variables)
+        stats = self._eval.test(self.test_global, None, self.args)
+        out = {
+            "round": round_idx,
+            "test_acc": round(float(stats["test_correct"]) / max(float(stats["test_total"]), 1.0), 4),
+            "test_loss": round(float(stats["test_loss"]) / max(float(stats["test_total"]), 1.0), 4),
+        }
+        self.eval_history.append(out)
+        logger.info("cross-device eval: %s", out)
+        return out

@@ -1,0 +1,89 @@
+"""Cross-device server round state machine.
+
+Parity with reference ``cross_device/server_mnn/fedml_server_manager.py``:
+the same ONLINE-handshake → init-config → collect/aggregate/test/sync loop as
+cross-silo, except the model rides as a FILE reference
+(``MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE``) that devices download and
+upload — the message plane never carries tensors.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+from ..core.distributed.comm_manager import FedMLCommManager
+from ..core.distributed.communication.message import Message
+from .message_define import MNNMessage
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLServerManager(FedMLCommManager):
+    def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0,
+                 backend: str = "LOOPBACK"):
+        super().__init__(args, comm, client_rank, client_num + 1, backend)
+        self.aggregator = aggregator
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.args.round_idx = 0
+        self.client_num = int(client_num)
+        self.client_online_status: Dict[int, bool] = {}
+        self.is_initialized = False
+        self.client_id_list_in_this_round: List[int] = list(range(1, self.client_num + 1))
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler("connection_ready", self._on_connection_ready)
+        self.register_message_receive_handler(
+            MNNMessage.MSG_TYPE_C2S_CLIENT_STATUS, self._on_client_status
+        )
+        self.register_message_receive_handler(
+            MNNMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self._on_model_from_client
+        )
+
+    # -- handshake ------------------------------------------------------------
+    def _on_connection_ready(self, msg: Message) -> None:
+        for client_id in range(1, self.client_num + 1):
+            self.send_message(
+                Message(MNNMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.rank, client_id)
+            )
+
+    def _on_client_status(self, msg: Message) -> None:
+        if msg.get(MNNMessage.MSG_ARG_KEY_CLIENT_STATUS) == MNNMessage.CLIENT_STATUS_ONLINE:
+            self.client_online_status[int(msg.get_sender_id())] = True
+        if not self.is_initialized and all(
+            self.client_online_status.get(cid, False) for cid in range(1, self.client_num + 1)
+        ):
+            self.is_initialized = True
+            self._send_round(MNNMessage.MSG_TYPE_S2C_INIT_CONFIG)
+
+    # -- round loop -----------------------------------------------------------
+    def _send_round(self, msg_type) -> None:
+        model_file = self.aggregator.get_global_model_params_file(self.args.round_idx)
+        for client_id in self.client_id_list_in_this_round:
+            m = Message(msg_type, self.rank, client_id)
+            m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, model_file)
+            m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_INDEX, client_id - 1)
+            m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+            self.send_message(m)
+
+    def _on_model_from_client(self, msg: Message) -> None:
+        sender = int(msg.get_sender_id())
+        model_file = msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE)
+        n = msg.get(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        self.aggregator.add_local_trained_result(
+            self.client_id_list_in_this_round.index(sender), model_file, n
+        )
+        if not self.aggregator.check_whether_all_receive():
+            return
+        self.aggregator.aggregate()
+        freq = int(getattr(self.args, "frequency_of_the_test", 1) or 0)
+        if freq and (self.args.round_idx % freq == 0 or self.args.round_idx == self.round_num - 1):
+            self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+
+        self.args.round_idx += 1
+        if self.args.round_idx >= self.round_num:
+            for client_id in range(1, self.client_num + 1):
+                self.send_message(Message(MNNMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
+            self.finish()
+            return
+        self._send_round(MNNMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)

@@ -1,0 +1,13 @@
+"""Cross-device message vocabulary.
+
+Same round protocol as cross-silo (``..cross_silo.message_define``) with one
+difference, mirroring the reference MNN variant
+(``cross_device/server_mnn/``): the model travels as a FILE reference
+(``model_params_file``), never as an in-memory pytree.
+"""
+
+from ..cross_silo.message_define import MyMessage as _Base
+
+
+class MNNMessage(_Base):
+    MSG_ARG_KEY_MODEL_PARAMS_FILE = "model_params_file"

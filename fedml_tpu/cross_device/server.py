@@ -1,0 +1,38 @@
+"""Cross-device server runner (reference ``cross_device/mnn_server.py``
+``ServerMNN`` + ``server_mnn_api.py``): composes the file-plane aggregator and
+the round state machine; ``run()`` blocks in the receive loop."""
+
+from __future__ import annotations
+
+from .fedml_aggregator import FedMLAggregator
+from .fedml_server_manager import FedMLServerManager
+
+
+class ServerDevice:
+    def __init__(self, args, device, dataset, model, server_aggregator=None):
+        [
+            _train_num,
+            _test_num,
+            _train_global,
+            test_global,
+            _local_num_dict,
+            _train_local_dict,
+            _test_local_dict,
+            _class_num,
+        ] = dataset
+        client_num = int(getattr(args, "client_num_per_round", getattr(args, "client_num_in_total", 1)))
+        self.aggregator = FedMLAggregator(
+            args, model, test_global, worker_num=client_num,
+            model_dir=getattr(args, "edge_model_dir", None),
+        )
+        self.server_manager = FedMLServerManager(
+            args,
+            self.aggregator,
+            client_rank=0,
+            client_num=client_num,
+            backend=str(getattr(args, "backend", "LOOPBACK")),
+        )
+
+    def run(self):
+        self.server_manager.run()
+        return self.aggregator.eval_history[-1] if self.aggregator.eval_history else {}

@@ -1,0 +1,11 @@
+"""One-line cross-device server launcher (reference ``launch_cross_device.py``
+``run_mnn_server``)."""
+
+from __future__ import annotations
+
+
+def run_device_server():
+    from fedml_tpu.constants import FEDML_TRAINING_PLATFORM_CROSS_DEVICE
+    from fedml_tpu.launch_cross_silo import launch
+
+    return launch(FEDML_TRAINING_PLATFORM_CROSS_DEVICE, role="server")

@@ -1,17 +1,19 @@
-"""One-line cross-silo launchers (reference ``launch_cross_silo_horizontal.py``)."""
+"""One-line launchers for the genuinely-distributed platforms (reference
+``launch_cross_silo_horizontal.py``): the shared init → device → data →
+model → FedMLRunner sequence, reused by ``launch_cross_device``."""
 
 from __future__ import annotations
 
 
-def run_cross_silo(role: str = "client"):
+def launch(training_type: str, role: str):
+    """The common launch sequence behind every one-liner."""
     import fedml_tpu
     from fedml_tpu import data as _data, device as _device, models as _models
     from fedml_tpu.arguments import load_arguments
-    from fedml_tpu.constants import FEDML_TRAINING_PLATFORM_CROSS_SILO
     from fedml_tpu.runner import FedMLRunner
 
-    args = load_arguments(FEDML_TRAINING_PLATFORM_CROSS_SILO)
-    args.training_type = FEDML_TRAINING_PLATFORM_CROSS_SILO
+    args = load_arguments(training_type)
+    args.training_type = training_type
     args.role = role
     args = fedml_tpu.init(args)
     device = _device.get_device(args)
@@ -19,3 +21,9 @@ def run_cross_silo(role: str = "client"):
     model = _models.create(args, output_dim)
     runner = FedMLRunner(args, device, dataset, model)
     return runner.run()
+
+
+def run_cross_silo(role: str = "client"):
+    from fedml_tpu.constants import FEDML_TRAINING_PLATFORM_CROSS_SILO
+
+    return launch(FEDML_TRAINING_PLATFORM_CROSS_SILO, role)
