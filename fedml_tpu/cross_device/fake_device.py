@@ -108,15 +108,28 @@ def _dense_stack(flat: Dict[str, np.ndarray]):
 
 
 class FakeDeviceManager(FedMLCommManager):
-    """One fake phone; give it a (x, y) shard and run it on a thread."""
+    """One fake phone; give it a (x, y) shard and run it on a thread.
+
+    ``use_native=True`` trains through the C++ edge runtime
+    (``fedml_tpu.native.EdgeTrainer`` over libfedml_edge.so) instead of the
+    numpy twin — the closest in-process stand-in for a real device."""
 
     def __init__(self, args, rank: int, train_data: Tuple[np.ndarray, np.ndarray],
-                 client_num: int, backend: str = "LOOPBACK", upload_dir: Optional[str] = None):
+                 client_num: int, backend: str = "LOOPBACK", upload_dir: Optional[str] = None,
+                 use_native: bool = False):
         super().__init__(args, None, rank, client_num + 1, backend)
         self.x, self.y = train_data
         self.upload_dir = upload_dir or tempfile.mkdtemp(prefix=f"fedml_tpu_dev{rank}_")
         os.makedirs(self.upload_dir, exist_ok=True)
         self.rounds_trained = 0
+        self.use_native = bool(use_native)
+        if self.use_native:  # write the device-side data file once
+            from .. import native
+
+            native.build()  # sequential: don't race make across device threads
+            self._data_path = os.path.join(self.upload_dir, "local_data.ftem")
+            x2d = np.asarray(self.x, np.float32).reshape(len(self.x), -1)
+            save_edge_model(self._data_path, {"x": x2d, "y": np.asarray(self.y, np.int32)})
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -140,18 +153,33 @@ class FakeDeviceManager(FedMLCommManager):
     def _on_model(self, msg: Message) -> None:
         model_file = msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE)
         round_idx = int(msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX) or 0)
-        flat = load_edge_model(model_file)
-        trained = train_numpy(
-            flat,
-            self.x,
-            self.y,
-            lr=float(getattr(self.args, "learning_rate", 0.1)),
-            epochs=int(getattr(self.args, "epochs", 1)),
-            batch_size=int(getattr(self.args, "batch_size", 32)),
-            seed=round_idx * 1000 + self.rank,
-        )
         out_path = os.path.join(self.upload_dir, f"model_r{round_idx}_c{self.rank}.ftem")
-        save_edge_model(out_path, trained)
+        if self.use_native:
+            from .. import native
+
+            t = native.EdgeTrainer(
+                model_file,
+                self._data_path,
+                batch_size=int(getattr(self.args, "batch_size", 32)),
+                lr=float(getattr(self.args, "learning_rate", 0.1)),
+                epochs=int(getattr(self.args, "epochs", 1)),
+                seed=round_idx * 1000 + self.rank,
+            )
+            t.train()
+            t.save(out_path)
+            t.close()
+        else:
+            flat = load_edge_model(model_file)
+            trained = train_numpy(
+                flat,
+                self.x,
+                self.y,
+                lr=float(getattr(self.args, "learning_rate", 0.1)),
+                epochs=int(getattr(self.args, "epochs", 1)),
+                batch_size=int(getattr(self.args, "batch_size", 32)),
+                seed=round_idx * 1000 + self.rank,
+            )
+            save_edge_model(out_path, trained)
         self.rounds_trained += 1
         m = Message(MNNMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, out_path)

@@ -1,0 +1,241 @@
+"""ctypes bindings for the native edge runtime (``native/``).
+
+The reference's JNI bridge (``android/fedmlsdk/src/main/jni/``) connects the
+Java edge SDK to the C++ MobileNN trainer; here ctypes connects the Python
+host stack to ``libfedml_edge.so`` (pybind11 is not in the image).  The
+library is built on demand with ``make`` — g++ is part of the baked-in
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libfedml_edge.so")
+_lib: Optional[ctypes.CDLL] = None
+_load_lock = __import__("threading").Lock()
+
+PROGRESS_CB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_double)
+
+
+def build(force: bool = False) -> str:
+    """Build libfedml_edge.so if missing or stale; returns its path.
+    Serialized: concurrent callers must not race `make` on the same objects."""
+    with _load_lock:
+        return _build_locked(force)
+
+
+def _build_locked(force: bool) -> str:
+    stale = force or not os.path.exists(_LIB_PATH)
+    if not stale:
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        for name in os.listdir(_NATIVE_DIR):
+            if name.endswith((".cpp", ".hpp")) and os.path.getmtime(
+                os.path.join(_NATIVE_DIR, name)
+            ) > lib_mtime:
+                stale = True
+                break
+    if stale:
+        proc = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _load_lock:  # device threads may race here: build exactly once
+        if _lib is not None:
+            return _lib
+        return _load_locked()
+
+
+def _load_locked() -> ctypes.CDLL:
+    global _lib
+    lib = ctypes.CDLL(_build_locked(force=False))  # lock already held
+
+    lib.fedml_last_error.restype = ctypes.c_char_p
+    lib.fedml_mnist_idx_to_ftem.argtypes = [ctypes.c_char_p] * 3 + [ctypes.c_int]
+
+    lib.fedml_trainer_create.restype = ctypes.c_void_p
+    lib.fedml_trainer_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
+        ctypes.c_int, ctypes.c_ulonglong,
+    ]
+    lib.fedml_trainer_set_callback.argtypes = [ctypes.c_void_p, PROGRESS_CB]
+    lib.fedml_trainer_train.argtypes = [ctypes.c_void_p]
+    lib.fedml_trainer_epoch_loss.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.fedml_trainer_stop.argtypes = [ctypes.c_void_p]
+    lib.fedml_trainer_num_samples.restype = ctypes.c_longlong
+    lib.fedml_trainer_num_samples.argtypes = [ctypes.c_void_p]
+    lib.fedml_trainer_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.fedml_trainer_eval.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.fedml_trainer_destroy.argtypes = [ctypes.c_void_p]
+
+    lib.fedml_lsa_chunk.argtypes = [ctypes.c_int] * 3
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.fedml_lsa_mask_encoding.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, i64p,
+        ctypes.c_ulonglong, i64p,
+    ]
+    lib.fedml_lsa_aggregate_decode.argtypes = [
+        i64p, i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, i64p,
+    ]
+
+    lib.fedml_client_create.restype = ctypes.c_void_p
+    lib.fedml_client_create.argtypes = lib.fedml_trainer_create.argtypes
+    lib.fedml_client_train.argtypes = [ctypes.c_void_p]
+    lib.fedml_client_save_model.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.fedml_client_save_masked_model.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_ulonglong, ctypes.c_char_p,
+    ]
+    lib.fedml_client_mask_dim.restype = ctypes.c_longlong
+    lib.fedml_client_mask_dim.argtypes = [ctypes.c_void_p]
+    lib.fedml_client_encode_mask.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_ulonglong, i64p,
+    ]
+    lib.fedml_client_destroy.argtypes = [ctypes.c_void_p]
+
+    _lib = lib
+    return lib
+
+
+def _check(rc: int) -> None:
+    if rc != 0:
+        raise RuntimeError(load().fedml_last_error().decode())
+
+
+def mnist_idx_to_ftem(images: str, labels: str, out: str, limit: int = 0) -> str:
+    _check(load().fedml_mnist_idx_to_ftem(images.encode(), labels.encode(), out.encode(), limit))
+    return out
+
+
+class EdgeTrainer:
+    """Native FedMLBaseTrainer handle (train / epoch+loss / stop / save)."""
+
+    def __init__(self, model_path: str, data_path: str, batch_size: int = 32,
+                 lr: float = 0.01, epochs: int = 1, seed: int = 0):
+        self._lib = load()
+        self._h = self._lib.fedml_trainer_create(
+            model_path.encode(), data_path.encode(), batch_size, lr, epochs, seed
+        )
+        if not self._h:
+            raise RuntimeError(self._lib.fedml_last_error().decode())
+        self._cb_ref = None  # keep the callback alive for the handle's lifetime
+
+    def set_progress_callback(self, fn) -> None:
+        self._cb_ref = PROGRESS_CB(fn)
+        self._lib.fedml_trainer_set_callback(self._h, self._cb_ref)
+
+    def train(self) -> None:
+        _check(self._lib.fedml_trainer_train(self._h))
+
+    def epoch_and_loss(self):
+        e, l = ctypes.c_int(), ctypes.c_double()
+        self._lib.fedml_trainer_epoch_loss(self._h, ctypes.byref(e), ctypes.byref(l))
+        return e.value, l.value
+
+    def stop_training(self) -> None:
+        self._lib.fedml_trainer_stop(self._h)
+
+    @property
+    def num_samples(self) -> int:
+        return int(self._lib.fedml_trainer_num_samples(self._h))
+
+    def save(self, out_path: str) -> str:
+        _check(self._lib.fedml_trainer_save(self._h, out_path.encode()))
+        return out_path
+
+    def evaluate(self):
+        acc, loss = ctypes.c_double(), ctypes.c_double()
+        _check(self._lib.fedml_trainer_eval(self._h, ctypes.byref(acc), ctypes.byref(loss)))
+        return acc.value, loss.value
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.fedml_trainer_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class EdgeClientManager:
+    """Native FedMLClientManager handle: trainer + LightSecAgg upload pair."""
+
+    def __init__(self, model_path: str, data_path: str, batch_size: int = 32,
+                 lr: float = 0.01, epochs: int = 1, seed: int = 0):
+        self._lib = load()
+        self._h = self._lib.fedml_client_create(
+            model_path.encode(), data_path.encode(), batch_size, lr, epochs, seed
+        )
+        if not self._h:
+            raise RuntimeError(self._lib.fedml_last_error().decode())
+
+    def train(self) -> None:
+        _check(self._lib.fedml_client_train(self._h))
+
+    def save_model(self, out_path: str) -> str:
+        _check(self._lib.fedml_client_save_model(self._h, out_path.encode()))
+        return out_path
+
+    @property
+    def mask_dim(self) -> int:
+        return int(self._lib.fedml_client_mask_dim(self._h))
+
+    def save_masked_model(self, q_bits: int, mask_seed: int, out_path: str) -> str:
+        _check(self._lib.fedml_client_save_masked_model(self._h, q_bits, mask_seed, out_path.encode()))
+        return out_path
+
+    def encode_mask(self, n: int, t: int, u: int, mask_seed: int) -> np.ndarray:
+        chunk = load().fedml_lsa_chunk(self.mask_dim, t, u)
+        out = np.zeros((n, chunk), np.int64)
+        _check(self._lib.fedml_client_encode_mask(self._h, n, t, u, mask_seed, out))
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.fedml_client_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def lsa_mask_encoding(d: int, n: int, t: int, u: int, mask: np.ndarray, seed: int) -> np.ndarray:
+    lib = load()
+    chunk = lib.fedml_lsa_chunk(d, t, u)
+    out = np.zeros((n, chunk), np.int64)
+    _check(lib.fedml_lsa_mask_encoding(d, n, t, u, np.ascontiguousarray(mask, np.int64), seed, out))
+    return out
+
+
+def lsa_aggregate_decode(rows: np.ndarray, ids, t: int, u: int, d: int) -> np.ndarray:
+    """rows: [n_ids, chunk] sorted by id; ids 1-based."""
+    lib = load()
+    rows = np.ascontiguousarray(rows, np.int64)
+    ids_arr = np.ascontiguousarray(ids, np.int32)
+    out = np.zeros(d, np.int64)
+    _check(lib.fedml_lsa_aggregate_decode(rows, ids_arr, len(ids_arr), t, u, d, rows.shape[1], out))
+    return out

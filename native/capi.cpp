@@ -1,0 +1,205 @@
+// C ABI for the native edge runtime (consumed from Python via ctypes —
+// pybind11 is deliberately not a dependency; role of the reference JNI bridge
+// android/fedmlsdk/src/main/jni/JniFedMLClientManager.cpp).
+
+#include <cstring>
+#include <string>
+
+#include "fedml_edge.hpp"
+
+using fedml::FedMLClientManager;
+using fedml::FedMLDenseTrainer;
+
+namespace {
+thread_local std::string g_last_error;
+int fail(const std::string& err) {
+  g_last_error = err;
+  return -1;
+}
+
+// C++ exceptions must not cross the C ABI (ctypes cannot catch them — the
+// process would abort). Every entry point that can allocate/throw runs
+// through one of these guards.
+template <typename F>
+int guarded(F&& f) {
+  try {
+    return f();
+  } catch (const std::exception& e) {
+    return fail(e.what());
+  } catch (...) {
+    return fail("unknown native error");
+  }
+}
+
+template <typename F>
+void* guarded_ptr(F&& f) {
+  try {
+    return f();
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  } catch (...) {
+    g_last_error = "unknown native error";
+    return nullptr;
+  }
+}
+}  // namespace
+
+extern "C" {
+
+const char* fedml_last_error() { return g_last_error.c_str(); }
+
+// -- data ------------------------------------------------------------------
+int fedml_mnist_idx_to_ftem(const char* images, const char* labels, const char* out,
+                            int limit) {
+  return guarded([&] {
+    std::string err;
+    return fedml::mnist_idx_to_ftem(images, labels, out, limit, err) ? 0 : fail(err);
+  });
+}
+
+// -- trainer (reference FedMLBaseTrainer contract) -------------------------
+void* fedml_trainer_create(const char* model_path, const char* data_path, int batch,
+                           double lr, int epochs, unsigned long long seed) {
+  return guarded_ptr([&]() -> void* {
+    auto* t = new FedMLDenseTrainer();
+    std::string err;
+    if (!t->init(model_path, data_path, batch, lr, epochs, seed, err)) {
+      g_last_error = err;
+      delete t;
+      return nullptr;
+    }
+    return t;
+  });
+}
+
+typedef void (*fedml_progress_cb)(int epoch, double loss);
+
+void fedml_trainer_set_callback(void* h, fedml_progress_cb cb) {
+  static_cast<FedMLDenseTrainer*>(h)->set_progress_callback(cb);
+}
+
+int fedml_trainer_train(void* h) {
+  return guarded([&] {
+    std::string err;
+    return static_cast<FedMLDenseTrainer*>(h)->train(err) ? 0 : fail(err);
+  });
+}
+
+void fedml_trainer_epoch_loss(void* h, int* epoch, double* loss) {
+  auto el = static_cast<FedMLDenseTrainer*>(h)->epoch_and_loss();
+  *epoch = el.first;
+  *loss = el.second;
+}
+
+void fedml_trainer_stop(void* h) { static_cast<FedMLDenseTrainer*>(h)->stop_training(); }
+
+long long fedml_trainer_num_samples(void* h) {
+  return static_cast<FedMLDenseTrainer*>(h)->num_samples();
+}
+
+int fedml_trainer_save(void* h, const char* out_path) {
+  return guarded([&] {
+    std::string err;
+    return static_cast<FedMLDenseTrainer*>(h)->save(out_path, err) ? 0 : fail(err);
+  });
+}
+
+int fedml_trainer_eval(void* h, double* acc, double* loss) {
+  return guarded([&] {
+    std::string err;
+    return static_cast<FedMLDenseTrainer*>(h)->evaluate(acc, loss, err) ? 0 : fail(err);
+  });
+}
+
+void fedml_trainer_destroy(void* h) { delete static_cast<FedMLDenseTrainer*>(h); }
+
+// -- LightSecAgg ------------------------------------------------------------
+int fedml_lsa_chunk(int d, int t, int u) { return fedml::lsa::chunk_size(d, t, u); }
+
+// out: [n * chunk] int64
+int fedml_lsa_mask_encoding(int d, int n, int t, int u, const long long* mask,
+                            unsigned long long seed, long long* out) {
+  return guarded([&] {
+    if (u <= t || n < u || d <= 0) return fail("need d > 0 and t < u <= n");
+    std::vector<int64_t> m(mask, mask + d);
+    auto rows = fedml::lsa::mask_encoding(d, n, t, u, m, seed);
+    memcpy(out, rows.data(), rows.size() * sizeof(int64_t));
+    return 0;
+  });
+}
+
+// rows: [n_ids * chunk] (sorted by id), ids: 1-based; out: [d]
+int fedml_lsa_aggregate_decode(const long long* rows, const int* ids, int n_ids, int t,
+                               int u, int d, int chunk, long long* out) {
+  return guarded([&] {
+    if (n_ids < u) return fail("need >= u surviving aggregate-encoded rows");
+    std::vector<std::pair<int, std::vector<int64_t>>> agg;
+    for (int i = 0; i < n_ids; ++i)
+      agg.emplace_back(ids[i],
+                       std::vector<int64_t>(rows + (size_t)i * chunk, rows + (size_t)(i + 1) * chunk));
+    auto mask = fedml::lsa::aggregate_mask_reconstruction(agg, t, u, d);
+    memcpy(out, mask.data(), (size_t)d * sizeof(int64_t));
+    return 0;
+  });
+}
+
+// -- client manager ---------------------------------------------------------
+void* fedml_client_create(const char* model_path, const char* data_path, int batch,
+                          double lr, int epochs, unsigned long long seed) {
+  return guarded_ptr([&]() -> void* {
+    auto* c = new FedMLClientManager();
+    std::string err;
+    if (!c->init(model_path, data_path, batch, lr, epochs, seed, err)) {
+      g_last_error = err;
+      delete c;
+      return nullptr;
+    }
+    return c;
+  });
+}
+
+int fedml_client_train(void* h) {
+  return guarded([&] {
+    std::string err;
+    return static_cast<FedMLClientManager*>(h)->train(err) ? 0 : fail(err);
+  });
+}
+
+int fedml_client_save_model(void* h, const char* out_path) {
+  return guarded([&] {
+    std::string err;
+    return static_cast<FedMLClientManager*>(h)->save_model(out_path, err) ? 0 : fail(err);
+  });
+}
+
+int fedml_client_save_masked_model(void* h, int q_bits, unsigned long long mask_seed,
+                                   const char* out_path) {
+  return guarded([&] {
+    std::string err;
+    return static_cast<FedMLClientManager*>(h)->save_masked_model(q_bits, mask_seed, out_path, err)
+               ? 0
+               : fail(err);
+  });
+}
+
+long long fedml_client_mask_dim(void* h) {
+  return static_cast<FedMLClientManager*>(h)->trainer().flat_size();
+}
+
+// out: [n * chunk] int64
+int fedml_client_encode_mask(void* h, int n, int t, int u, unsigned long long mask_seed,
+                             long long* out) {
+  return guarded([&] {
+    if (u <= t || n < u) return fail("need t < u <= n");
+    std::string err;
+    auto rows = static_cast<FedMLClientManager*>(h)->encode_mask(n, t, u, mask_seed, err);
+    if (rows.empty()) return fail(err.empty() ? "encode_mask failed" : err);
+    memcpy(out, rows.data(), rows.size() * sizeof(int64_t));
+    return 0;
+  });
+}
+
+void fedml_client_destroy(void* h) { delete static_cast<FedMLClientManager*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,175 @@
+// fedml_edge — native edge runtime for the TPU-native FedML rebuild.
+//
+// Role of the reference's MobileNN C++ SDK (android/fedmlsdk/MobileNN/):
+//   * FedMLBaseTrainer      (includes/train/FedMLBaseTrainer.h:13-46)
+//   * dataset readers       (src/MNN/{mnist,cifar10}.cpp)
+//   * LightSecAgg LCC codec (includes/security/LightSecAgg.h:11-33)
+//   * FedMLClientManager    (includes/FedMLClientManager.h:6-41)
+//
+// The model/data interchange format is FTEM (fedml_tpu/cross_device/
+// edge_model.py) — the same file the Python server writes/reads, so a native
+// device and the TPU server speak one format.
+
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace fedml {
+
+// ---------------------------------------------------------------------------
+// FTEM container
+// ---------------------------------------------------------------------------
+struct Tensor {
+  std::vector<uint32_t> dims;
+  int dtype = 0;  // 0 = f32, 1 = i32
+  std::vector<float> f32;
+  std::vector<int32_t> i32;
+  size_t size() const;
+};
+
+using TensorMap = std::map<std::string, Tensor>;  // sorted: canonical order
+
+bool ftem_read(const std::string& path, TensorMap& out, std::string& err);
+bool ftem_write(const std::string& path, const TensorMap& tensors, std::string& err);
+
+// MNIST idx pair -> FTEM {"x": [n, 784] f32 in [0,1], "y": [n] i32}
+// (role of reference MobileNN/src/MNN/mnist.cpp). limit <= 0 means all.
+bool mnist_idx_to_ftem(const std::string& images_path, const std::string& labels_path,
+                       const std::string& out_path, int limit, std::string& err);
+
+// ---------------------------------------------------------------------------
+// Trainer (reference FedMLBaseTrainer contract)
+// ---------------------------------------------------------------------------
+using ProgressCallback = void (*)(int epoch, double loss);
+
+class FedMLBaseTrainer {
+ public:
+  virtual ~FedMLBaseTrainer() = default;
+
+  // reference init(model_path, data_path, batch, lr, epochs)
+  virtual bool init(const std::string& model_path, const std::string& data_path,
+                    int batch_size, double lr, int epochs, uint64_t seed,
+                    std::string& err) = 0;
+  virtual bool train(std::string& err) = 0;             // full local run
+  virtual bool save(const std::string& out_path, std::string& err) = 0;
+  virtual bool evaluate(double* acc, double* loss, std::string& err) = 0;
+
+  // reference getEpochAndLoss() — both fields atomic: polled cross-thread
+  // while train() runs
+  std::pair<int, double> epoch_and_loss() const { return {epoch_.load(), loss_.load()}; }
+  // reference stopTraining()
+  void stop_training() { stop_requested_ = true; }
+  void set_progress_callback(ProgressCallback cb) { progress_cb_ = cb; }
+  int64_t num_samples() const { return num_samples_; }
+
+ protected:
+  std::atomic<int> epoch_{0};
+  std::atomic<double> loss_{0.0};
+  std::atomic<bool> stop_requested_{false};
+  ProgressCallback progress_cb_ = nullptr;
+  int64_t num_samples_ = 0;
+};
+
+// Dense-stack (LR / MLP) softmax-CE SGD trainer — the edge model family
+// (reference MobileNN trains LeNet-class models; dense stacks are the FTEM
+// models the Python hub marks edge-capable).
+class FedMLDenseTrainer : public FedMLBaseTrainer {
+ public:
+  bool init(const std::string& model_path, const std::string& data_path,
+            int batch_size, double lr, int epochs, uint64_t seed,
+            std::string& err) override;
+  bool train(std::string& err) override;
+  bool save(const std::string& out_path, std::string& err) override;
+  bool evaluate(double* acc, double* loss, std::string& err) override;
+
+  // flatten trained params in name-sorted order (the masking order the
+  // Python side uses: sorted(flat) — edge_model.py writes sorted too)
+  std::vector<float> flat_params() const;
+  int64_t flat_size() const;
+
+ private:
+  TensorMap model_;
+  // chained dense layers: indices into names
+  std::vector<std::pair<std::string, std::string>> layers_;  // (kernel, bias)
+  std::vector<float> x_;  // [n, d] row-major
+  std::vector<int32_t> y_;
+  int64_t dim_ = 0, classes_ = 0;
+  int batch_ = 32, epochs_ = 1;
+  double lr_ = 0.01;
+  uint64_t seed_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// LightSecAgg (reference includes/security/LightSecAgg.h)
+// ---------------------------------------------------------------------------
+namespace lsa {
+
+constexpr int64_t kPrime = 2147483647;  // M31, matches core/mpc/field.py
+
+int64_t mod_pow(int64_t base, int64_t exp, int64_t p = kPrime);
+int64_t mod_inverse(int64_t a, int64_t p = kPrime);  // Fermat a^(p-2)
+
+// U[t*k + j] = prod_{l!=j} (targets[t]-interp[l]) / (interp[j]-interp[l])
+std::vector<int64_t> lagrange_basis_at(const std::vector<int64_t>& interp,
+                                       const std::vector<int64_t>& targets,
+                                       int64_t p = kPrime);
+
+// X: [K, chunk] -> [N, chunk] evaluated at betas (alphas are 1..K interp pts)
+std::vector<int64_t> lcc_encode(const std::vector<int64_t>& X, int K, int chunk,
+                                const std::vector<int64_t>& alphas,
+                                const std::vector<int64_t>& betas, int64_t p = kPrime);
+// F: [R, chunk] known at eval_betas -> values at target_alphas
+std::vector<int64_t> lcc_decode(const std::vector<int64_t>& F, int chunk,
+                                const std::vector<int64_t>& eval_betas,
+                                const std::vector<int64_t>& target_alphas,
+                                int64_t p = kPrime);
+
+inline int chunk_size(int d, int t, int u) { int k = u - t; return (d + k - 1) / k; }
+
+// Encode a length-d mask into n sub-masks [n, chunk]; matches
+// fedml_tpu/core/mpc/lightsecagg.py mask_encoding (alphas 1..u, betas u+1..u+n).
+std::vector<int64_t> mask_encoding(int d, int n, int t, int u,
+                                   const std::vector<int64_t>& mask, uint64_t seed,
+                                   int64_t p = kPrime);
+
+// Server side: aggregate-encoded rows (keyed by 1-based client id) -> sum of
+// masks; matches lightsecagg.py aggregate_mask_reconstruction.
+std::vector<int64_t> aggregate_mask_reconstruction(
+    const std::vector<std::pair<int, std::vector<int64_t>>>& agg_encoded,
+    int t, int u, int d, int64_t p = kPrime);
+
+// fixed-point quantization (reference my_q / secagg.py:19-35)
+std::vector<int64_t> quantize(const std::vector<float>& x, int q_bits, int64_t p = kPrime);
+std::vector<double> dequantize(const std::vector<int64_t>& z, int q_bits, int64_t p = kPrime);
+
+}  // namespace lsa
+
+// ---------------------------------------------------------------------------
+// Client manager (reference FedMLClientManager.h:6-41): trainer + LightSecAgg
+// ---------------------------------------------------------------------------
+class FedMLClientManager {
+ public:
+  bool init(const std::string& model_path, const std::string& data_path,
+            int batch_size, double lr, int epochs, uint64_t seed, std::string& err);
+  bool train(std::string& err);
+  bool save_model(const std::string& out_path, std::string& err);
+  // LightSecAgg upload pair: masked quantized params (FTEM "masked_params"
+  // i32 [D] + "num_samples") and the LCC-encoded sub-masks of the local mask.
+  bool save_masked_model(int q_bits, uint64_t mask_seed, const std::string& out_path,
+                         std::string& err);
+  std::vector<int64_t> encode_mask(int n, int t, int u, uint64_t mask_seed,
+                                   std::string& err);
+
+  FedMLDenseTrainer& trainer() { return trainer_; }
+
+ private:
+  FedMLDenseTrainer trainer_;
+  int64_t mask_dim_ = 0;
+};
+
+}  // namespace fedml

@@ -1,0 +1,130 @@
+// FTEM container I/O + MNIST idx reader (roles of the reference's MNN model
+// file handling and MobileNN/src/MNN/mnist.cpp).
+
+#include <cstdio>
+#include <cstring>
+
+#include "fedml_edge.hpp"
+
+namespace fedml {
+
+static const char kMagic[4] = {'F', 'T', 'E', 'M'};
+static const uint32_t kVersion = 1;
+
+size_t Tensor::size() const {
+  size_t n = 1;
+  for (auto d : dims) n *= d;
+  return n;
+}
+
+static bool read_exact(FILE* f, void* buf, size_t n) { return fread(buf, 1, n, f) == n; }
+
+bool ftem_read(const std::string& path, TensorMap& out, std::string& err) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) { err = "cannot open " + path; return false; }
+  char magic[4];
+  uint32_t version = 0, count = 0;
+  if (!read_exact(f, magic, 4) || memcmp(magic, kMagic, 4) != 0) {
+    err = path + ": not an FTEM file"; fclose(f); return false;
+  }
+  if (!read_exact(f, &version, 4) || version != kVersion ||
+      !read_exact(f, &count, 4)) {
+    err = path + ": bad FTEM header"; fclose(f); return false;
+  }
+  for (uint32_t i = 0; i < count; ++i) {
+    uint32_t name_len = 0;
+    if (!read_exact(f, &name_len, 4) || name_len > 4096) { err = "bad name"; fclose(f); return false; }
+    std::string name(name_len, '\0');
+    uint8_t dtype = 0;
+    uint32_t ndim = 0;
+    if (!read_exact(f, name.data(), name_len) || !read_exact(f, &dtype, 1) ||
+        !read_exact(f, &ndim, 4) || ndim > 16) {
+      err = "bad tensor header"; fclose(f); return false;
+    }
+    Tensor t;
+    t.dtype = dtype;
+    t.dims.resize(ndim);
+    if (ndim && !read_exact(f, t.dims.data(), 4 * ndim)) { err = "bad dims"; fclose(f); return false; }
+    size_t n = t.size();
+    if (n > (size_t(1) << 31)) {  // corrupt header — don't attempt the alloc
+      err = path + ": tensor size implausibly large"; fclose(f); return false;
+    }
+    bool ok;
+    if (dtype == 0) { t.f32.resize(n); ok = !n || read_exact(f, t.f32.data(), 4 * n); }
+    else if (dtype == 1) { t.i32.resize(n); ok = !n || read_exact(f, t.i32.data(), 4 * n); }
+    else { err = "unknown dtype"; fclose(f); return false; }
+    if (!ok) { err = "truncated tensor data"; fclose(f); return false; }
+    out[name] = std::move(t);
+  }
+  fclose(f);
+  return true;
+}
+
+bool ftem_write(const std::string& path, const TensorMap& tensors, std::string& err) {
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) { err = "cannot open " + tmp; return false; }
+  uint32_t count = (uint32_t)tensors.size();
+  fwrite(kMagic, 1, 4, f);
+  fwrite(&kVersion, 4, 1, f);
+  fwrite(&count, 4, 1, f);
+  for (const auto& kv : tensors) {  // std::map iterates sorted — canonical
+    uint32_t name_len = (uint32_t)kv.first.size();
+    uint8_t dtype = (uint8_t)kv.second.dtype;
+    uint32_t ndim = (uint32_t)kv.second.dims.size();
+    fwrite(&name_len, 4, 1, f);
+    fwrite(kv.first.data(), 1, name_len, f);
+    fwrite(&dtype, 1, 1, f);
+    fwrite(&ndim, 4, 1, f);
+    if (ndim) fwrite(kv.second.dims.data(), 4, ndim, f);
+    if (dtype == 0) fwrite(kv.second.f32.data(), 4, kv.second.f32.size(), f);
+    else fwrite(kv.second.i32.data(), 4, kv.second.i32.size(), f);
+  }
+  if (fclose(f) != 0) { err = "write failed"; return false; }
+  if (rename(tmp.c_str(), path.c_str()) != 0) { err = "rename failed"; return false; }
+  return true;
+}
+
+// -- MNIST idx --------------------------------------------------------------
+
+static uint32_t be32(const unsigned char* b) {
+  return ((uint32_t)b[0] << 24) | ((uint32_t)b[1] << 16) | ((uint32_t)b[2] << 8) | b[3];
+}
+
+bool mnist_idx_to_ftem(const std::string& images_path, const std::string& labels_path,
+                       const std::string& out_path, int limit, std::string& err) {
+  FILE* fi = fopen(images_path.c_str(), "rb");
+  if (!fi) { err = "cannot open " + images_path; return false; }
+  FILE* fl = fopen(labels_path.c_str(), "rb");
+  if (!fl) { err = "cannot open " + labels_path; fclose(fi); return false; }
+
+  unsigned char ih[16], lh[8];
+  if (!read_exact(fi, ih, 16) || be32(ih) != 0x803 ||
+      !read_exact(fl, lh, 8) || be32(lh) != 0x801) {
+    err = "bad idx magic"; fclose(fi); fclose(fl); return false;
+  }
+  uint32_t n = be32(ih + 4), rows = be32(ih + 8), cols = be32(ih + 12);
+  uint32_t nl = be32(lh + 4);
+  if (nl < n) n = nl;
+  if (limit > 0 && (uint32_t)limit < n) n = (uint32_t)limit;
+  size_t d = (size_t)rows * cols;
+
+  Tensor x, y;
+  x.dtype = 0; x.dims = {n, (uint32_t)d}; x.f32.resize((size_t)n * d);
+  y.dtype = 1; y.dims = {n}; y.i32.resize(n);
+  std::vector<unsigned char> row(d);
+  for (uint32_t i = 0; i < n; ++i) {
+    if (!read_exact(fi, row.data(), d)) { err = "truncated images"; fclose(fi); fclose(fl); return false; }
+    for (size_t j = 0; j < d; ++j) x.f32[(size_t)i * d + j] = row[j] / 255.0f;
+    unsigned char lab;
+    if (!read_exact(fl, &lab, 1)) { err = "truncated labels"; fclose(fi); fclose(fl); return false; }
+    y.i32[i] = lab;
+  }
+  fclose(fi); fclose(fl);
+  TensorMap out;
+  out["x"] = std::move(x);
+  out["y"] = std::move(y);
+  return ftem_write(out_path, out, err);
+}
+
+}  // namespace fedml

@@ -1,0 +1,206 @@
+// LightSecAgg LCC codec over the M31 prime field (role of reference
+// MobileNN/src/security/LightSecAgg.cpp, includes/security/LightSecAgg.h:11-33:
+// LCC encode/decode with points, Lagrange coefficient generation, modular
+// inverse).  Bit-compatible with fedml_tpu/core/mpc/{field,lightsecagg}.py —
+// the Python server reconstructs masks encoded by this code.
+
+#include <cmath>
+#include <random>
+
+#include "fedml_edge.hpp"
+
+namespace fedml {
+namespace lsa {
+
+int64_t mod_pow(int64_t base, int64_t exp, int64_t p) {
+  base %= p;
+  if (base < 0) base += p;
+  int64_t result = 1;
+  while (exp > 0) {
+    if (exp & 1) result = (__int128)result * base % p;
+    base = (__int128)base * base % p;
+    exp >>= 1;
+  }
+  return result;
+}
+
+int64_t mod_inverse(int64_t a, int64_t p) { return mod_pow(a, p - 2, p); }
+
+std::vector<int64_t> lagrange_basis_at(const std::vector<int64_t>& interp,
+                                       const std::vector<int64_t>& targets,
+                                       int64_t p) {
+  size_t k = interp.size(), m = targets.size();
+  std::vector<int64_t> U(m * k);
+  for (size_t j = 0; j < k; ++j) {
+    int64_t den = 1;
+    for (size_t l = 0; l < k; ++l) {
+      if (l == j) continue;
+      int64_t diff = (interp[j] - interp[l]) % p;
+      if (diff < 0) diff += p;
+      den = (__int128)den * diff % p;
+    }
+    int64_t den_inv = mod_inverse(den, p);
+    for (size_t t = 0; t < m; ++t) {
+      int64_t num = 1;
+      for (size_t l = 0; l < k; ++l) {
+        if (l == j) continue;
+        int64_t diff = (targets[t] - interp[l]) % p;
+        if (diff < 0) diff += p;
+        num = (__int128)num * diff % p;
+      }
+      U[t * k + j] = (__int128)num * den_inv % p;
+    }
+  }
+  return U;
+}
+
+std::vector<int64_t> lcc_encode(const std::vector<int64_t>& X, int K, int chunk,
+                                const std::vector<int64_t>& alphas,
+                                const std::vector<int64_t>& betas, int64_t p) {
+  auto U = lagrange_basis_at(alphas, betas, p);  // [N, K]
+  int N = (int)betas.size();
+  std::vector<int64_t> out((size_t)N * chunk, 0);
+  for (int i = 0; i < N; ++i)
+    for (int j = 0; j < K; ++j) {
+      int64_t u = U[(size_t)i * K + j];
+      if (!u) continue;
+      for (int c = 0; c < chunk; ++c) {
+        int64_t x = X[(size_t)j * chunk + c] % p;
+        if (x < 0) x += p;
+        out[(size_t)i * chunk + c] =
+            (out[(size_t)i * chunk + c] + (__int128)u * x % p) % p;
+      }
+    }
+  return out;
+}
+
+std::vector<int64_t> lcc_decode(const std::vector<int64_t>& F, int chunk,
+                                const std::vector<int64_t>& eval_betas,
+                                const std::vector<int64_t>& target_alphas,
+                                int64_t p) {
+  return lcc_encode(F, (int)eval_betas.size(), chunk, eval_betas, target_alphas, p);
+}
+
+std::vector<int64_t> mask_encoding(int d, int n, int t, int u,
+                                   const std::vector<int64_t>& mask, uint64_t seed,
+                                   int64_t p) {
+  int k = u - t;
+  int chunk = chunk_size(d, t, u);
+  std::vector<int64_t> X((size_t)u * chunk, 0);
+  for (int i = 0; i < d; ++i) {
+    int64_t v = mask[i] % p;
+    if (v < 0) v += p;
+    X[i] = v;  // row-major [k, chunk] fill, data chunks first
+  }
+  std::mt19937_64 rng(seed);
+  std::uniform_int_distribution<int64_t> dist(0, p - 1);
+  for (size_t i = (size_t)k * chunk; i < X.size(); ++i) X[i] = dist(rng);  // t noise chunks
+
+  std::vector<int64_t> alphas(u), betas(n);
+  for (int i = 0; i < u; ++i) alphas[i] = i + 1;
+  for (int i = 0; i < n; ++i) betas[i] = u + 1 + i;
+  return lcc_encode(X, u, chunk, alphas, betas, p);  // [n, chunk]
+}
+
+std::vector<int64_t> aggregate_mask_reconstruction(
+    const std::vector<std::pair<int, std::vector<int64_t>>>& agg_encoded,
+    int t, int u, int d, int64_t p) {
+  int k = u - t;
+  int chunk = chunk_size(d, t, u);
+  // take the first u ids in sorted order (caller passes sorted), evaluate at
+  // betas[id-1] = u + id
+  std::vector<int64_t> eval_betas;
+  std::vector<int64_t> F;
+  for (int i = 0; i < u && i < (int)agg_encoded.size(); ++i) {
+    eval_betas.push_back(u + agg_encoded[i].first);
+    F.insert(F.end(), agg_encoded[i].second.begin(), agg_encoded[i].second.end());
+  }
+  std::vector<int64_t> target_alphas(k);
+  for (int i = 0; i < k; ++i) target_alphas[i] = i + 1;
+  auto decoded = lcc_decode(F, chunk, eval_betas, target_alphas, p);  // [k, chunk]
+  decoded.resize(d);
+  return decoded;
+}
+
+std::vector<int64_t> quantize(const std::vector<float>& x, int q_bits, int64_t p) {
+  double scale = (double)((int64_t)1 << q_bits);
+  std::vector<int64_t> out(x.size());
+  for (size_t i = 0; i < x.size(); ++i) {
+    int64_t q = (int64_t)std::llround((double)x[i] * scale);
+    q %= p;
+    if (q < 0) q += p;
+    out[i] = q;
+  }
+  return out;
+}
+
+std::vector<double> dequantize(const std::vector<int64_t>& z, int q_bits, int64_t p) {
+  double scale = (double)((int64_t)1 << q_bits);
+  int64_t half = (p - 1) / 2;
+  std::vector<double> out(z.size());
+  for (size_t i = 0; i < z.size(); ++i) {
+    int64_t v = z[i] % p;
+    if (v < 0) v += p;
+    out[i] = (v > half ? (double)(v - p) : (double)v) / scale;
+  }
+  return out;
+}
+
+}  // namespace lsa
+
+// ---------------------------------------------------------------------------
+// FedMLClientManager
+// ---------------------------------------------------------------------------
+
+bool FedMLClientManager::init(const std::string& model_path, const std::string& data_path,
+                              int batch_size, double lr, int epochs, uint64_t seed,
+                              std::string& err) {
+  if (!trainer_.init(model_path, data_path, batch_size, lr, epochs, seed, err)) return false;
+  mask_dim_ = trainer_.flat_size();
+  return true;
+}
+
+bool FedMLClientManager::train(std::string& err) { return trainer_.train(err); }
+
+bool FedMLClientManager::save_model(const std::string& out_path, std::string& err) {
+  return trainer_.save(out_path, err);
+}
+
+static std::vector<int64_t> local_mask(int64_t dim, uint64_t mask_seed) {
+  std::mt19937_64 rng(mask_seed);
+  std::uniform_int_distribution<int64_t> dist(0, lsa::kPrime - 1);
+  std::vector<int64_t> mask(dim);
+  for (auto& m : mask) m = dist(rng);
+  return mask;
+}
+
+bool FedMLClientManager::save_masked_model(int q_bits, uint64_t mask_seed,
+                                           const std::string& out_path, std::string& err) {
+  auto flat = trainer_.flat_params();
+  auto z = lsa::quantize(flat, q_bits);
+  auto mask = local_mask((int64_t)z.size(), mask_seed);
+  Tensor masked;
+  masked.dtype = 1;  // residues < p = 2^31 - 1 fit int32 exactly
+  masked.dims = {(uint32_t)z.size()};
+  masked.i32.resize(z.size());
+  for (size_t i = 0; i < z.size(); ++i)
+    masked.i32[i] = (int32_t)((z[i] + mask[i]) % lsa::kPrime);
+  Tensor ns;
+  ns.dtype = 1;
+  ns.dims = {1};
+  ns.i32 = {(int32_t)trainer_.num_samples()};
+  TensorMap out;
+  out["masked_params"] = std::move(masked);
+  out["num_samples"] = std::move(ns);
+  return ftem_write(out_path, out, err);
+}
+
+std::vector<int64_t> FedMLClientManager::encode_mask(int n, int t, int u,
+                                                     uint64_t mask_seed, std::string& err) {
+  (void)err;
+  auto mask = local_mask(mask_dim_, mask_seed);
+  // noise seed derived from mask seed (distinct stream)
+  return lsa::mask_encoding((int)mask_dim_, n, t, u, mask, mask_seed ^ 0x9e3779b97f4a7c15ull);
+}
+
+}  // namespace fedml

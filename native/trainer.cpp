@@ -1,0 +1,240 @@
+// Dense-stack SGD trainer (role of reference FedMLMNNTrainer/FedMLTorchTrainer,
+// android/fedmlsdk/MobileNN/src/train/): softmax-CE, per-epoch shuffling,
+// progress callbacks, cooperative stopTraining.
+
+#include <algorithm>
+#include <cmath>
+#include <random>
+
+#include "fedml_edge.hpp"
+
+namespace fedml {
+
+static bool ends_with(const std::string& s, const std::string& suf) {
+  return s.size() >= suf.size() && s.compare(s.size() - suf.size(), suf.size(), suf) == 0;
+}
+
+// order kernel/bias pairs by chaining out-dim(i) == in-dim(i+1)
+// (same logic as cross_device/fake_device.py _dense_stack)
+static std::vector<std::pair<std::string, std::string>> dense_stack(
+    const TensorMap& m, std::string& err) {
+  std::vector<std::pair<std::string, std::string>> pairs;
+  for (const auto& kv : m) {
+    if (ends_with(kv.first, "/kernel") && kv.second.dims.size() == 2) {
+      std::string bias = kv.first.substr(0, kv.first.size() - 6) + "bias";
+      if (m.count(bias)) pairs.emplace_back(kv.first, bias);
+    }
+  }
+  if (pairs.empty()) { err = "no kernel/bias dense pairs in model"; return {}; }
+  std::vector<std::pair<std::string, std::string>> ordered{pairs.front()};
+  pairs.erase(pairs.begin());
+  bool changed = true;
+  while (!pairs.empty() && changed) {
+    changed = false;
+    for (auto it = pairs.begin(); it != pairs.end(); ++it) {
+      uint32_t in0 = m.at(it->first).dims[0], out0 = m.at(it->first).dims[1];
+      if (in0 == m.at(ordered.back().first).dims[1]) {
+        ordered.push_back(*it); pairs.erase(it); changed = true; break;
+      }
+      if (out0 == m.at(ordered.front().first).dims[0]) {
+        ordered.insert(ordered.begin(), *it); pairs.erase(it); changed = true; break;
+      }
+    }
+  }
+  for (auto& p : pairs) ordered.push_back(p);
+  return ordered;
+}
+
+bool FedMLDenseTrainer::init(const std::string& model_path, const std::string& data_path,
+                             int batch_size, double lr, int epochs, uint64_t seed,
+                             std::string& err) {
+  if (!ftem_read(model_path, model_, err)) return false;
+  layers_ = dense_stack(model_, err);
+  if (layers_.empty()) return false;
+
+  TensorMap data;
+  if (!ftem_read(data_path, data, err)) return false;
+  auto xi = data.find("x");
+  auto yi = data.find("y");
+  if (xi == data.end() || yi == data.end() || xi->second.dims.size() != 2) {
+    err = "data file needs x [n, d] f32 and y [n] i32";
+    return false;
+  }
+  x_ = xi->second.f32;
+  y_ = yi->second.i32;
+  num_samples_ = yi->second.dims[0];
+  dim_ = xi->second.dims[1];
+  classes_ = model_.at(layers_.back().first).dims[1];
+  if (model_.at(layers_.front().first).dims[0] != (uint32_t)dim_) {
+    err = "model input dim != data dim";
+    return false;
+  }
+  for (int64_t i = 0; i < num_samples_; ++i) {
+    if (y_[i] < 0 || y_[i] >= classes_) {
+      err = "label out of range [0, classes)";
+      return false;
+    }
+  }
+  batch_ = batch_size;
+  lr_ = lr;
+  epochs_ = epochs;
+  seed_ = seed;
+  return true;
+}
+
+bool FedMLDenseTrainer::train(std::string& err) {
+  (void)err;
+  std::mt19937_64 rng(seed_);
+  const int64_t n = num_samples_;
+  const int L = (int)layers_.size();
+  std::vector<int64_t> order(n);
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+
+  // activations per layer for one batch (acts[0] = input)
+  for (int e = 0; e < epochs_ && !stop_requested_; ++e) {
+    std::shuffle(order.begin(), order.end(), rng);
+    double loss_sum = 0.0;
+    int64_t seen = 0;
+    for (int64_t s = 0; s < n && !stop_requested_; s += batch_) {
+      int64_t bs = std::min<int64_t>(batch_, n - s);
+      std::vector<std::vector<double>> acts(L + 1);
+      acts[0].resize(bs * dim_);
+      for (int64_t i = 0; i < bs; ++i)
+        for (int64_t j = 0; j < dim_; ++j)
+          acts[0][i * dim_ + j] = x_[order[s + i] * dim_ + j];
+
+      // forward
+      for (int li = 0; li < L; ++li) {
+        const Tensor& W = model_.at(layers_[li].first);
+        const Tensor& b = model_.at(layers_[li].second);
+        int64_t din = W.dims[0], dout = W.dims[1];
+        acts[li + 1].assign(bs * dout, 0.0);
+        for (int64_t i = 0; i < bs; ++i) {
+          for (int64_t k = 0; k < din; ++k) {
+            double a = acts[li][i * din + k];
+            if (a == 0.0) continue;
+            const float* wrow = &W.f32[k * dout];
+            double* orow = &acts[li + 1][i * dout];
+            for (int64_t j = 0; j < dout; ++j) orow[j] += a * wrow[j];
+          }
+          for (int64_t j = 0; j < dout; ++j) {
+            double z = acts[li + 1][i * dout + j] + b.f32[j];
+            acts[li + 1][i * dout + j] = (li < L - 1) ? std::max(z, 0.0) : z;
+          }
+        }
+      }
+
+      // softmax CE + grad at logits
+      int64_t dout = classes_;
+      std::vector<double> g(bs * dout);
+      for (int64_t i = 0; i < bs; ++i) {
+        double* logit = &acts[L][i * dout];
+        double mx = logit[0];
+        for (int64_t j = 1; j < dout; ++j) mx = std::max(mx, logit[j]);
+        double sum = 0.0;
+        for (int64_t j = 0; j < dout; ++j) sum += std::exp(logit[j] - mx);
+        int32_t lab = y_[order[s + i]];
+        loss_sum += -(logit[lab] - mx - std::log(sum));
+        for (int64_t j = 0; j < dout; ++j)
+          g[i * dout + j] = (std::exp(logit[j] - mx) / sum - (j == lab ? 1.0 : 0.0)) / bs;
+      }
+      seen += bs;
+
+      // backward + SGD update
+      for (int li = L - 1; li >= 0; --li) {
+        Tensor& W = model_.at(layers_[li].first);
+        Tensor& b = model_.at(layers_[li].second);
+        int64_t din = W.dims[0], dcur = W.dims[1];
+        std::vector<double> gprev;
+        if (li > 0) {
+          gprev.assign(bs * din, 0.0);
+          for (int64_t i = 0; i < bs; ++i)
+            for (int64_t k = 0; k < din; ++k) {
+              double acc = 0.0;
+              const float* wrow = &W.f32[k * dcur];
+              for (int64_t j = 0; j < dcur; ++j) acc += g[i * dcur + j] * wrow[j];
+              // relu mask of the input activation
+              gprev[i * din + k] = acts[li][i * din + k] > 0.0 ? acc : 0.0;
+            }
+        }
+        for (int64_t k = 0; k < din; ++k) {
+          float* wrow = &W.f32[k * dcur];
+          for (int64_t j = 0; j < dcur; ++j) {
+            double gw = 0.0;
+            for (int64_t i = 0; i < bs; ++i) gw += acts[li][i * din + k] * g[i * dcur + j];
+            wrow[j] -= (float)(lr_ * gw);
+          }
+        }
+        for (int64_t j = 0; j < dcur; ++j) {
+          double gb = 0.0;
+          for (int64_t i = 0; i < bs; ++i) gb += g[i * dcur + j];
+          b.f32[j] -= (float)(lr_ * gb);
+        }
+        if (li > 0) g.swap(gprev);
+      }
+    }
+    loss_ = seen ? loss_sum / seen : 0.0;
+    epoch_ = e + 1;
+    if (progress_cb_) progress_cb_(e + 1, loss_);
+  }
+  return true;
+}
+
+bool FedMLDenseTrainer::evaluate(double* acc, double* loss, std::string& err) {
+  (void)err;
+  const int L = (int)layers_.size();
+  int64_t correct = 0;
+  double loss_sum = 0.0;
+  std::vector<double> a, nxt;
+  for (int64_t i = 0; i < num_samples_; ++i) {
+    a.assign(x_.begin() + i * dim_, x_.begin() + (i + 1) * dim_);
+    for (int li = 0; li < L; ++li) {
+      const Tensor& W = model_.at(layers_[li].first);
+      const Tensor& b = model_.at(layers_[li].second);
+      int64_t din = W.dims[0], dout = W.dims[1];
+      nxt.assign(dout, 0.0);
+      for (int64_t k = 0; k < din; ++k) {
+        if (a[k] == 0.0) continue;
+        const float* wrow = &W.f32[k * dout];
+        for (int64_t j = 0; j < dout; ++j) nxt[j] += a[k] * wrow[j];
+      }
+      for (int64_t j = 0; j < dout; ++j) {
+        double z = nxt[j] + b.f32[j];
+        nxt[j] = (li < L - 1) ? std::max(z, 0.0) : z;
+      }
+      a.swap(nxt);
+    }
+    double mx = a[0];
+    int64_t arg = 0;
+    for (int64_t j = 1; j < (int64_t)a.size(); ++j)
+      if (a[j] > mx) { mx = a[j]; arg = j; }
+    double sum = 0.0;
+    for (double z : a) sum += std::exp(z - mx);
+    loss_sum += -(a[y_[i]] - mx - std::log(sum));
+    if (arg == y_[i]) ++correct;
+  }
+  *acc = num_samples_ ? (double)correct / num_samples_ : 0.0;
+  *loss = num_samples_ ? loss_sum / num_samples_ : 0.0;
+  return true;
+}
+
+bool FedMLDenseTrainer::save(const std::string& out_path, std::string& err) {
+  return ftem_write(out_path, model_, err);
+}
+
+std::vector<float> FedMLDenseTrainer::flat_params() const {
+  std::vector<float> out;
+  for (const auto& kv : model_)  // sorted-name order == Python sorted(flat)
+    if (kv.second.dtype == 0)
+      out.insert(out.end(), kv.second.f32.begin(), kv.second.f32.end());
+  return out;
+}
+
+int64_t FedMLDenseTrainer::flat_size() const {
+  int64_t n = 0;
+  for (const auto& kv : model_)
+    if (kv.second.dtype == 0) n += (int64_t)kv.second.f32.size();
+  return n;
+}
+
+}  // namespace fedml

@@ -1,0 +1,259 @@
+"""Native C++ edge runtime (native/ + fedml_tpu.native ctypes bindings) —
+the rebuild's MobileNN equivalent (SURVEY.md §2.8).  Builds the shared lib
+with make, then exercises trainer, FTEM interop, and the LightSecAgg codec
+cross-language against core/mpc."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from fedml_tpu.cross_device.edge_model import load_edge_model, save_edge_model
+
+native = pytest.importorskip("fedml_tpu.native")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    return native.load()  # builds via make when stale
+
+
+def _separable(n, d=10, classes=4, seed=0):
+    centers = np.random.RandomState(7).randn(classes, d) * 3
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, d) * 0.5
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def _write_data(tmp_path, x, y, name="data.ftem"):
+    path = str(tmp_path / name)
+    save_edge_model(path, {"x": x, "y": y.astype(np.int32)})
+    return path
+
+
+def _write_model(tmp_path, d, classes, hidden=0, name="model.ftem", seed=0):
+    rng = np.random.RandomState(seed)
+    if hidden:
+        flat = {
+            "params/Dense_0/kernel": (rng.randn(d, hidden) * 0.1).astype(np.float32),
+            "params/Dense_0/bias": np.zeros(hidden, np.float32),
+            "params/Dense_1/kernel": (rng.randn(hidden, classes) * 0.1).astype(np.float32),
+            "params/Dense_1/bias": np.zeros(classes, np.float32),
+        }
+    else:
+        flat = {
+            "params/linear/kernel": np.zeros((d, classes), np.float32),
+            "params/linear/bias": np.zeros(classes, np.float32),
+        }
+    path = str(tmp_path / name)
+    save_edge_model(path, flat)
+    return path
+
+
+class TestNativeTrainer:
+    def test_lr_learns_and_reports_progress(self, lib, tmp_path):
+        x, y = _separable(256)
+        data = _write_data(tmp_path, x, y)
+        model = _write_model(tmp_path, 10, 4)
+        t = native.EdgeTrainer(model, data, batch_size=32, lr=0.3, epochs=4, seed=1)
+        seen = []
+        t.set_progress_callback(lambda e, l: seen.append((e, l)))
+        t.train()
+        epoch, loss = t.epoch_and_loss()
+        assert epoch == 4 and len(seen) == 4
+        assert seen[-1][1] < seen[0][1]  # loss decreased
+        acc, _ = t.evaluate()
+        assert acc > 0.9
+        assert t.num_samples == 256
+
+        out = t.save(str(tmp_path / "trained.ftem"))
+        flat = load_edge_model(out)  # python reads what C++ wrote
+        assert flat["params/linear/kernel"].shape == (10, 4)
+        assert np.abs(flat["params/linear/kernel"]).sum() > 0
+        t.close()
+
+    def test_mlp_learns(self, lib, tmp_path):
+        x, y = _separable(256, seed=2)
+        t = native.EdgeTrainer(
+            _write_model(tmp_path, 10, 4, hidden=16), _write_data(tmp_path, x, y),
+            batch_size=32, lr=0.1, epochs=6, seed=3,
+        )
+        t.train()
+        acc, _ = t.evaluate()
+        assert acc > 0.9
+        t.close()
+
+    def test_bad_model_error_surfaces(self, lib, tmp_path):
+        data = _write_data(tmp_path, *_separable(16))
+        path = str(tmp_path / "junk.ftem")
+        save_edge_model(path, {"not_a_kernel": np.zeros(3, np.float32)})
+        with pytest.raises(RuntimeError, match="kernel"):
+            native.EdgeTrainer(path, data)
+
+    def test_mnist_idx_converter(self, lib, tmp_path):
+        # craft a 3-image idx pair
+        n, rows, cols = 3, 4, 4
+        imgs = tmp_path / "imgs"
+        labs = tmp_path / "labs"
+        pix = np.arange(n * rows * cols, dtype=np.uint8)
+        imgs.write_bytes(struct.pack(">IIII", 0x803, n, rows, cols) + pix.tobytes())
+        labs.write_bytes(struct.pack(">II", 0x801, n) + bytes([0, 1, 2]))
+        out = native.mnist_idx_to_ftem(str(imgs), str(labs), str(tmp_path / "m.ftem"))
+        flat = load_edge_model(out)
+        assert flat["x"].shape == (3, 16)
+        np.testing.assert_allclose(flat["x"][0, 1], 1 / 255.0, rtol=1e-5)
+        np.testing.assert_array_equal(flat["y"], [0, 1, 2])
+
+
+class TestLightSecAggInterop:
+    def test_native_encode_python_decode(self, lib):
+        """C++ mask encodings must reconstruct with the PYTHON server math."""
+        from fedml_tpu.core.mpc.field import FIELD_PRIME
+        from fedml_tpu.core.mpc.lightsecagg import (
+            aggregate_mask_reconstruction,
+            compute_aggregate_encoded_mask,
+        )
+
+        d, n, t, u = 23, 4, 1, 3
+        rng = np.random.default_rng(5)
+        masks = [rng.integers(0, int(FIELD_PRIME), d, dtype=np.int64) for _ in range(n)]
+        # each client encodes natively
+        rows_per_client = [native.lsa_mask_encoding(d, n, t, u, masks[c], seed=100 + c)
+                           for c in range(n)]
+        surviving = [1, 2, 3]  # client ids, 1-based; one dropout (4)
+        # surviving client j sums the rows addressed to it from surviving peers
+        agg = {}
+        for j in surviving:
+            received = {c + 1: rows_per_client[c][j - 1] for c in range(n) if c + 1 in surviving}
+            agg[j] = compute_aggregate_encoded_mask(received, surviving)
+        recon = aggregate_mask_reconstruction(agg, t, u, d)
+        expected = np.zeros(d, np.int64)
+        for c in surviving:
+            expected = (expected + masks[c - 1]) % FIELD_PRIME
+        np.testing.assert_array_equal(recon, expected)
+
+    def test_python_encode_native_decode(self, lib):
+        """And the reverse: python encodings decoded by the native codec."""
+        from fedml_tpu.core.mpc.field import FIELD_PRIME
+        from fedml_tpu.core.mpc.lightsecagg import mask_encoding
+
+        d, n, t, u = 17, 5, 2, 4
+        rng = np.random.default_rng(11)
+        masks = [rng.integers(0, int(FIELD_PRIME), d, dtype=np.int64) for _ in range(n)]
+        rows_per_client = [mask_encoding(d, n, t, u, masks[c], rng) for c in range(n)]
+        surviving = [1, 2, 4, 5]
+        agg_rows = []
+        for j in surviving:
+            s = np.zeros_like(rows_per_client[0][0])
+            for c in surviving:
+                s = (s + rows_per_client[c - 1][j - 1]) % FIELD_PRIME
+            agg_rows.append(s)
+        recon = native.lsa_aggregate_decode(np.stack(agg_rows), surviving, t, u, d)
+        expected = np.zeros(d, np.int64)
+        for c in surviving:
+            expected = (expected + masks[c - 1]) % FIELD_PRIME
+        np.testing.assert_array_equal(recon, expected)
+
+
+class TestNativeDeviceProtocol:
+    def test_cross_device_round_with_native_devices(self, lib, tmp_path):
+        """Full Beehive round where devices train in C++ (use_native=True)."""
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+        from fedml_tpu.cross_device.fake_device import FakeDeviceManager
+        from fedml_tpu.cross_device.fedml_aggregator import FedMLAggregator
+        from fedml_tpu.cross_device.fedml_server_manager import FedMLServerManager
+        from fedml_tpu.models.linear import LogisticRegression
+
+        LoopbackHub.reset()
+        args = Arguments.from_dict(
+            {
+                "common_args": {"training_type": "cross_device", "random_seed": 0,
+                                "run_id": "native-proto"},
+                "data_args": {"dataset": "synthetic"},
+                "model_args": {"model": "lr"},
+                "train_args": {
+                    "federated_optimizer": "FedAvg",
+                    "client_num_in_total": 2,
+                    "client_num_per_round": 2,
+                    "comm_round": 2,
+                    "epochs": 2,
+                    "batch_size": 16,
+                    "learning_rate": 0.2,
+                },
+                "validation_args": {"frequency_of_the_test": 1},
+                "comm_args": {"backend": "LOOPBACK"},
+            }
+        ).validate()
+        x_test, y_test = _separable(128, seed=9)
+        aggregator = FedMLAggregator(args, LogisticRegression(output_dim=4),
+                                     (x_test, y_test), worker_num=2,
+                                     model_dir=str(tmp_path / "models"))
+        server = FedMLServerManager(args, aggregator, client_rank=0, client_num=2)
+        devices = [
+            FakeDeviceManager(args, r, _separable(96, seed=r), client_num=2,
+                              upload_dir=str(tmp_path / f"dev{r}"), use_native=True)
+            for r in (1, 2)
+        ]
+        threads = [server.run_async()] + [d.run_async() for d in devices]
+        for t in threads:
+            t.join(timeout=60)
+        assert all(not t.is_alive() for t in threads)
+        assert aggregator.eval_history[-1]["test_acc"] > 0.8
+
+
+class TestNativeClientManager:
+    def test_full_lightsecagg_round(self, lib, tmp_path):
+        """3 native clients -> masked uploads + encoded sub-masks; python
+        server unmasks the aggregate and matches the true quantized average
+        (the C++ LightSecAggForMNN flow, SURVEY.md §2.8)."""
+        from fedml_tpu.core.mpc.field import FIELD_PRIME
+        from fedml_tpu.core.mpc.lightsecagg import (
+            aggregate_mask_reconstruction,
+            compute_aggregate_encoded_mask,
+        )
+        from fedml_tpu.core.mpc.secagg import transform_finite_to_tensor
+
+        n, t, u, q_bits = 3, 1, 3, 16
+        clients = []
+        for c in range(n):
+            x, y = _separable(96, seed=c)
+            cm = native.EdgeClientManager(
+                _write_model(tmp_path, 10, 4, name=f"m{c}.ftem"),
+                _write_data(tmp_path, x, y, name=f"d{c}.ftem"),
+                batch_size=32, lr=0.2, epochs=2, seed=c,
+            )
+            cm.train()
+            clients.append(cm)
+        d = clients[0].mask_dim
+
+        masked, enc_rows, plains = [], [], []
+        for c, cm in enumerate(clients):
+            mpath = cm.save_masked_model(q_bits, mask_seed=500 + c,
+                                         out_path=str(tmp_path / f"masked{c}.ftem"))
+            masked.append(load_edge_model(mpath)["masked_params"].astype(np.int64))
+            enc_rows.append(cm.encode_mask(n, t, u, mask_seed=500 + c))
+            # ground truth: the unmasked trained params
+            ppath = cm.save_model(str(tmp_path / f"plain{c}.ftem"))
+            flat = load_edge_model(ppath)
+            plains.append(np.concatenate([flat[k].ravel() for k in sorted(flat)]))
+
+        surviving = [1, 2, 3]
+        agg = {}
+        for j in surviving:
+            received = {c + 1: enc_rows[c][j - 1] for c in range(n)}
+            agg[j] = compute_aggregate_encoded_mask(received, surviving)
+        agg_mask = aggregate_mask_reconstruction(agg, t, u, d)
+
+        summed = np.zeros(d, np.int64)
+        for m in masked:
+            summed = (summed + m) % FIELD_PRIME
+        unmasked = (summed - agg_mask) % FIELD_PRIME
+        avg = transform_finite_to_tensor(unmasked, q_bits=q_bits) / n
+
+        expected = np.mean(plains, axis=0)
+        np.testing.assert_allclose(avg, expected, atol=2e-4)
+        for cm in clients:
+            cm.close()
