@@ -1,0 +1,2 @@
+"""CLI + edge deployment (reference ``python/fedml/cli/``: the ``fedml``
+click app, build packaging, client/server edge daemons, env collector)."""

@@ -1,0 +1,83 @@
+"""``fedml_tpu build`` — package user code for deployment.
+
+Parity with reference ``cli/cli.py:315-350`` (``fedml build``): zip the user's
+source directory + entry point + config YAML into a deployable package whose
+layout the edge runner (``edge_deployment/client_runner.py``) understands:
+
+    package.zip
+    ├── fedml_package.json   (entry, config, built_at, type)
+    ├── src/...              (the user source tree)
+    └── config/fedml_config.yaml
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zipfile
+from typing import Optional
+
+PACKAGE_META = "fedml_package.json"
+
+
+def build_package(
+    source_dir: str,
+    entry_point: str,
+    config_path: str,
+    dest_path: str,
+    package_type: str = "client",
+    ignore: Optional[list] = None,
+) -> str:
+    """Zip source + config into ``dest_path``; returns the package path."""
+    source_dir = os.path.abspath(source_dir)
+    if not os.path.isdir(source_dir):
+        raise FileNotFoundError(f"source dir not found: {source_dir}")
+    entry_abs = os.path.join(source_dir, entry_point)
+    if not os.path.isfile(entry_abs):
+        raise FileNotFoundError(f"entry point not found: {entry_abs}")
+    if not os.path.isfile(config_path):
+        raise FileNotFoundError(f"config not found: {config_path}")
+    ignore = set(ignore or []) | {"__pycache__", ".git", ".pytest_cache"}
+
+    meta = {
+        "entry": entry_point,
+        "config": "config/fedml_config.yaml",
+        "type": package_type,
+        "built_at": time.time(),
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(dest_path)) or ".", exist_ok=True)
+    dest_abs = os.path.abspath(dest_path)
+    with zipfile.ZipFile(dest_path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr(PACKAGE_META, json.dumps(meta, indent=2))
+        for root, dirs, files in os.walk(source_dir):
+            dirs[:] = [d for d in dirs if d not in ignore]
+            for name in files:
+                if name.endswith((".pyc", ".so")):
+                    continue
+                full = os.path.join(root, name)
+                # the archive being written may live inside source_dir
+                # (default dest_folder is ".") — never zip it into itself
+                if os.path.abspath(full) == dest_abs:
+                    continue
+                rel = os.path.relpath(full, source_dir)
+                z.write(full, os.path.join("src", rel))
+        z.write(config_path, "config/fedml_config.yaml")
+    return dest_path
+
+
+def read_package_meta(package_path: str) -> dict:
+    with zipfile.ZipFile(package_path) as z:
+        return json.loads(z.read(PACKAGE_META))
+
+
+def unpack_package(package_path: str, dest_dir: str) -> dict:
+    """Extract a package; returns its metadata."""
+    with zipfile.ZipFile(package_path) as z:
+        for info in z.infolist():
+            # zip-slip guard: refuse entries escaping dest_dir
+            target = os.path.realpath(os.path.join(dest_dir, info.filename))
+            if not target.startswith(os.path.realpath(dest_dir) + os.sep) and target != os.path.realpath(dest_dir):
+                raise ValueError(f"unsafe path in package: {info.filename}")
+        z.extractall(dest_dir)
+        return json.loads(z.read(PACKAGE_META))

@@ -1,0 +1,161 @@
+"""The ``fedml_tpu`` command (reference ``cli/cli.py:28-577``, the ``fedml``
+click app).  argparse-based; run as ``python -m fedml_tpu.cli <cmd>``.
+
+Commands: version, env, login, logout, build, run, status, logs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+ACCOUNT_DIR = os.path.expanduser("~/.fedml_tpu")
+ACCOUNT_FILE = os.path.join(ACCOUNT_DIR, "account.json")
+
+
+def cmd_version(_args) -> int:
+    import fedml_tpu
+
+    print(f"fedml_tpu version {fedml_tpu.__version__}")
+    return 0
+
+
+def cmd_env(args) -> int:
+    from .env.collect_env import print_env
+
+    print_env(verbose=args.verbose)
+    return 0
+
+
+def cmd_login(args) -> int:
+    """Bind an account id (reference ``fedml login <account_id>``; the MLOps
+    platform handshake is represented by the local binding file)."""
+    os.makedirs(ACCOUNT_DIR, exist_ok=True)
+    with open(ACCOUNT_FILE, "w") as f:
+        json.dump({"account_id": args.account_id, "role": args.role}, f)
+    print(f"logged in as account {args.account_id} ({args.role})")
+    return 0
+
+
+def cmd_logout(_args) -> int:
+    try:
+        os.remove(ACCOUNT_FILE)
+    except FileNotFoundError:
+        pass
+    print("logged out")
+    return 0
+
+
+def cmd_build(args) -> int:
+    from .build import build_package
+
+    dest = args.dest_package or os.path.join(
+        args.dest_folder or ".", f"fedml_{args.type}_package.zip"
+    )
+    path = build_package(
+        source_dir=args.source_folder,
+        entry_point=args.entry_point,
+        config_path=args.config_file,
+        dest_path=dest,
+        package_type=args.type,
+    )
+    print(f"built {args.type} package: {path}")
+    return 0
+
+
+def cmd_run(args) -> int:
+    """Run a deployed package under the supervisor (reference edge daemon)."""
+    from .edge_deployment.client_runner import FedMLRunnerSupervisor
+
+    sup = FedMLRunnerSupervisor(
+        package_path=args.package,
+        run_dir=args.run_dir,
+        run_id=args.run_id,
+        role=args.role,
+        max_restarts=args.max_restarts,
+        extra_args=args.extra or [],
+    )
+    return sup.run()
+
+
+def cmd_status(args) -> int:
+    from .edge_deployment.client_runner import FedMLRunnerSupervisor
+
+    records = FedMLRunnerSupervisor.read_status(args.run_dir)
+    if not records:
+        print("no status recorded")
+        return 1
+    for rec in records:
+        print(f"{rec['time']:.0f} run={rec['run_id']} role={rec['role']} {rec['status']}")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    path = os.path.join(args.run_dir, "run.log")
+    if not os.path.exists(path):
+        print("no logs")
+        return 1
+    with open(path, errors="replace") as f:
+        lines = f.readlines()
+    for line in lines[-args.lines:]:
+        sys.stdout.write(line)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="fedml_tpu", description="fedml_tpu CLI")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    pe = sub.add_parser("env")
+    pe.add_argument("-v", "--verbose", action="store_true", help="probe accelerators")
+    pe.set_defaults(fn=cmd_env)
+
+    pl = sub.add_parser("login")
+    pl.add_argument("account_id")
+    pl.add_argument("--role", default="client", choices=["client", "server"])
+    pl.set_defaults(fn=cmd_login)
+
+    sub.add_parser("logout").set_defaults(fn=cmd_logout)
+
+    pb = sub.add_parser("build")
+    pb.add_argument("--type", "-t", default="client", choices=["client", "server"])
+    pb.add_argument("--source_folder", "-sf", required=True)
+    pb.add_argument("--entry_point", "-ep", required=True)
+    pb.add_argument("--config_file", "-cf", required=True)
+    pb.add_argument("--dest_folder", "-df", default=".")
+    pb.add_argument("--dest_package", default=None)
+    pb.set_defaults(fn=cmd_build)
+
+    pr = sub.add_parser("run")
+    pr.add_argument("--package", "-p", required=True)
+    pr.add_argument("--run_dir", "-d", required=True)
+    pr.add_argument("--run_id", default="0")
+    pr.add_argument("--role", default="client", choices=["client", "server"])
+    pr.add_argument("--max_restarts", type=int, default=2)
+    pr.add_argument("extra", nargs="*", help="extra args passed to the entry")
+    pr.set_defaults(fn=cmd_run)
+
+    ps = sub.add_parser("status")
+    ps.add_argument("--run_dir", "-d", required=True)
+    ps.set_defaults(fn=cmd_status)
+
+    pg = sub.add_parser("logs")
+    pg.add_argument("--run_dir", "-d", required=True)
+    pg.add_argument("--lines", "-n", type=int, default=100)
+    pg.set_defaults(fn=cmd_logs)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

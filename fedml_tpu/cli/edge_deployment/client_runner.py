@@ -1,0 +1,132 @@
+"""Edge run supervisor.
+
+Parity with reference ``cli/edge_deployment/client_runner.py`` (901 LoC) +
+``client_daemon.py``: unpack a built package into a run directory, spawn the
+training entry as a subprocess, supervise it (restart-on-crash up to a retry
+budget), and report the run-status FSM transitions — to a JSONL status file
+(and through ``core.mlops`` when a broker is configured).  The server-side
+runner (reference ``server_runner.py``) shares this implementation: only the
+status vocabulary differs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ...core.mlops.mlops_status import ClientStatus, ServerStatus
+from ..build import unpack_package
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLRunnerSupervisor:
+    """Spawn + supervise one run of a deployed package."""
+
+    def __init__(
+        self,
+        package_path: str,
+        run_dir: str,
+        run_id: str = "0",
+        role: str = "client",
+        max_restarts: int = 2,
+        extra_args: Optional[List[str]] = None,
+        python_exe: Optional[str] = None,
+    ):
+        self.package_path = package_path
+        self.run_dir = os.path.abspath(run_dir)
+        self.run_id = str(run_id)
+        self.role = role
+        self.max_restarts = int(max_restarts)
+        self.extra_args = list(extra_args or [])
+        self.python_exe = python_exe or sys.executable
+        # role -> status vocabulary, resolved once (client vs server FSM)
+        if role == "client":
+            self._init_status = ClientStatus.INITIALIZING
+            self._running_status = ClientStatus.TRAINING
+        else:
+            self._init_status = ServerStatus.STARTING
+            self._running_status = ServerStatus.RUNNING
+        self.status_path = os.path.join(self.run_dir, "status.jsonl")
+        self.status = "IDLE"
+        self.restarts = 0
+        self._proc: Optional[subprocess.Popen] = None
+        self._stop = threading.Event()
+
+    # -- status --------------------------------------------------------------
+    def _report(self, status: str) -> None:
+        self.status = status
+        rec = {"run_id": self.run_id, "role": self.role, "status": status, "time": time.time()}
+        os.makedirs(self.run_dir, exist_ok=True)
+        with open(self.status_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        logger.info("run %s: %s", self.run_id, status)
+
+    # -- lifecycle -----------------------------------------------------------
+    def prepare(self) -> Dict[str, Any]:
+        self._report(self._init_status)
+        meta = unpack_package(self.package_path, self.run_dir)
+        return meta
+
+    def _spawn(self, meta: Dict[str, Any]) -> subprocess.Popen:
+        entry = os.path.join(self.run_dir, "src", meta["entry"])
+        config = os.path.join(self.run_dir, meta["config"])
+        cmd = [self.python_exe, entry, "--cf", config, "--run_id", self.run_id,
+               "--role", self.role] + self.extra_args
+        log_path = os.path.join(self.run_dir, "run.log")
+        # close the parent's handle right after the child inherits its dup —
+        # a restart loop must not leak one fd per spawn
+        with open(log_path, "ab") as logf:
+            return subprocess.Popen(cmd, cwd=os.path.join(self.run_dir, "src"),
+                                    stdout=logf, stderr=subprocess.STDOUT)
+
+    def run(self) -> int:
+        """Blocking supervise loop; returns the final exit code."""
+        meta = self.prepare()
+        while not self._stop.is_set():
+            self._proc = self._spawn(meta)
+            self._report(self._running_status)
+            rc = self._proc.wait()
+            if self._stop.is_set():
+                self._report("KILLED")
+                return rc
+            if rc == 0:
+                self._report("FINISHED")
+                return 0
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                self._report("FAILED")
+                return rc
+            logger.warning("run %s crashed (rc=%s); restart %d/%d",
+                           self.run_id, rc, self.restarts, self.max_restarts)
+        self._report("KILLED")
+        return -1
+
+    def run_async(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, daemon=True, name=f"runner-{self.run_id}")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+
+    # -- introspection (``fedml_tpu status``) --------------------------------
+    @staticmethod
+    def read_status(run_dir: str) -> List[Dict[str, Any]]:
+        path = os.path.join(run_dir, "status.jsonl")
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]

@@ -1,0 +1,42 @@
+"""Environment collector (reference ``cli/env/collect_env.py``): print the
+versions + accelerator inventory a bug report needs."""
+
+from __future__ import annotations
+
+import platform
+import sys
+from typing import Any, Dict
+
+
+def collect_env(verbose: bool = False) -> Dict[str, Any]:
+    info: Dict[str, Any] = {
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "machine": platform.machine(),
+    }
+    try:
+        import fedml_tpu
+
+        info["fedml_tpu"] = fedml_tpu.__version__
+    except Exception:  # pragma: no cover
+        info["fedml_tpu"] = "unknown"
+    for mod in ("jax", "flax", "optax", "numpy"):
+        try:
+            info[mod] = __import__(mod).__version__
+        except Exception:
+            info[mod] = "not installed"
+    if verbose:
+        # device probing initializes the backend — only on request
+        try:
+            import jax
+
+            info["devices"] = [str(d) for d in jax.devices()]
+            info["default_backend"] = jax.default_backend()
+        except Exception as e:
+            info["devices"] = f"unavailable ({e})"
+    return info
+
+
+def print_env(verbose: bool = False) -> None:
+    for k, v in collect_env(verbose).items():
+        print(f"{k}: {v}")

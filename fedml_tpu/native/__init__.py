@@ -207,6 +207,8 @@ class EdgeClientManager:
 
     def encode_mask(self, n: int, t: int, u: int, mask_seed: int) -> np.ndarray:
         chunk = load().fedml_lsa_chunk(self.mask_dim, t, u)
+        if chunk <= 0:
+            raise ValueError(f"invalid LightSecAgg params: need t < u <= n (t={t}, u={u})")
         out = np.zeros((n, chunk), np.int64)
         _check(self._lib.fedml_client_encode_mask(self._h, n, t, u, mask_seed, out))
         return out
@@ -226,6 +228,8 @@ class EdgeClientManager:
 def lsa_mask_encoding(d: int, n: int, t: int, u: int, mask: np.ndarray, seed: int) -> np.ndarray:
     lib = load()
     chunk = lib.fedml_lsa_chunk(d, t, u)
+    if chunk <= 0:
+        raise ValueError(f"invalid LightSecAgg params: need d > 0 and t < u (t={t}, u={u})")
     out = np.zeros((n, chunk), np.int64)
     _check(lib.fedml_lsa_mask_encoding(d, n, t, u, np.ascontiguousarray(mask, np.int64), seed, out))
     return out

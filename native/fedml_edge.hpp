@@ -129,7 +129,13 @@ std::vector<int64_t> lcc_decode(const std::vector<int64_t>& F, int chunk,
                                 const std::vector<int64_t>& target_alphas,
                                 int64_t p = kPrime);
 
-inline int chunk_size(int d, int t, int u) { int k = u - t; return (d + k - 1) / k; }
+// Returns -1 when parameters are invalid (u <= t or d <= 0) — callers must
+// check; a bare division by (u - t) here would SIGFPE through the C ABI.
+inline int chunk_size(int d, int t, int u) {
+  int k = u - t;
+  if (k <= 0 || d <= 0) return -1;
+  return (d + k - 1) / k;
+}
 
 // Encode a length-d mask into n sub-masks [n, chunk]; matches
 // fedml_tpu/core/mpc/lightsecagg.py mask_encoding (alphas 1..u, betas u+1..u+n).

@@ -6,6 +6,7 @@
 
 #include <cmath>
 #include <random>
+#include <stdexcept>
 
 #include "fedml_edge.hpp"
 
@@ -86,6 +87,7 @@ std::vector<int64_t> mask_encoding(int d, int n, int t, int u,
                                    int64_t p) {
   int k = u - t;
   int chunk = chunk_size(d, t, u);
+  if (chunk < 0) throw std::invalid_argument("mask_encoding: need d > 0 and t < u");
   std::vector<int64_t> X((size_t)u * chunk, 0);
   for (int i = 0; i < d; ++i) {
     int64_t v = mask[i] % p;
@@ -107,6 +109,7 @@ std::vector<int64_t> aggregate_mask_reconstruction(
     int t, int u, int d, int64_t p) {
   int k = u - t;
   int chunk = chunk_size(d, t, u);
+  if (chunk < 0) throw std::invalid_argument("aggregate_mask_reconstruction: need d > 0 and t < u");
   // take the first u ids in sorted order (caller passes sorted), evaluate at
   // betas[id-1] = u + id
   std::vector<int64_t> eval_betas;

@@ -56,14 +56,23 @@ bool FedMLDenseTrainer::init(const std::string& model_path, const std::string& d
   if (!ftem_read(data_path, data, err)) return false;
   auto xi = data.find("x");
   auto yi = data.find("y");
-  if (xi == data.end() || yi == data.end() || xi->second.dims.size() != 2) {
+  if (xi == data.end() || yi == data.end() || xi->second.dims.size() != 2 ||
+      xi->second.dtype != 0 || yi->second.dtype != 1 || yi->second.dims.size() != 1) {
     err = "data file needs x [n, d] f32 and y [n] i32";
+    return false;
+  }
+  if (yi->second.dims[0] != xi->second.dims[0]) {
+    err = "x and y row counts differ";
     return false;
   }
   x_ = xi->second.f32;
   y_ = yi->second.i32;
   num_samples_ = yi->second.dims[0];
   dim_ = xi->second.dims[1];
+  if ((int64_t)x_.size() != num_samples_ * dim_ || (int64_t)y_.size() != num_samples_) {
+    err = "tensor payload size mismatch";
+    return false;
+  }
   classes_ = model_.at(layers_.back().first).dims[1];
   if (model_.at(layers_.front().first).dims[0] != (uint32_t)dim_) {
     err = "model input dim != data dim";

@@ -1,0 +1,126 @@
+"""CLI + edge deployment (SURVEY.md §2.9 cli/): build packaging, the run
+supervisor's spawn/restart/status lifecycle, and the command surface."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+from fedml_tpu.cli.build import build_package, read_package_meta, unpack_package
+from fedml_tpu.cli.cli import main
+from fedml_tpu.cli.edge_deployment.client_runner import FedMLRunnerSupervisor
+
+
+@pytest.fixture
+def user_project(tmp_path):
+    """A minimal user training project: entry + config."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "train.py").write_text(textwrap.dedent("""\
+        import argparse, sys
+        p = argparse.ArgumentParser()
+        p.add_argument("--cf"); p.add_argument("--run_id"); p.add_argument("--role")
+        p.add_argument("--fail", action="store_true")
+        a, _ = p.parse_known_args()
+        print("training with", a.cf, a.run_id, a.role)
+        sys.exit(1 if a.fail else 0)
+    """))
+    cfg = tmp_path / "fedml_config.yaml"
+    cfg.write_text("train_args:\n  epochs: 1\n")
+    return src, cfg
+
+
+class TestBuild:
+    def test_build_and_unpack(self, user_project, tmp_path):
+        src, cfg = user_project
+        pkg = build_package(str(src), "train.py", str(cfg), str(tmp_path / "pkg.zip"))
+        meta = read_package_meta(pkg)
+        assert meta["entry"] == "train.py" and meta["type"] == "client"
+
+        dest = tmp_path / "unpacked"
+        meta2 = unpack_package(pkg, str(dest))
+        assert (dest / "src" / "train.py").exists()
+        assert (dest / "config" / "fedml_config.yaml").exists()
+        assert meta2 == meta
+
+    def test_missing_entry_rejected(self, user_project, tmp_path):
+        src, cfg = user_project
+        with pytest.raises(FileNotFoundError):
+            build_package(str(src), "nope.py", str(cfg), str(tmp_path / "p.zip"))
+
+    def test_zip_slip_rejected(self, tmp_path):
+        import zipfile
+
+        evil = tmp_path / "evil.zip"
+        with zipfile.ZipFile(evil, "w") as z:
+            z.writestr("fedml_package.json", json.dumps({"entry": "x", "config": "c"}))
+            z.writestr("../escape.txt", "boom")
+        with pytest.raises(ValueError, match="unsafe"):
+            unpack_package(str(evil), str(tmp_path / "out"))
+
+
+class TestSupervisor:
+    def _pkg(self, user_project, tmp_path):
+        src, cfg = user_project
+        return build_package(str(src), "train.py", str(cfg), str(tmp_path / "pkg.zip"))
+
+    def test_successful_run_reports_finished(self, user_project, tmp_path):
+        pkg = self._pkg(user_project, tmp_path)
+        sup = FedMLRunnerSupervisor(pkg, str(tmp_path / "run"), run_id="7")
+        assert sup.run() == 0
+        statuses = [r["status"] for r in FedMLRunnerSupervisor.read_status(str(tmp_path / "run"))]
+        assert statuses == ["INITIALIZING", "TRAINING", "FINISHED"]
+        log = (tmp_path / "run" / "run.log").read_text()
+        assert "training with" in log
+
+    def test_crash_restarts_then_fails(self, user_project, tmp_path):
+        pkg = self._pkg(user_project, tmp_path)
+        sup = FedMLRunnerSupervisor(pkg, str(tmp_path / "run"), run_id="8",
+                                    max_restarts=1, extra_args=["--fail"])
+        assert sup.run() != 0
+        statuses = [r["status"] for r in FedMLRunnerSupervisor.read_status(str(tmp_path / "run"))]
+        assert statuses.count("TRAINING") == 2  # initial + 1 restart
+        assert statuses[-1] == "FAILED"
+
+    def test_server_role_vocab(self, user_project, tmp_path):
+        pkg = self._pkg(user_project, tmp_path)
+        sup = FedMLRunnerSupervisor(pkg, str(tmp_path / "run"), role="server")
+        assert sup.run() == 0
+        statuses = [r["status"] for r in FedMLRunnerSupervisor.read_status(str(tmp_path / "run"))]
+        assert statuses == ["STARTING", "RUNNING", "FINISHED"]
+
+
+class TestCLICommands:
+    def test_version(self, capsys):
+        assert main(["version"]) == 0
+        assert "fedml_tpu version" in capsys.readouterr().out
+
+    def test_env(self, capsys):
+        assert main(["env"]) == 0
+        out = capsys.readouterr().out
+        assert "python:" in out and "jax:" in out
+
+    def test_build_run_status_logs(self, user_project, tmp_path, capsys):
+        src, cfg = user_project
+        pkg = str(tmp_path / "p.zip")
+        assert main(["build", "-sf", str(src), "-ep", "train.py", "-cf", str(cfg),
+                     "--dest_package", pkg]) == 0
+        run_dir = str(tmp_path / "run")
+        assert main(["run", "-p", pkg, "-d", run_dir, "--run_id", "42"]) == 0
+        assert main(["status", "-d", run_dir]) == 0
+        out = capsys.readouterr().out
+        assert "FINISHED" in out
+        assert main(["logs", "-d", run_dir]) == 0
+        assert "training with" in capsys.readouterr().out
+
+    def test_login_logout(self, tmp_path, monkeypatch, capsys):
+        import fedml_tpu.cli.cli as cli_mod
+
+        monkeypatch.setattr(cli_mod, "ACCOUNT_DIR", str(tmp_path / "acct"))
+        monkeypatch.setattr(cli_mod, "ACCOUNT_FILE", str(tmp_path / "acct" / "account.json"))
+        assert main(["login", "acct-123"]) == 0
+        assert json.load(open(cli_mod.ACCOUNT_FILE))["account_id"] == "acct-123"
+        assert main(["logout"]) == 0
+        assert not os.path.exists(cli_mod.ACCOUNT_FILE)
