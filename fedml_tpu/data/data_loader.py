@@ -50,6 +50,10 @@ DATASET_SPECS: Dict[str, Dict[str, Any]] = {
     "stackoverflow_lr": dict(classes=500, shape=(10004,), train=50000, test=5000, kind="taglr"),
     "synthetic": dict(classes=10, shape=(60,), train=9600, test=2400, kind="feature"),
     "synthetic_1_1": dict(classes=10, shape=(60,), train=9600, test=2400, kind="feature"),
+    # segmentation (FedSeg; reference uses pascal_voc/coco — synthetic fallback
+    # keeps 3 shape classes at 32x32 for practical FL round sizes)
+    "synthetic_seg": dict(classes=3, shape=(32, 32, 3), train=2000, test=400, kind="segmentation"),
+    "pascal_voc": dict(classes=3, shape=(32, 32, 3), train=2000, test=400, kind="segmentation"),
 }
 
 
@@ -64,6 +68,10 @@ def _generate(spec: Dict[str, Any], n: int, seed: int, scale_override: int = 0,
     if kind == "nwp":
         return synthetic.make_next_token_corpus(
             n, int(spec["shape"][0]), spec["vocab"], seed=seed, proto_seed=proto_seed
+        )
+    if kind == "segmentation":
+        return synthetic.make_segmentation(
+            n, tuple(spec["shape"][:2]), seed=seed, proto_seed=proto_seed
         )
     if kind == "taglr":
         x, y = synthetic.make_classification(
@@ -118,8 +126,25 @@ def load(args) -> Tuple[list, int]:
     y_train, y_test = data["y_train"], data["y_test"]
 
     if method in ("hetero", "noniid", "dirichlet"):
-        # NWP labels are sequences; partition those by sequence-mean token bucket
-        part_labels = y_train if y_train.ndim == 1 else (y_train.mean(axis=1) % data["class_num"]).astype(int)
+        name = str(getattr(args, "dataset", "mnist")).lower()
+        kind = DATASET_SPECS.get(name, {}).get("kind")
+        if y_train.ndim == 1:
+            part_labels = y_train
+        elif kind == "segmentation":
+            # dominant FOREGROUND class per image: a mask-mean bucket would
+            # put ~every image in bucket 0 (background majority) and the
+            # Dirichlet split would degenerate to quantity-only
+            flat = y_train.reshape(len(y_train), -1)
+            counts = np.stack(
+                [(flat == c).sum(axis=1) for c in range(data["class_num"])], axis=1
+            )
+            fg = counts[:, 1:]
+            part_labels = np.where(fg.max(axis=1) > 0, fg.argmax(axis=1) + 1, 0)
+        else:
+            # NWP labels are sequences; bucket by sequence-mean token
+            part_labels = (
+                y_train.reshape(len(y_train), -1).mean(axis=1) % data["class_num"]
+            ).astype(int)
         train_map = non_iid_partition_with_dirichlet_distribution(
             part_labels, client_num, data["class_num"], alpha, seed=seed
         )

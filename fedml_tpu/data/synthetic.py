@@ -92,3 +92,38 @@ def make_next_token_corpus(
         cdf = np.cumsum(trans[seqs[:, t - 1]], axis=1)
         seqs[:, t] = (u[:, None] > cdf).sum(axis=1)
     return seqs[:, :-1], seqs[:, 1:]
+
+
+def make_segmentation(
+    n: int, image_hw: Tuple[int, int] = (32, 32), seed: int = 0, proto_seed: int = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Synthetic segmentation pairs: images [n, H, W, 3] with a random circle
+    (class 1) and/or rectangle (class 2) on textured background (class 0);
+    masks [n, H, W] int32.  Shape-faithful stand-in for VOC/COCO-style data
+    when no cache is mounted (FedSeg)."""
+    h, w = image_hw
+    rng = np.random.RandomState(seed)
+    # the class "appearance" (object colors) is the distribution — it derives
+    # from proto_seed so train and test share it (same contract as
+    # make_classification's prototypes)
+    proto_rng = np.random.RandomState(seed if proto_seed is None else proto_seed)
+    circle_color = np.array([0.9, 0.2, 0.2]) + 0.05 * proto_rng.randn(3)
+    rect_color = np.array([0.2, 0.2, 0.9]) + 0.05 * proto_rng.randn(3)
+    x = rng.rand(n, h, w, 3).astype(np.float32) * 0.2
+    masks = np.zeros((n, h, w), dtype=np.int32)
+    yy, xx = np.mgrid[0:h, 0:w]
+    for i in range(n):
+        if rng.rand() < 0.8:  # circle
+            cy, cx = rng.randint(h // 4, 3 * h // 4), rng.randint(w // 4, 3 * w // 4)
+            r = rng.randint(min(h, w) // 8, min(h, w) // 4)
+            circ = (yy - cy) ** 2 + (xx - cx) ** 2 <= r * r
+            masks[i][circ] = 1
+            x[i][circ] = circle_color + 0.1 * rng.randn(3)
+        if rng.rand() < 0.8:  # rectangle (drawn second: may occlude)
+            y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+            hh, ww = rng.randint(h // 6, h // 3), rng.randint(w // 6, w // 3)
+            rect = np.zeros((h, w), bool)
+            rect[y0 : y0 + hh, x0 : x0 + ww] = True
+            masks[i][rect] = 2
+            x[i][rect] = rect_color + 0.1 * rng.randn(3)
+    return x, masks

@@ -1,0 +1,93 @@
+"""DARTS search space for FedNAS (reference ``simulation/mpi/fednas`` +
+``model/cv/darts/``): a differentiable cell whose edges are softmax-weighted
+mixtures over a candidate op set; architecture parameters (alphas) are a
+separate pytree trained alongside the weights and FedAvg-aggregated by the
+FedNAS server, exactly like weights.
+
+Kept deliberately compact (one cell type, ``STEPS`` intermediate nodes, each
+connected to the 2 previous states) — the search mechanics, aggregation
+semantics, and discrete-architecture derivation match the reference; the op
+set is sized for TPU-friendly static shapes."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+OPS = ("skip", "conv3", "conv1", "avgpool", "zero")
+STEPS = 2  # intermediate nodes per cell
+PREV = 2  # each node sees the 2 previous states
+
+
+def num_edges() -> int:
+    return STEPS * PREV
+
+
+def _gn(c: int):
+    return nn.GroupNorm(num_groups=min(8, c))
+
+
+class MixedOp(nn.Module):
+    """Softmax(alpha)-weighted sum of the candidate ops on one edge."""
+
+    width: int
+
+    @nn.compact
+    def __call__(self, x, weights):
+        outs = [
+            x,  # skip
+            nn.relu(_gn(self.width)(nn.Conv(self.width, (3, 3), padding="SAME")(x))),
+            nn.relu(_gn(self.width)(nn.Conv(self.width, (1, 1))(x))),
+            nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME"),
+            jnp.zeros_like(x),  # zero
+        ]
+        return sum(w * o for w, o in zip(weights, outs))
+
+
+class DARTSNetwork(nn.Module):
+    """Stem -> one searched cell -> GAP -> classifier.  ``alphas``:
+    [num_edges, len(OPS)] logits passed at call time (a separate pytree)."""
+
+    num_classes: int = 10
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x, alphas, train: bool = False):
+        weights = jax.nn.softmax(alphas, axis=-1)
+        s0 = nn.relu(_gn(self.width)(nn.Conv(self.width, (3, 3), padding="SAME")(x)))
+        s1 = nn.relu(_gn(self.width)(nn.Conv(self.width, (3, 3), strides=(2, 2), padding="SAME")(s0)))
+        s0 = nn.avg_pool(s0, (2, 2), strides=(2, 2))  # align spatial dims
+        states = [s0, s1]
+        edge = 0
+        for _ in range(STEPS):
+            acc = 0.0
+            for j in range(PREV):
+                acc = acc + MixedOp(self.width)(states[-1 - j], weights[edge])
+                edge += 1
+            states.append(acc)
+        h = states[-1].mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(h)
+
+
+def init_alphas(seed: int = 0) -> jnp.ndarray:
+    """Near-uniform architecture logits (reference initializes 1e-3 randn)."""
+    return 1e-3 * jax.random.normal(jax.random.PRNGKey(seed), (num_edges(), len(OPS)))
+
+
+def derive_architecture(alphas) -> List[Dict[str, Any]]:
+    """Discrete genotype: argmax op per edge, 'zero' excluded (reference
+    genotype derivation)."""
+    a = jnp.asarray(alphas)
+    zero_idx = OPS.index("zero")
+    masked = a.at[:, zero_idx].set(-jnp.inf)
+    choices = jnp.argmax(masked, axis=-1)
+    genotype = []
+    edge = 0
+    for node in range(STEPS):
+        for j in range(PREV):
+            genotype.append({"node": node, "input": -1 - j, "op": OPS[int(choices[edge])]})
+            edge += 1
+    return genotype

@@ -1,0 +1,60 @@
+"""FedGKT model pair (reference ``simulation/mpi/fedgkt/model_hub.py:49-52``:
+ResNet-8 edge model + ResNet-55 server model).
+
+The client net is a small conv feature extractor + auxiliary classifier head
+that runs on the edge; the server net is the large residual tower that
+resumes from the client's feature maps.  Group norm throughout (no batch
+stats to aggregate — the FL-friendly choice)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _gn(c: int):
+    return nn.GroupNorm(num_groups=min(8, c))
+
+
+class GKTClientNet(nn.Module):
+    """Edge-side extractor: stem + one residual block; returns
+    (features [B, H/2, W/2, width], logits [B, classes])."""
+
+    num_classes: int = 10
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        h = nn.Conv(self.width, (3, 3), padding="SAME")(x)
+        h = nn.relu(_gn(self.width)(h))
+        h = nn.Conv(self.width, (3, 3), strides=(2, 2), padding="SAME")(h)
+        h = nn.relu(_gn(self.width)(h))
+        r = nn.Conv(self.width, (3, 3), padding="SAME")(h)
+        r = nn.relu(_gn(self.width)(r))
+        r = nn.Conv(self.width, (3, 3), padding="SAME")(r)
+        features = nn.relu(_gn(self.width)(r) + h)
+        pooled = features.mean(axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(pooled)
+        return features, logits
+
+
+class GKTServerNet(nn.Module):
+    """Server-side tower consuming client feature maps."""
+
+    num_classes: int = 10
+    width: int = 64
+    blocks: int = 3
+
+    @nn.compact
+    def __call__(self, features, train: bool = False) -> jnp.ndarray:
+        h = nn.Conv(self.width, (3, 3), padding="SAME")(features)
+        h = nn.relu(_gn(self.width)(h))
+        for _ in range(self.blocks):
+            r = nn.Conv(self.width, (3, 3), padding="SAME")(h)
+            r = nn.relu(_gn(self.width)(r))
+            r = nn.Conv(self.width, (3, 3), padding="SAME")(r)
+            h = nn.relu(_gn(self.width)(r) + h)
+        h = h.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(h)

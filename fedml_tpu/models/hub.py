@@ -80,6 +80,22 @@ def create(args: Any, output_dim: int) -> nn.Module:
         from .gan import MNISTGenerator
 
         return MNISTGenerator()
+    if name in ("unet", "deeplabv3", "deeplabv3_plus"):
+        from .unet import UNet
+
+        return UNet(num_classes=output_dim)
+    if name in ("gkt_client", "resnet8_gkt"):
+        from .gkt import GKTClientNet
+
+        return GKTClientNet(num_classes=output_dim)
+    if name in ("gkt_server", "resnet55_gkt"):
+        from .gkt import GKTServerNet
+
+        return GKTServerNet(num_classes=output_dim)
+    if name in ("darts", "darts_network"):
+        from .darts import DARTSNetwork
+
+        return DARTSNetwork(num_classes=output_dim)
     raise ValueError(f"unknown model {name!r} for dataset {dataset!r}")
 
 

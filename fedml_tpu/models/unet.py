@@ -1,0 +1,68 @@
+"""U-Net for federated semantic segmentation (FedSeg).
+
+Role of reference ``simulation/mpi/fedseg``'s DeepLab/backbone models
+(``model/cv/``): an encoder-decoder with skip connections producing per-pixel
+class logits.  Group norm, compact widths — sized so 100-client FL rounds fit
+comfortably in HBM next to the data."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _gn(c: int):
+    return nn.GroupNorm(num_groups=min(8, c))
+
+
+class _ConvBlock(nn.Module):
+    width: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(_gn(self.width)(nn.Conv(self.width, (3, 3), padding="SAME")(x)))
+        x = nn.relu(_gn(self.width)(nn.Conv(self.width, (3, 3), padding="SAME")(x)))
+        return x
+
+
+class UNet(nn.Module):
+    """Input [B, H, W, C] -> logits [B, H, W, num_classes] (H, W div by 4)."""
+
+    num_classes: int
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = self.width
+        e1 = _ConvBlock(w)(x)                                       # H
+        e2 = _ConvBlock(w * 2)(nn.max_pool(e1, (2, 2), strides=(2, 2)))  # H/2
+        b = _ConvBlock(w * 4)(nn.max_pool(e2, (2, 2), strides=(2, 2)))   # H/4
+        u2 = nn.ConvTranspose(w * 2, (2, 2), strides=(2, 2))(b)     # H/2
+        d2 = _ConvBlock(w * 2)(jnp.concatenate([u2, e2], axis=-1))
+        u1 = nn.ConvTranspose(w, (2, 2), strides=(2, 2))(d2)        # H
+        d1 = _ConvBlock(w)(jnp.concatenate([u1, e1], axis=-1))
+        return nn.Conv(self.num_classes, (1, 1))(d1)
+
+
+def iou_counts(logits: jnp.ndarray, masks: jnp.ndarray, num_classes: int):
+    """Per-class (intersection, union) pixel counts — accumulate these across
+    batches and divide once for dataset-level mIoU (batch-mean mIoU is biased
+    when classes are sparse)."""
+    pred = jnp.argmax(logits, axis=-1)
+    inter = jnp.stack([jnp.sum((pred == c) & (masks == c)) for c in range(num_classes)])
+    union = jnp.stack([jnp.sum((pred == c) | (masks == c)) for c in range(num_classes)])
+    return inter, union
+
+
+def mean_iou(logits: jnp.ndarray, masks: jnp.ndarray, num_classes: int) -> jnp.ndarray:
+    """Mean intersection-over-union over classes present in target or pred."""
+    pred = jnp.argmax(logits, axis=-1)
+    ious = []
+    for c in range(num_classes):
+        p = pred == c
+        t = masks == c
+        inter = jnp.sum(p & t)
+        union = jnp.sum(p | t)
+        ious.append(jnp.where(union > 0, inter / jnp.maximum(union, 1), jnp.nan))
+    ious = jnp.stack(ious)
+    return jnp.nanmean(ious)

@@ -71,4 +71,16 @@ def _dispatch(optimizer: str, args, device, dataset, model):
         from .fedgan.fedgan_api import FedGanAPI
 
         return FedGanAPI(args, device, dataset, model)
+    if opt == "fedgkt":
+        from .fedgkt.gkt_api import FedGKTAPI
+
+        return FedGKTAPI(args, device, dataset, model)
+    if opt == "fednas":
+        from .fednas.fednas_api import FedNASAPI
+
+        return FedNASAPI(args, device, dataset, model)
+    if opt == "fedseg":
+        from .fedseg.fedseg_api import FedSegAPI
+
+        return FedSegAPI(args, device, dataset, model)
     raise ValueError(f"unknown federated_optimizer {optimizer!r}")

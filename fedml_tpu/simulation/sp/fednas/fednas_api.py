@@ -1,0 +1,115 @@
+"""FedNAS: federated neural architecture search (reference
+``simulation/mpi/fednas``, 890 LoC).
+
+Each round, sampled clients run DARTS search steps on local data — updating
+both network weights w and architecture logits alpha (the reference's
+single-level MiLeNAS-style joint update) — and the server FedAvg-aggregates
+BOTH pytrees.  After the final round the discrete architecture is derived by
+per-edge argmax (models/darts.py ``derive_architecture``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ....core.aggregate import weighted_mean
+from ....models.darts import DARTSNetwork, derive_architecture, init_alphas
+from ....utils.metrics import MetricsLogger
+
+logger = logging.getLogger(__name__)
+
+
+class FedNASAPI:
+    def __init__(self, args, device, dataset, model=None):
+        self.args = args
+        (
+            _tn, _ten, _tg, self.test_global, self.local_num, self.local_train, _lt, self.class_num,
+        ) = dataset
+        self.bs = int(getattr(args, "batch_size", 32))
+        seed = int(getattr(args, "random_seed", 0))
+        w_lr = float(getattr(args, "learning_rate", 0.025))
+        a_lr = float(getattr(args, "arch_learning_rate", 3e-3))
+
+        self.net = model if isinstance(model, DARTSNetwork) else DARTSNetwork(
+            num_classes=self.class_num
+        )
+        self.alphas = init_alphas(seed)
+        sample = jnp.asarray(next(iter(self.local_train.values()))[0][: self.bs])
+        self.params = self.net.init(jax.random.PRNGKey(seed), sample, self.alphas)
+        self.w_tx = optax.sgd(w_lr, momentum=0.9)
+        self.a_tx = optax.adam(a_lr)
+        self.metrics = MetricsLogger(args)
+        self.eval_history: List[Dict[str, Any]] = []
+
+        net, w_tx, a_tx = self.net, self.w_tx, self.a_tx
+
+        @jax.jit
+        def search_step(params, alphas, w_opt, a_opt, x, y):
+            def loss_fn(p, a):
+                logits = net.apply(p, x, a)
+                return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, y))
+
+            loss, (gw, ga) = jax.value_and_grad(loss_fn, argnums=(0, 1))(params, alphas)
+            wu, w_opt = w_tx.update(gw, w_opt, params)
+            au, a_opt = a_tx.update(ga, a_opt, alphas)
+            return optax.apply_updates(params, wu), optax.apply_updates(alphas, au), w_opt, a_opt, loss
+
+        @jax.jit
+        def infer(params, alphas, x):
+            return net.apply(params, x, alphas)
+
+        self._search_step, self._infer = search_step, infer
+
+    def train(self) -> Dict[str, Any]:
+        comm_round = int(self.args.comm_round)
+        epochs = int(getattr(self.args, "epochs", 1))
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        last: Dict[str, Any] = {}
+        for round_idx in range(comm_round):
+            from ....core.sampling import client_sampling
+
+            sampled = client_sampling(
+                round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
+            )
+            locals_: List[Tuple[float, Any]] = []
+            alpha_locals: List[Tuple[float, Any]] = []
+            for cid in sampled:
+                x, y = self.local_train[int(cid)]
+                params, alphas = self.params, self.alphas
+                w_opt, a_opt = self.w_tx.init(params), self.a_tx.init(alphas)
+                for _ in range(epochs):
+                    for s in range(0, len(y) - self.bs + 1, self.bs):
+                        params, alphas, w_opt, a_opt, loss = self._search_step(
+                            params, alphas, w_opt, a_opt,
+                            jnp.asarray(x[s : s + self.bs]), jnp.asarray(y[s : s + self.bs]),
+                        )
+                n = float(self.local_num[int(cid)])
+                locals_.append((n, params))
+                alpha_locals.append((n, alphas))
+            self.params = weighted_mean(locals_)
+            self.alphas = weighted_mean(alpha_locals)
+            self.metrics.log({"round": round_idx})
+            if round_idx % freq == 0 or round_idx == comm_round - 1:
+                last = self._test_global(round_idx)
+        last["genotype"] = derive_architecture(self.alphas)
+        logger.info("derived architecture: %s", last["genotype"])
+        return last
+
+    def _test_global(self, round_idx: int) -> Dict[str, Any]:
+        x, y = self.test_global
+        correct = total = 0
+        for s in range(0, len(y), 256):
+            logits = self._infer(self.params, self.alphas, jnp.asarray(x[s : s + 256]))
+            correct += int(jnp.sum(jnp.argmax(logits, -1) == jnp.asarray(y[s : s + 256])))
+            total += len(y[s : s + 256])
+        out = {"round": round_idx, "test_acc": round(correct / max(total, 1), 4)}
+        self.eval_history.append(out)
+        self.metrics.log(out)
+        logger.info("fednas eval: %s", out)
+        return out
