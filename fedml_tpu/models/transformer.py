@@ -50,14 +50,11 @@ def rope(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0) -> jnp.n
 
 
 def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
-    """Default fused attention: [B, L, H, D] -> [B, L, H, D], causal."""
-    d = q.shape[-1]
-    scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(d).astype(q.dtype)
-    L, M = q.shape[1], k.shape[1]
-    mask = jnp.tril(jnp.ones((L, M), dtype=bool))
-    scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+    """Default fused attention: [B, L, H, D] -> [B, L, H, D], causal.
+    Single definition lives in ops (also the pallas kernel's oracle)."""
+    from ..ops.flash_attention import reference_attention
+
+    return reference_attention(q, k, v, causal=True)
 
 
 AttentionFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]

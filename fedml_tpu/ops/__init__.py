@@ -1,0 +1,5 @@
+"""TPU kernels (pallas) + fused-XLA fallbacks for the hot ops."""
+
+from .flash_attention import attention, flash_attention, reference_attention
+
+__all__ = ["attention", "flash_attention", "reference_attention"]

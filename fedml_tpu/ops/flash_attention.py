@@ -1,0 +1,191 @@
+"""Pallas flash attention (TPU kernel) with an XLA fallback.
+
+The single-chip hot path of the transformer stack: blockwise attention with
+online softmax.  Grid is (batch·heads, L/block_q, L/block_k) — TPU executes
+the innermost grid dimension sequentially per core, so the running
+(max, denom, out) accumulators live in VMEM scratch across k-steps and only
+[block_q, D] / [block_k, D] tiles are VMEM-resident (never the full K/V, so
+long contexts aren't VMEM-capped).  Composes with ring attention
+(parallel/ring_attention.py): ring moves K/V shards across chips, this
+kernel does the per-chip block math.
+
+Differentiation: a ``jax.custom_vjp`` whose backward recomputes through the
+fused-XLA reference — exact gradients, O(L²) memory on the backward only (a
+dedicated pallas backward kernel is the planned upgrade).
+
+``interpret=True`` runs the same kernel on CPU (how tests exercise it);
+:func:`attention` picks the kernel on TPU and the fused-XLA reference
+elsewhere, padding ragged sequence lengths to block multiples.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas imports fine everywhere; Mosaic lowering needs TPU
+    from jax.experimental import pallas as pl
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Fused-XLA attention, [B, L, H, D] layout (fallback, test oracle, and
+    the single fused-attention definition — models/transformer.py delegates
+    here)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) / jnp.sqrt(
+        jnp.float32(d)
+    )
+    if causal:
+        L, M = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((L, M), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_q, block_k, n_kb, causal, scale, valid_len):
+    """Grid cell (bh, qi, kj): fold K/V block kj into q block qi's online
+    softmax state (scratch persists across the sequential kj dimension)."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # a causal block whose keys all lie after this q block's last row (or an
+    # entirely-padded key block) contributes nothing — skip its FLOPs
+    block_live = kj * block_k < valid_len
+    if causal:
+        block_live = jnp.logical_and(block_live, kj * block_k <= (qi + 1) * block_q - 1)
+
+    @pl.when(block_live)
+    def _attend():
+        # matmuls stay in the input dtype (bf16 rides the MXU at full rate)
+        # with f32 accumulation; softmax state is f32 throughout
+        q = q_ref[0]  # [bq, D]
+        k = k_ref[0]  # [bk, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        live = k_pos < valid_len  # padded tail keys never contribute
+        if causal:
+            live = live & (q_pos >= k_pos)
+        s = jnp.where(live, s, -jnp.inf)
+
+        m = m_ref[:]
+        l = l_ref[:]
+        block_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, block_max)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(s - safe_m[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        m_ref[:] = new_m
+        l_ref[:] = l * corr + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr[:, None] + pv
+
+    @pl.when(kj == n_kb - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    if not _HAS_PALLAS:
+        raise RuntimeError("pallas is unavailable in this jax build; use reference_attention")
+    B, L, H, D = q.shape
+    block_q = min(block_q, L)
+    block_k = min(block_k, L)
+    Lp = -(-L // max(block_q, block_k)) * max(block_q, block_k)
+
+    def to_bh(x):  # [B, L, H, D] -> [B*H, Lp, D]
+        x = x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+        if Lp != L:
+            x = jnp.pad(x, ((0, 0), (0, Lp - L), (0, 0)))
+        return x
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    scale = float(1.0 / (D**0.5))  # python float: traced scalars can't be closed over
+    n_kb = Lp // block_k
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, n_kb=n_kb,
+        causal=causal, scale=scale, valid_len=L,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Lp // block_q, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
+        scratch_shapes=_scratch(block_q, D),
+        interpret=interpret,
+    )(qb, kb, vb)
+    out = out[:, :L]
+    return out.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+
+def _scratch(block_q, D):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return [
+        pltpu.VMEM((block_q,), jnp.float32),
+        pltpu.VMEM((block_q,), jnp.float32),
+        pltpu.VMEM((block_q, D), jnp.float32),
+    ]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas blockwise attention. q/k/v: [B, L, H, D] -> [B, L, H, D].
+    Ragged L is padded to a block multiple internally."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # exact gradients via the fused-XLA reference (recompute; O(L^2) memory
+    # on the backward pass only — pallas backward kernel is the upgrade path)
+    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention(q, k, v, causal: bool = True):
+    """Dispatch: pallas kernel on TPU, XLA reference elsewhere."""
+    if _HAS_PALLAS and jax.default_backend() == "tpu":
+        return flash_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal)

@@ -1,0 +1,19 @@
+"""Parallelism layer: device meshes, ring attention, sequence parallelism.
+
+The TPU-native successor of the reference's process-group/NCCL plumbing
+(SURVEY.md §2.10): scale axes are mesh axes, communication is XLA
+collectives over ICI.
+"""
+
+from .mesh import create_fl_mesh, create_mesh, create_train_mesh, replicated, sharded
+from .ring_attention import ring_attention, ring_attention_inner
+
+__all__ = [
+    "create_mesh",
+    "create_fl_mesh",
+    "create_train_mesh",
+    "replicated",
+    "sharded",
+    "ring_attention",
+    "ring_attention_inner",
+]

@@ -1,0 +1,113 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context capability the reference lacks entirely (SURVEY.md §5
+"long-context/sequence parallelism: absent") but this framework treats as
+first-class: each device in the ``sp`` ring holds one sequence shard of
+Q/K/V; K/V blocks rotate around the ring via ``jax.lax.ppermute`` (ICI
+neighbor traffic, no all-gather), and softmax is accumulated online
+(flash-attention style running max / denominator), so the full [L, L] score
+matrix never materializes and memory per chip stays O(L/sp · L/sp).
+
+Two entry points:
+
+* :func:`ring_attention_inner` — use inside an existing ``shard_map`` (this
+  is what the sequence-parallel transformer binds as its ``attention_fn``);
+* :func:`ring_attention` — standalone: shard_maps itself over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.7
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+        )
+
+
+def _block_attend(q, k, v, q_pos, k_pos, causal, m, l, o):
+    """One K/V block's contribution under online softmax.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; q_pos/k_pos: [Lq]/[Lk] global
+    positions; (m, l, o): running (max [B,H,Lq], denom [B,H,Lq],
+    out [B,Lq,H,D]) accumulators, all float32.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Lq, Lk]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    block_max = jnp.max(scores, axis=-1)  # [B, H, Lq]
+    new_m = jnp.maximum(m, block_max)
+    # guard: rows with every position masked keep -inf max; exp(-inf - -inf)
+    # would be nan, so shift by a finite max in that case
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    p = jnp.exp(scores - safe_m[..., None])  # [B, H, Lq, Lk]
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    correction = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+    correction = jnp.where(jnp.isfinite(m), correction, 0.0)  # first block: no history
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhlm,bmhd->blhd", p, v.astype(jnp.float32))
+    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return new_m, new_l, new_o
+
+
+def ring_attention_inner(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact attention where q/k/v are the LOCAL sequence shards [B, Ls, H, D]
+    of a ring over ``axis_name``.  Must run inside shard_map."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Ls, H, D = q.shape
+    m = jnp.full((B, H, Ls), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Ls), jnp.float32)
+    o = jnp.zeros((B, Ls, H, D), jnp.float32)
+    q_pos = my * Ls + jnp.arange(Ls)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    cur_k, cur_v = k, v
+    for r in range(n):
+        src = (my - r) % n  # ring shift r: the block originated on device my-r
+        k_pos = src * Ls + jnp.arange(cur_k.shape[1])
+        m, l, o = _block_attend(q, cur_k, cur_v, q_pos, k_pos, causal, m, l, o)
+        if r < n - 1:
+            # one collective for both operands (pytree ppermute)
+            cur_k, cur_v = jax.lax.ppermute((cur_k, cur_v), axis_name, perm)
+    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]  # [B, Lq, H, 1]
+    return (o / denom).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Standalone ring attention: q/k/v are FULL [B, L, H, D] arrays; the
+    sequence axis is sharded over ``axis_name`` and the result gathered."""
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        partial(ring_attention_inner, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,106 @@
+"""Sequence-parallel transformer execution.
+
+Runs :class:`fedml_tpu.models.transformer.TransformerLM` with tokens sharded
+over the mesh's ``sp`` axis: activations stay sequence-sharded through every
+layer, attention is exact ring attention over ICI
+(:mod:`.ring_attention`), and parameters are replicated (compose with a
+``dp``/``tp`` axis for weight sharding).  RoPE uses absolute positions, so
+each shard computes its rotary phases from its global offsets and no
+cross-shard position fixup is needed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.transformer import TransformerConfig, TransformerLM
+from .ring_attention import ring_attention_inner, shard_map
+
+Pytree = Any
+
+
+def make_sp_model(cfg: TransformerConfig, axis_name: str = "sp") -> TransformerLM:
+    """A TransformerLM whose attention is ring attention over ``axis_name``
+    (only valid inside shard_map — use :func:`sp_apply` / :func:`sp_loss_fn`)."""
+    return TransformerLM(
+        cfg, attention_fn=partial(ring_attention_inner, axis_name=axis_name, causal=True)
+    )
+
+
+def sp_apply(
+    cfg: TransformerConfig,
+    params: Pytree,
+    tokens: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Sequence-parallel forward: tokens [B, L] (L divisible by the axis
+    size) -> logits [B, L, vocab], numerically equal to the single-device
+    forward."""
+    model = make_sp_model(cfg, axis_name)
+    n = mesh.shape[axis_name]
+    L = tokens.shape[1]
+    assert L % n == 0, f"seq len {L} not divisible by sp={n}"
+
+    def fwd(params, tok_shard):
+        # global positions for this shard (RoPE needs absolute indices)
+        idx = jax.lax.axis_index(axis_name)
+        Ls = tok_shard.shape[1]
+        positions = jnp.broadcast_to(idx * Ls + jnp.arange(Ls), tok_shard.shape)
+        return model.apply(params, tok_shard, positions=positions)
+
+    return shard_map(
+        fwd,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name)),
+        out_specs=P(None, axis_name, None),
+    )(params, tokens)
+
+
+def sp_init(cfg: TransformerConfig, seed: int = 0, batch: int = 1) -> Pytree:
+    """Initialize params for the sp model (init runs unsharded — shapes are
+    identical; only the forward is sequence-parallel)."""
+    model = TransformerLM(cfg)
+    # no parameter shape depends on L (RoPE is stateless) — any short dummy
+    # length initializes identical shapes
+    tokens = jnp.zeros((batch, 8), jnp.int32)
+    return model.init(jax.random.PRNGKey(seed), tokens)
+
+
+def sp_loss_fn(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    axis_name: str = "sp",
+):
+    """Next-token CE loss over the sequence-sharded forward; mean over all
+    tokens (psum across shards).  Returns ``loss(params, tokens) -> scalar``
+    — differentiable, so ``jax.grad`` gives sequence-parallel training."""
+    model = make_sp_model(cfg, axis_name)
+
+    def local_loss(params, tok_shard, tgt_shard):
+        idx = jax.lax.axis_index(axis_name)
+        Ls = tok_shard.shape[1]
+        positions = jnp.broadcast_to(idx * Ls + jnp.arange(Ls), tok_shard.shape)
+        logits = model.apply(params, tok_shard, positions=positions)
+        import optax
+
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, tgt_shard)
+        total = jax.lax.psum(jnp.sum(per), axis_name)
+        count = jax.lax.psum(jnp.float32(per.size), axis_name)
+        return total / count
+
+    def loss(params, tokens, targets):
+        fn = shard_map(
+            local_loss,
+            mesh=mesh,
+            in_specs=(P(), P(None, axis_name), P(None, axis_name)),
+            out_specs=P(),
+        )
+        return fn(params, tokens, targets)
+
+    return loss
