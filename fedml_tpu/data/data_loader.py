@@ -54,6 +54,21 @@ DATASET_SPECS: Dict[str, Dict[str, Any]] = {
     # keeps 3 shape classes at 32x32 for practical FL round sizes)
     "synthetic_seg": dict(classes=3, shape=(32, 32, 3), train=2000, test=400, kind="segmentation"),
     "pascal_voc": dict(classes=3, shape=(32, 32, 3), train=2000, test=400, kind="segmentation"),
+    # fednlp text classification (reference app/fednlp: 20news/agnews/sst_2)
+    "agnews": dict(classes=4, shape=(64,), train=12000, test=2000, kind="seqcls", vocab=2000),
+    "sst_2": dict(classes=2, shape=(32,), train=8000, test=1000, kind="seqcls", vocab=2000),
+    "20news": dict(classes=20, shape=(128,), train=11000, test=2000, kind="seqcls", vocab=4000),
+    # fedgraphnn (reference app/fedgraphnn: moleculenet graph classification)
+    "synthetic_graph": dict(classes=4, shape=(16, 24), train=2000, test=400, kind="graph",
+                            num_nodes=16, feat_dim=8),
+    "sider": dict(classes=4, shape=(16, 24), train=1400, test=300, kind="graph",
+                  num_nodes=16, feat_dim=8),
+    "clintox": dict(classes=2, shape=(16, 24), train=1400, test=300, kind="graph",
+                    num_nodes=16, feat_dim=8),
+    # healthcare / tabular (reference data: UCI, lending_club, FeTS)
+    "uci": dict(classes=2, shape=(32,), train=8000, test=1600, kind="feature"),
+    "lending_club": dict(classes=2, shape=(90,), train=10000, test=2000, kind="feature"),
+    "fets2021": dict(classes=3, shape=(32, 32, 3), train=1000, test=200, kind="segmentation"),
 }
 
 
@@ -72,6 +87,17 @@ def _generate(spec: Dict[str, Any], n: int, seed: int, scale_override: int = 0,
     if kind == "segmentation":
         return synthetic.make_segmentation(
             n, tuple(spec["shape"][:2]), seed=seed, proto_seed=proto_seed
+        )
+    if kind == "seqcls":
+        # class->vocab-band mapping is deterministic, so train/test share the
+        # distribution without a proto_seed
+        return synthetic.make_sequence_classification(
+            n, spec["classes"], int(spec["shape"][0]), spec["vocab"], seed=seed
+        )
+    if kind == "graph":
+        return synthetic.make_graph_classification(
+            n, spec["num_nodes"], spec["feat_dim"], spec["classes"],
+            seed=seed, proto_seed=proto_seed,
         )
     if kind == "taglr":
         x, y = synthetic.make_classification(

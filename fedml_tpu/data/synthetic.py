@@ -127,3 +127,30 @@ def make_segmentation(
             masks[i][rect] = 2
             x[i][rect] = rect_color + 0.1 * rng.randn(3)
     return x, masks
+
+
+def make_graph_classification(
+    n: int, num_nodes: int = 16, feat_dim: int = 8, num_classes: int = 4,
+    seed: int = 0, proto_seed: int = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Synthetic graph-classification set packed as [n, N, F+N] (node
+    features ‖ dense adjacency — the layout models/gcn.py consumes).  Class
+    signal: per-class node-feature prototypes AND class-dependent edge
+    density, so both the feature and the structure path of a GNN carry
+    information."""
+    rng = np.random.RandomState(seed)
+    proto_rng = np.random.RandomState(seed if proto_seed is None else proto_seed)
+    protos = proto_rng.randn(num_classes, feat_dim).astype(np.float32)
+    densities = np.linspace(0.15, 0.6, num_classes)
+    y = rng.randint(0, num_classes, size=n).astype(np.int32)
+    x = np.zeros((n, num_nodes, feat_dim + num_nodes), np.float32)
+    for i in range(n):
+        c = y[i]
+        n_real = rng.randint(max(num_nodes // 2, 2), num_nodes + 1)
+        feats = protos[c] + 0.5 * rng.randn(n_real, feat_dim)
+        upper = rng.rand(n_real, n_real) < densities[c]
+        adj = np.triu(upper, 1)
+        adj = (adj | adj.T).astype(np.float32)
+        x[i, :n_real, :feat_dim] = feats
+        x[i, :n_real, feat_dim : feat_dim + n_real] = adj
+    return x, y

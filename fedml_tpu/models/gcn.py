@@ -1,0 +1,47 @@
+"""Graph neural networks (the fedgraphnn app's model family).
+
+Role of reference ``python/app/fedgraphnn`` models (GCN/GAT/GraphSAGE over
+moleculenet): a dense-adjacency GCN — TPU-first means fixed-size padded
+graphs and adjacency matmuls on the MXU instead of sparse gather/scatter.
+
+Graph batch packing (matches data kind "graph"): each sample is
+``[N, F + N]`` — node features [N, F] concatenated with the dense adjacency
+[N, N] (self-loops added by the model).  Padding nodes have all-zero rows.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def unpack_graph(x, feat_dim: int):
+    """[B, N, F+N] -> (features [B, N, F], adjacency [B, N, N])."""
+    return x[..., :feat_dim], x[..., feat_dim:]
+
+
+class GCN(nn.Module):
+    """Graph-level classifier: GCN layers + masked mean pooling."""
+
+    num_classes: int
+    feat_dim: int
+    hidden: int = 64
+    n_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        feats, adj = unpack_graph(x, self.feat_dim)
+        n = adj.shape[-1]
+        # normalized adjacency with self loops: D^-1/2 (A + I) D^-1/2
+        a = adj + jnp.eye(n)
+        deg = jnp.clip(a.sum(-1), 1e-6, None)
+        dinv = 1.0 / jnp.sqrt(deg)
+        a_norm = a * dinv[..., :, None] * dinv[..., None, :]
+        node_mask = (jnp.abs(feats).sum(-1) > 0).astype(feats.dtype)  # [B, N]
+
+        h = feats
+        for i in range(self.n_layers):
+            h = a_norm @ nn.Dense(self.hidden, name=f"gc{i}")(h)
+            h = nn.relu(h) * node_mask[..., None]  # keep padding nodes silent
+        pooled = h.sum(axis=-2) / jnp.clip(node_mask.sum(-1, keepdims=True), 1.0, None)
+        return nn.Dense(self.num_classes, name="readout")(pooled)

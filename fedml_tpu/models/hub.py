@@ -96,6 +96,23 @@ def create(args: Any, output_dim: int) -> nn.Module:
         from .darts import DARTSNetwork
 
         return DARTSNetwork(num_classes=output_dim)
+    if name in ("transformer_cls", "bert_cls", "distilbert"):
+        from ..data.data_loader import DATASET_SPECS
+        from .nlp import TransformerClassifier
+
+        vocab = int(DATASET_SPECS.get(dataset, {}).get("vocab", 2000))
+        return TransformerClassifier(num_classes=output_dim, vocab_size=vocab)
+    if name in ("gcn", "graphsage", "gat"):
+        from ..data.data_loader import DATASET_SPECS
+
+        from .gcn import GCN
+
+        feat_dim = int(DATASET_SPECS.get(dataset, {}).get("feat_dim", 8))
+        return GCN(num_classes=output_dim, feat_dim=feat_dim)
+    if name in ("mlp",):
+        from .linear import MLP
+
+        return MLP(output_dim=output_dim)
     raise ValueError(f"unknown model {name!r} for dataset {dataset!r}")
 
 

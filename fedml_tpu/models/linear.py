@@ -19,3 +19,18 @@ class LogisticRegression(nn.Module):
     def __call__(self, x, train: bool = False):
         x = x.reshape((x.shape[0], -1))
         return nn.Dense(self.output_dim, name="linear")(x)
+
+
+class MLP(nn.Module):
+    """Two-hidden-layer perceptron for tabular tasks (healthcare/UCI rows of
+    the reference data layer)."""
+
+    output_dim: int
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.output_dim)(x)

@@ -1,0 +1,40 @@
+"""NLP task heads (the fednlp app's model family).
+
+Role of reference ``python/app/fednlp`` models (stock HuggingFace encoders +
+task heads): a compact transformer encoder classifier, TPU-first (static
+shapes, bf16-ready, GAP pooling)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import Block, TransformerConfig
+
+
+class TransformerClassifier(nn.Module):
+    """Token ids [B, L] -> class logits [B, num_classes] (mean-pooled
+    bidirectional encoder: attention is non-causal for classification)."""
+
+    num_classes: int
+    vocab_size: int = 32000
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        from ..ops.flash_attention import reference_attention
+
+        cfg = TransformerConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, d_ff=self.d_ff,
+        )
+        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed")(tokens)
+        attn = lambda q, k, v: reference_attention(q, k, v, causal=False)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, attention_fn=attn, name=f"layer{i}")(x, positions, train)
+        x = nn.RMSNorm(name="final_norm")(x)
+        return nn.Dense(self.num_classes, name="cls_head")(x.mean(axis=1))
