@@ -1,0 +1,5 @@
+"""Centralized (non-federated) baselines — reference ``fedml/centralized``."""
+
+from .centralized_trainer import CentralizedTrainer
+
+__all__ = ["CentralizedTrainer"]

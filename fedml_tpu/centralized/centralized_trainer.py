@@ -1,0 +1,59 @@
+"""Centralized baseline trainer (reference ``python/fedml/centralized/``,
+164 LoC): train the same model on the POOLED data with the same engine, so
+federated results have an upper-bound comparison inside one framework."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from ..data.data_loader import load_centralized
+from ..ml.aggregator.aggregator_creator import create_server_aggregator
+from ..ml.engine.train import init_variables
+from ..ml.trainer.trainer_creator import create_model_trainer
+
+logger = logging.getLogger(__name__)
+
+
+class CentralizedTrainer:
+    def __init__(self, args, model=None):
+        self.args = args
+        self.data = load_centralized(args)
+        if model is None:
+            from ..models import hub
+
+            model = hub.create(args, self.data["class_num"])
+        self.module = model
+        sample = jnp.asarray(self.data["x_train"][:1])
+        self.variables = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
+        self.trainer = create_model_trainer(model, args)
+        self.aggregator = create_server_aggregator(model, args)
+
+    def train(self) -> Dict[str, Any]:
+        epochs_total = int(getattr(self.args, "comm_round", 1)) * int(
+            getattr(self.args, "epochs", 1)
+        )
+        x, y = self.data["x_train"], self.data["y_train"]
+        self.trainer.set_model_params(self.variables)
+        last: Dict[str, Any] = {}
+        for epoch in range(epochs_total):
+            self.trainer.round_idx = epoch  # distinct shuffling per epoch
+            self.trainer.train((x, y), None, self.args)
+            last = self.test(epoch)
+        self.variables = self.trainer.get_model_params()
+        return last
+
+    def test(self, epoch: int) -> Dict[str, Any]:
+        self.aggregator.set_model_params(self.trainer.get_model_params())
+        stats = self.aggregator.test(
+            (self.data["x_test"], self.data["y_test"]), None, self.args
+        )
+        out = {
+            "epoch": epoch,
+            "test_acc": round(stats["test_correct"] / max(stats["test_total"], 1.0), 4),
+            "test_loss": round(stats["test_loss"] / max(stats["test_total"], 1.0), 4),
+        }
+        logger.info("centralized eval: %s", out)
+        return out

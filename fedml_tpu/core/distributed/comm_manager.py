@@ -21,6 +21,7 @@ from ...constants import (
     FEDML_BACKEND_LOOPBACK,
     FEDML_BACKEND_MQTT_S3,
     FEDML_BACKEND_MQTT_S3_MNN,
+    FEDML_BACKEND_TRPC,
 )
 from .communication.base_com_manager import BaseCommunicationManager, Observer
 from .communication.message import Message
@@ -123,6 +124,17 @@ class FedMLCommManager(Observer):
                 client_rank=self.rank,
                 client_num=self.size,
                 mnn_mode=(backend == FEDML_BACKEND_MQTT_S3_MNN),
+            )
+        elif backend == FEDML_BACKEND_TRPC:
+            from .communication.tcp.tcp_comm_manager import TCPCommManager
+
+            self.com_manager = TCPCommManager(
+                host=getattr(self.args, "trpc_host", "127.0.0.1"),
+                base_port=int(getattr(self.args, "trpc_base_port", 9690)),
+                rank=self.rank,
+                size=self.size,
+                ip_table=getattr(self.args, "trpc_ip_table", None),
+                bind_host=getattr(self.args, "trpc_bind_host", "0.0.0.0"),
             )
         else:
             raise ValueError(f"unsupported comm backend: {self.backend!r}")

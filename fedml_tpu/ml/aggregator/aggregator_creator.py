@@ -1,0 +1,28 @@
+"""ServerAggregator factory (reference ``ml/aggregator/aggregator_creator.py``
+``create_server_aggregator``): dataset-family dispatch mirroring the trainer
+factory.  The default aggregator's masked eval already computes token-level
+metrics for NWP label tensors; tag prediction gets the BCE aggregator."""
+
+from __future__ import annotations
+
+from ...core.alg_frame.server_aggregator import ServerAggregator
+from ..trainer.trainer_creator import _TAG_DATASETS
+from .default_aggregator import DefaultServerAggregator
+
+
+class TAGPredServerAggregator(DefaultServerAggregator):
+    """Evaluates with the multi-label BCE metrics of the tag trainer."""
+
+    def test(self, test_data, device, args):
+        from ..trainer.tag_trainer import ModelTrainerTAGPred
+
+        probe = ModelTrainerTAGPred(self.module, args)
+        probe.set_model_params(self.variables)
+        return probe.test(test_data, device, args)
+
+
+def create_server_aggregator(model, args) -> ServerAggregator:
+    dataset = str(getattr(args, "dataset", "")).lower()
+    if dataset in _TAG_DATASETS:
+        return TAGPredServerAggregator(model, args)
+    return DefaultServerAggregator(model, args)

@@ -61,6 +61,19 @@ def softmax_ce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray)
     return total / count, (total, count)
 
 
+def sigmoid_bce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray):
+    """Masked multi-label BCE: labels are multi-hot [B, C] floats (tag
+    prediction); per-example mask [B] broadcasts over label positions."""
+    per = optax.sigmoid_binary_cross_entropy(logits, labels)
+    mask = mask.reshape(mask.shape + (1,) * (per.ndim - mask.ndim))
+    total = jnp.sum(per * mask)
+    count = jnp.maximum(jnp.sum(jnp.broadcast_to(mask, per.shape)), 1.0)
+    return total / count, (total, count)
+
+
+LOSS_FNS = {"ce": softmax_ce_loss, "bce": sigmoid_bce_loss}
+
+
 def make_local_train_fn(
     module,
     args,
@@ -81,6 +94,7 @@ def build_local_train(
     epochs: Optional[int] = None,
     has_dropout: bool = True,
     grad_hook: Optional[Callable] = None,
+    loss: str = "ce",
 ) -> Callable[..., LocalTrainResult]:
     """Build the PURE local-training function (not jitted — composable inside
     shard_map/scan in the XLA simulator).
@@ -118,8 +132,8 @@ def build_local_train(
         else:
             logits = module.apply(variables, bx, train=True, rngs=rngs)
             updated = {}
-        loss, _ = softmax_ce_loss(logits, by, bmask)
-        return loss, updated
+        loss_val, _ = LOSS_FNS[loss](logits, by, bmask)
+        return loss_val, updated
 
     def train(variables, x, y, n_valid, rng, extra=None) -> LocalTrainResult:
         params = variables["params"]

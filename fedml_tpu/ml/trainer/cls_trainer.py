@@ -17,6 +17,8 @@ from ..engine.train import make_eval_fn, make_local_train_fn, pad_to
 
 
 class ModelTrainerCLS(ClientTrainer):
+    loss_kind = "ce"  # subclasses override (tag prediction uses "bce")
+
     def __init__(self, model, args, grad_hook=None):
         super().__init__(model, args)
         self.module = model
@@ -43,7 +45,8 @@ class ModelTrainerCLS(ClientTrainer):
 
             self._train_fns[key] = jax.jit(
                 build_local_train(
-                    self.module, self.args, batch_size, padded_n, grad_hook=self.grad_hook
+                    self.module, self.args, batch_size, padded_n,
+                    grad_hook=self.grad_hook, loss=self.loss_kind,
                 )
             )
         return self._train_fns[key]

@@ -1,0 +1,15 @@
+"""Next-word-prediction ClientTrainer (reference
+``ml/trainer/my_model_trainer_nwp.py`` ``ModelTrainerNWP``).
+
+The compiled engine already treats [B, L] integer label tensors per-token
+(masked CE + token accuracy, ml/engine/train.py), so the NWP trainer IS the
+classification trainer with token-level metrics; this subclass exists for
+factory parity and as the anchor for NWP-specific extensions."""
+
+from __future__ import annotations
+
+from .cls_trainer import ModelTrainerCLS
+
+
+class ModelTrainerNWP(ModelTrainerCLS):
+    pass

@@ -1,0 +1,62 @@
+"""Tag-prediction ClientTrainer (reference
+``ml/trainer/my_model_trainer_tag_prediction.py`` ``ModelTrainerTAGPred``):
+multi-label classification with sigmoid BCE on the SHARED compiled engine
+(``loss="bce"``) — same padding/masking/scan machinery as every other
+trainer, so no client sample is dropped or double-weighted.
+
+Labels may be multi-hot [B, C] float or integer class ids [B] (converted to
+one-hot), matching the stackoverflow_lr data either way.  Eval reports
+label-position accuracy through the protocol's shared keys (test_correct /
+test_total are per-label counts; loss aggregates to mean BCE per label) plus
+precision/recall/F1 extras."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .cls_trainer import ModelTrainerCLS
+
+
+def _as_multihot(y: jnp.ndarray, num_classes: int) -> jnp.ndarray:
+    if y.ndim == 1:
+        return jax.nn.one_hot(y, num_classes)
+    return y.astype(jnp.float32)
+
+
+class ModelTrainerTAGPred(ModelTrainerCLS):
+    loss_kind = "bce"
+
+    def _num_classes(self) -> int:
+        leaves = jax.tree_util.tree_leaves(self.variables["params"])
+        return int(leaves[-1].shape[-1])
+
+    def train(self, train_data, device, args, extra=None):
+        x, y = train_data
+        yh = _as_multihot(jnp.asarray(y), self._num_classes())
+        return super().train((x, yh), device, args, extra=extra)
+
+    def test(self, test_data, device, args):
+        x, y = test_data
+        logits = self.module.apply(self.variables, jnp.asarray(x), train=False)
+        yh = _as_multihot(jnp.asarray(y), logits.shape[-1])
+        pred = (jax.nn.sigmoid(logits) > 0.5).astype(jnp.float32)
+        tp = float(jnp.sum(pred * yh))
+        fp = float(jnp.sum(pred * (1 - yh)))
+        fn = float(jnp.sum((1 - pred) * yh))
+        precision = tp / max(tp + fp, 1.0)
+        recall = tp / max(tp + fn, 1.0)
+        f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+        mean_bce = float(jnp.mean(optax.sigmoid_binary_cross_entropy(logits, yh)))
+        n_positions = float(yh.size)
+        return {
+            # shared protocol keys, all per label-position so the server's
+            # correct/total and loss/total divisions stay meaningful
+            "test_correct": float(jnp.sum(pred == yh)),
+            "test_loss": mean_bce * n_positions,
+            "test_total": n_positions,
+            "test_precision": precision,
+            "test_recall": recall,
+            "test_f1": f1,
+        }

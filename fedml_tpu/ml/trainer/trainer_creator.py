@@ -1,0 +1,24 @@
+"""Trainer factory (reference ``ml/trainer/trainer_creator.py:6-13``
+``create_model_trainer``): dispatch on dataset family."""
+
+from __future__ import annotations
+
+from ...core.alg_frame.client_trainer import ClientTrainer
+
+_NWP_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
+_TAG_DATASETS = {"stackoverflow_lr"}
+
+
+def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
+    dataset = str(getattr(args, "dataset", "")).lower()
+    if dataset in _NWP_DATASETS:
+        from .nwp_trainer import ModelTrainerNWP
+
+        return ModelTrainerNWP(model, args, grad_hook=grad_hook)
+    if dataset in _TAG_DATASETS:
+        from .tag_trainer import ModelTrainerTAGPred
+
+        return ModelTrainerTAGPred(model, args)
+    from .cls_trainer import ModelTrainerCLS
+
+    return ModelTrainerCLS(model, args, grad_hook=grad_hook)

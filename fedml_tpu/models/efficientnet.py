@@ -1,0 +1,78 @@
+"""EfficientNet (flax.linen) — reference ``model/cv/efficientnet*``
+(model hub key ``efficientnet``, model_hub.py:20-85).
+
+Compact B0-style: MBConv (expand → depthwise → squeeze-excite → project)
+with GroupNorm (FL-correct: no running stats to average) and stride pattern
+scaled for CIFAR-sized inputs."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _gn(c: int):
+    return nn.GroupNorm(num_groups=min(8, c))
+
+
+class SqueezeExcite(nn.Module):
+    channels: int
+    ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        s = x.mean(axis=(1, 2))
+        s = nn.relu(nn.Dense(max(self.channels // self.ratio, 4))(s))
+        s = nn.sigmoid(nn.Dense(self.channels)(s))
+        return x * s[:, None, None, :]
+
+
+class MBConv(nn.Module):
+    out_ch: int
+    expand: int = 4
+    stride: int = 1
+    kernel: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        mid = in_ch * self.expand
+        h = x
+        if self.expand != 1:
+            h = nn.relu(_gn(mid)(nn.Conv(mid, (1, 1), use_bias=False)(h)))
+        h = nn.Conv(mid, (self.kernel, self.kernel), strides=(self.stride, self.stride),
+                    padding="SAME", feature_group_count=mid, use_bias=False)(h)
+        h = nn.relu(_gn(mid)(h))
+        h = SqueezeExcite(mid)(h)
+        h = _gn(self.out_ch)(nn.Conv(self.out_ch, (1, 1), use_bias=False)(h))
+        if self.stride == 1 and in_ch == self.out_ch:
+            h = h + x
+        return h
+
+
+class EfficientNet(nn.Module):
+    """(out_ch, expand, stride, repeats) stages; default ~B0-lite."""
+
+    num_classes: int
+    stages: Sequence[Tuple[int, int, int, int]] = (
+        (16, 1, 1, 1),
+        (24, 4, 2, 2),
+        (40, 4, 2, 2),
+        (80, 4, 2, 2),
+        (112, 4, 1, 1),
+    )
+    stem: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        h = nn.relu(_gn(self.stem)(nn.Conv(self.stem, (3, 3), padding="SAME",
+                                           use_bias=False)(x)))
+        for out_ch, expand, stride, repeats in self.stages:
+            for r in range(repeats):
+                h = MBConv(out_ch, expand, stride if r == 0 else 1)(h)
+        h = nn.relu(_gn(192)(nn.Conv(192, (1, 1), use_bias=False)(h)))
+        return nn.Dense(self.num_classes)(h.mean(axis=(1, 2)))

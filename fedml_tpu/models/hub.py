@@ -113,6 +113,10 @@ def create(args: Any, output_dim: int) -> nn.Module:
         from .linear import MLP
 
         return MLP(output_dim=output_dim)
+    if name in ("efficientnet", "efficientnet_b0"):
+        from .efficientnet import EfficientNet
+
+        return EfficientNet(num_classes=output_dim)
     raise ValueError(f"unknown model {name!r} for dataset {dataset!r}")
 
 

@@ -24,9 +24,9 @@ from ....core.aggregate import FedMLAggOperator
 from ....core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
 from ....core.security.fedml_attacker import FedMLAttacker
 from ....core.security.fedml_defender import FedMLDefender
-from ....ml.aggregator.default_aggregator import DefaultServerAggregator
+from ....ml.aggregator.aggregator_creator import create_server_aggregator
 from ....ml.engine.train import init_variables
-from ....ml.trainer.cls_trainer import ModelTrainerCLS
+from ....ml.trainer.trainer_creator import create_model_trainer
 from ....utils.metrics import MetricsLogger
 
 logger = logging.getLogger(__name__)
@@ -80,8 +80,8 @@ class FedAvgAPI:
         sample = jax.numpy.asarray(self.train_data_global[0][:1])
         self.w_global = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
 
-        self.trainer = ModelTrainerCLS(model, args)
-        self.aggregator = DefaultServerAggregator(model, args)
+        self.trainer = create_model_trainer(model, args)
+        self.aggregator = create_server_aggregator(model, args)
         self.aggregator.set_model_params(self.w_global)
 
         self.client_list: List[Client] = []
