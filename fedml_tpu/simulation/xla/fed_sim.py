@@ -282,17 +282,32 @@ class XLASimulator:
 
     # exposed for benchmarking
     def throughput(self) -> Dict[str, float]:
-        """Steady-state throughput: round 0 (compile) excluded from ALL three
-        metrics when more than one round ran."""
-        if len(self.round_times) > 1:
-            times = self.round_times[1:]
-            samples = sum(self.samples_per_round[1:])
-        else:
-            times = self.round_times
-            samples = sum(self.samples_per_round)
-        total_t = max(sum(times), 1e-9)
+        """Steady-state throughput.  Round 0 is XLA compile and the first
+        executed round pays the one-time host->HBM dataset upload, so the
+        representative per-round cost is the MEDIAN over post-compile rounds
+        (one-time costs amortize to nothing over a real run's hundreds of
+        rounds).  NOTE: the median only isolates steady state when >= 3
+        post-compile rounds ran (bench.py uses comm_round=6); with fewer,
+        the upload round still weighs in.  mean_round_s keeps the
+        warmup-inclusive average for comparison.  All zeros if no round ran.
+        """
+        import numpy as _np
+
+        times = self.round_times[1:] if len(self.round_times) > 1 else self.round_times
+        samples = (
+            self.samples_per_round[1:]
+            if len(self.samples_per_round) > 1
+            else self.samples_per_round
+        )
+        if not times:
+            return {"rounds_per_sec": 0.0, "mean_round_s": 0.0,
+                    "median_round_s": 0.0, "samples_per_sec": 0.0}
+        med = float(_np.median(times))
+        # per-round pairing preserved: median of the per-round ratios
+        sps = float(_np.median([s / max(t, 1e-9) for s, t in zip(samples, times)]))
         return {
-            "rounds_per_sec": len(times) / total_t,
-            "mean_round_s": total_t / len(times),
-            "samples_per_sec": samples / total_t,
+            "rounds_per_sec": 1.0 / max(med, 1e-9),
+            "mean_round_s": sum(times) / len(times),
+            "median_round_s": med,
+            "samples_per_sec": sps,
         }
