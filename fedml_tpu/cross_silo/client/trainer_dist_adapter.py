@@ -38,10 +38,18 @@ class TrainerDistAdapter:
         self.trainer = model_trainer
         self.trainer.set_id(self.client_index)
 
-        # hierarchical scenario: announce the intra-silo mesh
+        # hierarchical scenario: local training runs through the mesh-sharded
+        # DistributedTrainer (batch over dp; grad all-reduce compiled to ICI)
         scenario = str(getattr(args, "scenario", "horizontal"))
         n_dev = len(jax.devices())
+        self.dist_trainer = None
         if scenario == "hierarchical" and n_dev > 1:
+            from ...distributed import DistributedTrainer
+            from ...parallel.mesh import create_train_mesh
+
+            self.dist_trainer = DistributedTrainer(
+                model, args, mesh=create_train_mesh(dp=n_dev)
+            )
             logger.info("silo rank %d: intra-silo dp over %d devices (mesh-sharded batch)",
                         client_rank, n_dev)
 
@@ -60,6 +68,17 @@ class TrainerDistAdapter:
         self.trainer.round_idx = int(round_idx)  # advance the per-round RNG stream
         train_data = self.train_data_local_dict[self.client_index]
         n = self.train_data_local_num_dict[self.client_index]
+        if self.dist_trainer is not None:
+            # hierarchical: global model in -> mesh-dp local epochs -> host out
+            self.dist_trainer.init_from(self.trainer.get_model_params())
+            x, y = train_data
+            self.dist_trainer.fit(
+                x, y, epochs=int(getattr(self.args, "epochs", 1)),
+                seed=int(round_idx) * 1000 + self.client_rank,
+            )
+            params = self.dist_trainer.get_variables()
+            self.trainer.set_model_params(params)
+            return params, n
         self.trainer.on_before_local_training(train_data, self.device, self.args)
         self.trainer.train(train_data, self.device, self.args)
         self.trainer.on_after_local_training(train_data, self.device, self.args)

@@ -1,0 +1,11 @@
+"""Distributed training acceleration — the Cheetah pillar.
+
+The reference's ``python/fedml/distributed`` is an empty stub (SURVEY.md §1:
+the real intra-silo acceleration is PyTorch DDP in the hierarchical
+cross-silo path).  Here the pillar is first-class and TPU-native: a sharded
+trainer over a ``dp x tp`` device mesh with XLA collectives over ICI.
+"""
+
+from .trainer import DistributedTrainer
+
+__all__ = ["DistributedTrainer"]

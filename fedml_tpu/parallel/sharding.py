@@ -1,0 +1,53 @@
+"""Parameter/batch sharding rules.
+
+The TPU-native successor of DDP wrapping (reference
+``ml/engine/ml_engine_adapter.py:273-281`` ``model_ddp``): instead of
+wrapping a module, annotate each array with a ``NamedSharding`` and let XLA
+insert the collectives.  Heuristic tensor-parallel rule: shard a parameter's
+largest axis over ``tp`` when divisible (dense kernels [in, out] split out;
+embeddings [vocab, d] split vocab); everything else replicates.  Batches
+shard over ``dp``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+
+def param_spec(shape, tp: int) -> P:
+    """PartitionSpec for one parameter under the tp heuristic."""
+    if len(shape) < 2 or tp <= 1:
+        return P()
+    axis = int(np.argmax(shape))
+    if shape[axis] % tp != 0:
+        return P()
+    spec = [None] * len(shape)
+    spec[axis] = "tp"
+    return P(*spec)
+
+
+def param_shardings(params: Pytree, mesh: Mesh) -> Pytree:
+    """NamedSharding pytree for params over ``mesh`` (axes dp and/or tp)."""
+    tp = int(mesh.shape.get("tp", 1))
+
+    def rule(x):
+        return NamedSharding(mesh, param_spec(np.shape(x), tp))
+
+    return jax.tree_util.tree_map(rule, params)
+
+
+def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard axis 0 (batch) over dp, replicate the rest."""
+    if "dp" in mesh.axis_names:
+        return NamedSharding(mesh, P("dp", *([None] * (ndim - 1))))
+    return NamedSharding(mesh, P())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
