@@ -29,9 +29,10 @@ def create_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
     return Mesh(dev_array, tuple(axis_names))
 
 
-def create_fl_mesh(n_devices: Optional[int] = None) -> Mesh:
+def create_fl_mesh(n_devices: Optional[int] = None,
+                   devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """1-D mesh over the 'client' axis — the Parrot-XLA simulator's layout."""
-    devices = jax.devices()
+    devices = list(devices if devices is not None else jax.devices())
     n = int(n_devices or len(devices))
     return create_mesh((n,), ("client",), devices)
 

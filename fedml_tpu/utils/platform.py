@@ -1,0 +1,28 @@
+"""Backend-selection helpers for environments that pin a TPU backend.
+
+The deployment environment registers a tunneled-TPU ("axon") jax backend in
+every Python process via sitecustomize, so ``JAX_PLATFORMS=cpu`` alone is not
+enough to keep unit tests / dry runs off the TPU: the factory must also be
+deregistered before first backend use (its PJRT init can block the process).
+Shared by ``tests/conftest.py`` and ``__graft_entry__._dryrun_impl``.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_backend() -> None:
+    """Force jax onto the host-CPU backend even if a TPU factory is registered.
+
+    Must run before jax initializes a backend.  Device COUNT
+    (``--xla_force_host_platform_device_count``) must still be set via
+    ``XLA_FLAGS`` in the environment before the jax import.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:  # pragma: no cover - jax internals may move
+        pass

@@ -18,15 +18,20 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-import jax  # noqa: E402
+# Load the shared force-CPU helper WITHOUT importing the fedml_tpu package:
+# `from fedml_tpu.utils.platform import ...` would execute fedml_tpu/__init__
+# (and its full import graph) before the axon backend is deregistered — any
+# future module-level jax.devices()/jnp constant there would then touch the
+# TPU tunnel and wedge the suite.
+import importlib.util as _ilu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax._src import xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:  # pragma: no cover - jax internals may move
-    pass
+_spec = _ilu.spec_from_file_location(
+    "_fedml_tpu_platform_util",
+    os.path.join(os.path.dirname(__file__), os.pardir, "fedml_tpu", "utils", "platform.py"),
+)
+_mod = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+_mod.force_cpu_backend()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
