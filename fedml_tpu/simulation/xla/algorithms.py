@@ -1,0 +1,399 @@
+"""In-mesh algorithm strategies for the Parrot-XLA simulator.
+
+The reference ships one MPI directory per algorithm, each re-implementing the
+round loop around different server math (``simulation/mpi/{fedopt,fednova,
+async_fedavg,...}`` — SURVEY.md §2.5).  Here an algorithm is a STRATEGY
+traced into the one compiled round program of
+:class:`~fedml_tpu.simulation.xla.fed_sim.XLASimulator`:
+
+* a per-step gradient hook (SCAFFOLD/FedDyn drift correction) compiled into
+  the local-SGD scan;
+* a per-client contribution pytree, weighted-summed on device and reduced
+  with one ``psum`` over the client axis (rides ICI);
+* a per-client output (new control variates) returned sharded and scattered
+  into an HBM-resident client-state table;
+* a server update applied to the psum'd aggregate INSIDE the same XLA
+  program — FedOpt's adaptive server step, FedNova's normalized averaging,
+  FedDyn's dynamic regularizer all cost zero extra host round-trips.
+
+Each strategy's math mirrors its single-process twin in ``simulation/sp/``
+(the equivalence is tested in tests/test_xla_zoo.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+def _weighted_avg(acc: Pytree, wsum: jnp.ndarray, like: Pytree) -> Pytree:
+    """acc is the fp32 weighted SUM of client trees; divide and restore dtype."""
+    return jax.tree_util.tree_map(
+        lambda a, v: (a / jnp.maximum(wsum, 1e-9)).astype(v.dtype), acc, like
+    )
+
+
+class InMeshAlgorithm:
+    """FedAvg — also the base contract every in-mesh strategy implements.
+
+    Host-side methods (``init_*``, ``gather_client_extras``,
+    ``apply_client_outs``, ``host_round_end``) run in Python between rounds;
+    everything else is traced into the compiled round and must be jax-pure.
+    """
+
+    needs_client_state = False
+
+    def __init__(self, args):
+        self.args = args
+
+    # -- traced: engine plumbing ------------------------------------------
+    def grad_hook(self):
+        """Per-step hook for ml.engine.build_local_train (None = plain SGD)."""
+        return None
+
+    def engine_extra(self, cex: Pytree, server_state: Pytree) -> Pytree:
+        """The ``extra`` handed to the engine's grad hook for one client."""
+        return None
+
+    # -- traced: per-client reduction -------------------------------------
+    def zero_contrib(self, variables: Pytree) -> Pytree:
+        return jnp.zeros(())
+
+    def client_contrib(self, variables, result, w, real, cex, server_state) -> Pytree:
+        """Extra per-client contribution, accumulated by plain tree-sum then
+        psum'd (the weighted variables sum is always accumulated by the
+        simulator itself)."""
+        return jnp.zeros(())
+
+    def client_out(self, variables, result, real, cex, server_state) -> Pytree:
+        """Per-client output, returned stacked over the client axis (e.g. a
+        control-variate delta to scatter back into the client-state table)."""
+        return jnp.zeros(())
+
+    # -- traced: server step ----------------------------------------------
+    def server_update(self, acc, wsum, ext, variables, server_state) -> Tuple[Pytree, Pytree]:
+        return _weighted_avg(acc, wsum, variables), server_state
+
+    # -- host side ---------------------------------------------------------
+    def init_server_state(self, variables: Pytree) -> Pytree:
+        return ()
+
+    def init_client_state(self, num_clients: int, variables: Pytree) -> Optional[Pytree]:
+        return None
+
+    def gather_client_extras(self, client_state, ids: np.ndarray, real: np.ndarray,
+                             round_idx: int) -> Pytree:
+        """Per-round per-client inputs, leading axis = len(ids), sharded over
+        the client mesh axis."""
+        if client_state is None:
+            return jnp.zeros((len(ids),), jnp.float32)
+        return jax.tree_util.tree_map(lambda t: t[jnp.asarray(ids)], client_state)
+
+    def apply_client_outs(self, client_state, ids: np.ndarray, outs: Pytree):
+        """Fold the round's stacked client outputs back into the state table.
+        Outputs are DELTAS masked to zero for padded slots, so a scatter-add
+        is safe even when the padding repeats a real client id."""
+        if client_state is None:
+            return None
+        idx = jnp.asarray(ids)
+        return jax.tree_util.tree_map(lambda t, o: t.at[idx].add(o), client_state, outs)
+
+    def host_round_end(self, ids: np.ndarray, real: np.ndarray, round_idx: int) -> None:
+        pass
+
+    def host_state(self) -> Dict[str, Any]:
+        """Host-side mutable state for checkpointing (msgpack-serializable)."""
+        return {}
+
+    def restore_host_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+class FedAvgInMesh(InMeshAlgorithm):
+    """Weighted averaging; FedProx rides this unchanged (the engine installs
+    the proximal grad hook from ``args.proximal_mu`` — sp/fedprox parity)."""
+
+
+class FedOptInMesh(InMeshAlgorithm):
+    """Server-side adaptive optimization (Reddi et al.) — sp/fedopt twin:
+    the weighted-average delta is a pseudo-gradient for an optax server
+    optimizer whose state is replicated mesh-wide and carried round to round."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        from ..sp.fedopt.fedopt_api import make_server_optimizer
+
+        self._tx = make_server_optimizer(args)
+
+    def init_server_state(self, variables):
+        return self._tx.init(variables["params"])
+
+    def server_update(self, acc, wsum, ext, variables, server_state):
+        import optax
+
+        avg = _weighted_avg(acc, wsum, variables)
+        pseudo_grad = jax.tree_util.tree_map(
+            lambda p, a: p - a, variables["params"], avg["params"]
+        )
+        updates, new_state = self._tx.update(pseudo_grad, server_state, variables["params"])
+        params = optax.apply_updates(variables["params"], updates)
+        return dict(avg, params=params), new_state
+
+
+class FedNovaInMesh(InMeshAlgorithm):
+    """Normalized averaging (Wang et al.) — sp/fednova twin:
+    w <- w - tau_eff * sum_i p_i d_i with d_i = (w - w_i)/tau_i,
+    tau_eff = sum_i p_i tau_i, p_i = n_i / sum n.  tau_i is the engine's
+    masked step count (LocalTrainResult.steps)."""
+
+    def zero_contrib(self, variables):
+        return {
+            "d": jax.tree_util.tree_map(
+                lambda v: jnp.zeros_like(v, jnp.float32), variables
+            ),
+            "tau": jnp.zeros(()),
+        }
+
+    def client_contrib(self, variables, result, w, real, cex, server_state):
+        tau = jnp.maximum(result.steps, 1.0)
+        d_i = jax.tree_util.tree_map(
+            lambda g, wi: (g.astype(jnp.float32) - wi.astype(jnp.float32)) / tau,
+            variables, result.variables,
+        )
+        return {
+            "d": jax.tree_util.tree_map(lambda x: w * x, d_i),
+            "tau": w * result.steps,
+        }
+
+    def server_update(self, acc, wsum, ext, variables, server_state):
+        denom = jnp.maximum(wsum, 1e-9)
+        tau_eff = ext["tau"] / denom
+        new = jax.tree_util.tree_map(
+            lambda g, d: (g.astype(jnp.float32) - tau_eff * d / denom).astype(g.dtype),
+            variables, ext["d"],
+        )
+        return new, server_state
+
+
+class ScaffoldInMesh(InMeshAlgorithm):
+    """Stochastic controlled averaging (Karimireddy et al.) — sp/scaffold
+    twin.  Per-client control variates c_i live in an HBM table sharded over
+    rounds by gather/scatter-add; the server control c is replicated state.
+    Local steps use g - c_i + c; after K steps
+    c_i+ = c_i - c + (w - w_i)/(K lr) and c += (1/N) sum_i (c_i+ - c_i)."""
+
+    needs_client_state = True
+
+    def __init__(self, args):
+        super().__init__(args)
+        # c_i+ = c_i - c + (w - w_i)/(K lr) assumes each local step is exactly
+        # p -= lr*g; with momentum/Adam the relation (and hence the control
+        # variates) would silently be wrong.
+        opt = str(getattr(args, "client_optimizer", "sgd")).lower()
+        momentum = float(getattr(args, "momentum", 0.0) or 0.0)
+        if opt != "sgd" or momentum > 0:
+            raise NotImplementedError(
+                "in-mesh SCAFFOLD requires client_optimizer='sgd' with zero "
+                f"momentum (got {opt!r}, momentum={momentum})"
+            )
+        self.lr = float(getattr(args, "learning_rate", 0.01))
+        self.n_total = float(args.client_num_in_total)
+
+    def grad_hook(self):
+        def hook(grads, params, anchor, extra):
+            c_i, c = extra
+            return jax.tree_util.tree_map(
+                lambda g, ci, cg: g - ci + cg, grads, c_i, c
+            )
+
+        return hook
+
+    def engine_extra(self, cex, server_state):
+        return (cex, server_state)
+
+    def init_server_state(self, variables):
+        return jax.tree_util.tree_map(
+            lambda v: jnp.zeros_like(v, jnp.float32), variables["params"]
+        )
+
+    def init_client_state(self, num_clients, variables):
+        return jax.tree_util.tree_map(
+            lambda v: jnp.zeros((num_clients,) + v.shape, jnp.float32),
+            variables["params"],
+        )
+
+    def _dc(self, variables, result, real, cex, c):
+        K = jnp.maximum(result.steps, 1.0)
+        new_ci = jax.tree_util.tree_map(
+            lambda ci, cg, wg, wi: ci - cg + (wg.astype(jnp.float32) - wi.astype(jnp.float32)) / (K * self.lr),
+            cex, c, variables["params"], result.variables["params"],
+        )
+        return jax.tree_util.tree_map(lambda n, o: real * (n - o), new_ci, cex)
+
+    def zero_contrib(self, variables):
+        return self.init_server_state(variables)
+
+    def client_contrib(self, variables, result, w, real, cex, server_state):
+        return self._dc(variables, result, real, cex, server_state)
+
+    def client_out(self, variables, result, real, cex, server_state):
+        return self._dc(variables, result, real, cex, server_state)
+
+    def server_update(self, acc, wsum, ext, variables, server_state):
+        new_c = jax.tree_util.tree_map(
+            lambda c, d: c + d / self.n_total, server_state, ext
+        )
+        return _weighted_avg(acc, wsum, variables), new_c
+
+
+class FedDynInMesh(InMeshAlgorithm):
+    """Dynamic regularization (Acar et al.) — sp/feddyn twin.  Per-client
+    h_i table + replicated running mean h; local grads use
+    g - h_i + alpha (w - w_t); h_i+ = h_i - alpha (w_i - w_t);
+    h <- h + (1/N) sum_i (h_i+ - h_i); w <- avg - h/alpha."""
+
+    needs_client_state = True
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.alpha = float(getattr(args, "feddyn_alpha", 0.01))
+        self.n_total = float(args.client_num_in_total)
+
+    def grad_hook(self):
+        alpha = self.alpha
+
+        def hook(grads, params, anchor, extra):
+            return jax.tree_util.tree_map(
+                lambda g, h, p, a: g - h + alpha * (p - a), grads, extra, params, anchor
+            )
+
+        return hook
+
+    def engine_extra(self, cex, server_state):
+        return cex
+
+    def init_server_state(self, variables):
+        return jax.tree_util.tree_map(
+            lambda v: jnp.zeros_like(v, jnp.float32), variables["params"]
+        )
+
+    def init_client_state(self, num_clients, variables):
+        return jax.tree_util.tree_map(
+            lambda v: jnp.zeros((num_clients,) + v.shape, jnp.float32),
+            variables["params"],
+        )
+
+    def _dh(self, variables, result, real):
+        return jax.tree_util.tree_map(
+            lambda wi, wg: -self.alpha * real * (wi.astype(jnp.float32) - wg.astype(jnp.float32)),
+            result.variables["params"], variables["params"],
+        )
+
+    def zero_contrib(self, variables):
+        return self.init_server_state(variables)
+
+    def client_contrib(self, variables, result, w, real, cex, server_state):
+        return self._dh(variables, result, real)
+
+    def client_out(self, variables, result, real, cex, server_state):
+        return self._dh(variables, result, real)
+
+    def server_update(self, acc, wsum, ext, variables, server_state):
+        avg = _weighted_avg(acc, wsum, variables)
+        new_h = jax.tree_util.tree_map(
+            lambda h, d: h + d / self.n_total, server_state, ext
+        )
+        params = jax.tree_util.tree_map(
+            lambda p, h: (p.astype(jnp.float32) - h / self.alpha).astype(p.dtype),
+            avg["params"], new_h,
+        )
+        return dict(avg, params=params), new_h
+
+
+class AsyncFedAvgInMesh(InMeshAlgorithm):
+    """Buffered asynchronous FedAvg (FedBuff-style, Nguyen et al.
+    arXiv:2106.06639) — the in-mesh counterpart of sp/async_fedavg's
+    event-driven loop.  Each round is one buffer flush: the sampled clients'
+    deltas are mixed with staleness-discounted weights
+    a_i = alpha / (1 + tau_i)^beta where tau_i = rounds since client i last
+    participated, and w <- w + (1/K) sum_i a_i (w_i - w).  Unlike the
+    event-driven sp path, clients train from the current model (the
+    discounting models staleness; the stale-weights effect is not simulated)."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.alpha = float(getattr(args, "async_alpha", 0.6))
+        self.beta = float(getattr(args, "async_beta", 0.5))
+        self._last_round: Dict[int, int] = {}
+
+    def gather_client_extras(self, client_state, ids, real, round_idx):
+        stale = np.array(
+            [round_idx - self._last_round.get(int(c), round_idx) for c in ids],
+            np.float32,
+        )
+        return jnp.asarray(stale)
+
+    def host_round_end(self, ids, real, round_idx):
+        for c, r in zip(ids, real):
+            if r > 0:
+                self._last_round[int(c)] = round_idx
+
+    def host_state(self):
+        return {"last_round": {str(k): v for k, v in self._last_round.items()}}
+
+    def restore_host_state(self, state):
+        self._last_round = {int(k): int(v) for k, v in state.get("last_round", {}).items()}
+
+    def zero_contrib(self, variables):
+        return {
+            "d": jax.tree_util.tree_map(
+                lambda v: jnp.zeros_like(v, jnp.float32), variables
+            ),
+            "k": jnp.zeros(()),
+        }
+
+    def client_contrib(self, variables, result, w, real, cex, server_state):
+        a_i = self.alpha / (1.0 + cex) ** self.beta
+        return {
+            "d": jax.tree_util.tree_map(
+                lambda wi, wg: a_i * real * (wi.astype(jnp.float32) - wg.astype(jnp.float32)),
+                result.variables, variables,
+            ),
+            "k": real,
+        }
+
+    def server_update(self, acc, wsum, ext, variables, server_state):
+        k = jnp.maximum(ext["k"], 1.0)
+        new = jax.tree_util.tree_map(
+            lambda g, d: (g.astype(jnp.float32) + d / k).astype(g.dtype),
+            variables, ext["d"],
+        )
+        return new, server_state
+
+
+_REGISTRY = {
+    "fedavg": FedAvgInMesh,
+    "fedprox": FedAvgInMesh,  # engine grad hook from args.proximal_mu
+    "fedsgd": FedAvgInMesh,  # E=1, full batch — configured via args
+    "fedopt": FedOptInMesh,
+    "fednova": FedNovaInMesh,
+    "scaffold": ScaffoldInMesh,
+    "feddyn": FedDynInMesh,
+    "async_fedavg": AsyncFedAvgInMesh,
+}
+
+
+def create_inmesh_algorithm(args) -> InMeshAlgorithm:
+    opt = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+    cls = _REGISTRY.get(opt)
+    if cls is None:
+        raise NotImplementedError(
+            f"federated_optimizer {opt!r} has no in-mesh strategy; use the 'sp' "
+            "backend (its host round loop supports the full zoo)"
+        )
+    return cls(args)

@@ -21,6 +21,13 @@ Client heterogeneity under static shapes: all clients pad to one bucket
 rounds whose sampled-client count doesn't fill devices evenly pad with
 weight-0 dummy clients.  Static greedy balancing of clients->devices by
 sample count (core/schedule) minimizes the padding waste.
+
+The algorithm zoo rides this same compiled round via in-mesh strategies
+(algorithms.py): FedAvg/FedProx/FedSGD/FedOpt/FedNova/SCAFFOLD/FedDyn/
+buffered-async all compile to ONE XLA program — per-step grad hooks, extra
+per-client contributions psum'd alongside the weighted model sum, control
+variates in HBM client-state tables, and the server step traced after the
+psum (reference ``simulation/mpi/*`` parity, SURVEY.md §2.5).
 """
 
 from __future__ import annotations
@@ -55,6 +62,7 @@ from ...ml.aggregator.default_aggregator import DefaultServerAggregator
 from ...ml.engine.train import build_local_train, init_variables
 from ...parallel.mesh import create_fl_mesh
 from ...utils.metrics import MetricsLogger
+from .algorithms import create_inmesh_algorithm
 
 logger = logging.getLogger(__name__)
 
@@ -97,6 +105,9 @@ class XLASimulator:
         self._pack_data()
         sample = jnp.asarray(self.train_global[0][:1])
         self.variables = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
+        self.algo = create_inmesh_algorithm(args)
+        self.server_state = self.algo.init_server_state(self.variables)
+        self.client_state = self.algo.init_client_state(self.num_clients, self.variables)
         self._build_round_fn()
 
         self.runtime_estimator = RuntimeEstimator(self.n_dev, uniform_devices=True)
@@ -145,46 +156,62 @@ class XLASimulator:
     # ------------------------------------------------------------------
     def _build_round_fn(self):
         mesh = self.mesh
+        algo = self.algo
         local_train = build_local_train(
-            self.module, self.args, self.batch_size, self.padded_n
+            self.module, self.args, self.batch_size, self.padded_n,
+            grad_hook=algo.grad_hook(),
         )
 
-        def per_device(variables, x_all, y_all, idx_l, counts_l, rngs_l):
+        def per_device(variables, server_state, x_all, y_all, idx_l, counts_l, rngs_l, cex_l):
             # idx_l: [C/n_dev, padded_n]; counts_l: [C/n_dev]; rngs_l: [C/n_dev, 2]
+            # cex_l: per-client algorithm inputs (leading axis C/n_dev)
             zeros = jax.tree_util.tree_map(
                 lambda v: jnp.zeros_like(v, dtype=jnp.float32), variables
             )
 
             def train_one(carry, inp):
-                acc, wsum, lsum = carry
-                idx_row, n_i, rng = inp
+                acc, wsum, lsum, ext = carry
+                idx_row, n_i, rng, cex = inp
                 x = jnp.take(x_all, idx_row, axis=0)
                 y = jnp.take(y_all, idx_row, axis=0)
-                result = local_train(variables, x, y, n_i, rng)
+                result = local_train(
+                    variables, x, y, n_i, rng,
+                    extra=algo.engine_extra(cex, server_state),
+                )
                 w = n_i.astype(jnp.float32)
+                real = (n_i > 0).astype(jnp.float32)
                 acc = jax.tree_util.tree_map(
                     lambda a, p: a + w * p.astype(jnp.float32), acc, result.variables
                 )
-                return (acc, wsum + w, lsum + result.loss * w), None
+                ext = jax.tree_util.tree_map(
+                    jnp.add, ext,
+                    algo.client_contrib(variables, result, w, real, cex, server_state),
+                )
+                out = algo.client_out(variables, result, real, cex, server_state)
+                return (acc, wsum + w, lsum + result.loss * w, ext), out
 
-            (acc, wsum, lsum), _ = jax.lax.scan(
-                train_one, (zeros, 0.0, 0.0), (idx_l, counts_l, rngs_l)
+            (acc, wsum, lsum, ext), outs = jax.lax.scan(
+                train_one,
+                (zeros, 0.0, 0.0, algo.zero_contrib(variables)),
+                (idx_l, counts_l, rngs_l, cex_l),
             )
             # the "fedml_nccl_reduce": one psum over ICI
             acc = jax.lax.psum(acc, "client")
             wsum = jax.lax.psum(wsum, "client")
             lsum = jax.lax.psum(lsum, "client")
-            new_global = jax.tree_util.tree_map(
-                lambda a, v: (a / jnp.maximum(wsum, 1e-9)).astype(v.dtype), acc, variables
+            ext = jax.lax.psum(ext, "client")
+            # algorithm server step, replicated — still inside the XLA program
+            new_global, new_state = algo.server_update(
+                acc, wsum, ext, variables, server_state
             )
-            return new_global, lsum / jnp.maximum(wsum, 1e-9)
+            return new_global, new_state, lsum / jnp.maximum(wsum, 1e-9), outs
 
         self._round_fn = jax.jit(
             shard_map(
                 per_device,
                 mesh=mesh,
-                in_specs=(P(), P(), P(), P("client"), P("client"), P("client")),
-                out_specs=(P(), P()),
+                in_specs=(P(), P(), P(), P(), P("client"), P("client"), P("client"), P("client")),
+                out_specs=(P(), P(), P(), P("client")),
                 check_vma=False,
             )
         )
@@ -213,9 +240,21 @@ class XLASimulator:
         ckpt = maybe_checkpointer(self.args)
         start_round = 0
         if ckpt is not None and ckpt.latest_step() is not None:
+            from flax import serialization
+
             step, state = ckpt.restore()
             self.variables = state["variables"]
             self._rng = jnp.asarray(state["rng"])
+            if "server_state" in state:
+                self.server_state = serialization.from_state_dict(
+                    self.server_state, state["server_state"]
+                )
+            if self.client_state is not None and "client_state" in state:
+                self.client_state = serialization.from_state_dict(
+                    self.client_state, state["client_state"]
+                )
+            if "algo_host_state" in state:
+                self.algo.restore_host_state(state["algo_host_state"])
             start_round = step + 1
             logger.info("resumed from checkpoint round %d", step)
         for round_idx in range(start_round, comm_round):
@@ -223,17 +262,27 @@ class XLASimulator:
             sampled = self._client_sampling(round_idx)
             ids, real = self._schedule(sampled)
             counts = np.where(real > 0, np.asarray(self.client_counts)[ids], 0)
+            # participation mask as the compiled round sees it: a sampled
+            # client with zero local samples contributes nothing in-mesh
+            participated = (counts > 0).astype(np.float32)
             self._rng, sub = jax.random.split(self._rng)
             rngs = jax.random.split(jax.random.fold_in(sub, round_idx), len(ids))
             idx_rows = self.client_idx[jnp.asarray(ids)]
-            self.variables, mean_loss = self._round_fn(
+            cex = self.algo.gather_client_extras(
+                self.client_state, ids, participated, round_idx
+            )
+            self.variables, self.server_state, mean_loss, outs = self._round_fn(
                 self.variables,
+                self.server_state,
                 self.x_all,
                 self.y_all,
                 idx_rows,
                 jnp.asarray(counts),
                 rngs,
+                cex,
             )
+            self.client_state = self.algo.apply_client_outs(self.client_state, ids, outs)
+            self.algo.host_round_end(ids, participated, round_idx)
             # host-side hooks (attack/defense need per-client updates and run
             # in the host path; central DP applies here)
             dp = FedMLDifferentialPrivacy.get_instance()
@@ -263,7 +312,16 @@ class XLASimulator:
             if ckpt is not None and (
                 round_idx % checkpoint_frequency(self.args) == 0 or round_idx == comm_round - 1
             ):
-                ckpt.save(round_idx, {"variables": self.variables, "rng": self._rng})
+                from flax import serialization
+
+                state = {"variables": self.variables, "rng": self._rng,
+                         "server_state": serialization.to_state_dict(self.server_state)}
+                if self.client_state is not None:
+                    state["client_state"] = serialization.to_state_dict(self.client_state)
+                host = self.algo.host_state()
+                if host:
+                    state["algo_host_state"] = host
+                ckpt.save(round_idx, state)
             if eval_enabled and (round_idx % freq == 0 or round_idx == comm_round - 1):
                 last = self._test_global(round_idx)
         return last
