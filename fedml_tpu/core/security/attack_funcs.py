@@ -5,9 +5,15 @@ on pytrees + ``jax.random``:
 
 * byzantine (zero / random / flip modes) — ``byzantine_attack.py``
 * label flipping (poison a dataset's labels) — ``label_flipping_attack.py``
-* model replacement / scaled backdoor push — ``backdoor_attack.py`` core step
-* gradient inversion (DLG-style reconstruction by gradient matching)
-  — ``dlg_attack.py`` / ``invert_gradient_attack.py``
+* model replacement / scaled malicious push — ``model_replacement``
+* backdoor: trigger-pattern poisoning + ALIE in-range evasion
+  — ``backdoor_attack.py``
+* edge-case backdoor: tail-sample relabeling + norm-ball projection
+  — ``edge_case_backdoor_attack.py``
+* DLG full reconstruction pipeline from an intercepted update
+  — ``dlg_attack.py``
+* gradient inversion core (reconstruction by gradient matching)
+  — ``invert_gradient_attack.py``
 * revealing labels from gradients (sign heuristic on the last-layer grad)
   — ``revealing_labels_from_gradients_attack.py``
 """
@@ -128,3 +134,154 @@ def reveal_labels_from_gradients(last_layer_bias_grad: jnp.ndarray) -> jnp.ndarr
     """Classes present in a cross-entropy batch have negative bias-gradient
     entries (iDLG observation) — return indices sorted by most-negative."""
     return jnp.argsort(last_layer_bias_grad)
+
+
+# ---------------------------------------------------------------------------
+# Backdoor: trigger-pattern data poisoning + ALIE model-side evasion
+# ---------------------------------------------------------------------------
+def add_backdoor_pattern(x: jnp.ndarray, size: int = 5, value: float = 2.8) -> jnp.ndarray:
+    """Stamp a corner trigger patch on a batch of images (reference
+    ``backdoor_attack.py:91-94`` uses img[:, :5, :5] = 2.8; NHWC here)."""
+    patch = jnp.full_like(x[:, :size, :size], value)
+    return x.at[:, :size, :size].set(patch)
+
+
+def poison_backdoor(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    target_class: int,
+    fraction: float,
+    key: jax.Array,
+    size: int = 5,
+    value: float = 2.8,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Poison a random ``fraction`` of a client's samples: stamp the trigger
+    and relabel to ``target_class`` (reference backdoor_attack.py 'pattern'
+    mode: triggered images always map to one class)."""
+    n = x.shape[0]
+    k = int(n * float(fraction))
+    if k == 0:
+        return x, y
+    idx = jax.random.permutation(key, n)[:k]
+    stamped = add_backdoor_pattern(x[idx], size=size, value=value)
+    return x.at[idx].set(stamped), y.at[idx].set(target_class)
+
+
+def alie_attack(
+    updates: Updates, byzantine_idxs: Sequence[int], num_std: float,
+    mode: str = "craft",
+) -> Updates:
+    """'A little is enough' (Baruch et al., reference backdoor_attack.py):
+    keep malicious updates inside the benign per-coordinate range
+    [mean - z*std, mean + z*std] so distance/range defenses struggle.
+
+    ``mode='craft'`` places every malicious update at mean - z*std (the
+    paper's parameter-crafting form — no malicious training needed);
+    ``mode='clip'`` clips each malicious client's OWN update (e.g. one
+    trained on backdoored data) into the range, the reference's
+    backdoor_attack.py:83-85 form — the trigger survives to the degree it
+    fits inside the benign envelope.  One vectorized pass over the raveled
+    update matrix (vs the reference's per-name numpy loops)."""
+    bad = set(int(i) for i in byzantine_idxs)
+    benign = [p for j, (_, p) in enumerate(updates) if j not in bad]
+    if not benign:
+        return updates
+    vecs = jnp.stack([ravel_pytree(p)[0] for p in benign], 0)
+    _, unravel = ravel_pytree(benign[0])
+    mean = jnp.mean(vecs, axis=0)
+    std = jnp.std(vecs, axis=0)
+    z = float(num_std)
+    if mode == "craft":
+        mal = unravel(mean - z * std)
+        return [(n, mal if j in bad else p) for j, (n, p) in enumerate(updates)]
+    if mode == "clip":
+        out = list(updates)
+        for j in bad:
+            n, p = updates[j]
+            v, _ = ravel_pytree(p)
+            out[j] = (n, unravel(jnp.clip(v, mean - z * std, mean + z * std)))
+        return out
+    raise ValueError(f"unknown alie mode {mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# Edge-case backdoor (Wang et al. 2020, reference edge_case_backdoor_attack.py)
+# ---------------------------------------------------------------------------
+def select_edge_cases(
+    logits: jnp.ndarray, fraction: float
+) -> jnp.ndarray:
+    """Indices of the tail samples — lowest max-softmax confidence — the
+    'edge cases' whose poisoning is hardest to detect (they sit in a region
+    the benign distribution barely covers)."""
+    conf = jnp.max(jax.nn.softmax(logits, axis=-1), axis=-1)
+    k = max(int(conf.shape[0] * float(fraction)), 1)
+    return jnp.argsort(conf)[:k]
+
+
+def poison_edge_cases(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    logits: jnp.ndarray,
+    target_class: int,
+    fraction: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Relabel the edge-case tail to ``target_class`` (no visible trigger —
+    the edge-case inputs themselves are the backdoor key)."""
+    idx = select_edge_cases(logits, fraction)
+    return x, y.at[idx].set(target_class)
+
+
+def project_to_norm_ball(params: Pytree, global_params: Pytree, eps: float) -> Pytree:
+    """PGD-style projection of a (malicious) model onto the eps-ball around
+    the global model — the norm-evasion step edge-case backdoors pair with
+    scaling (reference edge_case_backdoor_attack.py's projected variant)."""
+    d_vec, unravel = ravel_pytree(
+        jax.tree_util.tree_map(lambda p, g: p - g, params, global_params)
+    )
+    norm = jnp.linalg.norm(d_vec)
+    scale = jnp.minimum(1.0, eps / jnp.maximum(norm, 1e-12))
+    g_vec, _ = ravel_pytree(global_params)
+    return unravel(g_vec + d_vec * scale)
+
+
+# ---------------------------------------------------------------------------
+# DLG: full reconstruction pipeline from an intercepted client update
+# ---------------------------------------------------------------------------
+def dlg_attack(
+    module,
+    variables: Pytree,
+    client_update: Pytree,
+    x_shape: Tuple[int, ...],
+    num_classes: int,
+    key: jax.Array,
+    lr_client: float = 0.1,
+    steps: int = 200,
+    lr_attack: float = 0.1,
+):
+    """Deep-leakage-from-gradients (reference dlg_attack.py): approximate the
+    client's step gradient as (w_global - w_client)/lr, then reconstruct a
+    representative (x, y) by gradient matching (invert_gradient).  Returns
+    ``(x_rec, y_soft)``."""
+    import optax
+
+    target_grads = jax.tree_util.tree_map(
+        lambda g, w: (g - w) / lr_client, variables["params"], client_update["params"]
+    )
+
+    def grad_fn(x, y_soft):
+        def loss(params):
+            logits = module.apply(dict(variables, params=params), x, train=False)
+            per = optax.softmax_cross_entropy(logits.astype(jnp.float32), y_soft)
+            return jnp.mean(per)
+
+        return jax.grad(loss)(variables["params"])
+
+    return invert_gradient(
+        grad_fn,
+        target_grads,
+        x_shape,
+        (x_shape[0], num_classes),
+        key,
+        steps=steps,
+        lr=lr_attack,
+    )

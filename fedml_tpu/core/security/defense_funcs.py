@@ -14,8 +14,9 @@ geometric median a.k.a. RFA (geometric_median_defense.py), norm-difference
 clipping (norm_diff_clipping_defense.py), centered clip / CClip
 (cclip_defense.py), weak DP (weak_dp_defense.py), SLSGD (slsgd_defense.py),
 FoolsGold (foolsgold_defense.py), robust learning rate (robust_learning_rate_defense.py),
-Bulyan (bulyan_defense.py), three-sigma outlier removal, Soteria and WBC are
-in their class wrappers.
+Bulyan (bulyan_defense.py), three-sigma outlier removal, Soteria
+representation-gradient pruning (soteria_defense.py) and FL-WBC client-side
+perturbation (wbc_defense.py).
 """
 
 from __future__ import annotations
@@ -237,3 +238,79 @@ def three_sigma_filter(updates: Updates, global_params: Pytree) -> Updates:
     mask = jnp.abs(arr - mu) <= 3.0 * sigma + 1e-12
     keep = [i for i, ok in enumerate(mask.tolist()) if ok]
     return [updates[i] for i in keep] or updates
+
+
+# ---------------------------------------------------------------------------
+# Soteria: representation-gradient pruning (Sun et al., arXiv:2012.06043;
+# reference soteria_defense.py)
+# ---------------------------------------------------------------------------
+def soteria_scores(feature_fn, xs: jnp.ndarray) -> jnp.ndarray:
+    """Per-feature sensitivity ||dr_f/dx|| / |r_f| summed over a probe batch.
+
+    The reference loops a backward pass per feature
+    (soteria_defense.py:60-71); here one ``jax.jacrev`` per sample (vmapped)
+    computes the whole Jacobian on-device.  ``feature_fn``: single input ->
+    representation vector [F] (the layer whose gradient the client shares)."""
+
+    def per_sample(x):
+        r = feature_fn(x)
+        J = jax.jacrev(feature_fn)(x)  # [F, *x.shape]
+        Jn = jnp.sqrt(jnp.sum(J.reshape(J.shape[0], -1) ** 2, axis=1))
+        return Jn / jnp.maximum(jnp.abs(r), 1e-8)
+
+    return jnp.sum(jax.vmap(per_sample)(xs), axis=0)
+
+
+def soteria_mask(scores: jnp.ndarray, percentile: float = 1.0) -> jnp.ndarray:
+    """0/1 mask zeroing the features BELOW the given percentile of
+    sensitivity — low ||dr/dx||/|r| features leak the most under gradient
+    inversion (the paper's pruning rule, reference soteria_defense.py:74-78)."""
+    thresh = jnp.percentile(scores, percentile)
+    return (scores >= thresh).astype(jnp.float32)
+
+
+def soteria_apply(update: Pytree, global_params: Pytree, mask: jnp.ndarray,
+                  layer_path: Sequence[str]) -> Pytree:
+    """Mask the pruned representation features out of a client's DELTA (the
+    shared gradient), leaving the rest of the update untouched.
+
+    Pruning dL/dr_f zeroes feature f's contribution to the gradient of the
+    layer PRODUCING the representation: ``layer_path`` addresses that layer's
+    kernel (flax layout [in, F] — the feature axis is the LAST axis, so the
+    mask broadcasts over leading axes; also correct for its bias [F])."""
+
+    def walk(tree, gtree, path):
+        if not path:
+            delta = tree - gtree
+            return gtree + delta * mask.reshape((1,) * (tree.ndim - 1) + (-1,))
+        out = dict(tree)
+        out[path[0]] = walk(tree[path[0]], gtree[path[0]], path[1:])
+        return out
+
+    out = dict(update)
+    out["params"] = walk(update["params"], global_params["params"], list(layer_path))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# FL-WBC: white-blood-cell client-side perturbation (Sun et al., NeurIPS'21;
+# reference wbc_defense.py)
+# ---------------------------------------------------------------------------
+def wbc_perturb(update: Pytree, prev_update: Pytree, key: jax.Array,
+                strength: float = 1.0, lr: float = 0.1) -> Pytree:
+    """Perturb the parameter space where an attack effect PERSISTS: where the
+    update barely changed since the previous round (small |delta - prev|),
+    a poisoning push can hide, so Laplace noise is injected there; fast-moving
+    coordinates (|diff| > |noise|) are left alone to preserve accuracy
+    (reference wbc_defense.py:55-70 per-tensor loop, vectorized here)."""
+    vec, unravel = ravel_pytree(update)
+    prev_vec, _ = ravel_pytree(prev_update)
+    diff = vec - prev_vec
+    noise = strength * _laplace(key, vec.shape)
+    noise = jnp.where(jnp.abs(diff) > jnp.abs(noise), 0.0, noise)
+    return unravel(vec + lr * noise)
+
+
+def _laplace(key: jax.Array, shape) -> jnp.ndarray:
+    u = jax.random.uniform(key, shape, minval=-0.5 + 1e-7, maxval=0.5)
+    return -jnp.sign(u) * jnp.log1p(-2.0 * jnp.abs(u))

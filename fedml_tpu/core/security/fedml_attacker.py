@@ -16,15 +16,27 @@ import numpy as np
 
 from . import attack_funcs as A
 from .constants import (
+    ATTACK_METHOD_BACKDOOR,
     ATTACK_METHOD_BYZANTINE_ATTACK,
+    ATTACK_METHOD_DLG,
+    ATTACK_METHOD_EDGE_CASE_BACKDOOR,
     ATTACK_METHOD_LABEL_FLIPPING,
     ATTACK_METHOD_MODEL_REPLACEMENT,
 )
 
 logger = logging.getLogger(__name__)
 
-_MODEL_ATTACKS = {ATTACK_METHOD_BYZANTINE_ATTACK, ATTACK_METHOD_MODEL_REPLACEMENT}
-_DATA_ATTACKS = {ATTACK_METHOD_LABEL_FLIPPING}
+_MODEL_ATTACKS = {
+    ATTACK_METHOD_BYZANTINE_ATTACK,
+    ATTACK_METHOD_MODEL_REPLACEMENT,
+    ATTACK_METHOD_BACKDOOR,  # ALIE in-range evasion on the update list
+    ATTACK_METHOD_EDGE_CASE_BACKDOOR,  # scaled push projected into a norm ball
+}
+_DATA_ATTACKS = {
+    ATTACK_METHOD_LABEL_FLIPPING,
+    ATTACK_METHOD_BACKDOOR,  # trigger-pattern stamping + relabel
+    ATTACK_METHOD_EDGE_CASE_BACKDOOR,  # tail-sample relabel
+}
 
 
 class FedMLAttacker:
@@ -89,11 +101,34 @@ class FedMLAttacker:
                 n, p = out[i]
                 out[i] = (n, A.model_replacement(p, extra_auxiliary_info, scale))
             return out
+        if self.attack_type == ATTACK_METHOD_BACKDOOR:
+            # model side of the backdoor: ALIE keeps malicious updates inside
+            # the benign per-coordinate range ('craft' replaces them with
+            # mean - z*std; 'clip' clips the backdoor-trained update into
+            # range so the planted trigger survives)
+            return A.alie_attack(
+                raw_client_grad_list, idxs,
+                num_std=float(getattr(self.args, "attack_num_std", 1.5)),
+                mode=str(getattr(self.args, "attack_mode", "craft")),
+            )
+        if self.attack_type == ATTACK_METHOD_EDGE_CASE_BACKDOOR:
+            # scaled push, then projected back into an eps-ball around the
+            # global model to evade norm-based defenses
+            scale = float(getattr(self.args, "attack_scale", 10.0))
+            eps = float(getattr(self.args, "attack_norm_bound", 5.0))
+            out = list(raw_client_grad_list)
+            for i in idxs:
+                n, p = out[i]
+                pushed = A.model_replacement(p, extra_auxiliary_info, scale)
+                out[i] = (n, A.project_to_norm_ball(pushed, extra_auxiliary_info, eps))
+            return out
         return raw_client_grad_list
 
     def poison_data(self, labels):
         if not self.is_data_poisoning_attack():
             return labels
+        if self.attack_type != ATTACK_METHOD_LABEL_FLIPPING:
+            return labels  # backdoor variants poison (x, y) via poison_dataset
         return np.asarray(
             A.flip_labels(
                 labels,
@@ -101,3 +136,51 @@ class FedMLAttacker:
                 int(getattr(self.args, "target_class", 7)),
             )
         )
+
+    def poison_dataset(self, x, y, logits=None):
+        """Data side of the backdoor attacks: stamp triggers / relabel tails.
+        ``logits`` (model outputs on x) are required for edge-case selection;
+        without them the edge-case variant falls back to poisoning nothing."""
+        if not self.is_data_poisoning_attack():
+            return x, y
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        target = int(getattr(self.args, "target_class", 0))
+        frac = float(getattr(self.args, "poison_fraction", 0.2))
+        if self.attack_type == ATTACK_METHOD_BACKDOOR:
+            self._key, sub = jax.random.split(self._key)
+            return A.poison_backdoor(x, y, target, frac, sub)
+        if self.attack_type == ATTACK_METHOD_EDGE_CASE_BACKDOOR and logits is not None:
+            return A.poison_edge_cases(x, y, jnp.asarray(logits), target, frac)
+        return x, y
+
+    def poison_local_data(self, client_idx: int, num_clients: int, x, y, logits=None):
+        """Per-client data-poisoning entry the round loop calls before local
+        training: applies this attack's data transformation IF ``client_idx``
+        is one of the malicious clients (byzantine idxs drawn over the full
+        population), else returns the data unchanged."""
+        if not self.is_data_poisoning_attack():
+            return x, y
+        if int(client_idx) not in set(self.get_byzantine_idxs(num_clients)):
+            return x, y
+        if self.attack_type == ATTACK_METHOD_LABEL_FLIPPING:
+            return x, self.poison_data(y)
+        return self.poison_dataset(x, y, logits=logits)
+
+    # -- privacy attacks ----------------------------------------------------
+    def reconstruct_data(self, module, variables, client_update, x_shape, num_classes):
+        """DLG (attack_type='dlg'): reconstruct a representative input batch
+        from one intercepted client update; returns (x_rec, y_soft) and keeps
+        the result on the instance for inspection."""
+        if self.attack_type != ATTACK_METHOD_DLG:
+            return None
+        self._key, sub = jax.random.split(self._key)
+        self.last_reconstruction = A.dlg_attack(
+            module, variables, client_update, x_shape, num_classes, sub,
+            lr_client=float(getattr(self.args, "learning_rate", 0.1)),
+            steps=int(getattr(self.args, "dlg_steps", 200)),
+            lr_attack=float(getattr(self.args, "dlg_lr", 0.1)),
+        )
+        return self.last_reconstruction

@@ -36,7 +36,9 @@ from .constants import (
     DEFENSE_RFA,
     DEFENSE_ROBUST_LEARNING_RATE,
     DEFENSE_SLSGD,
+    DEFENSE_SOTERIA,
     DEFENSE_THREE_SIGMA,
+    DEFENSE_WBC,
     DEFENSE_WEAK_DP,
 )
 
@@ -49,6 +51,8 @@ _BEFORE_DEFENSES = {
     DEFENSE_MULTI_KRUM,
     DEFENSE_NORM_DIFF_CLIPPING,
     DEFENSE_THREE_SIGMA,
+    DEFENSE_SOTERIA,  # client-side in the paper; applied to each shared update
+    DEFENSE_WBC,  # client-side in the paper; applied to each shared update
 }
 _ON_DEFENSES = {
     DEFENSE_SLSGD,
@@ -90,9 +94,23 @@ class FedMLDefender:
         self.is_enabled = True
         self.defense_type = str(args.defense_type).strip()
         self._history = None
+        self._wbc_prev = None
+        self._soteria_probe = None
         if self.defense_type not in SUPPORTED_DEFENSES:
             raise ValueError(
                 f"unknown defense_type {self.defense_type!r}; supported: {SUPPORTED_DEFENSES}"
+            )
+        if self.defense_type == DEFENSE_WBC and int(
+            getattr(args, "client_num_in_total", 0)
+        ) != int(getattr(args, "client_num_per_round", 0)):
+            # WBC compares each client's update to ITS OWN previous update;
+            # the aggregation hook only sees positional slots, which map to
+            # stable clients only under full participation — fail loudly
+            # rather than comparing unrelated clients' updates.
+            raise NotImplementedError(
+                "defense 'wbc' requires full participation "
+                "(client_num_per_round == client_num_in_total): per-client "
+                "update history is keyed by round slot"
             )
         self._key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 1013)
         logger.info("defense enabled: %s", self.defense_type)
@@ -132,7 +150,64 @@ class FedMLDefender:
             )
         if t == DEFENSE_THREE_SIGMA:
             return F.three_sigma_filter(raw_client_grad_list, extra_auxiliary_info)
+        if t == DEFENSE_SOTERIA:
+            return self._soteria(raw_client_grad_list, extra_auxiliary_info)
+        if t == DEFENSE_WBC:
+            return self._wbc(raw_client_grad_list, extra_auxiliary_info)
         return raw_client_grad_list
+
+    # -- client-side defenses run over the shared-update list ----------------
+    def register_soteria_probe(self, feature_fn: Callable, probe_data) -> None:
+        """Install the representation function + probe batch that Soteria
+        scores sensitivities with (the client-side information the paper
+        assumes).  Without a probe, sensitivities fall back to a
+        delta-magnitude proxy on the defended layer."""
+        self._soteria_probe = (feature_fn, probe_data)
+
+    def _soteria(self, updates: Updates, global_params: Any) -> Updates:
+        a = self.args
+        layer_path = tuple(
+            getattr(a, "soteria_layer", ("classifier", "kernel"))
+        )
+        pct = float(getattr(a, "soteria_percentile", 10.0))
+        probe = getattr(self, "_soteria_probe", None)
+        if probe is not None:
+            feature_fn, xs = probe
+            scores = F.soteria_scores(feature_fn, xs)
+            mask = F.soteria_mask(scores, pct)
+        else:
+            mask = None
+        out = []
+        for n, p in updates:
+            if mask is None:
+                # proxy: per-feature delta magnitude on the defended layer
+                node, gnode = p["params"], global_params["params"]
+                for kpath in layer_path:
+                    node, gnode = node[kpath], gnode[kpath]
+                # per-feature (last-axis) delta magnitude
+                mag = jnp.sqrt(
+                    jnp.sum((node - gnode).reshape(-1, node.shape[-1]) ** 2, axis=0)
+                )
+                m = F.soteria_mask(mag, pct)
+            else:
+                m = mask
+            out.append((n, F.soteria_apply(p, global_params, m, layer_path)))
+        return out
+
+    def _wbc(self, updates: Updates, global_params: Any) -> Updates:
+        a = self.args
+        strength = float(getattr(a, "wbc_strength", 1.0))
+        lr = float(getattr(a, "wbc_lr", 0.1))
+        prev = getattr(self, "_wbc_prev", None) or {}
+        out, new_prev = [], {}
+        for i, (n, p) in enumerate(updates):
+            new_prev[i] = p
+            if i in prev:
+                self._key, sub = jax.random.split(self._key)
+                p = F.wbc_perturb(p, prev[i], sub, strength=strength, lr=lr)
+            out.append((n, p))
+        self._wbc_prev = new_prev
+        return out
 
     def defend_on_aggregation(
         self,

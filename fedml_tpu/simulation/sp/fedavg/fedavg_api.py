@@ -88,6 +88,7 @@ class FedAvgAPI:
         self._setup_clients()
         self.metrics = MetricsLogger(args)
         self.round_times: List[float] = []
+        self.samples_per_round: List[int] = []
 
     def _setup_clients(self):
         for client_idx in range(int(self.args.client_num_per_round)):
@@ -128,16 +129,23 @@ class FedAvgAPI:
             client_indexes = self._client_sampling(round_idx)
             logger.info("round %d: clients %s", round_idx, client_indexes)
             w_locals: List[Tuple[float, Any]] = []
+            attacker = FedMLAttacker.get_instance()
             for slot, idx in enumerate(client_indexes):
                 client = self.client_list[slot]
+                local_data = self.train_data_local_dict[idx]
+                if attacker.is_data_poisoning_attack():
+                    local_data = self._poisoned_copy(idx, local_data, attacker)
                 client.update_local_dataset(
                     idx,
-                    self.train_data_local_dict[idx],
+                    local_data,
                     self.test_data_local_dict[idx],
                     self.train_data_local_num_dict[idx],
                 )
                 w = client.train(self.w_global)
                 w_locals.append((float(client.local_sample_number), w))
+            self.samples_per_round.append(
+                int(sum(n for n, _ in w_locals)) * int(getattr(self.args, "epochs", 1))
+            )
 
             self.w_global = self.server_update(w_locals)
             self.aggregator.set_model_params(self.w_global)
@@ -152,6 +160,24 @@ class FedAvgAPI:
             if round_idx % freq == 0 or round_idx == comm_round - 1:
                 last_metrics = self._test_global(round_idx)
         return last_metrics
+
+    def _poisoned_copy(self, client_idx: int, local_data, attacker) -> Any:
+        """Data-poisoning attacks transform a MALICIOUS client's local set
+        before training (reference wires this in its data loaders; here it's
+        per-round so the clean dict is never mutated).  Edge-case selection
+        gets current-model logits."""
+        import jax.numpy as jnp
+
+        x, y = local_data
+        logits = None
+        from ....core.security.constants import ATTACK_METHOD_EDGE_CASE_BACKDOOR
+
+        if attacker.attack_type == ATTACK_METHOD_EDGE_CASE_BACKDOOR:
+            logits = self.module.apply(self.w_global, jnp.asarray(x), train=False)
+        px, py = attacker.poison_local_data(
+            client_idx, int(self.args.client_num_in_total), x, y, logits=logits
+        )
+        return (px, py)
 
     def checkpoint_state(self) -> Dict[str, Any]:
         """Full server-side state to persist; algorithm subclasses MUST extend

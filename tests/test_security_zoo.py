@@ -1,0 +1,298 @@
+"""Backdoor / edge-case backdoor / DLG attacks and Soteria / WBC defenses
+(reference ``core/security/{attack,defense}``), including paired tests that a
+defense measurably reduces its paired attack's effect."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.security import attack_funcs as A
+from fedml_tpu.core.security import defense_funcs as F
+from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+
+class _Args:
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def _tiny_updates(key, n=8, dim=6, spread=0.01):
+    """n benign updates clustered around ones."""
+    keys = jax.random.split(key, n)
+    return [
+        (10.0, {"params": {"dense": {"kernel": jnp.ones((dim,)) + spread * jax.random.normal(k, (dim,))}}})
+        for k in keys
+    ]
+
+
+def _kernel(update):
+    return update["params"]["dense"]["kernel"]
+
+
+class TestBackdoorAttack:
+    def test_pattern_stamps_and_relabels(self):
+        x = jnp.zeros((10, 8, 8, 3))
+        y = jnp.arange(10) % 5 + 1
+        px, py = A.poison_backdoor(x, y, target_class=0, fraction=0.5,
+                                   key=jax.random.PRNGKey(0), size=3, value=2.8)
+        poisoned = np.flatnonzero(np.asarray(py) == 0)
+        assert len(poisoned) == 5
+        for i in poisoned:
+            assert float(px[i, 0, 0, 0]) == pytest.approx(2.8)
+        clean = np.flatnonzero(np.asarray(py) != 0)
+        for i in clean:
+            assert float(jnp.abs(px[i]).max()) == 0.0
+
+    def test_alie_stays_in_range_but_biases(self):
+        updates = _tiny_updates(jax.random.PRNGKey(1))
+        attacked = A.alie_attack(updates, [0, 1], num_std=1.5)
+        benign = jnp.stack([_kernel(p) for _, p in updates[2:]])
+        mal = _kernel(attacked[0][1])
+        mean, std = benign.mean(0), benign.std(0)
+        # inside mean +/- 2*std of the benign cloud (evades range checks) ...
+        assert bool(jnp.all(jnp.abs(mal - mean) <= 2.0 * std + 1e-6))
+        # ... but consistently below the mean (the bias direction)
+        assert bool(jnp.all(mal <= mean))
+
+    def test_alie_clip_mode_bounds_poisoned_update(self):
+        updates = _tiny_updates(jax.random.PRNGKey(6))
+        # malicious client 0 trained a wildly poisoned update
+        n0, p0 = updates[0]
+        updates[0] = (n0, jax.tree_util.tree_map(lambda t: t + 100.0, p0))
+        attacked = A.alie_attack(updates, [0], num_std=1.5, mode="clip")
+        benign = jnp.stack([_kernel(p) for _, p in updates[1:]])
+        mean, std = benign.mean(0), benign.std(0)
+        mal = _kernel(attacked[0][1])
+        assert bool(jnp.all(mal <= mean + 1.5 * std + 1e-6))
+        # benign clients untouched
+        np.testing.assert_allclose(
+            np.asarray(_kernel(attacked[3][1])), np.asarray(_kernel(updates[3][1]))
+        )
+
+    def test_alie_shifts_mean_vs_trimmed_mean_recovers(self):
+        """Paired: coordinate-wise trimmed mean cuts an aggressive (z=3) ALIE
+        pair's pull on the average.  (At small z ALIE sits inside the benign
+        cloud and evades selection defenses — that is the attack's point.)"""
+        updates = _tiny_updates(jax.random.PRNGKey(2), n=8)
+        attacked = A.alie_attack(updates, [0, 1], num_std=3.0)
+        benign_mean = jnp.stack([_kernel(p) for _, p in updates[2:]]).mean(0)
+        naive_mean = jnp.stack([_kernel(p) for _, p in attacked]).mean(0)
+        def_mean = _kernel(F.coordinate_wise_trimmed_mean(attacked, 0.25))
+        assert float(jnp.linalg.norm(def_mean - benign_mean)) < float(
+            jnp.linalg.norm(naive_mean - benign_mean)
+        )
+
+
+class TestEdgeCaseBackdoor:
+    def test_selects_low_confidence_tail(self):
+        logits = jnp.array([[9.0, 0.0], [0.1, 0.0], [5.0, 0.0], [0.2, 0.1]])
+        idx = np.asarray(A.select_edge_cases(logits, fraction=0.5))
+        assert set(idx.tolist()) == {1, 3}
+
+    def test_poison_edge_cases_relabels_only_tail(self):
+        x = jnp.zeros((4, 2))
+        y = jnp.array([0, 0, 0, 0])
+        logits = jnp.array([[9.0, 0.0], [0.1, 0.0], [5.0, 0.0], [0.2, 0.1]])
+        _, py = A.poison_edge_cases(x, y, logits, target_class=1, fraction=0.5)
+        assert np.asarray(py).tolist() == [0, 1, 0, 1]
+
+    def test_projection_evades_naive_norm_check_but_clipping_defends(self):
+        """Paired: scaled push projected into the eps-ball passes a norm gate;
+        norm_diff_clipping still shrinks its effect on the average."""
+        updates = _tiny_updates(jax.random.PRNGKey(3), n=4)
+        # global model at the benign cluster center: benign deltas are tiny
+        g = jax.tree_util.tree_map(jnp.ones_like, updates[0][1])
+        pushed = A.model_replacement(updates[0][1], g, scale=50.0)
+        proj = A.project_to_norm_ball(pushed, g, eps=3.0)
+        d = jnp.linalg.norm(_kernel(proj) - _kernel(g))
+        assert float(d) <= 3.0 + 1e-4
+        attacked = [(updates[0][0], proj)] + updates[1:]
+        benign_mean = jnp.stack([_kernel(p) for _, p in updates[1:]]).mean(0)
+        naive_mean = jnp.stack([_kernel(p) for _, p in attacked]).mean(0)
+        clipped = F.norm_diff_clipping(attacked, g, norm_bound=0.1)
+        def_mean = jnp.stack([_kernel(p) for _, p in clipped]).mean(0)
+        assert float(jnp.linalg.norm(def_mean - benign_mean)) < float(
+            jnp.linalg.norm(naive_mean - benign_mean)
+        )
+
+    def test_poison_local_data_only_for_malicious(self):
+        att = FedMLAttacker.get_instance()
+        att.init(_Args(enable_attack=True, attack_type="backdoor",
+                       byzantine_client_num=2, target_class=0,
+                       poison_fraction=1.0, random_seed=0))
+        bad = set(att.get_byzantine_idxs(8))
+        good = next(i for i in range(8) if i not in bad)
+        x = jnp.zeros((6, 8, 8, 3))
+        y = jnp.ones((6,), jnp.int32)
+        bx, by = att.poison_local_data(next(iter(bad)), 8, x, y)
+        assert np.asarray(by).tolist() == [0] * 6  # relabeled
+        assert float(jnp.abs(bx).max()) > 0  # trigger stamped
+        gx, gy = att.poison_local_data(good, 8, x, y)
+        assert np.asarray(gy).tolist() == [1] * 6
+        assert float(jnp.abs(gx).max()) == 0.0
+
+    def test_attacker_dispatch_edge_case(self):
+        att = FedMLAttacker.get_instance()
+        att.init(_Args(enable_attack=True, attack_type="edge_case_backdoor",
+                       byzantine_client_num=1, attack_scale=50.0,
+                       attack_norm_bound=2.0, random_seed=0))
+        updates = _tiny_updates(jax.random.PRNGKey(4), n=4)
+        g = jax.tree_util.tree_map(jnp.zeros_like, updates[0][1])
+        out = att.attack_model(updates, g)
+        idx = att.get_byzantine_idxs(4)[0]
+        d = jnp.linalg.norm(_kernel(out[idx][1]) - _kernel(g))
+        assert float(d) <= 2.0 + 1e-4
+
+
+class _TinyNet(nn.Module):
+    features: int = 8
+    classes: int = 4
+
+    def setup(self):
+        self.fc1 = nn.Dense(self.features)
+        self.classifier = nn.Dense(self.classes)
+
+    def representation(self, x):
+        h = x.reshape((x.shape[0], -1)) if x.ndim > 2 else x
+        return nn.relu(self.fc1(h))
+
+    def __call__(self, x, train: bool = False):
+        return self.classifier(self.representation(x))
+
+
+class TestDLGAndSoteria:
+    def _setup(self):
+        model = _TinyNet()
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 6))
+        y = jnp.array([1, 3])
+        variables = model.init(jax.random.PRNGKey(0), x)
+        return model, dict(variables), x, y
+
+    def _client_step(self, model, variables, x, y, lr=0.1):
+        import optax
+
+        def loss(params):
+            logits = model.apply(dict(variables, params=params), x)
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            )
+
+        g = jax.grad(loss)(variables["params"])
+        new = jax.tree_util.tree_map(lambda p, gr: p - lr * gr, variables["params"], g)
+        return dict(variables, params=new)
+
+    def test_dlg_reconstructs_better_than_noise(self):
+        model, variables, x, y = self._setup()
+        client = self._client_step(model, variables, x, y)
+        x_rec, _ = A.dlg_attack(model, variables, client, x.shape, 4,
+                                jax.random.PRNGKey(7), lr_client=0.1,
+                                steps=300, lr_attack=0.05)
+        base = jax.random.normal(jax.random.PRNGKey(8), x.shape)
+
+        def best_match_mse(rec):
+            # permutation-invariant: best assignment of reconstructed rows
+            d = jnp.sum((rec[:, None, :] - x[None, :, :]) ** 2, axis=-1)
+            return float(jnp.minimum(
+                d[0, 0] + d[1, 1], d[0, 1] + d[1, 0]
+            )) / x.size
+
+        assert best_match_mse(x_rec) < best_match_mse(base)
+
+    def test_soteria_degrades_dlg_reconstruction(self):
+        """Paired: pruning the representation-layer delta raises DLG error."""
+        model, variables, x, y = self._setup()
+        client = self._client_step(model, variables, x, y)
+
+        defender = FedMLDefender.get_instance()
+        defender.init(_Args(enable_defense=True, defense_type="soteria",
+                            soteria_percentile=75.0,
+                            soteria_layer=("fc1", "kernel"), random_seed=0))
+        defender.register_soteria_probe(
+            lambda xi: model.apply(variables, xi[None], method=_TinyNet.representation)[0],
+            x,
+        )
+        defended = defender.defend_before_aggregation([(2.0, client)], variables)
+        x_def, _ = A.dlg_attack(model, variables, defended[0][1], x.shape, 4,
+                                jax.random.PRNGKey(7), lr_client=0.1,
+                                steps=300, lr_attack=0.05)
+        x_rec, _ = A.dlg_attack(model, variables, client, x.shape, 4,
+                                jax.random.PRNGKey(7), lr_client=0.1,
+                                steps=300, lr_attack=0.05)
+        mse_plain = float(jnp.mean((x_rec - x) ** 2))
+        mse_def = float(jnp.mean((x_def - x) ** 2))
+        assert mse_def > mse_plain
+
+    def test_attacker_reconstruct_dispatch(self):
+        model, variables, x, y = self._setup()
+        client = self._client_step(model, variables, x, y)
+        att = FedMLAttacker.get_instance()
+        att.init(_Args(enable_attack=True, attack_type="dlg", random_seed=0,
+                       learning_rate=0.1, dlg_steps=50, dlg_lr=0.05))
+        rec = att.reconstruct_data(model, variables, client, x.shape, 4)
+        assert rec is not None and rec[0].shape == x.shape
+
+
+class TestWBC:
+    def test_perturbs_only_persistent_space(self):
+        key = jax.random.PRNGKey(9)
+        prev = {"w": jnp.zeros((6,))}
+        # coords 0-2 moved a lot since last round; 3-5 barely moved
+        update = {"w": jnp.array([5.0, -4.0, 6.0, 1e-4, -1e-4, 0.0])}
+        out = F.wbc_perturb(update, prev, key, strength=1.0, lr=0.1)
+        moved = np.asarray(out["w"]) - np.asarray(update["w"])
+        assert np.allclose(moved[:3], 0.0)  # fast coords untouched
+        assert np.any(moved[3:] != 0.0)  # persistent space perturbed
+
+    def test_defender_dispatch_stateful(self):
+        defender = FedMLDefender.get_instance()
+        defender.init(_Args(enable_defense=True, defense_type="wbc",
+                            wbc_strength=1.0, wbc_lr=0.1, random_seed=0))
+        u1 = _tiny_updates(jax.random.PRNGKey(10), n=3)
+        g = jax.tree_util.tree_map(jnp.zeros_like, u1[0][1])
+        out1 = defender.defend_before_aggregation(u1, g)
+        # round 1: no history yet -> passthrough
+        for (_, a), (_, b) in zip(u1, out1):
+            assert np.allclose(np.asarray(_kernel(a)), np.asarray(_kernel(b)))
+        u2 = _tiny_updates(jax.random.PRNGKey(11), n=3)
+        out2 = defender.defend_before_aggregation(u2, g)
+        changed = any(
+            not np.allclose(np.asarray(_kernel(a)), np.asarray(_kernel(b)))
+            for (_, a), (_, b) in zip(u2, out2)
+        )
+        assert changed  # round 2: perturbation active
+
+    def test_wbc_bounds_hidden_poison_persistence(self):
+        """Paired: a small persistent poison (hiding in slow coordinates) is
+        disrupted by WBC noise while large benign motion is preserved."""
+        key = jax.random.PRNGKey(12)
+        prev = {"w": jnp.ones((100,))}
+        poison = jnp.zeros((100,)).at[:50].set(1e-6)  # persistent tiny push
+        update = {"w": prev["w"] + poison}
+        out = F.wbc_perturb(update, prev, key, strength=1.0, lr=0.1)
+        # the poisoned (slow) coords get noise of magnitude >> the poison
+        delta = np.abs(np.asarray(out["w"]) - np.asarray(update["w"]))[:50]
+        assert np.median(delta) > 1e-3
+
+
+class TestSoteriaMask:
+    def test_mask_zeros_low_sensitivity(self):
+        scores = jnp.array([0.1, 5.0, 3.0, 0.2, 9.0])
+        mask = F.soteria_mask(scores, percentile=40.0)
+        assert np.asarray(mask).tolist() == [0.0, 1.0, 1.0, 0.0, 1.0]
+
+    def test_apply_masks_only_target_layer(self):
+        g = {"params": {"fc1": {"kernel": jnp.zeros((2, 3))},
+                        "classifier": {"kernel": jnp.ones((3, 2))}}}
+        u = {"params": {"fc1": {"kernel": jnp.ones((2, 3))},
+                        "classifier": {"kernel": 2.0 * jnp.ones((3, 2))}}}
+        mask = jnp.array([1.0, 0.0, 1.0])
+        out = F.soteria_apply(u, g, mask, ("fc1", "kernel"))
+        # feature axis (last) masked on the defended layer's delta
+        np.testing.assert_allclose(
+            np.asarray(out["params"]["fc1"]["kernel"])[0], [1.0, 0.0, 1.0]
+        )
+        np.testing.assert_allclose(np.asarray(out["params"]["classifier"]["kernel"]), 2.0)
