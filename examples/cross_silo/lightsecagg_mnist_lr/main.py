@@ -1,0 +1,20 @@
+"""LightSecAgg cross-silo example: dropout-tolerant secure aggregation
+(reference light_sec_agg_example).  Runs the full topology in-process:
+    python main.py --cf fedml_config.yaml
+"""
+import sys
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu.constants import FEDML_TRAINING_PLATFORM_CROSS_SILO
+from fedml_tpu.cross_silo.lightsecagg import run_lightsecagg_topology_in_threads
+
+if __name__ == "__main__":
+    args = load_arguments(FEDML_TRAINING_PLATFORM_CROSS_SILO)
+    args = fedml_tpu.init(args)
+    history = run_lightsecagg_topology_in_threads(
+        args,
+        lambda a: fedml_tpu.data.load(a),
+        lambda a, out_dim: fedml_tpu.models.create(a, out_dim),
+    )
+    print("history:", history)

@@ -32,19 +32,20 @@ def partition_class_samples_with_dirichlet_distribution(
     rng: np.random.RandomState,
 ):
     """Split one class's sample indices across clients ~ Dir(alpha), balancing
-    so no client exceeds N/client_num (reference :87-117)."""
+    so no client exceeds N/client_num (the standard LDA recipe,
+    arXiv:1909.06335; reference :87-117)."""
     rng.shuffle(idx_k)
-    proportions = rng.dirichlet(np.repeat(alpha, client_num))
-    # balance: zero the share of clients already at capacity
-    proportions = np.array(
-        [p * (len(idx_j) < N / client_num) for p, idx_j in zip(proportions, idx_batch)]
-    )
-    proportions = proportions / proportions.sum()
-    proportions = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
-    idx_batch = [
-        idx_j + idx.tolist() for idx_j, idx in zip(idx_batch, np.split(idx_k, proportions))
-    ]
-    min_size = min(len(idx_j) for idx_j in idx_batch)
+    shares = rng.dirichlet(alpha * np.ones(client_num))
+    # capacity-balance: clients already holding >= N/client_num samples are
+    # frozen out of this class's draw, and the rest renormalized
+    sizes = np.array([len(b) for b in idx_batch], dtype=np.float64)
+    shares = np.where(sizes < N / client_num, shares, 0.0)
+    shares /= shares.sum()
+    # convert shares to split points over this class's samples
+    cuts = np.floor(np.cumsum(shares[:-1]) * len(idx_k)).astype(np.int64)
+    for client, chunk in enumerate(np.split(idx_k, cuts)):
+        idx_batch[client] = idx_batch[client] + chunk.tolist()
+    min_size = min(len(b) for b in idx_batch)
     return idx_batch, min_size
 
 

@@ -78,6 +78,7 @@ def test_split_nn():
     assert metrics["test_acc"] > 0.4
 
 
+@pytest.mark.heavy
 def test_fedgan_runs():
     metrics = _run(_args("FedGAN", comm_round=2, client_num_in_total=3,
                          client_num_per_round=2, synthetic_train_size=300))

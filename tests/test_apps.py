@@ -10,6 +10,8 @@ import yaml
 import fedml_tpu
 from fedml_tpu.arguments import Arguments
 
+pytestmark = pytest.mark.heavy  # long XLA compiles; see pytest.ini
+
 APP_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "app")
 
 

@@ -6,6 +6,8 @@ import pytest
 import fedml_tpu
 from fedml_tpu.arguments import Arguments
 
+pytestmark = pytest.mark.heavy  # long XLA compiles; see pytest.ini
+
 
 def _args(optimizer, dataset="cifar10", model="cnn", **over):
     base = {

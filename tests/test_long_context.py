@@ -13,6 +13,8 @@ from fedml_tpu.ops.flash_attention import flash_attention, reference_attention
 from fedml_tpu.parallel.mesh import create_mesh
 from fedml_tpu.parallel.ring_attention import ring_attention
 
+pytestmark = pytest.mark.heavy  # long XLA compiles; see pytest.ini
+
 
 def _qkv(B=2, L=64, H=4, D=16, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)

@@ -7,6 +7,8 @@ import pytest
 
 from fedml_tpu.models import hub
 
+pytestmark = pytest.mark.heavy  # long XLA compiles; see pytest.ini
+
 
 class _Args:
     def __init__(self, model, dataset="cifar10"):

@@ -1,0 +1,30 @@
+"""Fast default-run model smoke (the full model-zoo forward matrix lives in
+test_models.py, marker heavy)."""
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.ml.engine.train import init_variables
+
+
+def test_lr_and_cnn_forward():
+    from fedml_tpu.models.cnn import CNN_DropOut
+    from fedml_tpu.models.linear import LogisticRegression
+
+    x = jnp.zeros((2, 28, 28, 1))
+    for model in (LogisticRegression(output_dim=10), CNN_DropOut(only_digits=True, num_classes=10)):
+        variables = init_variables(model, x, seed=0)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 10)
+
+
+def test_resnet_bf16_params_stay_fp32():
+    from fedml_tpu.models.resnet import resnet20
+
+    model = resnet20(num_classes=10, dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = init_variables(model, x, seed=0)
+    leaves = jax.tree_util.tree_leaves(variables["params"])
+    assert all(l.dtype == jnp.float32 for l in leaves)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)

@@ -54,6 +54,7 @@ class TestSPFedAvg:
         metrics = _run(_args())
         assert metrics["test_acc"] > 0.5  # synthetic mnist is separable; random = 0.1
 
+    @pytest.mark.heavy
     def test_cnn_runs(self):
         args = _args(model="cnn", comm_round=1, client_num_per_round=2, synthetic_train_size=400)
         metrics = _run(args)

@@ -9,6 +9,8 @@ from fedml_tpu.arguments import Arguments
 from fedml_tpu.parallel.mesh import create_fl_mesh
 from fedml_tpu.simulation.xla.fed_sim import XLASimulator
 
+pytestmark = pytest.mark.heavy  # long XLA compiles; see pytest.ini
+
 
 def _args(**over):
     args = Arguments.from_dict(

@@ -19,6 +19,8 @@ from fedml_tpu.ml.engine.train import build_local_train
 from fedml_tpu.parallel.mesh import create_fl_mesh
 from fedml_tpu.simulation.xla.fed_sim import XLASimulator
 
+pytestmark = pytest.mark.heavy  # long XLA compiles; see pytest.ini
+
 N_CLIENTS = 4
 ROUNDS = 2
 

@@ -8,6 +8,8 @@ import pytest
 import fedml_tpu
 from fedml_tpu.arguments import Arguments
 
+pytestmark = pytest.mark.heavy  # long XLA compiles; see pytest.ini
+
 
 def _args(**over):
     base = {
