@@ -127,9 +127,17 @@ class FakeDeviceManager(FedMLCommManager):
             from .. import native
 
             native.build()  # sequential: don't race make across device threads
-            self._data_path = os.path.join(self.upload_dir, "local_data.ftem")
-            x2d = np.asarray(self.x, np.float32).reshape(len(self.x), -1)
-            save_edge_model(self._data_path, {"x": x2d, "y": np.asarray(self.y, np.int32)})
+            # the model family (dense vs conv) is only known when the server
+            # sends the model, so write BOTH layouts up front: flat [n, d]
+            # for dense trainers, original [n, H, W, C] for conv trainers
+            y32 = np.asarray(self.y, np.int32)
+            x = np.asarray(self.x, np.float32)
+            self._data_path_2d = os.path.join(self.upload_dir, "local_data_2d.ftem")
+            save_edge_model(self._data_path_2d, {"x": x.reshape(len(x), -1), "y": y32})
+            self._data_path_4d = None
+            if x.ndim == 4:
+                self._data_path_4d = os.path.join(self.upload_dir, "local_data_4d.ftem")
+                save_edge_model(self._data_path_4d, {"x": x, "y": y32})
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -157,9 +165,13 @@ class FakeDeviceManager(FedMLCommManager):
         if self.use_native:
             from .. import native
 
+            # pick the data layout the received model's family needs
+            model_flat = load_edge_model(model_file)
+            is_conv = any(v.ndim == 4 and k.endswith("/kernel") for k, v in model_flat.items())
+            data_path = self._data_path_4d if (is_conv and self._data_path_4d) else self._data_path_2d
             t = native.EdgeTrainer(
                 model_file,
-                self._data_path,
+                data_path,
                 batch_size=int(getattr(self.args, "batch_size", 32)),
                 lr=float(getattr(self.args, "learning_rate", 0.1)),
                 epochs=int(getattr(self.args, "epochs", 1)),

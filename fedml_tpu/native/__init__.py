@@ -64,6 +64,7 @@ def _load_locked() -> ctypes.CDLL:
 
     lib.fedml_last_error.restype = ctypes.c_char_p
     lib.fedml_mnist_idx_to_ftem.argtypes = [ctypes.c_char_p] * 3 + [ctypes.c_int]
+    lib.fedml_cifar10_bin_to_ftem.argtypes = [ctypes.c_char_p] * 2 + [ctypes.c_int]
 
     lib.fedml_trainer_create.restype = ctypes.c_void_p
     lib.fedml_trainer_create.argtypes = [
@@ -122,6 +123,13 @@ def _check(rc: int) -> None:
 
 def mnist_idx_to_ftem(images: str, labels: str, out: str, limit: int = 0) -> str:
     _check(load().fedml_mnist_idx_to_ftem(images.encode(), labels.encode(), out.encode(), limit))
+    return out
+
+
+def cifar10_bin_to_ftem(bin_path: str, out: str, limit: int = 0) -> str:
+    """CIFAR-10 binary batch -> FTEM {"x": [n,32,32,3] f32, "y": [n] i32}
+    (reference MobileNN/src/MNN/cifar10.cpp role)."""
+    _check(load().fedml_cifar10_bin_to_ftem(bin_path.encode(), out.encode(), limit))
     return out
 
 

@@ -8,7 +8,7 @@
 #include "fedml_edge.hpp"
 
 using fedml::FedMLClientManager;
-using fedml::FedMLDenseTrainer;
+using fedml::FedMLBaseTrainer;
 
 namespace {
 thread_local std::string g_last_error;
@@ -58,12 +58,21 @@ int fedml_mnist_idx_to_ftem(const char* images, const char* labels, const char* 
   });
 }
 
+int fedml_cifar10_bin_to_ftem(const char* bin_path, const char* out, int limit) {
+  return guarded([&] {
+    std::string err;
+    return fedml::cifar10_bin_to_ftem(bin_path, out, limit, err) ? 0 : fail(err);
+  });
+}
+
 // -- trainer (reference FedMLBaseTrainer contract) -------------------------
 void* fedml_trainer_create(const char* model_path, const char* data_path, int batch,
                            double lr, int epochs, unsigned long long seed) {
+  // auto-detects dense vs conv (LeNet-grade) from the model's kernel ranks
   return guarded_ptr([&]() -> void* {
-    auto* t = new FedMLDenseTrainer();
     std::string err;
+    FedMLBaseTrainer* t = fedml::create_trainer(model_path, err);
+    if (!t) { g_last_error = err; return nullptr; }
     if (!t->init(model_path, data_path, batch, lr, epochs, seed, err)) {
       g_last_error = err;
       delete t;
@@ -76,43 +85,43 @@ void* fedml_trainer_create(const char* model_path, const char* data_path, int ba
 typedef void (*fedml_progress_cb)(int epoch, double loss);
 
 void fedml_trainer_set_callback(void* h, fedml_progress_cb cb) {
-  static_cast<FedMLDenseTrainer*>(h)->set_progress_callback(cb);
+  static_cast<FedMLBaseTrainer*>(h)->set_progress_callback(cb);
 }
 
 int fedml_trainer_train(void* h) {
   return guarded([&] {
     std::string err;
-    return static_cast<FedMLDenseTrainer*>(h)->train(err) ? 0 : fail(err);
+    return static_cast<FedMLBaseTrainer*>(h)->train(err) ? 0 : fail(err);
   });
 }
 
 void fedml_trainer_epoch_loss(void* h, int* epoch, double* loss) {
-  auto el = static_cast<FedMLDenseTrainer*>(h)->epoch_and_loss();
+  auto el = static_cast<FedMLBaseTrainer*>(h)->epoch_and_loss();
   *epoch = el.first;
   *loss = el.second;
 }
 
-void fedml_trainer_stop(void* h) { static_cast<FedMLDenseTrainer*>(h)->stop_training(); }
+void fedml_trainer_stop(void* h) { static_cast<FedMLBaseTrainer*>(h)->stop_training(); }
 
 long long fedml_trainer_num_samples(void* h) {
-  return static_cast<FedMLDenseTrainer*>(h)->num_samples();
+  return static_cast<FedMLBaseTrainer*>(h)->num_samples();
 }
 
 int fedml_trainer_save(void* h, const char* out_path) {
   return guarded([&] {
     std::string err;
-    return static_cast<FedMLDenseTrainer*>(h)->save(out_path, err) ? 0 : fail(err);
+    return static_cast<FedMLBaseTrainer*>(h)->save(out_path, err) ? 0 : fail(err);
   });
 }
 
 int fedml_trainer_eval(void* h, double* acc, double* loss) {
   return guarded([&] {
     std::string err;
-    return static_cast<FedMLDenseTrainer*>(h)->evaluate(acc, loss, err) ? 0 : fail(err);
+    return static_cast<FedMLBaseTrainer*>(h)->evaluate(acc, loss, err) ? 0 : fail(err);
   });
 }
 
-void fedml_trainer_destroy(void* h) { delete static_cast<FedMLDenseTrainer*>(h); }
+void fedml_trainer_destroy(void* h) { delete static_cast<FedMLBaseTrainer*>(h); }
 
 // -- LightSecAgg ------------------------------------------------------------
 int fedml_lsa_chunk(int d, int t, int u) { return fedml::lsa::chunk_size(d, t, u); }

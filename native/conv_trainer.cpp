@@ -1,0 +1,343 @@
+// LeNet-grade conv SGD trainer (role of reference MobileNN conv training,
+// android/fedmlsdk/MobileNN/includes/train/FedMLBaseTrainer.h:13-46 and the
+// mnist/cifar10 conv paths in src/MNN/): VALID-padding stride-1 convs with
+// ReLU + 2x2 max-pool, a dense softmax-CE head on the flattened output,
+// per-epoch shuffling, progress callbacks, cooperative stopTraining.
+//
+// Naive double-accumulator loops on purpose: the edge runtime optimizes for
+// portability + exactness, not throughput — the TPU path is the fast path.
+
+#include <algorithm>
+#include <cmath>
+#include <random>
+
+#include "fedml_edge.hpp"
+
+namespace fedml {
+
+namespace {
+
+bool ends_with_(const std::string& s, const std::string& suf) {
+  return s.size() >= suf.size() && s.compare(s.size() - suf.size(), suf.size(), suf) == 0;
+}
+
+std::string bias_of(const std::string& kernel) {
+  return kernel.substr(0, kernel.size() - 6) + "bias";
+}
+
+}  // namespace
+
+bool FedMLConvTrainer::init(const std::string& model_path, const std::string& data_path,
+                            int batch_size, double lr, int epochs, uint64_t seed,
+                            std::string& err) {
+  if (!ftem_read(model_path, model_, err)) return false;
+
+  // collect conv (4-D kernel) and dense (2-D kernel) layers in sorted order
+  std::vector<std::string> conv_k, dense_k;
+  for (const auto& kv : model_) {
+    if (!ends_with_(kv.first, "/kernel")) continue;
+    if (!model_.count(bias_of(kv.first))) {
+      err = "kernel without bias: " + kv.first;
+      return false;
+    }
+    if (kv.second.dims.size() == 4) conv_k.push_back(kv.first);
+    else if (kv.second.dims.size() == 2) dense_k.push_back(kv.first);
+  }
+  if (conv_k.empty()) { err = "conv trainer needs at least one 4-D kernel"; return false; }
+  if (dense_k.empty()) { err = "conv trainer needs a dense head"; return false; }
+  for (const auto& k : conv_k) convs_.push_back({k, bias_of(k)});
+  for (const auto& k : dense_k) dense_.emplace_back(k, bias_of(k));
+
+  // conv chain must link cin(i+1) == cout(i)
+  for (size_t i = 1; i < convs_.size(); ++i) {
+    if (model_.at(convs_[i].kernel).dims[2] != model_.at(convs_[i - 1].kernel).dims[3]) {
+      err = "conv channel chain broken at " + convs_[i].kernel;
+      return false;
+    }
+  }
+  // dense head (name-sorted) must chain din(i+1) == dout(i) — indexing below
+  // assumes it, so a broken chain must fail init, not corrupt memory
+  for (size_t i = 1; i < dense_.size(); ++i) {
+    if (model_.at(dense_[i].first).dims[0] != model_.at(dense_[i - 1].first).dims[1]) {
+      err = "dense head chain broken at " + dense_[i].first +
+            " (layers must chain in name-sorted order)";
+      return false;
+    }
+  }
+
+  TensorMap data;
+  if (!ftem_read(data_path, data, err)) return false;
+  auto xi = data.find("x");
+  auto yi = data.find("y");
+  if (xi == data.end() || yi == data.end() || xi->second.dims.size() != 4 ||
+      xi->second.dtype != 0 || yi->second.dtype != 1 || yi->second.dims.size() != 1) {
+    err = "conv data file needs x [n, H, W, C] f32 and y [n] i32";
+    return false;
+  }
+  num_samples_ = xi->second.dims[0];
+  H_ = xi->second.dims[1];
+  W_ = xi->second.dims[2];
+  C_ = xi->second.dims[3];
+  if (yi->second.dims[0] != (uint32_t)num_samples_) { err = "x and y row counts differ"; return false; }
+  if (model_.at(convs_[0].kernel).dims[2] != (uint32_t)C_) {
+    err = "first conv cin != data channels";
+    return false;
+  }
+  x_ = xi->second.f32;
+  y_ = yi->second.i32;
+
+  // validate spatial chain and dense-head input dim
+  int64_t h = H_, w = W_;
+  for (const auto& c : convs_) {
+    const auto& d = model_.at(c.kernel).dims;
+    h = (h - d[0] + 1) / 2;  // VALID conv then 2x2 pool
+    w = (w - d[1] + 1) / 2;
+    if (h <= 0 || w <= 0) { err = "conv chain shrinks spatial dims below 1"; return false; }
+  }
+  int64_t flat = h * w * model_.at(convs_.back().kernel).dims[3];
+  if (model_.at(dense_.front().first).dims[0] != (uint32_t)flat) {
+    err = "dense head input dim != flattened conv output (" + std::to_string(flat) + ")";
+    return false;
+  }
+  classes_ = model_.at(dense_.back().first).dims[1];
+  for (int64_t i = 0; i < num_samples_; ++i)
+    if (y_[i] < 0 || y_[i] >= classes_) { err = "label out of range"; return false; }
+
+  batch_ = batch_size;
+  lr_ = lr;
+  epochs_ = epochs;
+  seed_ = seed;
+  return true;
+}
+
+bool FedMLConvTrainer::forward_backward(const std::vector<int64_t>& rows, bool update,
+                                        double* loss_sum, int64_t* correct,
+                                        std::string& err) {
+  (void)err;
+  const int64_t bs = (int64_t)rows.size();
+  const int nc = (int)convs_.size();
+  const int nd = (int)dense_.size();
+
+  // per-conv-stage buffers (index 0 = input)
+  std::vector<std::vector<double>> act(nc + 1);      // pooled outputs per stage
+  std::vector<std::vector<double>> pre(nc);          // pre-pool ReLU outputs
+  std::vector<std::vector<int64_t>> argmax(nc);      // pool argmax flat index
+  std::vector<int64_t> hs(nc + 1), ws(nc + 1), cs(nc + 1);
+  hs[0] = H_; ws[0] = W_; cs[0] = C_;
+
+  act[0].resize(bs * H_ * W_ * C_);
+  for (int64_t i = 0; i < bs; ++i)
+    for (int64_t j = 0; j < H_ * W_ * C_; ++j)
+      act[0][i * H_ * W_ * C_ + j] = x_[rows[i] * H_ * W_ * C_ + j];
+
+  // ---- conv forward ----
+  for (int s = 0; s < nc; ++s) {
+    const Tensor& K = model_.at(convs_[s].kernel);
+    const Tensor& B = model_.at(convs_[s].bias);
+    int64_t kh = K.dims[0], kw = K.dims[1], ci = K.dims[2], co = K.dims[3];
+    int64_t oh = hs[s] - kh + 1, ow = ws[s] - kw + 1;
+    int64_t ph = oh / 2, pw = ow / 2;
+    hs[s + 1] = ph; ws[s + 1] = pw; cs[s + 1] = co;
+    pre[s].assign(bs * oh * ow * co, 0.0);
+    for (int64_t i = 0; i < bs; ++i) {
+      const double* in = &act[s][i * hs[s] * ws[s] * ci];
+      double* out = &pre[s][i * oh * ow * co];
+      for (int64_t oy = 0; oy < oh; ++oy)
+        for (int64_t ox = 0; ox < ow; ++ox)
+          for (int64_t c = 0; c < co; ++c) {
+            double acc = B.f32[c];
+            for (int64_t ky = 0; ky < kh; ++ky)
+              for (int64_t kx = 0; kx < kw; ++kx) {
+                const double* irow = &in[((oy + ky) * ws[s] + (ox + kx)) * ci];
+                const float* krow = &K.f32[((ky * kw + kx) * ci) * co + c];
+                for (int64_t z = 0; z < ci; ++z) acc += irow[z] * krow[z * co];
+              }
+            out[(oy * ow + ox) * co + c] = std::max(acc, 0.0);  // ReLU
+          }
+    }
+    // 2x2 max-pool, stride 2 (record argmax for backward)
+    act[s + 1].assign(bs * ph * pw * co, 0.0);
+    argmax[s].assign(bs * ph * pw * co, 0);
+    for (int64_t i = 0; i < bs; ++i)
+      for (int64_t py = 0; py < ph; ++py)
+        for (int64_t px = 0; px < pw; ++px)
+          for (int64_t c = 0; c < co; ++c) {
+            double best = -1.0;
+            int64_t best_idx = 0;
+            for (int64_t dy = 0; dy < 2; ++dy)
+              for (int64_t dx = 0; dx < 2; ++dx) {
+                int64_t idx = (i * oh + (py * 2 + dy)) * ow + (px * 2 + dx);
+                double v = pre[s][idx * co + c];
+                if (v > best) { best = v; best_idx = idx; }
+              }
+            act[s + 1][((i * ph + py) * pw + px) * co + c] = best;
+            argmax[s][((i * ph + py) * pw + px) * co + c] = best_idx;
+          }
+  }
+
+  // ---- dense forward (on flattened act[nc]) ----
+  int64_t flat = hs[nc] * ws[nc] * cs[nc];
+  std::vector<std::vector<double>> dact(nd + 1);
+  dact[0] = act[nc];  // already row-major [bs, flat]
+  for (int li = 0; li < nd; ++li) {
+    const Tensor& Wt = model_.at(dense_[li].first);
+    const Tensor& bt = model_.at(dense_[li].second);
+    int64_t din = Wt.dims[0], dout = Wt.dims[1];
+    dact[li + 1].assign(bs * dout, 0.0);
+    for (int64_t i = 0; i < bs; ++i) {
+      for (int64_t k = 0; k < din; ++k) {
+        double a = dact[li][i * din + k];
+        if (a == 0.0) continue;
+        const float* wrow = &Wt.f32[k * dout];
+        double* orow = &dact[li + 1][i * dout];
+        for (int64_t j = 0; j < dout; ++j) orow[j] += a * wrow[j];
+      }
+      for (int64_t j = 0; j < dout; ++j) {
+        double z = dact[li + 1][i * dout + j] + bt.f32[j];
+        dact[li + 1][i * dout + j] = (li < nd - 1) ? std::max(z, 0.0) : z;
+      }
+    }
+  }
+
+  // ---- softmax CE ----
+  std::vector<double> g(bs * classes_);
+  for (int64_t i = 0; i < bs; ++i) {
+    double* logit = &dact[nd][i * classes_];
+    double mx = logit[0];
+    for (int64_t j = 1; j < classes_; ++j) mx = std::max(mx, logit[j]);
+    double sum = 0.0;
+    for (int64_t j = 0; j < classes_; ++j) sum += std::exp(logit[j] - mx);
+    int32_t lab = y_[rows[i]];
+    if (loss_sum) *loss_sum += -(logit[lab] - mx - std::log(sum));
+    if (correct) {
+      int64_t arg = 0;
+      for (int64_t j = 1; j < classes_; ++j) if (logit[j] > logit[arg]) arg = j;
+      if (arg == lab) ++*correct;
+    }
+    for (int64_t j = 0; j < classes_; ++j)
+      g[i * classes_ + j] = (std::exp(logit[j] - mx) / sum - (j == lab ? 1.0 : 0.0)) / bs;
+  }
+  if (!update) return true;
+
+  // ---- dense backward + SGD ----
+  for (int li = nd - 1; li >= 0; --li) {
+    Tensor& Wt = model_.at(dense_[li].first);
+    Tensor& bt = model_.at(dense_[li].second);
+    int64_t din = Wt.dims[0], dcur = Wt.dims[1];
+    std::vector<double> gprev(bs * din, 0.0);
+    for (int64_t i = 0; i < bs; ++i)
+      for (int64_t k = 0; k < din; ++k) {
+        double acc = 0.0;
+        const float* wrow = &Wt.f32[k * dcur];
+        for (int64_t j = 0; j < dcur; ++j) acc += g[i * dcur + j] * wrow[j];
+        // ReLU mask (layer 0's input is the pooled conv output — its
+        // gradient flows through the pool, masked at the conv ReLU below)
+        gprev[i * din + k] = (li > 0 && dact[li][i * din + k] <= 0.0) ? 0.0 : acc;
+      }
+    for (int64_t k = 0; k < din; ++k) {
+      float* wrow = &Wt.f32[k * dcur];
+      for (int64_t j = 0; j < dcur; ++j) {
+        double gw = 0.0;
+        for (int64_t i = 0; i < bs; ++i) gw += dact[li][i * din + k] * g[i * dcur + j];
+        wrow[j] -= (float)(lr_ * gw);
+      }
+    }
+    for (int64_t j = 0; j < dcur; ++j) {
+      double gb = 0.0;
+      for (int64_t i = 0; i < bs; ++i) gb += g[i * dcur + j];
+      bt.f32[j] -= (float)(lr_ * gb);
+    }
+    g.swap(gprev);
+  }
+
+  // ---- conv backward (g is now grad wrt flattened act[nc]) ----
+  for (int s = nc - 1; s >= 0; --s) {
+    Tensor& K = model_.at(convs_[s].kernel);
+    Tensor& B = model_.at(convs_[s].bias);
+    int64_t kh = K.dims[0], kw = K.dims[1], ci = K.dims[2], co = K.dims[3];
+    int64_t oh = hs[s] - kh + 1, ow = ws[s] - kw + 1;
+    int64_t ph = hs[s + 1], pw = ws[s + 1];
+    // un-pool: route pooled grads to the argmax positions of pre[s]
+    std::vector<double> gpre(bs * oh * ow * co, 0.0);
+    for (int64_t i = 0; i < bs; ++i)
+      for (int64_t py = 0; py < ph; ++py)
+        for (int64_t px = 0; px < pw; ++px)
+          for (int64_t c = 0; c < co; ++c) {
+            int64_t pidx = ((i * ph + py) * pw + px) * co + c;
+            double gv = g[pidx];
+            if (gv == 0.0) continue;
+            // ReLU mask on the pre-pool activation
+            if (pre[s][argmax[s][pidx] * co + c] > 0.0)
+              gpre[argmax[s][pidx] * co + c] += gv;
+          }
+    // grads wrt kernel/bias/input
+    std::vector<double> gin;
+    if (s > 0) gin.assign(bs * hs[s] * ws[s] * ci, 0.0);
+    std::vector<double> gK(kh * kw * ci * co, 0.0), gB(co, 0.0);
+    for (int64_t i = 0; i < bs; ++i) {
+      const double* in = &act[s][i * hs[s] * ws[s] * ci];
+      for (int64_t oy = 0; oy < oh; ++oy)
+        for (int64_t ox = 0; ox < ow; ++ox)
+          for (int64_t c = 0; c < co; ++c) {
+            double gv = gpre[((i * oh + oy) * ow + ox) * co + c];
+            if (gv == 0.0) continue;
+            gB[c] += gv;
+            for (int64_t ky = 0; ky < kh; ++ky)
+              for (int64_t kx = 0; kx < kw; ++kx) {
+                const double* irow = &in[((oy + ky) * ws[s] + (ox + kx)) * ci];
+                for (int64_t z = 0; z < ci; ++z) {
+                  gK[((ky * kw + kx) * ci + z) * co + c] += irow[z] * gv;
+                  if (s > 0)
+                    gin[(i * hs[s] * ws[s] + (oy + ky) * ws[s] + (ox + kx)) * ci + z] +=
+                        K.f32[((ky * kw + kx) * ci + z) * co + c] * gv;
+                }
+              }
+          }
+    }
+    for (size_t j = 0; j < gK.size(); ++j) K.f32[j] -= (float)(lr_ * gK[j]);
+    for (int64_t c = 0; c < co; ++c) B.f32[c] -= (float)(lr_ * gB[c]);
+    if (s > 0) g.swap(gin);
+  }
+  return true;
+}
+
+bool FedMLConvTrainer::train(std::string& err) {
+  std::mt19937_64 rng(seed_);
+  std::vector<int64_t> order(num_samples_);
+  for (int64_t i = 0; i < num_samples_; ++i) order[i] = i;
+  for (int e = 0; e < epochs_ && !stop_requested_; ++e) {
+    std::shuffle(order.begin(), order.end(), rng);
+    double loss_sum = 0.0;
+    int64_t seen = 0;
+    for (int64_t s = 0; s < num_samples_ && !stop_requested_; s += batch_) {
+      int64_t bs = std::min<int64_t>(batch_, num_samples_ - s);
+      std::vector<int64_t> rows(order.begin() + s, order.begin() + s + bs);
+      if (!forward_backward(rows, /*update=*/true, &loss_sum, nullptr, err)) return false;
+      seen += bs;
+    }
+    loss_ = seen ? loss_sum / seen : 0.0;
+    epoch_ = e + 1;
+    if (progress_cb_) progress_cb_(e + 1, loss_);
+  }
+  return true;
+}
+
+bool FedMLConvTrainer::evaluate(double* acc, double* loss, std::string& err) {
+  double loss_sum = 0.0;
+  int64_t correct = 0;
+  for (int64_t s = 0; s < num_samples_; s += batch_) {
+    int64_t bs = std::min<int64_t>(batch_, num_samples_ - s);
+    std::vector<int64_t> rows(bs);
+    for (int64_t i = 0; i < bs; ++i) rows[i] = s + i;
+    if (!forward_backward(rows, /*update=*/false, &loss_sum, &correct, err)) return false;
+  }
+  *acc = num_samples_ ? (double)correct / num_samples_ : 0.0;
+  *loss = num_samples_ ? loss_sum / num_samples_ : 0.0;
+  return true;
+}
+
+bool FedMLConvTrainer::save(const std::string& out_path, std::string& err) {
+  return ftem_write(out_path, model_, err);
+}
+
+}  // namespace fedml

@@ -15,6 +15,7 @@
 #include <atomic>
 #include <cstdint>
 #include <map>
+#include <memory>
 #include <string>
 #include <utility>
 #include <vector>
@@ -42,6 +43,12 @@ bool ftem_write(const std::string& path, const TensorMap& tensors, std::string& 
 bool mnist_idx_to_ftem(const std::string& images_path, const std::string& labels_path,
                        const std::string& out_path, int limit, std::string& err);
 
+// CIFAR-10 binary batch (1 label byte + 3072 RGB-plane bytes per record) ->
+// FTEM {"x": [n, 32, 32, 3] f32 in [0,1] NHWC, "y": [n] i32} (role of
+// reference MobileNN/src/MNN/cifar10.cpp). limit <= 0 means all.
+bool cifar10_bin_to_ftem(const std::string& bin_path, const std::string& out_path,
+                         int limit, std::string& err);
+
 // ---------------------------------------------------------------------------
 // Trainer (reference FedMLBaseTrainer contract)
 // ---------------------------------------------------------------------------
@@ -67,13 +74,23 @@ class FedMLBaseTrainer {
   void set_progress_callback(ProgressCallback cb) { progress_cb_ = cb; }
   int64_t num_samples() const { return num_samples_; }
 
+  // flatten trained params in name-sorted order (the masking order the
+  // Python side uses: sorted(flat) — edge_model.py writes sorted too)
+  std::vector<float> flat_params() const;
+  int64_t flat_size() const;
+
  protected:
   std::atomic<int> epoch_{0};
   std::atomic<double> loss_{0.0};
   std::atomic<bool> stop_requested_{false};
   ProgressCallback progress_cb_ = nullptr;
   int64_t num_samples_ = 0;
+  TensorMap model_;
 };
+
+// Factory: picks FedMLConvTrainer when the model has any 4-D kernel, else
+// FedMLDenseTrainer.  Returns nullptr + err on a malformed model file.
+FedMLBaseTrainer* create_trainer(const std::string& model_path, std::string& err);
 
 // Dense-stack (LR / MLP) softmax-CE SGD trainer — the edge model family
 // (reference MobileNN trains LeNet-class models; dense stacks are the FTEM
@@ -87,18 +104,44 @@ class FedMLDenseTrainer : public FedMLBaseTrainer {
   bool save(const std::string& out_path, std::string& err) override;
   bool evaluate(double* acc, double* loss, std::string& err) override;
 
-  // flatten trained params in name-sorted order (the masking order the
-  // Python side uses: sorted(flat) — edge_model.py writes sorted too)
-  std::vector<float> flat_params() const;
-  int64_t flat_size() const;
-
  private:
-  TensorMap model_;
   // chained dense layers: indices into names
   std::vector<std::pair<std::string, std::string>> layers_;  // (kernel, bias)
   std::vector<float> x_;  // [n, d] row-major
   std::vector<int32_t> y_;
   int64_t dim_ = 0, classes_ = 0;
+  int batch_ = 32, epochs_ = 1;
+  double lr_ = 0.01;
+  uint64_t seed_ = 0;
+};
+
+// LeNet-grade conv trainer (role of reference MobileNN's conv graphs,
+// includes/train/FedMLBaseTrainer.h:13-46 + src/MNN/{mnist,cifar10}.cpp).
+// Model convention (inferred from the FTEM tensor map, name-sorted):
+//   * 4-D kernels [kh, kw, cin, cout] (flax NHWC Conv layout) + "/bias":
+//     conv blocks — VALID padding, stride 1, ReLU, then 2x2 max-pool —
+//     chained by cin(i+1) == cout(i);
+//   * 2-D kernels: the dense head on the flattened (H*W*C row-major) conv
+//     output, ReLU between layers, softmax-CE at the end.
+// Data: x must be [n, H, W, C] f32, y [n] i32.
+class FedMLConvTrainer : public FedMLBaseTrainer {
+ public:
+  bool init(const std::string& model_path, const std::string& data_path,
+            int batch_size, double lr, int epochs, uint64_t seed,
+            std::string& err) override;
+  bool train(std::string& err) override;
+  bool save(const std::string& out_path, std::string& err) override;
+  bool evaluate(double* acc, double* loss, std::string& err) override;
+
+ private:
+  struct ConvLayer { std::string kernel, bias; };
+  bool forward_backward(const std::vector<int64_t>& batch_rows, bool update,
+                        double* loss_sum, int64_t* correct, std::string& err);
+  std::vector<ConvLayer> convs_;
+  std::vector<std::pair<std::string, std::string>> dense_;  // (kernel, bias)
+  std::vector<float> x_;  // [n, H, W, C]
+  std::vector<int32_t> y_;
+  int64_t H_ = 0, W_ = 0, C_ = 0, classes_ = 0;
   int batch_ = 32, epochs_ = 1;
   double lr_ = 0.01;
   uint64_t seed_ = 0;
@@ -171,10 +214,10 @@ class FedMLClientManager {
   std::vector<int64_t> encode_mask(int n, int t, int u, uint64_t mask_seed,
                                    std::string& err);
 
-  FedMLDenseTrainer& trainer() { return trainer_; }
+  FedMLBaseTrainer& trainer() { return *trainer_; }
 
  private:
-  FedMLDenseTrainer trainer_;
+  std::unique_ptr<FedMLBaseTrainer> trainer_;  // dense or conv (create_trainer)
   int64_t mask_dim_ = 0;
 };
 

@@ -127,4 +127,44 @@ bool mnist_idx_to_ftem(const std::string& images_path, const std::string& labels
   return ftem_write(out_path, out, err);
 }
 
+// -- CIFAR-10 binary --------------------------------------------------------
+// data_batch_N.bin record layout: 1 label byte, then 3072 bytes as three
+// 1024-byte color planes (R, G, B) of a 32x32 image, row-major.  Output is
+// NHWC [n, 32, 32, 3] f32 in [0,1] — the layout the conv trainer and the
+// flax models consume.
+
+bool cifar10_bin_to_ftem(const std::string& bin_path, const std::string& out_path,
+                         int limit, std::string& err) {
+  constexpr uint32_t kHW = 32, kPlane = kHW * kHW, kRec = 1 + 3 * kPlane;
+  FILE* f = fopen(bin_path.c_str(), "rb");
+  if (!f) { err = "cannot open " + bin_path; return false; }
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (sz <= 0 || sz % kRec != 0) {
+    err = "not a CIFAR-10 binary batch (size % 3073 != 0)";
+    fclose(f);
+    return false;
+  }
+  uint32_t n = (uint32_t)(sz / kRec);
+  if (limit > 0 && (uint32_t)limit < n) n = (uint32_t)limit;
+
+  Tensor x, y;
+  x.dtype = 0; x.dims = {n, kHW, kHW, 3}; x.f32.resize((size_t)n * kPlane * 3);
+  y.dtype = 1; y.dims = {n}; y.i32.resize(n);
+  std::vector<unsigned char> rec(kRec);
+  for (uint32_t i = 0; i < n; ++i) {
+    if (!read_exact(f, rec.data(), kRec)) { err = "truncated batch"; fclose(f); return false; }
+    y.i32[i] = rec[0];
+    for (uint32_t p = 0; p < kPlane; ++p)
+      for (uint32_t c = 0; c < 3; ++c)  // planes -> interleaved NHWC
+        x.f32[((size_t)i * kPlane + p) * 3 + c] = rec[1 + c * kPlane + p] / 255.0f;
+  }
+  fclose(f);
+  TensorMap out;
+  out["x"] = std::move(x);
+  out["y"] = std::move(y);
+  return ftem_write(out_path, out, err);
+}
+
 }  // namespace fedml

@@ -158,15 +158,17 @@ std::vector<double> dequantize(const std::vector<int64_t>& z, int q_bits, int64_
 bool FedMLClientManager::init(const std::string& model_path, const std::string& data_path,
                               int batch_size, double lr, int epochs, uint64_t seed,
                               std::string& err) {
-  if (!trainer_.init(model_path, data_path, batch_size, lr, epochs, seed, err)) return false;
-  mask_dim_ = trainer_.flat_size();
+  trainer_.reset(create_trainer(model_path, err));  // dense or conv
+  if (!trainer_) return false;
+  if (!trainer_->init(model_path, data_path, batch_size, lr, epochs, seed, err)) return false;
+  mask_dim_ = trainer_->flat_size();
   return true;
 }
 
-bool FedMLClientManager::train(std::string& err) { return trainer_.train(err); }
+bool FedMLClientManager::train(std::string& err) { return trainer_->train(err); }
 
 bool FedMLClientManager::save_model(const std::string& out_path, std::string& err) {
-  return trainer_.save(out_path, err);
+  return trainer_->save(out_path, err);
 }
 
 static std::vector<int64_t> local_mask(int64_t dim, uint64_t mask_seed) {
@@ -179,7 +181,7 @@ static std::vector<int64_t> local_mask(int64_t dim, uint64_t mask_seed) {
 
 bool FedMLClientManager::save_masked_model(int q_bits, uint64_t mask_seed,
                                            const std::string& out_path, std::string& err) {
-  auto flat = trainer_.flat_params();
+  auto flat = trainer_->flat_params();
   auto z = lsa::quantize(flat, q_bits);
   auto mask = local_mask((int64_t)z.size(), mask_seed);
   Tensor masked;
@@ -191,7 +193,7 @@ bool FedMLClientManager::save_masked_model(int q_bits, uint64_t mask_seed,
   Tensor ns;
   ns.dtype = 1;
   ns.dims = {1};
-  ns.i32 = {(int32_t)trainer_.num_samples()};
+  ns.i32 = {(int32_t)trainer_->num_samples()};
   TensorMap out;
   out["masked_params"] = std::move(masked);
   out["num_samples"] = std::move(ns);

@@ -231,7 +231,7 @@ bool FedMLDenseTrainer::save(const std::string& out_path, std::string& err) {
   return ftem_write(out_path, model_, err);
 }
 
-std::vector<float> FedMLDenseTrainer::flat_params() const {
+std::vector<float> FedMLBaseTrainer::flat_params() const {
   std::vector<float> out;
   for (const auto& kv : model_)  // sorted-name order == Python sorted(flat)
     if (kv.second.dtype == 0)
@@ -239,11 +239,20 @@ std::vector<float> FedMLDenseTrainer::flat_params() const {
   return out;
 }
 
-int64_t FedMLDenseTrainer::flat_size() const {
+int64_t FedMLBaseTrainer::flat_size() const {
   int64_t n = 0;
   for (const auto& kv : model_)
     if (kv.second.dtype == 0) n += (int64_t)kv.second.f32.size();
   return n;
+}
+
+FedMLBaseTrainer* create_trainer(const std::string& model_path, std::string& err) {
+  TensorMap probe;
+  if (!ftem_read(model_path, probe, err)) return nullptr;
+  for (const auto& kv : probe)
+    if (ends_with(kv.first, "/kernel") && kv.second.dims.size() == 4)
+      return new FedMLConvTrainer();
+  return new FedMLDenseTrainer();
 }
 
 }  // namespace fedml

@@ -1,0 +1,199 @@
+"""Native conv (LeNet-grade) edge trainer — reference MobileNN conv parity
+(android/fedmlsdk/MobileNN/src/MNN/{mnist,cifar10}.cpp): conv training in
+C++, CIFAR-10 binary reader, and a cross-device e2e round with a conv model."""
+
+import struct
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fedml_tpu.cross_device.edge_model import load_edge_model, save_edge_model
+
+native = pytest.importorskip("fedml_tpu.native")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    return native.load()
+
+
+H = W = 12
+CLASSES = 4
+
+
+class LeNetTiny(nn.Module):
+    """Mirrors the native conv convention: VALID conv + ReLU + 2x2 max-pool,
+    flatten (row-major HWC), dense softmax head."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(6, (5, 5), padding="VALID", name="conv0")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(CLASSES, name="head")(x)
+
+
+def _conv_data(n, seed=0):
+    """Images with a class-dependent bright quadrant — conv-learnable."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, CLASSES, n).astype(np.int32)
+    x = rng.rand(n, H, W, 1).astype(np.float32) * 0.1
+    qy, qx = y // 2, y % 2
+    for i in range(n):
+        x[i, qy[i] * 6:qy[i] * 6 + 6, qx[i] * 6:qx[i] * 6 + 6, 0] += 0.9
+    return x, y
+
+
+def _save_flax_model(path, variables):
+    from fedml_tpu.cross_device.edge_model import flatten_params
+
+    save_edge_model(path, flatten_params(variables))
+    return path
+
+
+def _init_model(seed=0):
+    model = LeNetTiny()
+    variables = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, H, W, 1)))
+    return model, dict(variables)
+
+
+class TestConvTrainer:
+    def test_learns(self, lib, tmp_path):
+        x, y = _conv_data(256)
+        data = str(tmp_path / "d.ftem")
+        save_edge_model(data, {"x": x, "y": y})
+        model, variables = _init_model()
+        mpath = _save_flax_model(str(tmp_path / "m.ftem"), variables)
+        t = native.EdgeTrainer(mpath, data, batch_size=32, lr=0.1, epochs=8, seed=1)
+        t.train()
+        acc, loss = t.evaluate()
+        assert acc > 0.8, (acc, loss)
+        t.close()
+
+    def test_one_step_matches_flax(self, lib, tmp_path):
+        """One full-batch SGD step in C++ == the same step in flax/optax —
+        verifies the hand-written conv/pool backward against autodiff."""
+        x, y = _conv_data(32, seed=3)
+        data = str(tmp_path / "d.ftem")
+        save_edge_model(data, {"x": x, "y": y})
+        model, variables = _init_model(seed=2)
+        mpath = _save_flax_model(str(tmp_path / "m.ftem"), variables)
+
+        lr = 0.05
+        t = native.EdgeTrainer(mpath, data, batch_size=64, lr=lr, epochs=1, seed=1)
+        t.train()
+        out = str(tmp_path / "trained.ftem")
+        t.save(out)
+        t.close()
+        got = load_edge_model(out)
+
+        def loss_fn(params):
+            logits = model.apply(dict(variables, params=params), jnp.asarray(x))
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, jnp.asarray(y))
+            )
+
+        grads = jax.grad(loss_fn)(variables["params"])
+        expect = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, variables["params"], grads
+        )
+        from fedml_tpu.cross_device.edge_model import flatten_params
+
+        flat_expect = flatten_params({"params": expect})
+        for k, v in flat_expect.items():
+            np.testing.assert_allclose(got[k], v, rtol=2e-4, atol=2e-5, err_msg=k)
+
+    def test_bad_conv_model_fails_loud(self, lib, tmp_path):
+        # dense head input dim mismatched with the conv chain
+        x, y = _conv_data(8)
+        data = str(tmp_path / "d.ftem")
+        save_edge_model(data, {"x": x, "y": y})
+        rng = np.random.RandomState(0)
+        save_edge_model(str(tmp_path / "bad.ftem"), {
+            "params/conv0/kernel": rng.randn(5, 5, 1, 6).astype(np.float32) * 0.1,
+            "params/conv0/bias": np.zeros(6, np.float32),
+            "params/head/kernel": rng.randn(37, CLASSES).astype(np.float32),
+            "params/head/bias": np.zeros(CLASSES, np.float32),
+        })
+        with pytest.raises(RuntimeError, match="dense head input dim"):
+            native.EdgeTrainer(str(tmp_path / "bad.ftem"), data, 8, 0.1, 1, 0)
+
+
+class TestCifarReader:
+    def test_bin_to_ftem(self, lib, tmp_path):
+        n = 7
+        rng = np.random.RandomState(5)
+        labels = rng.randint(0, 10, n).astype(np.uint8)
+        planes = rng.randint(0, 256, (n, 3, 32, 32)).astype(np.uint8)
+        bin_path = str(tmp_path / "data_batch_1.bin")
+        with open(bin_path, "wb") as f:
+            for i in range(n):
+                f.write(struct.pack("B", labels[i]))
+                f.write(planes[i].tobytes())
+        out = native.cifar10_bin_to_ftem(bin_path, str(tmp_path / "c.ftem"))
+        got = load_edge_model(out)
+        assert got["x"].shape == (n, 32, 32, 3)
+        assert got["y"].tolist() == labels.tolist()
+        # NHWC interleave of the RGB planes, scaled to [0,1]
+        np.testing.assert_allclose(
+            got["x"][0, 1, 2, 0], planes[0, 0, 1, 2] / 255.0, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            got["x"][0, 1, 2, 2], planes[0, 2, 1, 2] / 255.0, rtol=1e-6
+        )
+
+    def test_truncated_bin_rejected(self, lib, tmp_path):
+        bad = str(tmp_path / "bad.bin")
+        open(bad, "wb").write(b"\x00" * 100)
+        with pytest.raises(RuntimeError, match="CIFAR-10"):
+            native.cifar10_bin_to_ftem(bad, str(tmp_path / "c.ftem"))
+
+
+class TestConvCrossDevice:
+    def test_round_with_native_conv_devices(self, lib, tmp_path):
+        """Beehive round where the devices train a CONV model in C++
+        (VERDICT item: fake-device e2e round-tripping a conv model)."""
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+        from fedml_tpu.cross_device.fake_device import FakeDeviceManager
+        from fedml_tpu.cross_device.fedml_aggregator import FedMLAggregator
+        from fedml_tpu.cross_device.fedml_server_manager import FedMLServerManager
+
+        LoopbackHub.reset()
+        args = Arguments.from_dict(
+            {
+                "common_args": {"training_type": "cross_device", "random_seed": 0,
+                                "run_id": "native-conv"},
+                "data_args": {"dataset": "synthetic"},
+                "model_args": {"model": "lenet_tiny"},
+                "train_args": {
+                    "federated_optimizer": "FedAvg",
+                    "client_num_in_total": 2,
+                    "client_num_per_round": 2,
+                    "comm_round": 2,
+                    "epochs": 4,
+                    "batch_size": 32,
+                    "learning_rate": 0.1,
+                },
+                "validation_args": {"frequency_of_the_test": 1},
+                "comm_args": {"backend": "LOOPBACK"},
+            }
+        ).validate()
+        x_test, y_test = _conv_data(128, seed=9)
+        aggregator = FedMLAggregator(args, LeNetTiny(), (x_test, y_test),
+                                     worker_num=2, model_dir=str(tmp_path / "models"))
+        server = FedMLServerManager(args, aggregator, client_rank=0, client_num=2)
+        devices = [
+            FakeDeviceManager(args, r, _conv_data(192, seed=r), client_num=2,
+                              upload_dir=str(tmp_path / f"dev{r}"), use_native=True)
+            for r in (1, 2)
+        ]
+        threads = [server.run_async()] + [d.run_async() for d in devices]
+        for t in threads:
+            t.join(timeout=120)
+        assert all(not t.is_alive() for t in threads)
+        assert aggregator.eval_history[-1]["test_acc"] > 0.6
