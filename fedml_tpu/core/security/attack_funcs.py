@@ -212,9 +212,10 @@ def select_edge_cases(
 ) -> jnp.ndarray:
     """Indices of the tail samples — lowest max-softmax confidence — the
     'edge cases' whose poisoning is hardest to detect (they sit in a region
-    the benign distribution barely covers)."""
+    the benign distribution barely covers).  fraction=0 selects none (so an
+    'attack disabled' ablation really is a no-op)."""
     conf = jnp.max(jax.nn.softmax(logits, axis=-1), axis=-1)
-    k = max(int(conf.shape[0] * float(fraction)), 1)
+    k = int(conf.shape[0] * float(fraction))
     return jnp.argsort(conf)[:k]
 
 

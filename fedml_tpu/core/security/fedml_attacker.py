@@ -62,6 +62,7 @@ class FedMLAttacker:
         self.is_enabled = True
         self.attack_type = str(args.attack_type).strip()
         self._key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 2027)
+        self._round_clients = None
         logger.info("attack enabled: %s", self.attack_type)
 
     def is_attack_enabled(self) -> bool:
@@ -78,13 +79,29 @@ class FedMLAttacker:
         rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)))
         return sorted(rng.choice(num_clients, size=min(k, num_clients), replace=False).tolist())
 
+    def set_round_clients(self, client_ids) -> None:
+        """Round loops call this with the round's sampled POPULATION client
+        ids (in collection order) so the model-side attack corrupts the same
+        clients the data-side poisoning targeted.  Without it, attack_model
+        falls back to drawing slot positions — only correct under full
+        participation."""
+        self._round_clients = [int(c) for c in client_ids]
+
+    def _malicious_slots(self, n_slots: int) -> List[int]:
+        round_ids = getattr(self, "_round_clients", None)
+        if round_ids is not None and len(round_ids) == n_slots:
+            total = int(getattr(self.args, "client_num_in_total", n_slots))
+            bad = set(self.get_byzantine_idxs(total))
+            return [slot for slot, cid in enumerate(round_ids) if cid in bad]
+        return self.get_byzantine_idxs(n_slots)
+
     # -- hooks ---------------------------------------------------------------
     def attack_model(
         self, raw_client_grad_list: List[Tuple[float, Any]], extra_auxiliary_info: Any = None
     ) -> List[Tuple[float, Any]]:
         if not self.is_model_attack():
             return raw_client_grad_list
-        idxs = self.get_byzantine_idxs(len(raw_client_grad_list))
+        idxs = self._malicious_slots(len(raw_client_grad_list))
         self._key, sub = jax.random.split(self._key)
         if self.attack_type == ATTACK_METHOD_BYZANTINE_ATTACK:
             return A.byzantine_attack(

@@ -130,6 +130,10 @@ class FedAvgAPI:
             logger.info("round %d: clients %s", round_idx, client_indexes)
             w_locals: List[Tuple[float, Any]] = []
             attacker = FedMLAttacker.get_instance()
+            if attacker.is_attack_enabled():
+                # model-side attack corrupts the same population clients the
+                # data-side poisoning targets (slots differ under sampling)
+                attacker.set_round_clients(client_indexes)
             for slot, idx in enumerate(client_indexes):
                 client = self.client_list[slot]
                 local_data = self.train_data_local_dict[idx]
@@ -168,6 +172,9 @@ class FedAvgAPI:
         gets current-model logits."""
         import jax.numpy as jnp
 
+        num_total = int(self.args.client_num_in_total)
+        if int(client_idx) not in set(attacker.get_byzantine_idxs(num_total)):
+            return local_data  # benign client: skip (and skip the forward pass)
         x, y = local_data
         logits = None
         from ....core.security.constants import ATTACK_METHOD_EDGE_CASE_BACKDOOR
@@ -175,7 +182,7 @@ class FedAvgAPI:
         if attacker.attack_type == ATTACK_METHOD_EDGE_CASE_BACKDOOR:
             logits = self.module.apply(self.w_global, jnp.asarray(x), train=False)
         px, py = attacker.poison_local_data(
-            client_idx, int(self.args.client_num_in_total), x, y, logits=logits
+            client_idx, num_total, x, y, logits=logits
         )
         return (px, py)
 
