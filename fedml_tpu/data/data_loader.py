@@ -69,6 +69,14 @@ DATASET_SPECS: Dict[str, Dict[str, Any]] = {
     "uci": dict(classes=2, shape=(32,), train=8000, test=1600, kind="feature"),
     "lending_club": dict(classes=2, shape=(90,), train=10000, test=2000, kind="feature"),
     "fets2021": dict(classes=3, shape=(32, 32, 3), train=1000, test=200, kind="segmentation"),
+    # fednlp sequence tagging / span extraction (reference app/fednlp
+    # seq_tagging + span_extraction; synthetic corpora share the shapes)
+    "onto_tagging": dict(classes=8, shape=(32,), train=8000, test=1600, kind="seqtag", vocab=2000),
+    "wikiner": dict(classes=5, shape=(48,), train=8000, test=1600, kind="seqtag", vocab=2000),
+    "squad_span": dict(classes=64, shape=(64,), train=8000, test=1600, kind="span", vocab=200),
+    # fedcv object detection (reference app/fedcv/object_detection)
+    "synthetic_det": dict(classes=6, shape=(32, 32, 3), train=4000, test=800, kind="detection"),
+    "coco_det": dict(classes=6, shape=(32, 32, 3), train=4000, test=800, kind="detection"),
 }
 
 
@@ -98,6 +106,18 @@ def _generate(spec: Dict[str, Any], n: int, seed: int, scale_override: int = 0,
         return synthetic.make_graph_classification(
             n, spec["num_nodes"], spec["feat_dim"], spec["classes"],
             seed=seed, proto_seed=proto_seed,
+        )
+    if kind == "seqtag":
+        return synthetic.make_sequence_tagging(
+            n, spec["classes"], int(spec["shape"][0]), spec["vocab"], seed=seed
+        )
+    if kind == "span":
+        return synthetic.make_span_extraction(
+            n, int(spec["shape"][0]), spec["vocab"], seed=seed
+        )
+    if kind == "detection":
+        return synthetic.make_detection(
+            n, tuple(spec["shape"][:2]), spec["classes"], seed=seed
         )
     if kind == "taglr":
         x, y = synthetic.make_classification(
@@ -156,6 +176,8 @@ def load(args) -> Tuple[list, int]:
         kind = DATASET_SPECS.get(name, {}).get("kind")
         if y_train.ndim == 1:
             part_labels = y_train
+        elif kind == "detection":
+            part_labels = y_train[:, 0].astype(int)  # object class column
         elif kind == "segmentation":
             # dominant FOREGROUND class per image: a mask-mean bucket would
             # put ~every image in bucket 0 (background majority) and the

@@ -116,6 +116,99 @@ def load_cifar_pickle(root: str, coarse100: bool = False) -> Optional[Arrays]:
     return xt, yt, xe, ye
 
 
+def load_image_folder(root: str, size: int = 32) -> Optional[Arrays]:
+    """ImageFolder layout (CINIC-10 release format): ``{train,test}/<class>/
+    *.png`` — class = sorted subdirectory index.  Needs Pillow."""
+    try:
+        from PIL import Image
+    except ImportError:  # pragma: no cover - Pillow is in the base image
+        return None
+
+    def _split(split_dir):
+        if not os.path.isdir(split_dir):
+            return None
+        classes = sorted(
+            d for d in os.listdir(split_dir)
+            if os.path.isdir(os.path.join(split_dir, d))
+        )
+        if not classes:
+            return None
+        xs, ys = [], []
+        for ci, cname in enumerate(classes):
+            cdir = os.path.join(split_dir, cname)
+            for f in sorted(os.listdir(cdir)):
+                if not f.lower().endswith((".png", ".jpg", ".jpeg")):
+                    continue
+                img = Image.open(os.path.join(cdir, f)).convert("RGB")
+                if img.size != (size, size):
+                    img = img.resize((size, size))
+                xs.append(np.asarray(img, np.float32) / 255.0)
+                ys.append(ci)
+        if not xs:
+            return None
+        return np.stack(xs), np.asarray(ys, np.int32)
+
+    train = _split(os.path.join(root, "train"))
+    test = _split(os.path.join(root, "test")) or _split(os.path.join(root, "valid"))
+    if train is None or test is None:
+        return None
+    return train[0], train[1], test[0], test[1]
+
+
+def load_csv_labeled(root: str) -> Optional[Arrays]:
+    """Tabular CSV parser (UCI / lending_club-style files, reference
+    ``data/data_loader.py`` tabular branches): ``train.csv`` (+ optional
+    ``test.csv``, else a 80/20 tail split).  The label column is the one
+    named 'label'/'target'/'y' in the header, else the LAST column; features
+    must be numeric."""
+    train_path = _find(root, "train.csv")
+    if train_path is None:
+        return None
+
+    def _parse(path):
+        with open(path) as f:
+            header = f.readline().strip().split(",")
+        names = [h.strip().lower() for h in header]
+        has_header = not all(_is_float(h) for h in names)
+        data = np.genfromtxt(path, delimiter=",", skip_header=1 if has_header else 0,
+                             dtype=np.float64)
+        if data.ndim == 1:
+            data = data[None, :]
+        label_col = len(names) - 1
+        if has_header:
+            for cand in ("label", "target", "y"):
+                if cand in names:
+                    label_col = names.index(cand)
+                    break
+        y = data[:, label_col].astype(np.int32)
+        x = np.delete(data, label_col, axis=1).astype(np.float32)
+        return x, y
+
+    xt, yt = _parse(train_path)
+    test_path = _find(root, "test.csv")
+    if test_path is not None:
+        xe, ye = _parse(test_path)
+    else:
+        # seeded shuffle before the 80/20 split: exported CSVs are often
+        # label-sorted, and an unshuffled tail would be single-class
+        perm = np.random.RandomState(0).permutation(len(yt))
+        xt, yt = xt[perm], yt[perm]
+        cut = max(int(len(yt) * 0.8), 1)
+        xe, ye = xt[cut:], yt[cut:]
+        xt, yt = xt[:cut], yt[:cut]
+        if len(ye) == 0:
+            xe, ye = xt, yt  # degenerate tiny file: eval on train
+    return xt, yt, xe, ye
+
+
+def _is_float(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
+
+
 def try_load_real(name: str, cache_dir: str) -> Optional[Arrays]:
     if not cache_dir or not os.path.isdir(cache_dir):
         return None
@@ -128,10 +221,14 @@ def try_load_real(name: str, cache_dir: str) -> Optional[Arrays]:
             out = load_mnist_idx(root) or load_leaf_json(root)
         elif name == "femnist":
             out = load_leaf_json(root)
-        elif name.startswith("cifar") or name in ("cinic10", "fed_cifar100"):
+        elif name == "cinic10":
+            out = load_image_folder(root) or load_cifar_pickle(root)
+        elif name.startswith("cifar") or name == "fed_cifar100":
             out = load_cifar_pickle(root, coarse100="100" in name)
         elif name in ("shakespeare", "fed_shakespeare", "stackoverflow_nwp", "stackoverflow_lr"):
             out = load_leaf_json(root)
+        elif name in ("uci", "lending_club"):
+            out = load_csv_labeled(root)
         else:
             out = None
         if out is not None:

@@ -154,3 +154,66 @@ def make_graph_classification(
         x[i, :n_real, :feat_dim] = feats
         x[i, :n_real, feat_dim : feat_dim + n_real] = adj
     return x, y
+
+
+def make_sequence_tagging(
+    n: int, num_tags: int, seq_len: int, vocab_size: int, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-token tagging corpus: each token's tag is its vocabulary band
+    (NER/POS-shaped — reference app/fednlp/seq_tagging).  x [n, L] int32,
+    y [n, L] int32 in [0, num_tags)."""
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, vocab_size, size=(n, seq_len)).astype(np.int32)
+    band = max(vocab_size // max(num_tags, 1), 1)
+    y = np.minimum(x // band, num_tags - 1).astype(np.int32)
+    # tag noise: a small fraction of tokens carry a random tag so the task
+    # is not trivially 100% learnable
+    flip = rng.rand(n, seq_len) < 0.05
+    y = np.where(flip, rng.randint(0, num_tags, size=(n, seq_len)), y).astype(np.int32)
+    return x, y
+
+
+def make_span_extraction(
+    n: int, seq_len: int, vocab_size: int, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Span-extraction corpus (SQuAD-shaped — reference
+    app/fednlp/span_extraction): the answer is a contiguous run of tokens
+    from a distinct vocabulary band ([2, 50) vs context [60, vocab)), so the
+    extraction RULE is generalizable; y [n, 2] = (start, end) indices.
+    (A pure marker-bracket design lets a memorizing net hit zero held-out
+    exact-match — band coding keeps the task rule-learnable at CI scale.)"""
+    rng = np.random.RandomState(seed)
+    x = rng.randint(60, max(vocab_size, 61), size=(n, seq_len)).astype(np.int32)
+    y = np.zeros((n, 2), np.int32)
+    for i in range(n):
+        start = rng.randint(1, seq_len - 4)
+        end = min(start + rng.randint(1, 5), seq_len - 2)
+        x[i, start:end + 1] = rng.randint(2, 50, size=end - start + 1)
+        y[i] = (start, end)
+    return x, y
+
+
+def make_detection(
+    n: int, hw: Tuple[int, int], num_classes: int, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Single-object detection set (reference app/fedcv/object_detection
+    shape): one axis-aligned bright box per image, class = box color channel
+    pattern.  x [n, H, W, 3] f32; y [n, 5] f32 = (class, cx, cy, w, h) with
+    box coords normalized to [0, 1]."""
+    rng = np.random.RandomState(seed)
+    H, W = hw
+    x = (rng.rand(n, H, W, 3) * 0.15).astype(np.float32)
+    y = np.zeros((n, 5), np.float32)
+    for i in range(n):
+        cls = rng.randint(0, num_classes)
+        bw = rng.randint(W // 6, W // 2)
+        bh = rng.randint(H // 6, H // 2)
+        x0 = rng.randint(0, W - bw)
+        y0 = rng.randint(0, H - bh)
+        patch = np.full((bh, bw, 3), 0.2, np.float32)
+        patch[..., cls % 3] = 0.95  # class-dependent dominant channel
+        if cls >= 3:  # second pattern axis: bright frame
+            patch[0, :, :] = patch[-1, :, :] = patch[:, 0, :] = patch[:, -1, :] = 1.0
+        x[i, y0:y0 + bh, x0:x0 + bw] = patch
+        y[i] = (cls, (x0 + bw / 2) / W, (y0 + bh / 2) / H, bw / W, bh / H)
+    return x, y

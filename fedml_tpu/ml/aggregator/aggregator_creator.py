@@ -1,7 +1,8 @@
 """ServerAggregator factory (reference ``ml/aggregator/aggregator_creator.py``
 ``create_server_aggregator``): dataset-family dispatch mirroring the trainer
 factory.  The default aggregator's masked eval already computes token-level
-metrics for NWP label tensors; tag prediction gets the BCE aggregator."""
+metrics for NWP label tensors; tag prediction / span extraction / detection
+evaluate through their task trainer's test() via _TrainerEvalAggregator."""
 
 from __future__ import annotations
 
@@ -10,19 +11,34 @@ from ..trainer.trainer_creator import _TAG_DATASETS
 from .default_aggregator import DefaultServerAggregator
 
 
-class TAGPredServerAggregator(DefaultServerAggregator):
-    """Evaluates with the multi-label BCE metrics of the tag trainer."""
+class _TrainerEvalAggregator(DefaultServerAggregator):
+    """Evaluates via a task trainer's test() (tag BCE metrics, span
+    exact-match, detection class-acc + IoU).  The probe is built once — its
+    jitted eval closure compiles once, not per eval round."""
+
+    def __init__(self, model, args, trainer_cls):
+        super().__init__(model, args)
+        self._probe = trainer_cls(model, args)
 
     def test(self, test_data, device, args):
-        from ..trainer.tag_trainer import ModelTrainerTAGPred
-
-        probe = ModelTrainerTAGPred(self.module, args)
-        probe.set_model_params(self.variables)
-        return probe.test(test_data, device, args)
+        self._probe.set_model_params(self.variables)
+        return self._probe.test(test_data, device, args)
 
 
 def create_server_aggregator(model, args) -> ServerAggregator:
     dataset = str(getattr(args, "dataset", "")).lower()
+    from ..trainer.trainer_creator import _DET_DATASETS, _SPAN_DATASETS
+
     if dataset in _TAG_DATASETS:
-        return TAGPredServerAggregator(model, args)
+        from ..trainer.tag_trainer import ModelTrainerTAGPred
+
+        return _TrainerEvalAggregator(model, args, ModelTrainerTAGPred)
+    if dataset in _SPAN_DATASETS:
+        from ..trainer.span_trainer import ModelTrainerSpan
+
+        return _TrainerEvalAggregator(model, args, ModelTrainerSpan)
+    if dataset in _DET_DATASETS:
+        from ..trainer.det_trainer import ModelTrainerDET
+
+        return _TrainerEvalAggregator(model, args, ModelTrainerDET)
     return DefaultServerAggregator(model, args)

@@ -53,8 +53,11 @@ def make_optimizer(args) -> optax.GradientTransformation:
 
 def softmax_ce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray):
     """Masked CE.  Handles both [B] labels and [B, L] per-token labels (NWP):
-    a per-example mask [B] broadcasts over trailing label axes."""
-    per = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    a per-example mask [B] broadcasts over trailing label axes.  Logits are
+    promoted to fp32 so bf16 compute mode keeps a stable softmax."""
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    )
     mask = mask.reshape(mask.shape + (1,) * (per.ndim - mask.ndim))
     total = jnp.sum(per * mask)
     count = jnp.maximum(jnp.sum(jnp.broadcast_to(mask, per.shape)), 1.0)
@@ -64,14 +67,49 @@ def softmax_ce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray)
 def sigmoid_bce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray):
     """Masked multi-label BCE: labels are multi-hot [B, C] floats (tag
     prediction); per-example mask [B] broadcasts over label positions."""
-    per = optax.sigmoid_binary_cross_entropy(logits, labels)
+    per = optax.sigmoid_binary_cross_entropy(logits.astype(jnp.float32), labels)
     mask = mask.reshape(mask.shape + (1,) * (per.ndim - mask.ndim))
     total = jnp.sum(per * mask)
     count = jnp.maximum(jnp.sum(jnp.broadcast_to(mask, per.shape)), 1.0)
     return total / count, (total, count)
 
 
-LOSS_FNS = {"ce": softmax_ce_loss, "bce": sigmoid_bce_loss}
+def span_ce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray):
+    """Span extraction: logits [B, L, 2], labels [B, 2] = (start, end);
+    CE over sequence positions for each endpoint (reference
+    app/fednlp/span_extraction QA loss)."""
+    start, end = logits[..., 0], logits[..., 1]
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        start.astype(jnp.float32), labels[:, 0]
+    ) + optax.softmax_cross_entropy_with_integer_labels(
+        end.astype(jnp.float32), labels[:, 1]
+    )
+    total = jnp.sum(per * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count, (total, count)
+
+
+def detection_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray,
+                   box_weight: float = 5.0):
+    """Single-object detection: logits [B, C+4] (class logits ‖ box),
+    labels [B, 5] = (class, cx, cy, w, h) — CE + weighted smooth-L1 on the
+    box (reference app/fedcv/object_detection composite loss shape)."""
+    n_cls = logits.shape[-1] - 4
+    cls_logits = logits[:, :n_cls].astype(jnp.float32)
+    box = logits[:, n_cls:].astype(jnp.float32)
+    per_cls = optax.softmax_cross_entropy_with_integer_labels(
+        cls_logits, labels[:, 0].astype(jnp.int32)
+    )
+    diff = jnp.abs(box - labels[:, 1:])
+    per_box = jnp.sum(jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5), axis=-1)
+    per = per_cls + box_weight * per_box
+    total = jnp.sum(per * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count, (total, count)
+
+
+LOSS_FNS = {"ce": softmax_ce_loss, "bce": sigmoid_bce_loss,
+            "span": span_ce_loss, "det": detection_loss}
 
 
 def make_local_train_fn(
@@ -200,7 +238,7 @@ def make_eval_fn(module) -> Callable:
 
     @jax.jit
     def evaluate(variables, x, y, mask):
-        logits = module.apply(variables, x, train=False)
+        logits = module.apply(variables, x, train=False).astype(jnp.float32)
         per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         pred = jnp.argmax(logits, axis=-1)
         mask = mask.astype(jnp.float32)

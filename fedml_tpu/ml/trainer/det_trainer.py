@@ -1,0 +1,58 @@
+"""Object-detection ClientTrainer (reference ``app/fedcv/object_detection``
+task family): CE + smooth-L1 box loss, class-accuracy + mean-IoU eval."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cls_trainer import ModelTrainerCLS
+
+
+def box_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """IoU of [B, 4] (cx, cy, w, h) box pairs."""
+    ax0, ay0 = a[:, 0] - a[:, 2] / 2, a[:, 1] - a[:, 3] / 2
+    ax1, ay1 = a[:, 0] + a[:, 2] / 2, a[:, 1] + a[:, 3] / 2
+    bx0, by0 = b[:, 0] - b[:, 2] / 2, b[:, 1] - b[:, 3] / 2
+    bx1, by1 = b[:, 0] + b[:, 2] / 2, b[:, 1] + b[:, 3] / 2
+    iw = jnp.maximum(jnp.minimum(ax1, bx1) - jnp.maximum(ax0, bx0), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay1, by1) - jnp.maximum(ay0, by0), 0.0)
+    inter = iw * ih
+    union = a[:, 2] * a[:, 3] + b[:, 2] * b[:, 3] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+class ModelTrainerDET(ModelTrainerCLS):
+    loss_kind = "det"
+
+    def __init__(self, model, args, grad_hook=None):
+        super().__init__(model, args, grad_hook=grad_hook)
+
+        @jax.jit
+        def evaluate(variables, x, y):
+            out = model.apply(variables, x, train=False).astype(jnp.float32)
+            n_cls = out.shape[-1] - 4
+            import optax
+
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                out[:, :n_cls], y[:, 0].astype(jnp.int32)
+            )
+            pred_cls = jnp.argmax(out[:, :n_cls], axis=-1)
+            correct = (pred_cls == y[:, 0].astype(jnp.int32)).astype(jnp.float32)
+            iou = box_iou(out[:, n_cls:], y[:, 1:])
+            return (jnp.sum(per), jnp.sum(correct), jnp.sum(iou),
+                    jnp.asarray(x.shape[0], jnp.float32))
+
+        self._det_eval = evaluate
+
+    def test(self, test_data, device, args):
+        x, y = test_data
+        l, correct, iou_sum, total = self._det_eval(
+            self.variables, jnp.asarray(x), jnp.asarray(y)
+        )
+        return {
+            "test_correct": float(correct),  # class-accuracy count
+            "test_loss": float(l),
+            "test_total": float(total),
+            "test_mean_iou": float(iou_sum) / max(float(total), 1.0),
+        }

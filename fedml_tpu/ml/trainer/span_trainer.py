@@ -1,0 +1,42 @@
+"""Span-extraction ClientTrainer (reference ``app/fednlp/span_extraction``
+QA task): start/end CE loss, exact-match + endpoint accuracy eval."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cls_trainer import ModelTrainerCLS
+
+
+class ModelTrainerSpan(ModelTrainerCLS):
+    loss_kind = "span"
+
+    def __init__(self, model, args, grad_hook=None):
+        super().__init__(model, args, grad_hook=grad_hook)
+
+        @jax.jit
+        def evaluate(variables, x, y):
+            logits = model.apply(variables, x, train=False).astype(jnp.float32)
+            import optax
+
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                logits[..., 0], y[:, 0]
+            ) + optax.softmax_cross_entropy_with_integer_labels(
+                logits[..., 1], y[:, 1]
+            )
+            start = jnp.argmax(logits[..., 0], axis=-1)
+            end = jnp.argmax(logits[..., 1], axis=-1)
+            exact = ((start == y[:, 0]) & (end == y[:, 1])).astype(jnp.float32)
+            return jnp.sum(per), jnp.sum(exact), jnp.asarray(x.shape[0], jnp.float32)
+
+        self._span_eval = evaluate
+
+    def test(self, test_data, device, args):
+        x, y = test_data
+        l, correct, total = self._span_eval(self.variables, jnp.asarray(x), jnp.asarray(y))
+        return {
+            "test_correct": float(correct),  # exact-match count
+            "test_loss": float(l),
+            "test_total": float(total),
+        }

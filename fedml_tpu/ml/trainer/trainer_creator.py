@@ -7,11 +7,16 @@ from ...core.alg_frame.client_trainer import ClientTrainer
 
 _NWP_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
 _TAG_DATASETS = {"stackoverflow_lr"}
+# per-token classification reuses the NWP trainer (same masked per-token CE
+# and token-accuracy math — reference seq_tagging task)
+_SEQTAG_DATASETS = {"onto_tagging", "wikiner"}
+_SPAN_DATASETS = {"squad_span"}
+_DET_DATASETS = {"synthetic_det", "coco_det"}
 
 
 def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
     dataset = str(getattr(args, "dataset", "")).lower()
-    if dataset in _NWP_DATASETS:
+    if dataset in _NWP_DATASETS or dataset in _SEQTAG_DATASETS:
         from .nwp_trainer import ModelTrainerNWP
 
         return ModelTrainerNWP(model, args, grad_hook=grad_hook)
@@ -19,6 +24,14 @@ def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
         from .tag_trainer import ModelTrainerTAGPred
 
         return ModelTrainerTAGPred(model, args)
+    if dataset in _SPAN_DATASETS:
+        from .span_trainer import ModelTrainerSpan
+
+        return ModelTrainerSpan(model, args, grad_hook=grad_hook)
+    if dataset in _DET_DATASETS:
+        from .det_trainer import ModelTrainerDET
+
+        return ModelTrainerDET(model, args, grad_hook=grad_hook)
     from .cls_trainer import ModelTrainerCLS
 
     return ModelTrainerCLS(model, args, grad_hook=grad_hook)

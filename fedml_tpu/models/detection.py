@@ -1,0 +1,29 @@
+"""Single-object detector (reference ``python/app/fedcv/object_detection``
+family, YOLO-lite scale): conv backbone -> class logits + normalized box.
+
+Output layout [B, num_classes + 4]: class logits then sigmoid (cx, cy, w, h).
+TPU-first: NHWC convs, static shapes, GAP head."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TinyDetector(nn.Module):
+    num_classes: int = 6
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i, feats in enumerate((16, 32, 64)):
+            x = nn.Conv(feats, (3, 3), strides=(2, 2), padding="SAME",
+                        use_bias=False, name=f"conv{i}")(x)
+            x = nn.GroupNorm(num_groups=None, group_size=8, name=f"norm{i}")(x)
+            x = nn.relu(x)
+        # FLATTEN, not GAP: box regression needs spatial position information
+        # (global pooling would make cx/cy unrecoverable)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64, name="neck")(x))
+        cls = nn.Dense(self.num_classes, name="cls_head")(x)
+        box = nn.sigmoid(nn.Dense(4, name="box_head")(x))
+        return jnp.concatenate([cls, box], axis=-1)

@@ -38,3 +38,59 @@ class TransformerClassifier(nn.Module):
             x = Block(cfg, attention_fn=attn, name=f"layer{i}")(x, positions, train)
         x = nn.RMSNorm(name="final_norm")(x)
         return nn.Dense(self.num_classes, name="cls_head")(x.mean(axis=1))
+
+
+class TransformerTagger(nn.Module):
+    """Token ids [B, L] -> per-token tag logits [B, L, num_tags] (reference
+    app/fednlp/seq_tagging task heads).  Same bidirectional encoder as the
+    classifier; the engine's per-token masked CE consumes [B, L] labels."""
+
+    num_tags: int
+    vocab_size: int = 32000
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        from ..ops.flash_attention import reference_attention
+
+        cfg = TransformerConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, d_ff=self.d_ff,
+        )
+        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed")(tokens)
+        attn = lambda q, k, v: reference_attention(q, k, v, causal=False)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, attention_fn=attn, name=f"layer{i}")(x, positions, train)
+        x = nn.RMSNorm(name="final_norm")(x)
+        return nn.Dense(self.num_tags, name="tag_head")(x)
+
+
+class TransformerSpanExtractor(nn.Module):
+    """Token ids [B, L] -> span logits [B, L, 2] (start, end) — reference
+    app/fednlp/span_extraction (SQuAD-style QA) head."""
+
+    vocab_size: int = 32000
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        from ..ops.flash_attention import reference_attention
+
+        cfg = TransformerConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, d_ff=self.d_ff,
+        )
+        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed")(tokens)
+        attn = lambda q, k, v: reference_attention(q, k, v, causal=False)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, attention_fn=attn, name=f"layer{i}")(x, positions, train)
+        x = nn.RMSNorm(name="final_norm")(x)
+        return nn.Dense(2, name="span_head")(x)

@@ -207,6 +207,10 @@ class FedAvgAPI:
         acc = stats["test_correct"] / stats["test_total"]
         loss = stats["test_loss"] / stats["test_total"]
         out = {"round": round_idx, "test_acc": round(float(acc), 4), "test_loss": round(float(loss), 4)}
+        # task-specific extras (e.g. detection's test_mean_iou) pass through
+        for k, v in stats.items():
+            if k.startswith("test_") and k not in ("test_correct", "test_total", "test_loss"):
+                out[k] = round(float(v), 4)
         self.metrics.log(out)
         logger.info("eval: %s", out)
         return out

@@ -101,6 +101,18 @@ class XLASimulator:
                 "use backend 'sp' for robustness experiments (central DP 'cdp' IS "
                 "supported on the XLA backend)"
             )
+        # the compiled round is wired for CE-style tasks; BCE/span/detection
+        # losses and their task-specific evals run on the sp backend
+        from ...ml.trainer.trainer_creator import (
+            _DET_DATASETS, _SPAN_DATASETS, _TAG_DATASETS,
+        )
+
+        ds = str(getattr(args, "dataset", "")).lower()
+        if ds in (_DET_DATASETS | _SPAN_DATASETS | _TAG_DATASETS):
+            raise NotImplementedError(
+                f"dataset {ds!r} (bce/span/det loss) is not wired into the "
+                "in-mesh XLA round; use backend 'sp'"
+            )
 
         self._pack_data()
         sample = jnp.asarray(self.train_global[0][:1])
@@ -154,6 +166,25 @@ class XLASimulator:
     # ------------------------------------------------------------------
     # the compiled round
     # ------------------------------------------------------------------
+    def _resolve_chunk(self, per_dev: int) -> int:
+        """Clients vmapped together per scan step (effective batch k*B, scan
+        runs per_dev/k steps).  Default is 1: measured on TPU v5e with the
+        bench model (ResNet-56/CIFAR, batch 64), vmapping clients did NOT
+        help — per-step time grew linearly with k (the ops are bandwidth/
+        lane-padding bound, not launch-bound), and fp32 chunk=8 was 1.6x
+        SLOWER than unchunked.  The knob stays for models where per-step cost
+        is launch-dominated (tiny dense models).  Must divide per_dev."""
+        req = int(getattr(self.args, "xla_client_chunk", 0) or 0)
+        if req <= 0:
+            return 1
+        k = max(d for d in range(1, min(req, per_dev) + 1) if per_dev % d == 0)
+        if k != req:
+            logger.warning(
+                "xla_client_chunk=%d does not divide clients/device=%d; using %d",
+                req, per_dev, k,
+            )
+        return k
+
     def _build_round_fn(self):
         mesh = self.mesh
         algo = self.algo
@@ -165,13 +196,13 @@ class XLASimulator:
         def per_device(variables, server_state, x_all, y_all, idx_l, counts_l, rngs_l, cex_l):
             # idx_l: [C/n_dev, padded_n]; counts_l: [C/n_dev]; rngs_l: [C/n_dev, 2]
             # cex_l: per-client algorithm inputs (leading axis C/n_dev)
+            per_dev = idx_l.shape[0]
+            k = self._resolve_chunk(per_dev)
             zeros = jax.tree_util.tree_map(
                 lambda v: jnp.zeros_like(v, dtype=jnp.float32), variables
             )
 
-            def train_one(carry, inp):
-                acc, wsum, lsum, ext = carry
-                idx_row, n_i, rng, cex = inp
+            def one_client(idx_row, n_i, rng, cex):
                 x = jnp.take(x_all, idx_row, axis=0)
                 y = jnp.take(y_all, idx_row, axis=0)
                 result = local_train(
@@ -180,20 +211,34 @@ class XLASimulator:
                 )
                 w = n_i.astype(jnp.float32)
                 real = (n_i > 0).astype(jnp.float32)
-                acc = jax.tree_util.tree_map(
-                    lambda a, p: a + w * p.astype(jnp.float32), acc, result.variables
+                wv = jax.tree_util.tree_map(
+                    lambda p: w * p.astype(jnp.float32), result.variables
                 )
-                ext = jax.tree_util.tree_map(
-                    jnp.add, ext,
-                    algo.client_contrib(variables, result, w, real, cex, server_state),
-                )
+                contrib = algo.client_contrib(variables, result, w, real, cex, server_state)
                 out = algo.client_out(variables, result, real, cex, server_state)
-                return (acc, wsum + w, lsum + result.loss * w, ext), out
+                return wv, w, result.loss * w, contrib, out
 
-            (acc, wsum, lsum, ext), outs = jax.lax.scan(
-                train_one,
-                (zeros, 0.0, 0.0, algo.zero_contrib(variables)),
+            vclients = jax.vmap(one_client)
+
+            def train_chunk(carry, inp):
+                acc, wsum, lsum, ext = carry
+                wv, w, wl, contrib, out = vclients(*inp)  # leading axis k
+                acc = jax.tree_util.tree_map(lambda a, p: a + p.sum(0), acc, wv)
+                ext = jax.tree_util.tree_map(lambda e, c: e + c.sum(0), ext, contrib)
+                return (acc, wsum + w.sum(), lsum + wl.sum(), ext), out
+
+            chunked = jax.tree_util.tree_map(
+                lambda t: t.reshape((per_dev // k, k) + t.shape[1:]),
                 (idx_l, counts_l, rngs_l, cex_l),
+            )
+            (acc, wsum, lsum, ext), outs = jax.lax.scan(
+                train_chunk,
+                (zeros, 0.0, 0.0, algo.zero_contrib(variables)),
+                chunked,
+            )
+            # un-chunk the stacked per-client outputs: [per_dev/k, k, ...] -> [per_dev, ...]
+            outs = jax.tree_util.tree_map(
+                lambda o: o.reshape((per_dev,) + o.shape[2:]), outs
             )
             # the "fedml_nccl_reduce": one psum over ICI
             acc = jax.lax.psum(acc, "client")
