@@ -16,9 +16,20 @@ import flax.linen as nn
 logger = logging.getLogger(__name__)
 
 
+_BF16_MODELS = {"resnet20", "resnet56", "resnet18", "resnet18_gn"}
+
+
 def create(args: Any, output_dim: int) -> nn.Module:
     name = str(getattr(args, "model", "lr")).lower()
     dataset = str(getattr(args, "dataset", "")).lower()
+
+    import jax.numpy as jnp
+
+    if _dtype(args) is not jnp.float32 and name not in _BF16_MODELS:
+        logger.warning(
+            "compute_dtype=%s is only plumbed into %s; model %r runs fp32",
+            getattr(args, "compute_dtype", None), sorted(_BF16_MODELS), name,
+        )
 
     if name in ("lr", "logistic_regression"):
         from .linear import LogisticRegression
@@ -35,15 +46,15 @@ def create(args: Any, output_dim: int) -> nn.Module:
     if name in ("resnet20",):
         from .resnet import resnet20
 
-        return resnet20(num_classes=output_dim, norm=_norm(args))
+        return resnet20(num_classes=output_dim, norm=_norm(args), dtype=_dtype(args))
     if name in ("resnet56",):
         from .resnet import resnet56
 
-        return resnet56(num_classes=output_dim, norm=_norm(args))
+        return resnet56(num_classes=output_dim, norm=_norm(args), dtype=_dtype(args))
     if name in ("resnet18", "resnet18_gn"):
         from .resnet import resnet18_gn
 
-        return resnet18_gn(num_classes=output_dim)
+        return resnet18_gn(num_classes=output_dim, dtype=_dtype(args))
     if name in ("mobilenet", "mobilenet_v1"):
         from .mobilenet import MobileNetV1
 
@@ -102,6 +113,24 @@ def create(args: Any, output_dim: int) -> nn.Module:
 
         vocab = int(DATASET_SPECS.get(dataset, {}).get("vocab", 2000))
         return TransformerClassifier(num_classes=output_dim, vocab_size=vocab)
+    if name in ("transformer_tagger", "bert_tagger"):
+        from ..data.data_loader import DATASET_SPECS
+        from .nlp import TransformerTagger
+
+        vocab = int(DATASET_SPECS.get(dataset, {}).get("vocab", 2000))
+        return TransformerTagger(num_tags=output_dim, vocab_size=vocab)
+    if name in ("transformer_span", "bert_qa"):
+        from ..data.data_loader import DATASET_SPECS
+        from .nlp import TransformerSpanExtractor
+
+        vocab = int(DATASET_SPECS.get(dataset, {}).get("vocab", 200))
+        # compact head: at CI data scales a wide encoder memorizes spans
+        # instead of learning the extraction rule
+        return TransformerSpanExtractor(vocab_size=vocab, d_model=48, d_ff=96)
+    if name in ("tiny_detector", "yolo_lite"):
+        from .detection import TinyDetector
+
+        return TinyDetector(num_classes=output_dim)
     if name in ("gcn", "graphsage", "gat"):
         from ..data.data_loader import DATASET_SPECS
 
@@ -122,3 +151,17 @@ def create(args: Any, output_dim: int) -> nn.Module:
 
 def _norm(args: Any) -> str:
     return str(getattr(args, "model_norm", "gn")).lower()
+
+
+def _dtype(args: Any):
+    """Compute dtype from ``args.compute_dtype`` — 'bf16' runs activations
+    and MXU passes in bfloat16 while parameters stay fp32 (mixed precision:
+    halves HBM traffic on the usual bandwidth-bound TPU regime)."""
+    import jax.numpy as jnp
+
+    name = str(getattr(args, "compute_dtype", "fp32") or "fp32").lower()
+    if name in ("fp32", "float32"):
+        return jnp.float32
+    if name in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    raise ValueError(f"unknown compute_dtype {name!r} (use fp32 or bf16)")

@@ -22,11 +22,12 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
-def _norm(norm: str, name: str, train: bool):
+def _norm(norm: str, name: str, train: bool, dtype=jnp.float32):
     if norm == "bn":
-        return nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name)
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name,
+                            dtype=dtype)
     if norm == "gn":
-        return nn.GroupNorm(num_groups=None, group_size=16, name=name)
+        return nn.GroupNorm(num_groups=None, group_size=16, name=name, dtype=dtype)
     raise ValueError(f"unknown norm {norm!r}")
 
 
@@ -34,20 +35,22 @@ class BasicBlock(nn.Module):
     filters: int
     stride: int = 1
     norm: str = "gn"
+    dtype: Any = jnp.float32  # compute dtype; params stay fp32 (mixed precision)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         residual = x
         y = nn.Conv(self.filters, (3, 3), strides=(self.stride, self.stride),
-                    padding="SAME", use_bias=False, name="conv1")(x)
-        y = _norm(self.norm, "norm1", train)(y)
+                    padding="SAME", use_bias=False, name="conv1", dtype=self.dtype)(x)
+        y = _norm(self.norm, "norm1", train, self.dtype)(y)
         y = nn.relu(y)
-        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False, name="conv2")(y)
-        y = _norm(self.norm, "norm2", train)(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False, name="conv2",
+                    dtype=self.dtype)(y)
+        y = _norm(self.norm, "norm2", train, self.dtype)(y)
         if residual.shape != y.shape:
             residual = nn.Conv(self.filters, (1, 1), strides=(self.stride, self.stride),
-                               use_bias=False, name="proj")(residual)
-            residual = _norm(self.norm, "norm_proj", train)(residual)
+                               use_bias=False, name="proj", dtype=self.dtype)(residual)
+            residual = _norm(self.norm, "norm_proj", train, self.dtype)(residual)
         return nn.relu(y + residual)
 
 
@@ -57,21 +60,24 @@ class CifarResNet(nn.Module):
     num_blocks: int  # n: 3 -> ResNet-20, 9 -> ResNet-56
     num_classes: int = 10
     norm: str = "gn"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if x.ndim == 3:
             x = x[..., None]
-        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv_init")(x)
-        x = _norm(self.norm, "norm_init", train)(x)
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv_init",
+                    dtype=self.dtype)(x)
+        x = _norm(self.norm, "norm_init", train, self.dtype)(x)
         x = nn.relu(x)
         for stage, filters in enumerate((16, 32, 64)):
             for block in range(self.num_blocks):
                 stride = 2 if (stage > 0 and block == 0) else 1
-                x = BasicBlock(filters, stride, self.norm,
+                x = BasicBlock(filters, stride, self.norm, self.dtype,
                                name=f"stage{stage}_block{block}")(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.num_classes, name="classifier")(x)
+        return nn.Dense(self.num_classes, name="classifier", dtype=self.dtype)(x)
 
 
 class ResNet18(nn.Module):
@@ -80,36 +86,39 @@ class ResNet18(nn.Module):
     num_classes: int = 100
     norm: str = "gn"
     small_images: bool = True  # CIFAR: 3x3 stem, no max-pool
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if x.ndim == 3:
             x = x[..., None]
+        x = x.astype(self.dtype)
         if self.small_images:
-            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, name="conv_init")(x)
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, name="conv_init",
+                        dtype=self.dtype)(x)
         else:
             x = nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME", use_bias=False,
-                        name="conv_init")(x)
-        x = _norm(self.norm, "norm_init", train)(x)
+                        name="conv_init", dtype=self.dtype)(x)
+        x = _norm(self.norm, "norm_init", train, self.dtype)(x)
         x = nn.relu(x)
         if not self.small_images:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, filters in enumerate((64, 128, 256, 512)):
             for block in range(2):
                 stride = 2 if (stage > 0 and block == 0) else 1
-                x = BasicBlock(filters, stride, self.norm,
+                x = BasicBlock(filters, stride, self.norm, self.dtype,
                                name=f"stage{stage}_block{block}")(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.num_classes, name="classifier")(x)
+        return nn.Dense(self.num_classes, name="classifier", dtype=self.dtype)(x)
 
 
-def resnet20(num_classes: int = 10, norm: str = "gn") -> CifarResNet:
-    return CifarResNet(num_blocks=3, num_classes=num_classes, norm=norm)
+def resnet20(num_classes: int = 10, norm: str = "gn", dtype=jnp.float32) -> CifarResNet:
+    return CifarResNet(num_blocks=3, num_classes=num_classes, norm=norm, dtype=dtype)
 
 
-def resnet56(num_classes: int = 10, norm: str = "gn") -> CifarResNet:
-    return CifarResNet(num_blocks=9, num_classes=num_classes, norm=norm)
+def resnet56(num_classes: int = 10, norm: str = "gn", dtype=jnp.float32) -> CifarResNet:
+    return CifarResNet(num_blocks=9, num_classes=num_classes, norm=norm, dtype=dtype)
 
 
-def resnet18_gn(num_classes: int = 100) -> ResNet18:
-    return ResNet18(num_classes=num_classes, norm="gn")
+def resnet18_gn(num_classes: int = 100, dtype=jnp.float32) -> ResNet18:
+    return ResNet18(num_classes=num_classes, norm="gn", dtype=dtype)
