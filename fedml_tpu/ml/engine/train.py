@@ -112,6 +112,42 @@ LOSS_FNS = {"ce": softmax_ce_loss, "bce": sigmoid_bce_loss,
             "span": span_ce_loss, "det": detection_loss}
 
 
+def resolve_grad_hook(args, grad_hook: Optional[Callable]) -> Optional[Callable]:
+    """Shared grad-hook resolution for both the padded and packed engines:
+    an explicit hook wins; otherwise ``args.proximal_mu`` > 0 installs the
+    FedProx hook (g + mu*(p - anchor))."""
+    mu = float(getattr(args, "proximal_mu", 0.0) or 0.0)
+    if grad_hook is None and mu > 0:
+        def grad_hook(grads, params, anchor, extra):
+            return jax.tree_util.tree_map(
+                lambda g, p, a: g + mu * (p - a), grads, params, anchor
+            )
+    return grad_hook
+
+
+def build_loss_fn(module, has_dropout: bool = True, loss: str = "ce") -> Callable:
+    """Shared masked-loss closure for both engines: applies the module with
+    any mutable (non-param) collections threaded through, returns
+    ``(loss_val, updated_collections)``."""
+    loss_kind = LOSS_FNS[loss]
+
+    def loss_fn(params, other_vars, bx, by, bmask, rng):
+        variables = dict(other_vars, params=params)
+        mutable = [k for k in other_vars.keys()]
+        rngs = {"dropout": rng} if has_dropout else None
+        if mutable:
+            logits, updated = module.apply(
+                variables, bx, train=True, rngs=rngs, mutable=mutable
+            )
+        else:
+            logits = module.apply(variables, bx, train=True, rngs=rngs)
+            updated = {}
+        loss_val, _ = loss_kind(logits, by, bmask)
+        return loss_val, updated
+
+    return loss_fn
+
+
 def make_local_train_fn(
     module,
     args,
@@ -152,26 +188,8 @@ def build_local_train(
     epochs = int(epochs if epochs is not None else getattr(args, "epochs", 1))
     steps_per_epoch = max(1, -(-padded_n // batch_size))
 
-    mu = float(getattr(args, "proximal_mu", 0.0) or 0.0)
-    if grad_hook is None and mu > 0:
-        def grad_hook(grads, params, anchor, extra):  # noqa: F811 - FedProx
-            return jax.tree_util.tree_map(
-                lambda g, p, a: g + mu * (p - a), grads, params, anchor
-            )
-
-    def loss_fn(params, other_vars, bx, by, bmask, rng):
-        variables = dict(other_vars, params=params)
-        mutable = [k for k in other_vars.keys()]
-        rngs = {"dropout": rng} if has_dropout else None
-        if mutable:
-            logits, updated = module.apply(
-                variables, bx, train=True, rngs=rngs, mutable=mutable
-            )
-        else:
-            logits = module.apply(variables, bx, train=True, rngs=rngs)
-            updated = {}
-        loss_val, _ = LOSS_FNS[loss](logits, by, bmask)
-        return loss_val, updated
+    grad_hook = resolve_grad_hook(args, grad_hook)
+    loss_fn = build_loss_fn(module, has_dropout, loss)
 
     def train(variables, x, y, n_valid, rng, extra=None) -> LocalTrainResult:
         params = variables["params"]

@@ -75,6 +75,11 @@ class InMeshAlgorithm:
         control-variate delta to scatter back into the client-state table)."""
         return jnp.zeros(())
 
+    def out_template(self, variables) -> Pytree:
+        """Shape template for one client's client_out (the packed round
+        pre-allocates its per-slot output buffer from this)."""
+        return jnp.zeros(())
+
     # -- traced: server step ----------------------------------------------
     def server_update(self, acc, wsum, ext, variables, server_state) -> Tuple[Pytree, Pytree]:
         return _weighted_avg(acc, wsum, variables), server_state
@@ -238,6 +243,9 @@ class ScaffoldInMesh(InMeshAlgorithm):
     def zero_contrib(self, variables):
         return self.init_server_state(variables)
 
+    def out_template(self, variables):
+        return self.init_server_state(variables)
+
     def client_contrib(self, variables, result, w, real, cex, server_state):
         return self._dc(variables, result, real, cex, server_state)
 
@@ -295,6 +303,9 @@ class FedDynInMesh(InMeshAlgorithm):
         )
 
     def zero_contrib(self, variables):
+        return self.init_server_state(variables)
+
+    def out_template(self, variables):
         return self.init_server_state(variables)
 
     def client_contrib(self, variables, result, w, real, cex, server_state):

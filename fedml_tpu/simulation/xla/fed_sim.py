@@ -120,7 +120,11 @@ class XLASimulator:
         self.algo = create_inmesh_algorithm(args)
         self.server_state = self.algo.init_server_state(self.variables)
         self.client_state = self.algo.init_client_state(self.num_clients, self.variables)
-        self._build_round_fn()
+        self.packed = bool(getattr(args, "xla_pack", False))
+        if self.packed:
+            self._build_packed_round_fn()
+        else:
+            self._build_round_fn()
 
         self.runtime_estimator = RuntimeEstimator(self.n_dev, uniform_devices=True)
         self.scheduler = SeqTrainScheduler(self.n_dev, estimator=self.runtime_estimator)
@@ -154,6 +158,7 @@ class XLASimulator:
                 idx[i, :n] = np.arange(cursor, cursor + n, dtype=np.int32)
                 idx[i, n:] = cursor  # padding rows (masked out by counts)
             cursor += n
+        self._client_rows = idx  # host copy (packed-round schedule builder)
         self.client_idx = jnp.asarray(idx)
         self.client_counts = jnp.asarray(counts)
         self.x_all = jnp.asarray(np.concatenate(xs, 0))
@@ -261,6 +266,65 @@ class XLASimulator:
             )
         )
 
+    def _build_packed_round_fn(self):
+        """Packed ragged round (ml/engine/packed.py): no per-client padding
+        to the global max — each client contributes exactly ceil(n_i/B)*E
+        batches, streamed through one while_loop per device.  Enabled by
+        ``args.xla_pack``."""
+        from ...ml.engine.packed import build_packed_device_fn, s_max_for
+
+        mesh = self.mesh
+        algo = self.algo
+        self.slots = -(-self.clients_per_round // self.n_dev)
+        self.s_max = s_max_for(
+            self.max_client_n, self.slots, self.batch_size,
+            int(getattr(self.args, "epochs", 1)),
+        )
+        device_fn = build_packed_device_fn(
+            self.module, self.args, algo, self.batch_size, self.slots
+        )
+
+        def per_device(variables, server_state, x_all, y_all, idx, mask, boundary,
+                       weight, slot, n_steps, rngs, cex):
+            # arrays with a [n_dev, ...] leading axis arrive as [1, ...]
+            acc, wsum, lsum, cnt, ext, outs = device_fn(
+                variables, server_state, x_all, y_all, idx[0], mask[0],
+                boundary[0], weight[0], slot[0], n_steps[0], rngs[0], cex,
+            )
+            acc = jax.lax.psum(acc, "client")
+            wsum = jax.lax.psum(wsum, "client")
+            lsum = jax.lax.psum(lsum, "client")
+            cnt = jax.lax.psum(cnt, "client")
+            ext = jax.lax.psum(ext, "client")
+            new_global, new_state = algo.server_update(
+                acc, wsum, ext, variables, server_state
+            )
+            return new_global, new_state, lsum / jnp.maximum(cnt, 1.0), outs
+
+        self._round_fn = jax.jit(
+            shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(P(), P(), P(), P(), P("client"), P("client"), P("client"),
+                          P("client"), P("client"), P("client"), P("client"), P("client")),
+                out_specs=(P(), P(), P(), P("client")),
+                check_vma=False,
+            )
+        )
+
+    def _packed_inputs(self, ids: np.ndarray, counts: np.ndarray, round_idx: int):
+        from ...ml.engine.packed import pack_round
+
+        ids2d = ids.reshape(self.n_dev, self.slots)
+        counts2d = counts.reshape(self.n_dev, self.slots)
+        sched = pack_round(
+            ids2d, counts2d,
+            lambda cid: self._client_rows[cid],
+            self.batch_size, int(getattr(self.args, "epochs", 1)),
+            int(getattr(self.args, "random_seed", 0)), round_idx, self.s_max,
+        )
+        return tuple(jnp.asarray(a) for a in sched)
+
     def _schedule(self, sampled: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Balance sampled clients across mesh slots via core/schedule
         (SeqTrainScheduler; runtime-model-aware once rounds have been
@@ -311,21 +375,31 @@ class XLASimulator:
             # client with zero local samples contributes nothing in-mesh
             participated = (counts > 0).astype(np.float32)
             self._rng, sub = jax.random.split(self._rng)
-            rngs = jax.random.split(jax.random.fold_in(sub, round_idx), len(ids))
-            idx_rows = self.client_idx[jnp.asarray(ids)]
             cex = self.algo.gather_client_extras(
                 self.client_state, ids, participated, round_idx
             )
-            self.variables, self.server_state, mean_loss, outs = self._round_fn(
-                self.variables,
-                self.server_state,
-                self.x_all,
-                self.y_all,
-                idx_rows,
-                jnp.asarray(counts),
-                rngs,
-                cex,
-            )
+            if self.packed:
+                packed = self._packed_inputs(np.asarray(ids), counts, round_idx)
+                dev_rngs = jax.random.split(
+                    jax.random.fold_in(sub, round_idx), self.n_dev
+                )
+                self.variables, self.server_state, mean_loss, outs = self._round_fn(
+                    self.variables, self.server_state, self.x_all, self.y_all,
+                    *packed, dev_rngs, cex,
+                )
+            else:
+                rngs = jax.random.split(jax.random.fold_in(sub, round_idx), len(ids))
+                idx_rows = self.client_idx[jnp.asarray(ids)]
+                self.variables, self.server_state, mean_loss, outs = self._round_fn(
+                    self.variables,
+                    self.server_state,
+                    self.x_all,
+                    self.y_all,
+                    idx_rows,
+                    jnp.asarray(counts),
+                    rngs,
+                    cex,
+                )
             self.client_state = self.algo.apply_client_outs(self.client_state, ids, outs)
             self.algo.host_round_end(ids, participated, round_idx)
             # host-side hooks (attack/defense need per-client updates and run
