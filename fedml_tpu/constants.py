@@ -76,6 +76,8 @@ FedML_FEDERATED_OPTIMIZER_ASYNC_FEDAVG = "Async_FedAvg"
 FedML_FEDERATED_OPTIMIZER_FEDDYN = "FedDyn"
 FedML_FEDERATED_OPTIMIZER_SCAFFOLD = "SCAFFOLD"
 FedML_FEDERATED_OPTIMIZER_MIME = "Mime"
+# decentralized multi-task GNN (reference research/SpreadGNN)
+FedML_FEDERATED_OPTIMIZER_SPREADGNN = "SpreadGNN"
 
 # ---------------------------------------------------------------------------
 # TPU mesh-axis naming conventions (native additions).

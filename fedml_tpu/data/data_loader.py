@@ -77,6 +77,22 @@ DATASET_SPECS: Dict[str, Dict[str, Any]] = {
     # fedcv object detection (reference app/fedcv/object_detection)
     "synthetic_det": dict(classes=6, shape=(32, 32, 3), train=4000, test=800, kind="detection"),
     "coco_det": dict(classes=6, shape=(32, 32, 3), train=4000, test=800, kind="detection"),
+    # fednlp seq2seq (reference app/fednlp/seq2seq: CornellMovieDialogue);
+    # classes = vocab (the LM head width over the packed sequence)
+    "synthetic_s2s": dict(classes=64, shape=(24,), train=8000, test=1600, kind="s2s",
+                          vocab=64, src_len=12, tgt_len=12),
+    "cornell_movie_dialogue": dict(classes=64, shape=(24,), train=8000, test=1600, kind="s2s",
+                                   vocab=64, src_len=12, tgt_len=12),
+    # fedgraphnn link prediction (reference app/fedgraphnn
+    # ego_networks_link_pred + recsys_subgraph_link_pred)
+    "ego_linkpred": dict(classes=2, shape=(16, 24), train=2000, test=400, kind="linkpred",
+                         num_nodes=16, feat_dim=8),
+    "recsys_linkpred": dict(classes=2, shape=(16, 24), train=2000, test=400, kind="linkpred",
+                            num_nodes=16, feat_dim=8, bipartite=True),
+    # multi-task molecular property prediction with partial labels
+    # (reference research/SpreadGNN; moleculenet sider/tox21 masks)
+    "moleculenet_mtl": dict(classes=8, shape=(16, 24), train=2000, test=400, kind="mtl_graph",
+                            num_nodes=16, feat_dim=8, num_tasks=8),
 }
 
 
@@ -118,6 +134,20 @@ def _generate(spec: Dict[str, Any], n: int, seed: int, scale_override: int = 0,
     if kind == "detection":
         return synthetic.make_detection(
             n, tuple(spec["shape"][:2]), spec["classes"], seed=seed
+        )
+    if kind == "s2s":
+        return synthetic.make_seq2seq(
+            n, spec["src_len"], spec["tgt_len"], spec["vocab"], seed=seed
+        )
+    if kind == "linkpred":
+        return synthetic.make_link_prediction(
+            n, spec["num_nodes"], spec["feat_dim"], seed=seed,
+            bipartite=bool(spec.get("bipartite", False)), proto_seed=proto_seed,
+        )
+    if kind == "mtl_graph":
+        return synthetic.make_multitask_graphs(
+            n, spec["num_nodes"], spec["feat_dim"], spec["num_tasks"],
+            seed=seed, proto_seed=proto_seed,
         )
     if kind == "taglr":
         x, y = synthetic.make_classification(
@@ -188,6 +218,19 @@ def load(args) -> Tuple[list, int]:
             )
             fg = counts[:, 1:]
             part_labels = np.where(fg.max(axis=1) > 0, fg.argmax(axis=1) + 1, 0)
+        elif kind in ("linkpred", "mtl_graph"):
+            # labels carry -1 sentinels; bucket by positive-label count
+            # (graph density / task profile), clipped to the class range
+            pos = (y_train.reshape(len(y_train), -1) > 0).sum(axis=1)
+            if kind == "linkpred":
+                pos //= 2  # symmetric pairs: raw counts are always even
+            part_labels = (pos % data["class_num"]).astype(int)
+        elif kind == "s2s":
+            # bucket by mean target token (ignore the -1 source positions)
+            flat = y_train.reshape(len(y_train), -1)
+            valid = flat >= 0
+            mean_tok = (flat * valid).sum(axis=1) / np.maximum(valid.sum(axis=1), 1)
+            part_labels = (mean_tok % data["class_num"]).astype(int)
         else:
             # NWP labels are sequences; bucket by sequence-mean token
             part_labels = (

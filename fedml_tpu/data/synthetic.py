@@ -217,3 +217,121 @@ def make_detection(
         x[i, y0:y0 + bh, x0:x0 + bw] = patch
         y[i] = (cls, (x0 + bw / 2) / W, (y0 + bh / 2) / H, bw / W, bh / H)
     return x, y
+
+
+def make_seq2seq(
+    n: int, src_len: int, tgt_len: int, vocab_size: int, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Seq2seq corpus packed for a causal decoder-only LM (the TPU-first
+    redesign of reference app/fednlp/seq2seq's encoder-decoder BART: one
+    causal stack over [src ‖ SEP ‖ tgt] with loss masked to target positions
+    — same task contract, no cross-attention module to shard).
+
+    Task: emit each source token's successor in vocab order (tgt[j] =
+    succ(src[j]) — a constant relative-offset attention pattern plus a
+    learned token mapping, the right-sized learnability gate for a RoPE
+    causal stack; reversal's varying offsets need far more steps than a CI
+    smoke test allows).  x [n, L] int32 with L = src_len + tgt_len: src
+    tokens in [2, vocab), SEP = 1, then the teacher-forced target prefix.
+    y [n, L] int32: -1 on source positions, target token ids elsewhere
+    (engine loss kind "s2s")."""
+    rng = np.random.RandomState(seed)
+    L = src_len + tgt_len
+    x = np.zeros((n, L), np.int32)
+    y = np.full((n, L), -1, np.int32)
+    src = rng.randint(2, max(vocab_size, 3), size=(n, src_len)).astype(np.int32)
+    tgt = (2 + (src - 2 + 1) % (vocab_size - 2)).astype(np.int32)
+    x[:, :src_len] = src
+    x[:, src_len] = 1  # SEP starts decoding
+    x[:, src_len + 1 :] = tgt[:, : tgt_len - 1]
+    y[:, src_len:] = tgt
+    return x, y
+
+
+def make_link_prediction(
+    n: int, num_nodes: int = 16, feat_dim: int = 8, seed: int = 0,
+    bipartite: bool = False, holdout: float = 0.3, proto_seed: int = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Link-prediction subgraphs (reference app/fedgraphnn
+    ego_networks_link_pred; ``bipartite=True`` is the recsys
+    user-item variant, recsys_subgraph_link_pred).
+
+    Each sample: nodes carry a latent community (or user-group/item-category
+    when bipartite); edges form mostly within-community (across matching
+    user-group/item-category pairs when bipartite).  A ``holdout`` fraction
+    of true edges is removed from the observed adjacency and becomes the
+    positive labels; an equal number of true non-edges becomes the
+    negatives.  x [n, N, F+N] (features ‖ observed adjacency, the gcn.py
+    packing); y [n, N, N] f32 in {-1, 0, 1} (engine loss kind "linkpred")."""
+    rng = np.random.RandomState(seed)
+    prng = np.random.RandomState((seed if proto_seed is None else proto_seed) + 77)
+    protos = prng.randn(2, feat_dim).astype(np.float32)
+    x = np.zeros((n, num_nodes, feat_dim + num_nodes), np.float32)
+    y = np.full((n, num_nodes, num_nodes), -1.0, np.float32)
+    half = num_nodes // 2
+    for i in range(n):
+        if bipartite:
+            # nodes [0, half) = users, [half, N) = items; community = group
+            comm = np.concatenate([rng.randint(0, 2, half), rng.randint(0, 2, num_nodes - half)])
+            is_user = np.arange(num_nodes) < half
+            cross = is_user[:, None] != is_user[None, :]
+            p_edge = np.where(comm[:, None] == comm[None, :], 0.8, 0.05) * cross
+        else:
+            comm = rng.randint(0, 2, num_nodes)
+            p_edge = np.where(comm[:, None] == comm[None, :], 0.7, 0.05)
+        feats = protos[comm] + 0.4 * rng.randn(num_nodes, feat_dim)
+        upper = np.triu(rng.rand(num_nodes, num_nodes) < p_edge, 1)
+        true_adj = (upper | upper.T)
+        # hold out a fraction of true edges as positive labels
+        iu, ju = np.nonzero(np.triu(true_adj, 1))
+        if len(iu) == 0:
+            x[i, :, :feat_dim] = feats
+            continue
+        k = max(1, int(holdout * len(iu)))
+        pick = rng.choice(len(iu), size=k, replace=False)
+        obs = true_adj.copy()
+        obs[iu[pick], ju[pick]] = obs[ju[pick], iu[pick]] = False
+        # negatives: sample k true non-edges (off-diagonal)
+        neg_mask = ~true_adj & ~np.eye(num_nodes, dtype=bool)
+        if bipartite:
+            neg_mask &= cross
+        ni, nj = np.nonzero(np.triu(neg_mask, 1))
+        npick = rng.choice(len(ni), size=min(k, len(ni)), replace=False)
+        y[i, iu[pick], ju[pick]] = y[i, ju[pick], iu[pick]] = 1.0
+        y[i, ni[npick], nj[npick]] = y[i, nj[npick], ni[npick]] = 0.0
+        x[i, :, :feat_dim] = feats
+        x[i, :, feat_dim:] = obs.astype(np.float32)
+    return x, y
+
+
+def make_multitask_graphs(
+    n: int, num_nodes: int = 16, feat_dim: int = 8, num_tasks: int = 8,
+    seed: int = 0, proto_seed: int = None, label_frac: float = 0.7,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Multi-task molecular-property-style graphs with PARTIAL labels — the
+    SpreadGNN setting (reference research/SpreadGNN; moleculenet sider/tox21
+    carry per-task label masks).  Each graph has a latent prototype; task t's
+    binary label is sign(w_t · prototype); each (graph, task) entry is
+    observed with prob ``label_frac`` else -1.  x packed as [n, N, F+N]
+    (gcn.py layout); y [n, T] f32 in {-1, 0, 1} (engine loss "mtl_bce")."""
+    rng = np.random.RandomState(seed)
+    prng = np.random.RandomState(seed if proto_seed is None else proto_seed)
+    n_proto = 6
+    protos = prng.randn(n_proto, feat_dim).astype(np.float32)
+    task_w = prng.randn(num_tasks, feat_dim).astype(np.float32)
+    x = np.zeros((n, num_nodes, feat_dim + num_nodes), np.float32)
+    y = np.zeros((n, num_tasks), np.float32)
+    densities = np.linspace(0.15, 0.6, n_proto)
+    for i in range(n):
+        c = rng.randint(0, n_proto)
+        n_real = rng.randint(max(num_nodes // 2, 2), num_nodes + 1)
+        feats = protos[c] + 0.4 * rng.randn(n_real, feat_dim)
+        upper = rng.rand(n_real, n_real) < densities[c]
+        adj = np.triu(upper, 1)
+        adj = (adj | adj.T).astype(np.float32)
+        x[i, :n_real, :feat_dim] = feats
+        x[i, :n_real, feat_dim : feat_dim + n_real] = adj
+        labels = (task_w @ protos[c] > 0).astype(np.float32)
+        observed = rng.rand(num_tasks) < label_frac
+        y[i] = np.where(observed, labels, -1.0)
+    return x, y

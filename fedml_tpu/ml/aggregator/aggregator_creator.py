@@ -41,4 +41,20 @@ def create_server_aggregator(model, args) -> ServerAggregator:
         from ..trainer.det_trainer import ModelTrainerDET
 
         return _TrainerEvalAggregator(model, args, ModelTrainerDET)
+    from ..trainer.trainer_creator import (
+        _LINKPRED_DATASETS, _MTL_DATASETS, _S2S_DATASETS,
+    )
+
+    if dataset in _S2S_DATASETS:
+        from ..trainer.s2s_trainer import ModelTrainerS2S
+
+        return _TrainerEvalAggregator(model, args, ModelTrainerS2S)
+    if dataset in _LINKPRED_DATASETS:
+        from ..trainer.graph_trainers import ModelTrainerLinkPred
+
+        return _TrainerEvalAggregator(model, args, ModelTrainerLinkPred)
+    if dataset in _MTL_DATASETS:
+        from ..trainer.graph_trainers import ModelTrainerMTL
+
+        return _TrainerEvalAggregator(model, args, ModelTrainerMTL)
     return DefaultServerAggregator(model, args)

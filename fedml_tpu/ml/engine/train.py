@@ -108,8 +108,44 @@ def detection_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray,
     return total / count, (total, count)
 
 
+def seq2seq_ce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray):
+    """Seq2seq teacher-forced CE (reference app/fednlp/seq2seq, BART-style):
+    logits [B, L, V] from a causal LM over the packed [src ‖ SEP ‖ tgt]
+    sequence; labels [B, L] int with -1 marking non-target positions (the
+    whole source prefix).  Per-token CE over target positions only."""
+    tok_mask = (labels >= 0).astype(jnp.float32)
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), jnp.maximum(labels, 0)
+    )
+    mask = mask.reshape(mask.shape + (1,) * (per.ndim - mask.ndim))
+    full = tok_mask * mask
+    total = jnp.sum(per * full)
+    count = jnp.maximum(jnp.sum(full), 1.0)
+    return total / count, (total, count)
+
+
+def masked_sentinel_bce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray):
+    """BCE over labeled entries only, with -1 sentinels marking unlabeled
+    positions.  Serves both link prediction ("linkpred": [B, N, N] pairwise
+    scores, labeled = held-out positives + sampled negatives — reference
+    app/fedgraphnn ego_networks/recsys_subgraph link_pred) and multi-task
+    property prediction with partial labels ("mtl_bce": [B, T] task logits,
+    the SpreadGNN / moleculenet setting)."""
+    labeled = (labels >= 0).astype(jnp.float32)
+    per = optax.sigmoid_binary_cross_entropy(
+        logits.astype(jnp.float32), jnp.maximum(labels, 0.0)
+    )
+    mask = mask.reshape(mask.shape + (1,) * (per.ndim - mask.ndim))
+    full = labeled * mask
+    total = jnp.sum(per * full)
+    count = jnp.maximum(jnp.sum(full), 1.0)
+    return total / count, (total, count)
+
+
 LOSS_FNS = {"ce": softmax_ce_loss, "bce": sigmoid_bce_loss,
-            "span": span_ce_loss, "det": detection_loss}
+            "span": span_ce_loss, "det": detection_loss,
+            "s2s": seq2seq_ce_loss, "linkpred": masked_sentinel_bce_loss,
+            "mtl_bce": masked_sentinel_bce_loss}
 
 
 def resolve_grad_hook(args, grad_hook: Optional[Callable]) -> Optional[Callable]:

@@ -1,0 +1,57 @@
+"""Graph-task ClientTrainers beyond graph classification (reference
+``app/fedgraphnn`` ego_networks_link_pred / recsys_subgraph_link_pred
+and ``research/SpreadGNN`` multi-task moleculenet).
+
+Both tasks share one masked-sentinel BCE eval (the -1 sentinel marks
+unlabeled pairs / tasks, matching the reference's masked-metric convention
+for link prediction and partially-labeled molecule sets); only the engine
+loss key differs."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cls_trainer import ModelTrainerCLS
+
+
+class _MaskedBCETrainer(ModelTrainerCLS):
+    """Shared eval: accuracy = (score > 0) vs label over labeled entries."""
+
+    def __init__(self, model, args, grad_hook=None):
+        super().__init__(model, args, grad_hook=grad_hook)
+
+        @jax.jit
+        def evaluate(variables, x, y):
+            import optax
+
+            scores = model.apply(variables, x, train=False).astype(jnp.float32)
+            labeled = (y >= 0).astype(jnp.float32)
+            labels = jnp.maximum(y, 0.0)
+            per = optax.sigmoid_binary_cross_entropy(scores, labels)
+            hit = ((scores > 0) == (labels > 0.5)).astype(jnp.float32) * labeled
+            return jnp.sum(per * labeled), jnp.sum(hit), jnp.sum(labeled)
+
+        self._bce_eval = evaluate
+
+    def test(self, test_data, device, args):
+        x, y = test_data
+        l, correct, total = self._bce_eval(self.variables, jnp.asarray(x), jnp.asarray(y))
+        return {
+            "test_correct": float(correct),
+            "test_loss": float(l),
+            "test_total": float(total),
+        }
+
+
+class ModelTrainerLinkPred(_MaskedBCETrainer):
+    """Link prediction: scores [B, N, N], labels {-1, 0, 1}."""
+
+    loss_kind = "linkpred"
+
+
+class ModelTrainerMTL(_MaskedBCETrainer):
+    """Multi-task binary property prediction with partial labels
+    (SpreadGNN setting): logits [B, T], labels {-1, 0, 1}."""
+
+    loss_kind = "mtl_bce"

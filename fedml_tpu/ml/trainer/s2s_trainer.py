@@ -1,0 +1,52 @@
+"""Seq2seq ClientTrainer (reference ``app/fednlp/seq2seq`` summarization /
+dialogue task): causal-LM teacher forcing over the packed [src ‖ SEP ‖ tgt]
+sequence, loss/eval masked to target positions (engine loss kind "s2s").
+Eval reports masked token accuracy (test_correct/test_total) plus exact
+sequence match (test_exact)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cls_trainer import ModelTrainerCLS
+
+
+class ModelTrainerS2S(ModelTrainerCLS):
+    loss_kind = "s2s"
+
+    def __init__(self, model, args, grad_hook=None):
+        super().__init__(model, args, grad_hook=grad_hook)
+
+        @jax.jit
+        def evaluate(variables, x, y):
+            import optax
+
+            logits = model.apply(variables, x, train=False).astype(jnp.float32)
+            tok_mask = (y >= 0).astype(jnp.float32)
+            labels = jnp.maximum(y, 0)
+            per = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+            pred = jnp.argmax(logits, axis=-1)
+            hit = (pred == labels).astype(jnp.float32) * tok_mask
+            exact = jnp.all((pred == labels) | (tok_mask < 0.5), axis=-1)
+            return (
+                jnp.sum(per * tok_mask),
+                jnp.sum(hit),
+                jnp.sum(tok_mask),
+                jnp.sum(exact.astype(jnp.float32)),
+            )
+
+        self._s2s_eval = evaluate
+
+    def test(self, test_data, device, args):
+        x, y = test_data
+        l, correct, total, exact = self._s2s_eval(
+            self.variables, jnp.asarray(x), jnp.asarray(y)
+        )
+        return {
+            "test_correct": float(correct),
+            "test_loss": float(l),
+            "test_total": float(total),
+            # normalized like det_trainer's test_mean_iou (rate, not count)
+            "test_exact_match": float(exact) / max(float(len(y)), 1.0),
+        }

@@ -12,6 +12,9 @@ _TAG_DATASETS = {"stackoverflow_lr"}
 _SEQTAG_DATASETS = {"onto_tagging", "wikiner"}
 _SPAN_DATASETS = {"squad_span"}
 _DET_DATASETS = {"synthetic_det", "coco_det"}
+_S2S_DATASETS = {"synthetic_s2s", "cornell_movie_dialogue"}
+_LINKPRED_DATASETS = {"ego_linkpred", "recsys_linkpred"}
+_MTL_DATASETS = {"moleculenet_mtl"}
 
 
 def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
@@ -32,6 +35,18 @@ def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
         from .det_trainer import ModelTrainerDET
 
         return ModelTrainerDET(model, args, grad_hook=grad_hook)
+    if dataset in _S2S_DATASETS:
+        from .s2s_trainer import ModelTrainerS2S
+
+        return ModelTrainerS2S(model, args, grad_hook=grad_hook)
+    if dataset in _LINKPRED_DATASETS:
+        from .graph_trainers import ModelTrainerLinkPred
+
+        return ModelTrainerLinkPred(model, args, grad_hook=grad_hook)
+    if dataset in _MTL_DATASETS:
+        from .graph_trainers import ModelTrainerMTL
+
+        return ModelTrainerMTL(model, args, grad_hook=grad_hook)
     from .cls_trainer import ModelTrainerCLS
 
     return ModelTrainerCLS(model, args, grad_hook=grad_hook)

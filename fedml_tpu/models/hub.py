@@ -138,6 +138,30 @@ def create(args: Any, output_dim: int) -> nn.Module:
 
         feat_dim = int(DATASET_SPECS.get(dataset, {}).get("feat_dim", 8))
         return GCN(num_classes=output_dim, feat_dim=feat_dim)
+    if name in ("gcn_linkpred", "gcn_link_pred"):
+        from ..data.data_loader import DATASET_SPECS
+        from .gcn import GCNLinkPred
+
+        feat_dim = int(DATASET_SPECS.get(dataset, {}).get("feat_dim", 8))
+        return GCNLinkPred(feat_dim=feat_dim)
+    if name in ("gcn_mtl", "gcn_multitask"):
+        from ..data.data_loader import DATASET_SPECS
+        from .gcn import GCN
+
+        spec = DATASET_SPECS.get(dataset, {})
+        feat_dim = int(spec.get("feat_dim", 8))
+        return GCN(num_classes=int(spec.get("num_tasks", output_dim)), feat_dim=feat_dim)
+    if name in ("transformer_s2s", "bart_s2s", "seq2seq"):
+        from ..data.data_loader import DATASET_SPECS
+        from .transformer import TransformerConfig, TransformerLM
+
+        vocab = int(DATASET_SPECS.get(dataset, {}).get("vocab", max(output_dim, 64)))
+        # causal decoder-only over [src ‖ SEP ‖ tgt] — the TPU-first seq2seq
+        # (reference app/fednlp/seq2seq uses encoder-decoder BART; the task
+        # contract is identical with loss masked to target positions)
+        return TransformerLM(TransformerConfig(
+            vocab_size=vocab, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+        ))
     if name in ("mlp",):
         from .linear import MLP
 

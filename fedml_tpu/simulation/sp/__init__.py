@@ -51,6 +51,10 @@ def _dispatch(optimizer: str, args, device, dataset, model):
         from .decentralized.decentralized_api import DecentralizedFLAPI
 
         return DecentralizedFLAPI(args, device, dataset, model)
+    if opt == "spreadgnn":
+        from .spreadgnn.spreadgnn_api import SpreadGNNAPI
+
+        return SpreadGNNAPI(args, device, dataset, model)
     if opt == "turbo_aggregate":
         from .turboaggregate.ta_api import TurboAggregateAPI
 
