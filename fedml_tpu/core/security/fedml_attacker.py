@@ -9,6 +9,7 @@ updates, and data loaders call ``poison_data`` for label-flipping.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -169,9 +170,36 @@ class FedMLAttacker:
         if self.attack_type == ATTACK_METHOD_BACKDOOR:
             self._key, sub = jax.random.split(self._key)
             return A.poison_backdoor(x, y, target, frac, sub)
-        if self.attack_type == ATTACK_METHOD_EDGE_CASE_BACKDOOR and logits is not None:
-            return A.poison_edge_cases(x, y, jnp.asarray(logits), target, frac)
+        if self.attack_type == ATTACK_METHOD_EDGE_CASE_BACKDOOR:
+            pool = self._edge_case_pool(x.shape[1:])
+            if pool is not None:
+                # reference variant (edge_case_examples ARDIS/Southwest
+                # pickles): inject mounted edge-case inputs labeled target
+                self._key, sub = jax.random.split(self._key)
+                k = max(1, int(frac * len(y)))
+                ksrc, kpos = jax.random.split(sub)
+                src = jax.random.choice(ksrc, pool.shape[0], (k,))
+                pos = jax.random.choice(kpos, len(y), (k,), replace=False)
+                return x.at[pos].set(pool[src]), y.at[pos].set(target)
+            if logits is not None:
+                return A.poison_edge_cases(x, y, jnp.asarray(logits), target, frac)
         return x, y
+
+    def _edge_case_pool(self, sample_shape):
+        """Mounted edge-case example pool (``args.edge_case_dir`` pointing at
+        reference-format pickles); cached; None when absent or shape-mismatched."""
+        if not hasattr(self, "_edge_pool_cache"):
+            import jax.numpy as jnp
+
+            from ...data.loaders import load_edge_case_pool
+
+            root = getattr(self.args, "edge_case_dir", None)
+            pool = load_edge_case_pool(root) if root and os.path.isdir(root) else None
+            self._edge_pool_cache = None if pool is None else jnp.asarray(pool)
+        pool = self._edge_pool_cache
+        if pool is None or tuple(pool.shape[1:]) != tuple(sample_shape):
+            return None
+        return pool
 
     def poison_local_data(self, client_idx: int, num_clients: int, x, y, logits=None):
         """Per-client data-poisoning entry the round loop calls before local

@@ -69,6 +69,17 @@ DATASET_SPECS: Dict[str, Dict[str, Any]] = {
     "uci": dict(classes=2, shape=(32,), train=8000, test=1600, kind="feature"),
     "lending_club": dict(classes=2, shape=(90,), train=10000, test=2000, kind="feature"),
     "fets2021": dict(classes=3, shape=(32, 32, 3), train=1000, test=200, kind="segmentation"),
+    # ImageNet family (reference data/ImageNet; downsampled 32px variant for
+    # TPU-static shapes — mounted train/val wnid folders parse via loaders)
+    "imagenet": dict(classes=1000, shape=(32, 32, 3), train=20000, test=4000, kind="image"),
+    "ilsvrc2012": dict(classes=1000, shape=(32, 32, 3), train=20000, test=4000, kind="image"),
+    "tiny_imagenet": dict(classes=200, shape=(32, 32, 3), train=20000, test=4000, kind="image"),
+    # Google Landmarks federated splits (reference data/Landmarks)
+    "gld23k": dict(classes=203, shape=(32, 32, 3), train=23080, test=1959, kind="image"),
+    "gld160k": dict(classes=2028, shape=(32, 32, 3), train=40000, test=4000, kind="image"),
+    # NUS-WIDE multi-label low-level features (reference data/NUS_WIDE,
+    # the vertical-FL dataset: 634-dim concatenated feature blocks, top-5 labels)
+    "nuswide": dict(classes=5, shape=(634,), train=20000, test=4000, kind="taglr"),
     # fednlp sequence tagging / span extraction (reference app/fednlp
     # seq_tagging + span_extraction; synthetic corpora share the shapes)
     "onto_tagging": dict(classes=8, shape=(32,), train=8000, test=1600, kind="seqtag", vocab=2000),

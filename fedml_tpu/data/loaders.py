@@ -149,7 +149,8 @@ def load_image_folder(root: str, size: int = 32) -> Optional[Arrays]:
         return np.stack(xs), np.asarray(ys, np.int32)
 
     train = _split(os.path.join(root, "train"))
-    test = _split(os.path.join(root, "test")) or _split(os.path.join(root, "valid"))
+    test = (_split(os.path.join(root, "test")) or _split(os.path.join(root, "valid"))
+            or _split(os.path.join(root, "val")))
     if train is None or test is None:
         return None
     return train[0], train[1], test[0], test[1]
@@ -229,8 +230,247 @@ def try_load_real(name: str, cache_dir: str) -> Optional[Arrays]:
             out = load_leaf_json(root)
         elif name in ("uci", "lending_club"):
             out = load_csv_labeled(root)
+        elif name in ("imagenet", "ilsvrc2012", "tiny_imagenet"):
+            out = load_imagenet_folder(root)
+        elif name in ("gld23k", "gld160k", "landmarks"):
+            out = load_landmarks_csv(root)
+        elif name in ("nuswide", "nus_wide"):
+            out = load_nuswide(root)
+        elif name == "fets2021":
+            out = load_fets_nifti(root)
         else:
             out = None
         if out is not None:
             return out
     return None
+
+
+# -- ImageNet / ILSVRC2012 ---------------------------------------------------
+
+
+def load_imagenet_folder(root: str, size: int = 32) -> Optional[Arrays]:
+    """ImageNet/ILSVRC2012 directory layout (reference
+    ``data/ImageNet/datasets.py:83-106``): ``train/<wnid>/*.JPEG`` +
+    ``val/<wnid>/*.JPEG`` (torchvision-foldered val).  Same ImageFolder
+    traversal as CINIC-10; class index = sorted wnid order.  Images are
+    resized to ``size`` (downsampled-ImageNet style) for TPU-static shapes."""
+    return load_image_folder(root, size=size)
+
+
+# -- Google Landmarks (gld23k / gld160k) ------------------------------------
+
+
+def load_landmarks_csv(root: str, size: int = 32) -> Optional[Arrays]:
+    """Google Landmarks federated split (reference
+    ``data/Landmarks/data_loader.py:123-150``): mapping CSVs with
+    ``user_id,image_id,class`` columns + an image directory.  Train CSV is
+    the first of ``*train*.csv`` / ``data_user.csv``; test is ``*test*.csv``;
+    images are searched as ``<image_id>.jpg`` under ``images/``, ``train/``,
+    or the root.  Needs Pillow."""
+    try:
+        from PIL import Image
+    except ImportError:  # pragma: no cover
+        return None
+    import csv as _csv
+    import glob as _glob
+
+    def _find_csv(*pats):
+        for p in pats:
+            hits = sorted(_glob.glob(os.path.join(root, p)))
+            if hits:
+                return hits[0]
+        return None
+
+    train_csv = _find_csv("*train*.csv", "data_user.csv")
+    test_csv = _find_csv("*test*.csv")
+    if train_csv is None or test_csv is None:
+        return None
+    img_dirs = [os.path.join(root, d) for d in ("images", "train", "")]
+
+    def _load_split(path):
+        xs, ys = [], []
+        with open(path, newline="") as f:
+            rows = list(_csv.DictReader(f))
+        if not rows or not {"image_id", "class"} <= set(rows[0]):
+            return None
+        for row in rows:
+            fname = row["image_id"] + ".jpg"
+            for d in img_dirs:
+                p = os.path.join(d, fname)
+                if os.path.isfile(p):
+                    img = Image.open(p).convert("RGB")
+                    if img.size != (size, size):
+                        img = img.resize((size, size))
+                    xs.append(np.asarray(img, np.float32) / 255.0)
+                    ys.append(int(row["class"]))
+                    break
+        if not xs:
+            return None
+        return np.stack(xs), np.asarray(ys, np.int32)
+
+    train, test = _load_split(train_csv), _load_split(test_csv)
+    if train is None or test is None:
+        return None
+    return train[0], train[1], test[0], test[1]
+
+
+# -- NUS-WIDE (multi-label; the reference's vertical-FL dataset) ------------
+
+
+def load_nuswide(root: str) -> Optional[Arrays]:
+    """NUS-WIDE low-level-features + multi-label groundtruth (reference
+    ``data/NUS_WIDE/nus_wide_dataset.py:8-60`` layout):
+    ``Groundtruth/TrainTestLabels/Labels_<name>_<Train|Test>.txt`` (one 0/1
+    per line) and ``Low_Level_Features/*_<Train|Test>_*.dat`` (whitespace-
+    separated floats per line, concatenated feature blocks).  Returns
+    multi-hot y [N, L] over the sorted label names."""
+    import glob as _glob
+
+    lab_dir = os.path.join(root, "Groundtruth", "TrainTestLabels")
+    feat_dir = os.path.join(root, "Low_Level_Features")
+    if not (os.path.isdir(lab_dir) and os.path.isdir(feat_dir)):
+        return None
+    names = sorted(
+        os.path.basename(p)[len("Labels_"):-len("_Train.txt")]
+        for p in _glob.glob(os.path.join(lab_dir, "Labels_*_Train.txt"))
+    )
+    if not names:
+        return None
+
+    def _labels(dtype):
+        cols = []
+        for nm in names:
+            p = os.path.join(lab_dir, f"Labels_{nm}_{dtype}.txt")
+            if not os.path.isfile(p):
+                return None
+            cols.append(np.loadtxt(p, dtype=np.float32).reshape(-1))
+        return np.stack(cols, axis=1)
+
+    def _feats(dtype):
+        blocks = []
+        for p in sorted(_glob.glob(os.path.join(feat_dir, f"*_{dtype}_*.dat"))):
+            blocks.append(np.loadtxt(p, dtype=np.float32, ndmin=2))
+        if not blocks:
+            return None
+        return np.concatenate(blocks, axis=1)
+
+    xt, yt = _feats("Train"), _labels("Train")
+    xe, ye = _feats("Test"), _labels("Test")
+    if any(v is None for v in (xt, yt, xe, ye)):
+        return None
+    n_tr, n_te = min(len(xt), len(yt)), min(len(xe), len(ye))
+    return xt[:n_tr], yt[:n_tr], xe[:n_te], ye[:n_te]
+
+
+# -- FeTS 2021 (medical segmentation, NIfTI volumes) ------------------------
+
+_NIFTI_DTYPES = {2: np.uint8, 4: np.int16, 8: np.int32, 16: np.float32,
+                 64: np.float64, 256: np.int8, 512: np.uint16}
+
+
+def _read_nifti(path: str) -> Optional[np.ndarray]:
+    """Minimal little-endian NIfTI-1 reader (no nibabel in the image):
+    348-byte header — dim[8] @40, datatype @70, vox_offset @108; data is
+    Fortran-ordered."""
+    import gzip
+    import struct
+
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        buf = f.read()
+    if len(buf) < 352 or struct.unpack_from("<i", buf, 0)[0] != 348:
+        return None
+    dim = struct.unpack_from("<8h", buf, 40)
+    ndim = max(1, min(dim[0], 7))
+    shape = tuple(int(d) for d in dim[1 : 1 + ndim])
+    dt = _NIFTI_DTYPES.get(struct.unpack_from("<h", buf, 70)[0])
+    if dt is None or any(s <= 0 for s in shape):
+        return None
+    vox = int(struct.unpack_from("<f", buf, 108)[0]) or 352
+    n = int(np.prod(shape))
+    arr = np.frombuffer(buf, dtype=dt, offset=vox, count=n)
+    return arr.reshape(shape, order="F")
+
+
+def _mid_slice_resized(vol: np.ndarray, size: int) -> np.ndarray:
+    """Middle axial slice, nearest-neighbor resized to [size, size]."""
+    sl = vol[:, :, vol.shape[2] // 2] if vol.ndim >= 3 else vol
+    sl = np.asarray(sl, np.float32)
+    iy = np.linspace(0, sl.shape[0] - 1, size).astype(int)
+    ix = np.linspace(0, sl.shape[1] - 1, size).astype(int)
+    return sl[np.ix_(iy, ix)]
+
+
+def load_fets_nifti(root: str, size: int = 32) -> Optional[Arrays]:
+    """FeTS 2021 (reference ``data/FeTS2021``; BraTS per-subject layout):
+    ``<subject>/<subject>_{t1,t1ce,t2,flair}.nii[.gz]`` + ``_seg``.  Takes
+    the middle axial slice, stacks 3 modalities as channels (normalized
+    per-slice), maps seg labels {0,1,2,4} -> {0,1,2}, and splits subjects
+    80/20 (sorted order, deterministic)."""
+    subjects = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    xs, ys = [], []
+    for s in subjects:
+        sdir = os.path.join(root, s)
+        files = {f.lower(): os.path.join(sdir, f) for f in os.listdir(sdir)}
+
+        def _mod(suffix):
+            for k, p in files.items():
+                if suffix in k and k.endswith((".nii", ".nii.gz")):
+                    return _read_nifti(p)
+            return None
+
+        seg = _mod("_seg")
+        mods = [m for m in (_mod("_t1ce"), _mod("_t1"), _mod("_t2"), _mod("_flair"))
+                if m is not None][:3]
+        if seg is None or not mods:
+            continue
+        while len(mods) < 3:
+            mods.append(mods[-1])
+        chans = []
+        for m in mods:
+            sl = _mid_slice_resized(m, size)
+            denom = sl.max() - sl.min()
+            chans.append((sl - sl.min()) / (denom if denom > 0 else 1.0))
+        mask = _mid_slice_resized(seg, size).astype(np.int32)
+        mask = np.where(mask >= 2, 2, mask)
+        xs.append(np.stack(chans, axis=-1))
+        ys.append(mask)
+    if len(xs) < 2:
+        return None
+    x, y = np.stack(xs), np.stack(ys)
+    cut = max(1, int(0.8 * len(x)))
+    return x[:cut], y[:cut], x[cut:], y[cut:]
+
+
+# -- edge-case backdoor example pools (ARDIS / Southwest) --------------------
+
+
+def load_edge_case_pool(root: str) -> Optional[np.ndarray]:
+    """Edge-case backdoor example pool (reference
+    ``data/edge_case_examples/data_loader.py``: ARDIS '7's for MNIST,
+    Southwest airliners for CIFAR — pickles of image arrays).  Accepts any
+    ``*.pkl`` under ``root`` holding an ndarray [N, ...] or a dict with a
+    'data' entry; pools are concatenated.  Returns float images in [0, 1]."""
+    import glob as _glob
+    import pickle
+
+    pools = []
+    for p in sorted(_glob.glob(os.path.join(root, "*.pkl"))):
+        try:
+            with open(p, "rb") as f:
+                obj = pickle.load(f)
+        except Exception:
+            continue
+        if isinstance(obj, dict):
+            obj = obj.get("data")
+        arr = np.asarray(obj)
+        if arr.ndim >= 2 and len(arr):
+            arr = arr.astype(np.float32)
+            if arr.max() > 1.5:  # uint8-coded images
+                arr = arr / 255.0
+            pools.append(arr)
+    if not pools:
+        return None
+    return np.concatenate(pools, axis=0)

@@ -6,7 +6,7 @@ from __future__ import annotations
 from ...core.alg_frame.client_trainer import ClientTrainer
 
 _NWP_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
-_TAG_DATASETS = {"stackoverflow_lr"}
+_TAG_DATASETS = {"stackoverflow_lr", "nuswide", "nus_wide"}
 # per-token classification reuses the NWP trainer (same masked per-token CE
 # and token-accuracy math — reference seq_tagging task)
 _SEQTAG_DATASETS = {"onto_tagging", "wikiner"}

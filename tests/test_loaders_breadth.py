@@ -1,0 +1,166 @@
+"""Mounted-file parsers beyond MNIST/CIFAR/LEAF (reference
+``data/{ImageNet,Landmarks,NUS_WIDE,FeTS2021,edge_case_examples}``):
+each test fabricates files in the real on-disk layout and checks the
+parser round-trips them."""
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data import loaders
+
+
+def _write_png(path, arr):
+    from PIL import Image
+
+    Image.fromarray(arr.astype(np.uint8)).save(path)
+
+
+class TestImageNetFolder:
+    def test_train_val_wnid_layout(self, tmp_path):
+        rng = np.random.RandomState(0)
+        for split, per in (("train", 3), ("val", 2)):
+            for wnid in ("n01440764", "n01443537"):
+                d = tmp_path / split / wnid
+                d.mkdir(parents=True)
+                for i in range(per):
+                    _write_png(d / f"{wnid}_{i}.JPEG".replace("JPEG", "jpeg"),
+                               rng.randint(0, 255, (48, 48, 3)))
+        out = loaders.load_imagenet_folder(str(tmp_path), size=32)
+        assert out is not None
+        xt, yt, xe, ye = out
+        assert xt.shape == (6, 32, 32, 3) and xe.shape == (4, 32, 32, 3)
+        assert set(yt) == {0, 1} and xt.max() <= 1.0
+
+
+class TestLandmarksCSV:
+    def test_mapping_csv_plus_images(self, tmp_path):
+        rng = np.random.RandomState(0)
+        (tmp_path / "images").mkdir()
+        rows_train, rows_test = [], []
+        for i in range(6):
+            img_id = f"img{i:03d}"
+            _write_png(tmp_path / "images" / f"{img_id}.jpg",
+                       rng.randint(0, 255, (32, 32, 3)))
+            (rows_train if i < 4 else rows_test).append(
+                (f"user{i % 2}", img_id, i % 3)
+            )
+        for name, rows in (("mini_gld_train_split.csv", rows_train),
+                           ("mini_gld_test.csv", rows_test)):
+            with open(tmp_path / name, "w") as f:
+                f.write("user_id,image_id,class\n")
+                for u, im, c in rows:
+                    f.write(f"{u},{im},{c}\n")
+        out = loaders.load_landmarks_csv(str(tmp_path))
+        assert out is not None
+        xt, yt, xe, ye = out
+        assert len(xt) == 4 and len(xe) == 2
+        assert list(yt) == [0, 1, 2, 0]
+
+
+class TestNUSWide:
+    def test_features_and_multilabel(self, tmp_path):
+        lab = tmp_path / "Groundtruth" / "TrainTestLabels"
+        feat = tmp_path / "Low_Level_Features"
+        lab.mkdir(parents=True), feat.mkdir()
+        rng = np.random.RandomState(0)
+        n_tr, n_te = 10, 4
+        for name in ("animal", "sky"):
+            np.savetxt(lab / f"Labels_{name}_Train.txt", rng.randint(0, 2, n_tr), fmt="%d")
+            np.savetxt(lab / f"Labels_{name}_Test.txt", rng.randint(0, 2, n_te), fmt="%d")
+        for block, d in (("CH", 3), ("EDH", 2)):
+            np.savetxt(feat / f"Normalized_{block}_Train_x.dat", rng.rand(n_tr, d))
+            np.savetxt(feat / f"Normalized_{block}_Test_x.dat", rng.rand(n_te, d))
+        out = loaders.load_nuswide(str(tmp_path))
+        assert out is not None
+        xt, yt, xe, ye = out
+        assert xt.shape == (10, 5) and yt.shape == (10, 2)  # 3+2 feature dims
+        assert xe.shape == (4, 5) and ye.shape == (4, 2)
+        assert set(np.unique(yt)) <= {0.0, 1.0}
+
+
+def _write_nifti(path, vol, dtype_code=16, np_dtype=np.float32):
+    hdr = bytearray(352)
+    struct.pack_into("<i", hdr, 0, 348)
+    dims = (vol.ndim,) + vol.shape + (1,) * (7 - vol.ndim)
+    struct.pack_into("<8h", hdr, 40, *dims)
+    struct.pack_into("<h", hdr, 70, dtype_code)
+    struct.pack_into("<f", hdr, 108, 352.0)
+    data = np.asarray(vol, np_dtype).flatten(order="F").tobytes()
+    op = gzip.open if str(path).endswith(".gz") else open
+    with op(str(path), "wb") as f:
+        f.write(bytes(hdr) + data)
+
+
+class TestFeTSNifti:
+    def test_brats_subject_layout(self, tmp_path):
+        rng = np.random.RandomState(0)
+        for s in range(4):
+            d = tmp_path / f"FeTS21_{s:03d}"
+            d.mkdir()
+            for mod in ("t1", "t1ce", "t2"):
+                _write_nifti(d / f"FeTS21_{s:03d}_{mod}.nii.gz",
+                             rng.rand(20, 22, 8).astype(np.float32))
+            seg = rng.choice([0, 1, 2, 4], size=(20, 22, 8))
+            _write_nifti(d / f"FeTS21_{s:03d}_seg.nii.gz", seg,
+                         dtype_code=4, np_dtype=np.int16)
+        out = loaders.load_fets_nifti(str(tmp_path))
+        assert out is not None
+        xt, yt, xe, ye = out
+        assert xt.shape == (3, 32, 32, 3) and yt.shape == (3, 32, 32)
+        assert xe.shape == (1, 32, 32, 3)
+        assert set(np.unique(np.concatenate([yt, ye]))) <= {0, 1, 2}
+        assert 0.0 <= xt.min() and xt.max() <= 1.0
+
+    def test_nifti_roundtrip_fortran_order(self, tmp_path):
+        vol = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        _write_nifti(tmp_path / "v.nii", vol)
+        back = loaders._read_nifti(str(tmp_path / "v.nii"))
+        assert back.shape == (2, 3, 4)
+        assert np.array_equal(back, vol)
+
+
+class TestEdgeCasePool:
+    def test_pickled_pools_concatenate(self, tmp_path):
+        rng = np.random.RandomState(0)
+        a = rng.randint(0, 255, (5, 8, 8, 3)).astype(np.uint8)
+        b = {"data": rng.rand(3, 8, 8, 3).astype(np.float32)}
+        with open(tmp_path / "southwest_train.pkl", "wb") as f:
+            pickle.dump(a, f)
+        with open(tmp_path / "ardis_test.pkl", "wb") as f:
+            pickle.dump(b, f)
+        pool = loaders.load_edge_case_pool(str(tmp_path))
+        assert pool.shape == (8, 8, 8, 3)
+        assert pool.max() <= 1.0
+
+    def test_attacker_injects_mounted_pool(self, tmp_path):
+        import jax.numpy as jnp
+
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+
+        rng = np.random.RandomState(0)
+        pool = np.full((4, 6, 6, 1), 0.5, np.float32)
+        with open(tmp_path / "edge.pkl", "wb") as f:
+            pickle.dump(pool, f)
+        args = Arguments.from_dict({"common_args": {}, "train_args": {}})
+        args.enable_attack = True
+        args.attack_type = "edge_case_backdoor"
+        args.byzantine_client_num = 1
+        args.attack_client_num = 1
+        args.client_num_in_total = 2
+        args.target_class = 9
+        args.poison_fraction = 0.5
+        args.edge_case_dir = str(tmp_path)
+        atk = FedMLAttacker.get_instance()
+        atk.init(args)
+        x = jnp.zeros((10, 6, 6, 1))
+        y = jnp.zeros((10,), jnp.int32)
+        px, py = atk.poison_dataset(x, y)
+        n_poisoned = int((py == 9).sum())
+        assert n_poisoned == 5  # frac * len
+        assert float(px.max()) == 0.5  # pool pixels injected
