@@ -31,21 +31,89 @@ def cmd_env(args) -> int:
 
 
 def cmd_login(args) -> int:
-    """Bind an account id (reference ``fedml login <account_id>``; the MLOps
-    platform handshake is represented by the local binding file)."""
+    """Bind an account id and start the edge daemon (reference ``fedml
+    login <account_id>`` boots ``client_daemon.py``; ``--no-daemon`` keeps
+    just the local binding file)."""
     os.makedirs(ACCOUNT_DIR, exist_ok=True)
+    record = {"account_id": args.account_id, "role": args.role}
+    if not args.no_daemon:
+        import subprocess
+
+        from .edge_deployment.daemon import FedMLDaemon
+
+        home = args.daemon_home or os.path.join(ACCOUNT_DIR, f"daemon_{args.role}")
+        os.makedirs(home, exist_ok=True)
+        state = FedMLDaemon.read_state(home)
+        if state is not None and __import__("time").time() - state["time"] < 10:
+            try:
+                os.kill(int(state["pid"]), 0)
+                print(f"daemon already running (pid {state['pid']}, home {home}); "
+                      "logout first to restart it")
+                return 1
+            except (OSError, ValueError):
+                pass  # stale heartbeat from a dead daemon: start a fresh one
+        cmd = [sys.executable, "-m", "fedml_tpu.cli.edge_deployment.daemon",
+               "--home", home, "--role", args.role, "--account-id", args.account_id]
+        if args.broker:
+            cmd += ["--broker", args.broker]
+        with open(os.path.join(home, "daemon.log"), "ab") as logf:
+            proc = subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
+                                    start_new_session=True)
+        record["daemon_pid"] = proc.pid
+        record["daemon_home"] = home
+        print(f"daemon started (pid {proc.pid}, home {home})")
     with open(ACCOUNT_FILE, "w") as f:
-        json.dump({"account_id": args.account_id, "role": args.role}, f)
+        json.dump(record, f)
     print(f"logged in as account {args.account_id} ({args.role})")
     return 0
 
 
 def cmd_logout(_args) -> int:
     try:
+        with open(ACCOUNT_FILE) as f:
+            record = json.load(f)
+    except (FileNotFoundError, ValueError):
+        record = {}
+    home = record.get("daemon_home")
+    if home:
+        from .edge_deployment.daemon import FedMLDaemon
+
+        try:
+            FedMLDaemon.request_stop(home)
+            print(f"daemon stop requested ({home})")
+        except OSError:
+            print(f"daemon home {home} gone; clearing binding anyway")
+    try:
         os.remove(ACCOUNT_FILE)
     except FileNotFoundError:
         pass
     print("logged out")
+    return 0
+
+
+def cmd_dispatch(args) -> int:
+    """Dispatch a run request to a running daemon (reference: the MLOps
+    platform pushing a start-run message to the device)."""
+    req = {"run_id": args.run_id, "package": os.path.abspath(args.package),
+           "max_restarts": args.max_restarts, "extra_args": args.extra or []}
+    home = args.daemon_home
+    if home is None:
+        try:
+            with open(ACCOUNT_FILE) as f:
+                home = json.load(f).get("daemon_home")
+        except (FileNotFoundError, ValueError):
+            pass
+    if home is None:
+        print("no daemon home (login first or pass --daemon_home)")
+        return 1
+    dispatch = os.path.join(home, "dispatch")
+    os.makedirs(dispatch, exist_ok=True)
+    path = os.path.join(dispatch, f"run_{args.run_id}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(req, f)
+    os.replace(tmp, path)
+    print(f"dispatched run {args.run_id} -> {path}")
     return 0
 
 
@@ -82,6 +150,27 @@ def cmd_run(args) -> int:
 
 
 def cmd_status(args) -> int:
+    if args.run_dir is None:
+        # daemon-level status (reference `fedml status` against the platform)
+        from .edge_deployment.daemon import FedMLDaemon
+
+        home = args.daemon_home
+        if home is None:
+            try:
+                with open(ACCOUNT_FILE) as f:
+                    home = json.load(f).get("daemon_home")
+            except (FileNotFoundError, ValueError):
+                pass
+        state = FedMLDaemon.read_state(home) if home else None
+        if state is None:
+            print("no daemon state (login first, or pass --run_dir)")
+            return 1
+        age = __import__("time").time() - state["time"]
+        print(f"daemon pid={state['pid']} role={state['role']} "
+              f"account={state['account_id']} heartbeat {age:.1f}s ago")
+        for rid, st in sorted(state.get("runs", {}).items()):
+            print(f"  run {rid}: {st}")
+        return 0
     from .edge_deployment.client_runner import FedMLRunnerSupervisor
 
     records = FedMLRunnerSupervisor.read_status(args.run_dir)
@@ -118,9 +207,22 @@ def build_parser() -> argparse.ArgumentParser:
     pl = sub.add_parser("login")
     pl.add_argument("account_id")
     pl.add_argument("--role", default="client", choices=["client", "server"])
+    pl.add_argument("--no-daemon", action="store_true",
+                    help="only write the account binding; don't start the daemon")
+    pl.add_argument("--daemon_home", default=None)
+    pl.add_argument("--broker", default=None,
+                    help="host:port of a LocalBroker to take dispatches from")
     pl.set_defaults(fn=cmd_login)
 
     sub.add_parser("logout").set_defaults(fn=cmd_logout)
+
+    pd = sub.add_parser("dispatch")
+    pd.add_argument("--package", "-p", required=True)
+    pd.add_argument("--run_id", default="0")
+    pd.add_argument("--daemon_home", default=None)
+    pd.add_argument("--max_restarts", type=int, default=2)
+    pd.add_argument("extra", nargs="*", help="extra args passed to the entry")
+    pd.set_defaults(fn=cmd_dispatch)
 
     pb = sub.add_parser("build")
     pb.add_argument("--type", "-t", default="client", choices=["client", "server"])
@@ -141,7 +243,9 @@ def build_parser() -> argparse.ArgumentParser:
     pr.set_defaults(fn=cmd_run)
 
     ps = sub.add_parser("status")
-    ps.add_argument("--run_dir", "-d", required=True)
+    ps.add_argument("--run_dir", "-d", default=None,
+                    help="run directory (omit for daemon-level status)")
+    ps.add_argument("--daemon_home", default=None)
     ps.set_defaults(fn=cmd_status)
 
     pg = sub.add_parser("logs")

@@ -1,0 +1,217 @@
+"""Edge deployment daemon — the long-lived login process.
+
+Parity with reference ``cli/edge_deployment/client_daemon.py`` +
+``server_runner.py`` (the ~2k-LoC platform daemons): ``fedml login``
+starts one of these per device; it then
+* listens for run-dispatch requests — from a local dispatch directory
+  (drop ``run_<id>.json``; the zero-egress stand-in for the hosted MLOps
+  request channel) and, when a broker address is configured, from the
+  in-repo TCP broker topic ``mlops/deploy/<role>/<account>`` (the same
+  channel the reference's MQTT daemon subscribes to),
+* spawns a supervised runner per request (``FedMLRunnerSupervisor``:
+  unpack package, run entry, restart-on-crash budget),
+* heart-beats its pid + per-run status FSM into ``daemon.json`` so
+  ``fedml status`` can introspect it from another process,
+* publishes run status transitions back to the broker
+  (``mlops/status/<role>/<run_id>``) when connected — the reporting leg
+  of the reference's MLOps glue,
+* stops when ``daemon.stop`` appears (``fedml logout``) or on SIGTERM.
+
+Request schema (file or broker payload)::
+
+    {"run_id": "42", "package": "/path/to/pkg.zip",
+     "extra_args": [...], "max_restarts": 2}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .client_runner import FedMLRunnerSupervisor
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLDaemon:
+    def __init__(
+        self,
+        home_dir: str,
+        role: str = "client",
+        account_id: str = "0",
+        broker: Optional[str] = None,  # "host:port" of a LocalBroker
+        poll_interval: float = 0.5,
+    ):
+        self.home = os.path.abspath(home_dir)
+        self.role = role
+        self.account_id = str(account_id)
+        self.poll_interval = float(poll_interval)
+        self.dispatch_dir = os.path.join(self.home, "dispatch")
+        self.runs_dir = os.path.join(self.home, "runs")
+        self.state_path = os.path.join(self.home, "daemon.json")
+        self.stop_path = os.path.join(self.home, "daemon.stop")
+        os.makedirs(self.dispatch_dir, exist_ok=True)
+        os.makedirs(self.runs_dir, exist_ok=True)
+        self._stop = threading.Event()
+        self._runs: Dict[str, FedMLRunnerSupervisor] = {}
+        self._threads: Dict[str, threading.Thread] = {}
+        self._client = None
+        if broker:
+            host, _, port = broker.partition(":")
+            self._connect_broker(host, int(port or 1883))
+
+    # -- broker channel ------------------------------------------------------
+    def _connect_broker(self, host: str, port: int) -> None:
+        from ...core.distributed.communication.mqtt_s3.broker import BrokerClient
+
+        def on_message(topic: str, payload) -> None:
+            try:
+                self._accept_request(dict(payload))
+            except Exception:
+                logger.exception("bad dispatch payload on %s", topic)
+
+        self._client = BrokerClient(host, port, on_message)
+        self._client.subscribe(f"mlops/deploy/{self.role}/{self.account_id}")
+
+    def _publish_status(self, run_id: str, status: str) -> None:
+        if self._client is not None:
+            self._client.publish(
+                f"mlops/status/{self.role}/{run_id}",
+                {"run_id": run_id, "role": self.role, "status": status,
+                 "account": self.account_id, "time": time.time()},
+            )
+
+    # -- request handling ----------------------------------------------------
+    def _accept_request(self, req: Dict[str, Any]) -> None:
+        run_id = str(req["run_id"])
+        if run_id in self._runs:
+            logger.warning("run %s already dispatched; ignoring", run_id)
+            return
+        sup = FedMLRunnerSupervisor(
+            package_path=req["package"],
+            run_dir=os.path.join(self.runs_dir, run_id),
+            run_id=run_id,
+            role=self.role,
+            max_restarts=int(req.get("max_restarts", 2)),
+            extra_args=list(req.get("extra_args", [])),
+        )
+        # status hook: mirror every FSM transition to the broker
+        orig_report = sup._report
+
+        def report(status: str) -> None:
+            orig_report(status)
+            self._publish_status(run_id, status)
+
+        sup._report = report  # type: ignore[method-assign]
+        self._runs[run_id] = sup
+        self._threads[run_id] = sup.run_async()
+        logger.info("dispatched run %s (package=%s)", run_id, req["package"])
+
+    def _scan_dispatch_dir(self) -> None:
+        for fn in sorted(os.listdir(self.dispatch_dir)):
+            if not fn.endswith(".json"):
+                continue
+            path = os.path.join(self.dispatch_dir, fn)
+            try:
+                with open(path) as f:
+                    req = json.load(f)
+            except (OSError, ValueError):
+                continue  # partially-written file: retry next tick
+            try:
+                self._accept_request(req)
+            finally:
+                try:
+                    os.replace(path, path + ".accepted")
+                except FileNotFoundError:
+                    pass  # another daemon on the same home claimed it first
+
+    # -- heartbeat / introspection -------------------------------------------
+    def _heartbeat(self) -> None:
+        state = {
+            "pid": os.getpid(),
+            "role": self.role,
+            "account_id": self.account_id,
+            "time": time.time(),
+            "runs": {rid: sup.status for rid, sup in self._runs.items()},
+        }
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.state_path)
+
+    @staticmethod
+    def read_state(home_dir: str) -> Optional[Dict[str, Any]]:
+        path = os.path.join(os.path.abspath(home_dir), "daemon.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    @staticmethod
+    def request_stop(home_dir: str) -> None:
+        with open(os.path.join(os.path.abspath(home_dir), "daemon.stop"), "w") as f:
+            f.write(str(time.time()))
+
+    # -- main loop -----------------------------------------------------------
+    def serve(self) -> None:
+        """Blocking daemon loop (the process `fedml login` leaves behind)."""
+        if threading.current_thread() is threading.main_thread():
+            signal.signal(signal.SIGTERM, lambda *_: self._stop.set())
+        logger.info("daemon up: role=%s account=%s home=%s",
+                    self.role, self.account_id, self.home)
+        try:
+            while not self._stop.is_set():
+                if os.path.exists(self.stop_path):
+                    break
+                self._scan_dispatch_dir()
+                self._heartbeat()
+                self._stop.wait(self.poll_interval)
+        finally:
+            self.shutdown()
+
+    def serve_async(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve, daemon=True, name="fedml-daemon")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def shutdown(self) -> None:
+        for sup in self._runs.values():
+            sup.stop()
+        for t in self._threads.values():
+            t.join(timeout=10)
+        self._heartbeat()
+        if self._client is not None:
+            self._client.disconnect()
+        try:
+            os.remove(self.stop_path)
+        except FileNotFoundError:
+            pass
+        logger.info("daemon down")
+
+
+def main(argv=None) -> int:
+    """``python -m fedml_tpu.cli.edge_deployment.daemon`` entry."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--home", required=True)
+    p.add_argument("--role", default="client", choices=["client", "server"])
+    p.add_argument("--account-id", default="0")
+    p.add_argument("--broker", default=None)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    FedMLDaemon(args.home, role=args.role, account_id=args.account_id,
+                broker=args.broker).serve()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -16,6 +16,8 @@ import jax
 import numpy as np
 
 from . import attack_funcs as A
+
+_UNSET = object()  # edge-pool cache sentinel (None is a valid cached value)
 from .constants import (
     ATTACK_METHOD_BACKDOOR,
     ATTACK_METHOD_BYZANTINE_ATTACK,
@@ -53,6 +55,7 @@ class FedMLAttacker:
         self.is_enabled = False
         self.attack_type: Optional[str] = None
         self.args = None
+        self._edge_pool_cache = _UNSET
         self._key = jax.random.PRNGKey(23)
 
     def init(self, args: Any) -> None:
@@ -64,6 +67,7 @@ class FedMLAttacker:
         self.attack_type = str(args.attack_type).strip()
         self._key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 2027)
         self._round_clients = None
+        self._edge_pool_cache = _UNSET  # re-read edge_case_dir on re-init
         logger.info("attack enabled: %s", self.attack_type)
 
     def is_attack_enabled(self) -> bool:
@@ -187,19 +191,25 @@ class FedMLAttacker:
 
     def _edge_case_pool(self, sample_shape):
         """Mounted edge-case example pool (``args.edge_case_dir`` pointing at
-        reference-format pickles); cached; None when absent or shape-mismatched."""
-        if not hasattr(self, "_edge_pool_cache"):
-            import jax.numpy as jnp
-
+        reference-format pickles); cached per init(); pools are keyed by
+        sample shape so only the matching-shape pool is injected (a mounted
+        dir may mix ARDIS MNIST-shaped and Southwest CIFAR-shaped pickles)."""
+        if self._edge_pool_cache is _UNSET:
             from ...data.loaders import load_edge_case_pool
 
             root = getattr(self.args, "edge_case_dir", None)
-            pool = load_edge_case_pool(root) if root and os.path.isdir(root) else None
-            self._edge_pool_cache = None if pool is None else jnp.asarray(pool)
-        pool = self._edge_pool_cache
-        if pool is None or tuple(pool.shape[1:]) != tuple(sample_shape):
+            self._edge_pool_cache = (
+                load_edge_case_pool(root) if root and os.path.isdir(root) else None
+            )
+        pools = self._edge_pool_cache
+        if pools is None:
             return None
-        return pool
+        pool = pools.get(tuple(sample_shape))
+        if pool is None:
+            return None
+        import jax.numpy as jnp
+
+        return jnp.asarray(pool)
 
     def poison_local_data(self, client_idx: int, num_clients: int, x, y, logits=None):
         """Per-client data-poisoning entry the round loop calls before local

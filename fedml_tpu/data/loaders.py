@@ -317,13 +317,15 @@ def load_landmarks_csv(root: str, size: int = 32) -> Optional[Arrays]:
 # -- NUS-WIDE (multi-label; the reference's vertical-FL dataset) ------------
 
 
-def load_nuswide(root: str) -> Optional[Arrays]:
+def load_nuswide(root: str, top_k: int = 5) -> Optional[Arrays]:
     """NUS-WIDE low-level-features + multi-label groundtruth (reference
     ``data/NUS_WIDE/nus_wide_dataset.py:8-60`` layout):
     ``Groundtruth/TrainTestLabels/Labels_<name>_<Train|Test>.txt`` (one 0/1
     per line) and ``Low_Level_Features/*_<Train|Test>_*.dat`` (whitespace-
-    separated floats per line, concatenated feature blocks).  Returns
-    multi-hot y [N, L] over the sorted label names."""
+    separated floats per line, concatenated feature blocks).  A full mount
+    has 81 concept files; like the reference's ``get_top_k_labels`` the
+    ``top_k`` most frequent (by train positives) are kept so label width
+    matches the registered spec.  Returns multi-hot y [N, top_k]."""
     import glob as _glob
 
     lab_dir = os.path.join(root, "Groundtruth", "TrainTestLabels")
@@ -336,6 +338,16 @@ def load_nuswide(root: str) -> Optional[Arrays]:
     )
     if not names:
         return None
+    if len(names) > top_k:
+        counts = {}
+        for nm in names:
+            try:
+                counts[nm] = float(
+                    np.loadtxt(os.path.join(lab_dir, f"Labels_{nm}_Train.txt")).sum()
+                )
+            except (OSError, ValueError):
+                counts[nm] = -1.0
+        names = sorted(sorted(counts, key=counts.get, reverse=True)[:top_k])
 
     def _labels(dtype):
         cols = []
@@ -376,8 +388,11 @@ def _read_nifti(path: str) -> Optional[np.ndarray]:
     import struct
 
     op = gzip.open if path.endswith(".gz") else open
-    with op(path, "rb") as f:
-        buf = f.read()
+    try:
+        with op(path, "rb") as f:
+            buf = f.read()
+    except (OSError, EOFError, gzip.BadGzipFile):
+        return None  # corrupt/truncated volume: skip subject, don't abort load
     if len(buf) < 352 or struct.unpack_from("<i", buf, 0)[0] != 348:
         return None
     dim = struct.unpack_from("<8h", buf, 40)
@@ -388,6 +403,8 @@ def _read_nifti(path: str) -> Optional[np.ndarray]:
         return None
     vox = int(struct.unpack_from("<f", buf, 108)[0]) or 352
     n = int(np.prod(shape))
+    if vox + n * np.dtype(dt).itemsize > len(buf):
+        return None  # truncated data section
     arr = np.frombuffer(buf, dtype=dt, offset=vox, count=n)
     return arr.reshape(shape, order="F")
 
@@ -415,9 +432,10 @@ def load_fets_nifti(root: str, size: int = 32) -> Optional[Arrays]:
         sdir = os.path.join(root, s)
         files = {f.lower(): os.path.join(sdir, f) for f in os.listdir(sdir)}
 
-        def _mod(suffix):
+        def _mod(name):
+            # exact modality suffix: "_t1" must not match "..._t1ce.nii.gz"
             for k, p in files.items():
-                if suffix in k and k.endswith((".nii", ".nii.gz")):
+                if k.endswith((f"{name}.nii", f"{name}.nii.gz")):
                     return _read_nifti(p)
             return None
 
@@ -447,16 +465,18 @@ def load_fets_nifti(root: str, size: int = 32) -> Optional[Arrays]:
 # -- edge-case backdoor example pools (ARDIS / Southwest) --------------------
 
 
-def load_edge_case_pool(root: str) -> Optional[np.ndarray]:
-    """Edge-case backdoor example pool (reference
+def load_edge_case_pool(root: str) -> Optional[dict]:
+    """Edge-case backdoor example pools (reference
     ``data/edge_case_examples/data_loader.py``: ARDIS '7's for MNIST,
     Southwest airliners for CIFAR — pickles of image arrays).  Accepts any
     ``*.pkl`` under ``root`` holding an ndarray [N, ...] or a dict with a
-    'data' entry; pools are concatenated.  Returns float images in [0, 1]."""
+    'data' entry.  A mounted dir typically mixes sample shapes (MNIST-shaped
+    ARDIS next to CIFAR-shaped Southwest), so pools are grouped BY SAMPLE
+    SHAPE: returns ``{sample_shape_tuple: float_images_in_[0,1]}``."""
     import glob as _glob
     import pickle
 
-    pools = []
+    groups: dict = {}
     for p in sorted(_glob.glob(os.path.join(root, "*.pkl"))):
         try:
             with open(p, "rb") as f:
@@ -470,7 +490,7 @@ def load_edge_case_pool(root: str) -> Optional[np.ndarray]:
             arr = arr.astype(np.float32)
             if arr.max() > 1.5:  # uint8-coded images
                 arr = arr / 255.0
-            pools.append(arr)
-    if not pools:
+            groups.setdefault(tuple(arr.shape[1:]), []).append(arr)
+    if not groups:
         return None
-    return np.concatenate(pools, axis=0)
+    return {shape: np.concatenate(pools, axis=0) for shape, pools in groups.items()}

@@ -1,0 +1,129 @@
+"""Edge deployment daemon (reference ``cli/edge_deployment/client_daemon.py``
++ ``server_runner.py``): dispatch-directory and broker run channels,
+heartbeat introspection, status publication, stop protocol."""
+
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from fedml_tpu.cli.build import build_package
+from fedml_tpu.cli.edge_deployment.daemon import FedMLDaemon
+
+
+@pytest.fixture
+def package(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "train.py").write_text(textwrap.dedent("""\
+        import argparse, sys
+        p = argparse.ArgumentParser()
+        p.add_argument("--cf"); p.add_argument("--run_id"); p.add_argument("--role")
+        a, _ = p.parse_known_args()
+        print("trained", a.run_id)
+        sys.exit(0)
+    """))
+    cfg = tmp_path / "fedml_config.yaml"
+    cfg.write_text("train_args:\n  epochs: 1\n")
+    return build_package(str(src), "train.py", str(cfg), str(tmp_path / "pkg.zip"))
+
+
+def _wait(pred, timeout=30.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+class TestDispatchDir:
+    def test_file_dispatch_runs_and_heartbeats(self, package, tmp_path):
+        home = tmp_path / "home"
+        d = FedMLDaemon(str(home), role="client", account_id="acc1",
+                        poll_interval=0.1)
+        t = d.serve_async()
+        try:
+            req = {"run_id": "42", "package": package}
+            path = home / "dispatch" / "run_42.json"
+            with open(str(path) + ".tmp", "w") as f:
+                json.dump(req, f)
+            os.replace(str(path) + ".tmp", path)
+            assert _wait(lambda: (FedMLDaemon.read_state(str(home)) or {})
+                         .get("runs", {}).get("42") == "FINISHED")
+            # request file was consumed
+            assert not path.exists()
+            assert (home / "dispatch" / "run_42.json.accepted").exists()
+            state = FedMLDaemon.read_state(str(home))
+            assert state["role"] == "client" and state["account_id"] == "acc1"
+            log = (home / "runs" / "42" / "run.log").read_text()
+            assert "trained 42" in log
+        finally:
+            d.stop()
+            t.join(timeout=10)
+
+    def test_stop_file_ends_serve(self, tmp_path):
+        home = tmp_path / "home"
+        d = FedMLDaemon(str(home), poll_interval=0.05)
+        t = d.serve_async()
+        assert _wait(lambda: FedMLDaemon.read_state(str(home)) is not None)
+        FedMLDaemon.request_stop(str(home))
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+class TestBrokerChannel:
+    def test_broker_dispatch_and_status_publication(self, package, tmp_path):
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import (
+            BrokerClient, LocalBroker,
+        )
+
+        broker = LocalBroker().start()
+        statuses = []
+        try:
+            watcher = BrokerClient(
+                "127.0.0.1", broker.port,
+                lambda topic, payload: statuses.append(payload["status"]),
+            )
+            watcher.subscribe("mlops/status/client/#")
+            home = tmp_path / "home"
+            d = FedMLDaemon(str(home), role="client", account_id="acc2",
+                            broker=f"127.0.0.1:{broker.port}", poll_interval=0.1)
+            t = d.serve_async()
+            try:
+                pusher = BrokerClient("127.0.0.1", broker.port, lambda *_: None)
+                pusher.publish("mlops/deploy/client/acc2",
+                               {"run_id": "7", "package": package})
+                assert _wait(lambda: "FINISHED" in statuses)
+                assert statuses[0] in ("INITIALIZING", "STARTING")
+                pusher.disconnect()
+            finally:
+                d.stop()
+                t.join(timeout=10)
+            watcher.disconnect()
+        finally:
+            broker.stop()
+
+
+class TestCLISurface:
+    def test_dispatch_and_status_commands(self, package, tmp_path, capsys):
+        from fedml_tpu.cli.cli import main
+
+        home = tmp_path / "home"
+        d = FedMLDaemon(str(home), poll_interval=0.1)
+        t = d.serve_async()
+        try:
+            rc = main(["dispatch", "--package", package, "--run_id", "9",
+                       "--daemon_home", str(home)])
+            assert rc == 0
+            assert _wait(lambda: (FedMLDaemon.read_state(str(home)) or {})
+                         .get("runs", {}).get("9") == "FINISHED")
+            rc = main(["status", "--daemon_home", str(home)])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "run 9: FINISHED" in out
+        finally:
+            d.stop()
+            t.join(timeout=10)

@@ -125,17 +125,21 @@ class TestFeTSNifti:
 
 
 class TestEdgeCasePool:
-    def test_pickled_pools_concatenate(self, tmp_path):
+    def test_pickled_pools_group_by_shape(self, tmp_path):
         rng = np.random.RandomState(0)
         a = rng.randint(0, 255, (5, 8, 8, 3)).astype(np.uint8)
         b = {"data": rng.rand(3, 8, 8, 3).astype(np.float32)}
-        with open(tmp_path / "southwest_train.pkl", "wb") as f:
+        mnist_shaped = rng.rand(4, 28, 28, 1).astype(np.float32)  # ARDIS next
+        with open(tmp_path / "southwest_train.pkl", "wb") as f:  # to Southwest
             pickle.dump(a, f)
-        with open(tmp_path / "ardis_test.pkl", "wb") as f:
+        with open(tmp_path / "southwest_test.pkl", "wb") as f:
             pickle.dump(b, f)
-        pool = loaders.load_edge_case_pool(str(tmp_path))
-        assert pool.shape == (8, 8, 8, 3)
-        assert pool.max() <= 1.0
+        with open(tmp_path / "ardis_7.pkl", "wb") as f:
+            pickle.dump(mnist_shaped, f)
+        pools = loaders.load_edge_case_pool(str(tmp_path))
+        assert pools[(8, 8, 3)].shape == (8, 8, 8, 3)
+        assert pools[(28, 28, 1)].shape == (4, 28, 28, 1)
+        assert pools[(8, 8, 3)].max() <= 1.0
 
     def test_attacker_injects_mounted_pool(self, tmp_path):
         import jax.numpy as jnp
