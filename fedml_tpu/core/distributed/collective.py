@@ -1,0 +1,202 @@
+"""Host-plane process-group collectives over TCP.
+
+Parity with the role reference ``cross_silo/client/process_group_manager.py``
++ ``torch.distributed`` (NCCL/GLOO process groups) play for multi-process /
+multi-host runs: rendezvous, broadcast, allreduce, allgather, barrier over
+pytrees of numpy arrays.
+
+TPU-first split of responsibilities: DEVICE-side gradient/batch collectives
+are XLA's job (psum/all_gather compiled over ICI inside the jitted step —
+see parallel/mesh.py and the in-mesh simulator); what remains for the host
+plane is low-rate model-blob coordination between PROCESSES (intra-silo
+slave sync, multi-host bootstrap), which the reference routes through
+NCCL/MPI.  That traffic is latency-tolerant and model-sized, so a star
+topology over persistent TCP sockets (rank 0 = hub) is the right-sized
+transport: reduce-to-hub + rebroadcast is 2 model transfers per allreduce,
+and no GPU/TPU interconnect is touched.
+
+Rendezvous: rank 0 listens on ``addr``; other ranks connect and identify
+with their rank.  All ops are collective — every rank must call them in the
+same order (the torch.distributed contract).
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">Q", len(blob)) + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed during frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _to_host(tree: Pytree) -> Pytree:
+    """Device arrays -> numpy before pickling (sockets move host memory)."""
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class ProcessGroup:
+    """A star-topology process group; rank 0 is the hub.
+
+    >>> pg = ProcessGroup(rank, world_size, addr=("127.0.0.1", 29500))
+    >>> tree = pg.broadcast(tree)          # src=0 by default
+    >>> mean = pg.allreduce_mean(grads)
+    """
+
+    def __init__(self, rank: int, world_size: int, addr=("127.0.0.1", 29500),
+                 timeout: float = 60.0):
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.addr = (addr[0], int(addr[1]))
+        self.timeout = float(timeout)
+        self._peers: List[Optional[socket.socket]] = [None] * world_size
+        self._server: Optional[socket.socket] = None
+        if world_size > 1:
+            self._rendezvous()
+
+    # -- bootstrap -----------------------------------------------------------
+    def _rendezvous(self) -> None:
+        if self.rank == 0:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(self.addr)
+            srv.listen(self.world_size)
+            srv.settimeout(self.timeout)
+            self._server = srv
+            for _ in range(self.world_size - 1):
+                conn, _ = srv.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                peer_rank = _recv_frame(conn)
+                self._peers[int(peer_rank)] = conn
+            logger.info("pg hub up: %d peers joined", self.world_size - 1)
+        else:
+            deadline = time.time() + self.timeout
+            last_err: Optional[Exception] = None
+            while time.time() < deadline:
+                try:
+                    s = socket.create_connection(self.addr, timeout=self.timeout)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    _send_frame(s, self.rank)
+                    self._peers[0] = s
+                    return
+                except OSError as e:  # hub not up yet: retry
+                    last_err = e
+                    time.sleep(0.1)
+            raise ConnectionError(f"rank {self.rank}: rendezvous timed out: {last_err}")
+
+    # -- collectives ---------------------------------------------------------
+    def broadcast(self, tree: Pytree = None, src: int = 0) -> Pytree:
+        """Every rank returns src's tree.  Non-src ranks may pass None."""
+        if self.world_size == 1:
+            return tree
+        if src != 0:
+            # route through the hub: src uploads, hub rebroadcasts
+            if self.rank == src:
+                _send_frame(self._peers[0], _to_host(tree))
+                return tree
+            if self.rank == 0:
+                tree = _recv_frame(self._peers[src])
+        if self.rank == 0:
+            payload = _to_host(tree)
+            for r, sock in enumerate(self._peers):
+                if sock is not None and r != src:
+                    _send_frame(sock, payload)
+            return tree
+        if self.rank == src:
+            return tree
+        return _recv_frame(self._peers[0])
+
+    def gather(self, tree: Pytree, dst: int = 0) -> Optional[List[Pytree]]:
+        """dst returns [tree_rank0, ..., tree_rankN-1]; others return None."""
+        if self.world_size == 1:
+            return [tree]
+        if self.rank == 0:
+            out: List[Pytree] = [None] * self.world_size
+            out[0] = _to_host(tree)
+            for r, sock in enumerate(self._peers):
+                if sock is not None:
+                    out[r] = _recv_frame(sock)
+            if dst == 0:
+                return out
+            _send_frame(self._peers[dst], out)
+            return None
+        _send_frame(self._peers[0], _to_host(tree))
+        if self.rank == dst:
+            return _recv_frame(self._peers[0])
+        return None
+
+    def allgather(self, tree: Pytree) -> List[Pytree]:
+        gathered = self.gather(tree, dst=0)
+        return self.broadcast(gathered, src=0)
+
+    def allreduce_sum(self, tree: Pytree) -> Pytree:
+        """Elementwise tree sum across ranks (reduce-to-hub + rebroadcast)."""
+        if self.world_size == 1:
+            return tree
+        gathered = self.gather(tree, dst=0)
+        if self.rank == 0:
+            reduced = jax.tree_util.tree_map(
+                lambda *xs: np.sum(np.stack(xs, 0), axis=0), *gathered
+            )
+        else:
+            reduced = None
+        return self.broadcast(reduced, src=0)
+
+    def allreduce_mean(self, tree: Pytree, weight: float = 1.0) -> Pytree:
+        """Weighted mean: sum(w_i * x_i) / sum(w_i) across ranks."""
+        w = float(weight)
+        weighted = jax.tree_util.tree_map(lambda x: np.asarray(x) * w, tree)
+        num = self.allreduce_sum(weighted)
+        den = self.allreduce_sum(np.asarray(w))
+        return jax.tree_util.tree_map(lambda x: x / float(den), num)
+
+    def barrier(self) -> None:
+        self.allgather(np.zeros(()))
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        for sock in self._peers:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "ProcessGroup":
+        return self
+
+    def __exit__(self, *_) -> None:
+        self.close()

@@ -26,6 +26,14 @@ class Client:
             train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
             model_trainer,
         )
+        # multi-process silo: only proc 0 (master) owns the WAN connection;
+        # other processes run the slave loop (reference client_initializer.py
+        # rank-in-silo dispatch)
+        if int(getattr(args, "proc_rank_in_silo", 0) or 0) > 0:
+            from .fedml_client_slave_manager import ClientSlaveManager
+
+            self.manager = ClientSlaveManager(args, adapter)
+            return
         backend = str(getattr(args, "backend", "LOOPBACK"))
         size = int(getattr(args, "client_num_in_total", 1)) + 1
         self.manager = ClientMasterManager(args, adapter, rank=client_rank, size=size, backend=backend)

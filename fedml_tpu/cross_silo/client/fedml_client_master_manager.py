@@ -69,6 +69,7 @@ class ClientMasterManager(FedMLCommManager):
 
     def handle_message_finish(self, msg: Message) -> None:
         logger.info("client rank %d: FINISH", self.rank)
+        self.trainer_dist_adapter.finish_silo()  # release silo slaves (no-op single-proc)
         self.finish()
 
     # -- actions ------------------------------------------------------------

@@ -1,0 +1,39 @@
+"""Intra-silo slave process loop.
+
+Parity with reference ``cross_silo/client/fedml_client_slave_manager.py:6-48``
+(``ClientSlaveManager``): a slave process joins the silo's host-plane
+process group, then loops — await the master's broadcast of
+(round_idx, model_params, client_index, finished), train its shard, join
+the weighted allreduce — until the master signals FINISH.  The slave never
+talks to the FL server; only the silo master holds the WAN connection.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class ClientSlaveManager:
+    def __init__(self, args, trainer_dist_adapter):
+        self.args = args
+        self.trainer_dist_adapter = trainer_dist_adapter
+        self.finished = False
+
+    def train(self) -> None:
+        if not self.trainer_dist_adapter.train_slave_shard():
+            self.finish()
+
+    def finish(self) -> None:
+        self.trainer_dist_adapter.finish_silo()
+        self.finished = True
+        logger.info(
+            "slave proc %d in silo rank %s finished",
+            int(getattr(self.args, "proc_rank_in_silo", 0)),
+            getattr(self.args, "rank", "?"),
+        )
+
+    def run(self) -> None:
+        while not self.finished:
+            self.train()

@@ -1,13 +1,19 @@
 """Bridges the WAN FL loop to intra-silo device parallelism ("Cheetah").
 
-Parity with reference ``cross_silo/client/fedml_trainer_dist_adapter.py:9-93``,
-replaced TPU-first: where the reference wraps the model in torch DDP across
-torchrun-spawned slave processes (``model_ddp``, ``process_group_manager.py``),
-here the silo is one process and the local batch axis is sharded over the
-silo's jax devices via a ``Mesh`` — XLA compiles the same gradient all-reduce
-DDP would issue through NCCL, but over ICI and fused into the step.  The
-"slave manager"/"process group" machinery therefore has no equivalent; its
-job is done by the compiler.
+Parity with reference ``cross_silo/client/fedml_trainer_dist_adapter.py:9-93``:
+two nested levels of intra-silo parallelism, both TPU-first:
+
+* WITHIN a process, the local batch axis is sharded over the process's jax
+  devices via a ``Mesh`` — XLA compiles the gradient all-reduce torch DDP
+  would issue through NCCL, but over ICI and fused into the step.
+* ACROSS silo processes/hosts (``n_proc_in_silo > 1`` — the reference's
+  torchrun-spawned slave processes, ``process_group_manager.py`` +
+  ``fedml_client_slave_manager.py``), a host-plane ``ProcessGroup``
+  (core/distributed/collective.py) synchronizes the round: the master
+  broadcasts (round, params, client_index), every process trains a
+  disjoint stride-shard of the client's local data, and a weighted
+  allreduce-mean merges the results — host-level data parallelism whose
+  heavy per-step traffic still never leaves each process's compiled step.
 """
 
 from __future__ import annotations
@@ -20,6 +26,12 @@ import jax
 from ...ml.trainer.cls_trainer import ModelTrainerCLS
 
 logger = logging.getLogger(__name__)
+
+
+def _to_host_tree(tree):
+    import numpy as np
+
+    return jax.tree_util.tree_map(np.asarray, tree)
 
 
 class TrainerDistAdapter:
@@ -53,6 +65,19 @@ class TrainerDistAdapter:
             logger.info("silo rank %d: intra-silo dp over %d devices (mesh-sharded batch)",
                         client_rank, n_dev)
 
+        # multi-process silo (reference torchrun slaves): host-plane pg
+        self.n_proc = int(getattr(args, "n_proc_in_silo", 1) or 1)
+        self.proc_rank = int(getattr(args, "proc_rank_in_silo", 0) or 0)
+        self.pg = None
+        if self.n_proc > 1:
+            from ...core.distributed.collective import ProcessGroup
+
+            addr = (str(getattr(args, "pg_master_address", "127.0.0.1")),
+                    int(getattr(args, "pg_master_port", 29500)))
+            self.pg = ProcessGroup(self.proc_rank, self.n_proc, addr=addr)
+            logger.info("silo rank %d: host pg up (proc %d/%d @ %s:%d)",
+                        client_rank, self.proc_rank, self.n_proc, *addr)
+
     def get_model_params(self):
         return self.trainer.get_model_params()
 
@@ -64,10 +89,53 @@ class TrainerDistAdapter:
         self.trainer.set_id(self.client_index)
 
     def train(self, round_idx: int):
-        """One local-training pass; returns (params, local_sample_num)."""
+        """One local-training pass; returns (params, local_sample_num).
+        With a multi-process silo, the MASTER calls this: it syncs the
+        slaves, trains its own shard, and merges via weighted allreduce."""
+        if self.pg is not None:
+            assert self.proc_rank == 0, "slaves train via train_slave_shard"
+            self.pg.broadcast([int(round_idx), _to_host_tree(self.trainer.get_model_params()),
+                               int(self.client_index), False])
+            return self._train_silo_shard(round_idx)
+        return self._train_local(round_idx)
+
+    def train_slave_shard(self):
+        """SLAVE side of one silo round: await the master's sync, train this
+        process's shard, join the allreduce.  Returns False when the master
+        signalled FINISH (reference ClientSlaveManager.await_sync_process_group)."""
+        round_idx, params, client_index, finished = self.pg.broadcast(None)
+        if finished:
+            return False
+        self.update_dataset(int(client_index))
+        self.set_model_params(params)
+        self._train_silo_shard(int(round_idx))
+        return True
+
+    def finish_silo(self) -> None:
+        """Master: release the slaves and tear down the host pg."""
+        if self.pg is not None and self.proc_rank == 0:
+            self.pg.broadcast([0, None, 0, True])
+        if self.pg is not None:
+            self.pg.close()
+            self.pg = None
+
+    def _train_silo_shard(self, round_idx: int):
+        """Train this process's stride-shard, then weighted allreduce-mean."""
+        x, y = self.train_data_local_dict[self.client_index]
+        xs, ys = x[self.proc_rank :: self.n_proc], y[self.proc_rank :: self.n_proc]
+        shard_n = len(ys)
+        full_n = self.train_data_local_num_dict[self.client_index]
+        params, _ = self._train_local(round_idx, train_data=(xs, ys), n=shard_n)
+        merged = self.pg.allreduce_mean(_to_host_tree(params), weight=float(max(shard_n, 1)))
+        self.trainer.set_model_params(merged)
+        return merged, full_n
+
+    def _train_local(self, round_idx: int, train_data=None, n=None):
         self.trainer.round_idx = int(round_idx)  # advance the per-round RNG stream
-        train_data = self.train_data_local_dict[self.client_index]
-        n = self.train_data_local_num_dict[self.client_index]
+        if train_data is None:
+            train_data = self.train_data_local_dict[self.client_index]
+        if n is None:
+            n = self.train_data_local_num_dict[self.client_index]
         if self.dist_trainer is not None:
             # hierarchical: global model in -> mesh-dp local epochs -> host out
             self.dist_trainer.init_from(self.trainer.get_model_params())

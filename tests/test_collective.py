@@ -1,0 +1,174 @@
+"""Host-plane process-group collectives (core/distributed/collective.py) —
+the multi-process transport the reference routes through torch.distributed
+NCCL/GLOO process groups — and the intra-silo master/slave shard round
+built on it (reference fedml_client_slave_manager.py)."""
+
+import multiprocessing as mp
+import pickle
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.distributed.collective import ProcessGroup
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _collective_worker(rank, world, port, q):
+    pg = ProcessGroup(rank, world, addr=("127.0.0.1", port), timeout=30)
+    try:
+        # broadcast from 0
+        tree = {"w": np.full((3,), float(rank)), "b": np.asarray(rank, np.float32)}
+        got = pg.broadcast(tree if rank == 0 else None)
+        # allreduce sum: ranks contribute rank value
+        summed = pg.allreduce_sum({"v": np.full((2,), float(rank))})
+        # weighted mean: weight = rank + 1
+        mean = pg.allreduce_mean(np.full((2,), float(rank)), weight=rank + 1.0)
+        # allgather
+        gathered = pg.allgather(np.asarray([rank], np.int32))
+        pg.barrier()
+        q.put((rank, float(got["w"][0]), float(summed["v"][0]), float(mean[0]),
+               [int(g[0]) for g in gathered]))
+    finally:
+        pg.close()
+
+
+class TestProcessGroup:
+    def test_collectives_across_processes(self):
+        world, port = 3, _free_port()
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_collective_worker, args=(r, world, port, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        results = {}
+        for _ in range(world):
+            rank, bcast, summed, mean, gathered = q.get(timeout=120)
+            results[rank] = (bcast, summed, mean, gathered)
+        for p in procs:
+            p.join(timeout=30)
+        assert set(results) == {0, 1, 2}
+        for rank, (bcast, summed, mean, gathered) in results.items():
+            assert bcast == 0.0  # everyone got rank 0's tree
+            assert summed == 0.0 + 1.0 + 2.0
+            # weighted mean: (0*1 + 1*2 + 2*3) / (1+2+3) = 8/6
+            assert abs(mean - 8.0 / 6.0) < 1e-6
+            assert gathered == [0, 1, 2]
+
+    def test_single_process_group_is_identity(self):
+        pg = ProcessGroup(0, 1)
+        t = {"a": np.ones(2)}
+        assert pg.broadcast(t) is t
+        assert pg.allreduce_sum(t) is t
+        assert pg.allgather(t) == [t]
+        pg.barrier()
+        pg.close()
+
+
+def _silo_proc(rank, world, port, q):
+    """One silo process training its shard of a shared linear regression;
+    master (rank 0) broadcasts sync like TrainerDistAdapter.train does."""
+    pg = ProcessGroup(rank, world, addr=("127.0.0.1", port), timeout=30)
+    try:
+        rng = np.random.RandomState(0)  # same data everywhere (same mount)
+        x = rng.randn(64, 4).astype(np.float32)
+        w_true = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+        y = x @ w_true
+        w = pg.broadcast(np.zeros(4, np.float32) if rank == 0 else None)
+        for _ in range(150):
+            xs, ys = x[rank::world], y[rank::world]
+            grad = xs.T @ (xs @ w - ys) / len(ys)
+            w = w - 0.1 * grad
+            w = pg.allreduce_mean(w, weight=float(len(ys)))
+        q.put((rank, w))
+    finally:
+        pg.close()
+
+
+class TestSiloShardRound:
+    def test_sharded_training_converges_and_agrees(self):
+        world, port = 2, _free_port()
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_silo_proc, args=(r, world, port, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        out = {rank: w for rank, w in [q.get(timeout=120) for _ in range(world)]}
+        for p in procs:
+            p.join(timeout=30)
+        np.testing.assert_allclose(out[0], out[1], rtol=1e-6)  # consensus
+        np.testing.assert_allclose(out[0], [1.0, -2.0, 0.5, 3.0], atol=0.05)
+
+
+def _adapter_proc(rank, world, port, q):
+    """Real TrainerDistAdapter master/slave round over the host pg."""
+    from types import SimpleNamespace as NS
+
+    import fedml_tpu
+    from fedml_tpu.cross_silo.client.trainer_dist_adapter import TrainerDistAdapter
+
+    args = NS(n_proc_in_silo=world, proc_rank_in_silo=rank,
+              pg_master_address="127.0.0.1", pg_master_port=port,
+              scenario="horizontal", epochs=2, batch_size=16,
+              client_optimizer="sgd", learning_rate=0.1, random_seed=0,
+              dataset="synthetic", rank=1)
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    import jax
+
+    from fedml_tpu.ml.engine.train import init_variables
+    from fedml_tpu.models.linear import LogisticRegression
+
+    model = LogisticRegression(output_dim=2)
+    adapter = TrainerDistAdapter(
+        args, None, 1, model, 64, {0: 64}, {0: (x, y)}, {0: (x, y)}
+    )
+    adapter.update_dataset(0)
+    variables = init_variables(model, x[:1], seed=0)
+    adapter.set_model_params(variables)
+    if rank == 0:
+        params0, n0 = adapter.train(0)
+        params1, _ = adapter.train(1)
+        adapter.finish_silo()
+        leaves = jax.tree_util.tree_leaves(params1)
+        q.put((rank, n0, float(np.sum([np.sum(np.abs(l)) for l in leaves]))))
+    else:
+        from fedml_tpu.cross_silo.client.fedml_client_slave_manager import (
+            ClientSlaveManager,
+        )
+
+        ClientSlaveManager(args, adapter).run()
+        leaves = jax.tree_util.tree_leaves(adapter.get_model_params())
+        q.put((rank, 64, float(np.sum([np.sum(np.abs(l)) for l in leaves]))))
+
+
+@pytest.mark.heavy
+class TestSiloMasterSlaveAdapter:
+    def test_master_slave_round_agrees(self):
+        world, port = 2, _free_port()
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_adapter_proc, args=(r, world, port, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        out = {}
+        for _ in range(world):
+            rank, n, norm = q.get(timeout=240)
+            out[rank] = (n, norm)
+        for p in procs:
+            p.join(timeout=60)
+        assert out[0][0] == 64  # master reports the FULL client sample count
+        # both processes ended the rounds with the same merged model
+        assert abs(out[0][1] - out[1][1]) < 1e-4, out
