@@ -105,6 +105,10 @@ class ProcessGroup:
                     s = socket.create_connection(self.addr, timeout=self.timeout)
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     _send_frame(s, self.rank)
+                    # the connect timeout must NOT govern collective waits: a
+                    # slave legitimately blocks far longer than the rendezvous
+                    # window (master doing WAN round trips between syncs)
+                    s.settimeout(None)
                     self._peers[0] = s
                     return
                 except OSError as e:  # hub not up yet: retry
@@ -171,12 +175,23 @@ class ProcessGroup:
         return self.broadcast(reduced, src=0)
 
     def allreduce_mean(self, tree: Pytree, weight: float = 1.0) -> Pytree:
-        """Weighted mean: sum(w_i * x_i) / sum(w_i) across ranks."""
-        w = float(weight)
-        weighted = jax.tree_util.tree_map(lambda x: np.asarray(x) * w, tree)
-        num = self.allreduce_sum(weighted)
-        den = self.allreduce_sum(np.asarray(w))
-        return jax.tree_util.tree_map(lambda x: x / float(den), num)
+        """Weighted mean: sum(w_i * x_i) / sum(w_i) across ranks.  The weight
+        rides the same gather as the tree — one gather + one broadcast total,
+        not two sequential collectives."""
+        if self.world_size == 1:
+            return tree
+        gathered = self.gather((_to_host(tree), float(weight)), dst=0)
+        if self.rank == 0:
+            trees = [t for t, _ in gathered]
+            ws = [w for _, w in gathered]
+            den = sum(ws)
+            den = den if den > 0 else 1.0
+            reduced = jax.tree_util.tree_map(
+                lambda *xs: sum(x * w for x, w in zip(xs, ws)) / den, *trees
+            )
+        else:
+            reduced = None
+        return self.broadcast(reduced, src=0)
 
     def barrier(self) -> None:
         self.allgather(np.zeros(()))

@@ -3,8 +3,10 @@
 Parity with reference ``cross_silo/client/fedml_client_master_manager.py:17-157``:
 ONLINE handshake on connection-ready, init-config consumption, per-round
 train→report, FINISH teardown.  The reference's ``sync_process_group``
-broadcast to intra-silo slaves does not exist here — intra-silo parallelism
-is mesh sharding inside this process (see trainer_dist_adapter.py).
+slave broadcast lives inside the adapter: single-process silos shard the
+batch over the in-process device mesh, and with ``n_proc_in_silo > 1`` the
+adapter's ``train``/``finish_silo`` sync the slave processes over the
+host-plane ProcessGroup (see trainer_dist_adapter.py).
 """
 
 from __future__ import annotations

@@ -28,10 +28,6 @@ from ...ml.trainer.cls_trainer import ModelTrainerCLS
 logger = logging.getLogger(__name__)
 
 
-def _to_host_tree(tree):
-    import numpy as np
-
-    return jax.tree_util.tree_map(np.asarray, tree)
 
 
 class TrainerDistAdapter:
@@ -68,6 +64,11 @@ class TrainerDistAdapter:
         # multi-process silo (reference torchrun slaves): host-plane pg
         self.n_proc = int(getattr(args, "n_proc_in_silo", 1) or 1)
         self.proc_rank = int(getattr(args, "proc_rank_in_silo", 0) or 0)
+        if self.proc_rank >= self.n_proc:
+            raise ValueError(
+                f"proc_rank_in_silo={self.proc_rank} requires "
+                f"n_proc_in_silo > {self.proc_rank} (got {self.n_proc})"
+            )
         self.pg = None
         if self.n_proc > 1:
             from ...core.distributed.collective import ProcessGroup
@@ -94,7 +95,7 @@ class TrainerDistAdapter:
         slaves, trains its own shard, and merges via weighted allreduce."""
         if self.pg is not None:
             assert self.proc_rank == 0, "slaves train via train_slave_shard"
-            self.pg.broadcast([int(round_idx), _to_host_tree(self.trainer.get_model_params()),
+            self.pg.broadcast([int(round_idx), self.trainer.get_model_params(),
                                int(self.client_index), False])
             return self._train_silo_shard(round_idx)
         return self._train_local(round_idx)
@@ -125,8 +126,13 @@ class TrainerDistAdapter:
         xs, ys = x[self.proc_rank :: self.n_proc], y[self.proc_rank :: self.n_proc]
         shard_n = len(ys)
         full_n = self.train_data_local_num_dict[self.client_index]
-        params, _ = self._train_local(round_idx, train_data=(xs, ys), n=shard_n)
-        merged = self.pg.allreduce_mean(_to_host_tree(params), weight=float(max(shard_n, 1)))
+        if shard_n > 0:
+            params, _ = self._train_local(round_idx, train_data=(xs, ys), n=shard_n)
+        else:
+            # sample-less shard (tiny client, many procs): contribute weight 0
+            # so the stale pre-round params don't bias the merge
+            params = self.trainer.get_model_params()
+        merged = self.pg.allreduce_mean(params, weight=float(shard_n))
         self.trainer.set_model_params(merged)
         return merged, full_n
 
