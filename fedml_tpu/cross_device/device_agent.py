@@ -1,0 +1,159 @@
+"""Out-of-process device: the WAN bridge for the native edge agent.
+
+Role of the reference Android split (``android/fedmlsdk``): a Java service
+owns the MQTT connection and drives the on-device C++ MobileNN trainer;
+here a Python bridge owns the comm-backend connection and drives the
+standalone ``fedml_edge_agent`` PROCESS (``native/agent.cpp``) through its
+directory protocol — model/update exchange stays FTEM files end to end, and
+the training runtime holds no Python.
+
+``AgentBridge`` is the transport-free core (spawn, submit job, await
+update, stop); ``AgentDeviceManager`` plugs it into the cross-device round
+protocol by overriding the fake device's local-training hook.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .edge_model import save_edge_model
+from .fake_device import FakeDeviceManager
+
+
+class AgentBridge:
+    def __init__(self, workdir: str, poll_s: float = 0.05, spawn: bool = True):
+        from .. import native
+
+        self.workdir = os.path.abspath(workdir)
+        self.inbox = os.path.join(self.workdir, "inbox")
+        self.outbox = os.path.join(self.workdir, "outbox")
+        os.makedirs(self.inbox, exist_ok=True)
+        os.makedirs(self.outbox, exist_ok=True)
+        self.poll_s = float(poll_s)
+        self._proc: Optional[subprocess.Popen] = None
+        if spawn:
+            binary = native.build_agent()
+            log = open(os.path.join(self.workdir, "agent.log"), "ab")
+            self._proc = subprocess.Popen(
+                [binary, "--dir", self.workdir, "--poll-ms", "20"],
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+            log.close()
+
+    def submit(self, round_idx: int, model_path: str, data_path: str,
+               batch_size: int, lr: float, epochs: int, seed: int) -> None:
+        meta = (f"model={model_path}\ndata={data_path}\nbatch={batch_size}\n"
+                f"lr={lr}\nepochs={epochs}\nseed={seed}\n")
+        path = os.path.join(self.inbox, f"job_r{round_idx}.meta")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(meta)
+        os.replace(tmp, path)
+
+    def await_update(self, round_idx: int, timeout: float = 120.0
+                     ) -> Tuple[str, Dict[str, float]]:
+        """Blocks until update_r<k>.done (or .err) appears; returns
+        (update_ftem_path, metrics)."""
+        done = os.path.join(self.outbox, f"update_r{round_idx}.done")
+        errf = os.path.join(self.outbox, f"update_r{round_idx}.err")
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if os.path.exists(errf):
+                with open(errf) as f:
+                    raise RuntimeError(f"agent job r{round_idx}: {f.read().strip()}")
+            if os.path.exists(done):
+                metrics = {}
+                with open(done) as f:
+                    for line in f:
+                        k, _, v = line.strip().partition("=")
+                        if v:
+                            metrics[k] = float(v)
+                return os.path.join(self.outbox, f"update_r{round_idx}.ftem"), metrics
+            if self._proc is not None and self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"agent died (rc={self._proc.returncode}) before r{round_idx}"
+                )
+            time.sleep(self.poll_s)
+        raise TimeoutError(f"agent job r{round_idx} timed out")
+
+    def status(self) -> Dict[str, str]:
+        path = os.path.join(self.workdir, "status")
+        out: Dict[str, str] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    k, _, v = line.strip().partition("=")
+                    out[k] = v
+        return out
+
+    def close(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            with open(os.path.join(self.workdir, "stop"), "w") as f:
+                f.write("1")
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        self._proc = None
+
+
+class AgentDeviceManager(FakeDeviceManager):
+    """A cross-device client whose training runs in the agent process."""
+
+    def __init__(self, args, rank, train_data, client_num,
+                 backend: str = "LOOPBACK", upload_dir: Optional[str] = None):
+        super().__init__(args, rank, train_data, client_num,
+                         backend=backend, upload_dir=upload_dir, use_native=False)
+        self.bridge = AgentBridge(os.path.join(self.upload_dir, "agent"))
+        # device-side data files in both layouts (see FakeDeviceManager)
+        y32 = np.asarray(self.y, np.int32)
+        x = np.asarray(self.x, np.float32)
+        self._agent_data_2d = os.path.join(self.upload_dir, "agent_data_2d.ftem")
+        save_edge_model(self._agent_data_2d, {"x": x.reshape(len(x), -1), "y": y32})
+        self._agent_data_4d = None
+        if x.ndim == 4:
+            self._agent_data_4d = os.path.join(self.upload_dir, "agent_data_4d.ftem")
+            save_edge_model(self._agent_data_4d, {"x": x, "y": y32})
+
+    def _train_local_file(self, model_file: str, round_idx: int) -> Tuple[str, int]:
+        from .edge_model import load_edge_model
+
+        model_flat = load_edge_model(model_file)
+        is_conv = any(v.ndim == 4 and k.endswith("/kernel")
+                      for k, v in model_flat.items())
+        data = self._agent_data_4d if (is_conv and self._agent_data_4d) else self._agent_data_2d
+        self.bridge.submit(
+            round_idx, model_file, data,
+            batch_size=int(getattr(self.args, "batch_size", 32)),
+            lr=float(getattr(self.args, "learning_rate", 0.1)),
+            epochs=int(getattr(self.args, "epochs", 1)),
+            seed=round_idx * 1000 + self.rank,
+        )
+        update, metrics = self.bridge.await_update(round_idx)
+        # the server protocol expects the update under the device upload dir
+        out_path = os.path.join(self.upload_dir, f"model_r{round_idx}_c{self.rank}.ftem")
+        shutil.copyfile(update, out_path)
+        return out_path, int(metrics.get("num_samples", len(self.y)))
+
+    def _on_model(self, msg) -> None:
+        from ..core.distributed.communication.message import Message
+        from .message_define import MNNMessage
+
+        model_file = msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE)
+        round_idx = int(msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX) or 0)
+        out_path, n = self._train_local_file(model_file, round_idx)
+        self.rounds_trained += 1
+        m = Message(MNNMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, out_path)
+        m.add_params(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES, n)
+        self.send_message(m)
+
+    def finish(self) -> None:
+        self.bridge.close()
+        super().finish()

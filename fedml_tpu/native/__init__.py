@@ -48,6 +48,30 @@ def _build_locked(force: bool) -> str:
     return _LIB_PATH
 
 
+_AGENT_PATH = os.path.join(_NATIVE_DIR, "fedml_edge_agent")
+
+
+def build_agent(force: bool = False) -> str:
+    """Build the standalone device-agent binary (``make agent``); returns its
+    path.  Same staleness rule and serialization as :func:`build`."""
+    with _load_lock:
+        stale = force or not os.path.exists(_AGENT_PATH)
+        if not stale:
+            bin_mtime = os.path.getmtime(_AGENT_PATH)
+            for name in os.listdir(_NATIVE_DIR):
+                if name.endswith((".cpp", ".hpp")) and os.path.getmtime(
+                    os.path.join(_NATIVE_DIR, name)
+                ) > bin_mtime:
+                    stale = True
+                    break
+        if stale:
+            proc = subprocess.run(["make", "-C", _NATIVE_DIR, "agent"],
+                                  capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(f"agent build failed:\n{proc.stdout}\n{proc.stderr}")
+        return _AGENT_PATH
+
+
 def load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:

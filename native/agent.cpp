@@ -1,0 +1,161 @@
+// fedml_edge_agent — standalone on-device client process.
+//
+// Role of the reference Android client's native core driven by its Java
+// service (android/fedmlsdk/FedMLClientManager + MobileNN trainers): a real
+// DEVICE-SIDE process, separate from any Python runtime, that executes
+// local training jobs.  The WAN leg (MQTT in the reference, the in-repo
+// comm backends here) stays with the host bridge
+// (fedml_tpu/cross_device/device_agent.py), which drives this agent through
+// a directory protocol — the same split as Java-service + C++-trainer.
+//
+// Protocol (all under --dir):
+//   inbox/job_r<k>.meta   key=value lines: model=<ftem> data=<ftem>
+//                         batch=<int> lr=<float> epochs=<int> seed=<u64>
+//   outbox/update_r<k>.ftem   trained model (written first)
+//   outbox/update_r<k>.done   key=value: num_samples, train_acc, train_loss
+//   status                heartbeat: state=idle|training round=<k> pid=<pid>
+//   stop                  -> agent exits 0
+//
+// A job is processed once: presence of the .done marker makes restarts
+// idempotent.  Malformed jobs produce update_r<k>.err instead of .done.
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <filesystem>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "fedml_edge.hpp"
+
+namespace fs = std::filesystem;
+
+namespace {
+
+std::map<std::string, std::string> read_meta(const fs::path& p) {
+  std::map<std::string, std::string> kv;
+  std::ifstream f(p);
+  std::string line;
+  while (std::getline(f, line)) {
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    kv[line.substr(0, eq)] = line.substr(eq + 1);
+  }
+  return kv;
+}
+
+void write_text(const fs::path& p, const std::string& body) {
+  // write-then-rename: watchers never see a partial file
+  fs::path tmp = p;
+  tmp += ".tmp";
+  {
+    std::ofstream f(tmp);
+    f << body;
+  }
+  fs::rename(tmp, p);
+}
+
+void write_status(const fs::path& dir, const std::string& state, int round) {
+  std::ostringstream ss;
+  ss << "state=" << state << "\nround=" << round << "\npid=" << getpid() << "\n";
+  write_text(dir / "status", ss.str());
+}
+
+// "job_r<k>.meta" -> k, or -1
+int job_round(const std::string& name) {
+  if (name.rfind("job_r", 0) != 0) return -1;
+  auto dot = name.find(".meta");
+  if (dot == std::string::npos) return -1;
+  try {
+    return std::stoi(name.substr(5, dot - 5));
+  } catch (...) {
+    return -1;
+  }
+}
+
+bool process_job(const fs::path& dir, int round, const fs::path& meta_path) {
+  fs::path outbox = dir / "outbox";
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "update_r%d", round);
+  fs::path update = outbox / (std::string(buf) + ".ftem");
+  fs::path done = outbox / (std::string(buf) + ".done");
+  fs::path errf = outbox / (std::string(buf) + ".err");
+  if (fs::exists(done) || fs::exists(errf)) return false;  // already handled
+
+  auto kv = read_meta(meta_path);
+  std::string err;
+  auto fail = [&](const std::string& why) {
+    write_text(errf, "error=" + why + "\n");
+    std::fprintf(stderr, "job r%d failed: %s\n", round, why.c_str());
+    return true;
+  };
+  if (!kv.count("model") || !kv.count("data")) return fail("meta missing model/data");
+
+  int batch = kv.count("batch") ? std::stoi(kv["batch"]) : 32;
+  double lr = kv.count("lr") ? std::stod(kv["lr"]) : 0.01;
+  int epochs = kv.count("epochs") ? std::stoi(kv["epochs"]) : 1;
+  uint64_t seed = kv.count("seed") ? std::stoull(kv["seed"]) : 0;
+
+  std::unique_ptr<fedml::FedMLBaseTrainer> t(fedml::create_trainer(kv["model"], err));
+  if (!t) return fail(err);
+  if (!t->init(kv["model"], kv["data"], batch, lr, epochs, seed, err)) return fail(err);
+  if (!t->train(err)) return fail(err);
+  if (!t->save(update.string(), err)) return fail(err);
+  double acc = 0.0, loss = 0.0;
+  if (!t->evaluate(&acc, &loss, err)) return fail(err);
+
+  std::ostringstream ss;
+  ss << "num_samples=" << t->num_samples() << "\ntrain_acc=" << acc
+     << "\ntrain_loss=" << loss << "\n";
+  write_text(done, ss.str());  // .done written LAST: update is complete
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string dir;
+  int poll_ms = 100;
+  for (int i = 1; i < argc - 1; ++i) {
+    std::string a = argv[i];
+    if (a == "--dir") dir = argv[++i];
+    else if (a == "--poll-ms") poll_ms = std::stoi(argv[++i]);
+  }
+  if (dir.empty()) {
+    std::fprintf(stderr, "usage: fedml_edge_agent --dir DIR [--poll-ms N]\n");
+    return 2;
+  }
+  fs::path root(dir);
+  fs::create_directories(root / "inbox");
+  fs::create_directories(root / "outbox");
+  write_status(root, "idle", -1);
+
+  while (!fs::exists(root / "stop")) {
+    std::vector<std::pair<int, fs::path>> jobs;
+    for (auto& e : fs::directory_iterator(root / "inbox")) {
+      int r = job_round(e.path().filename().string());
+      if (r >= 0) jobs.emplace_back(r, e.path());
+    }
+    std::sort(jobs.begin(), jobs.end());
+    bool worked = false;
+    for (auto& [r, p] : jobs) {
+      write_status(root, "training", r);
+      worked = process_job(root, r, p) || worked;
+      write_status(root, "idle", r);
+    }
+    if (!worked) {
+      write_status(root, "idle", jobs.empty() ? -1 : jobs.back().first);
+      std::this_thread::sleep_for(std::chrono::milliseconds(poll_ms));
+    }
+  }
+  fs::remove(root / "stop");
+  write_status(root, "stopped", -1);
+  return 0;
+}
