@@ -1,0 +1,11 @@
+"""One-line app entry: `python main.py --cf fedml_config.yaml`."""
+
+import os
+import sys
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    if "--cf" not in sys.argv and "--yaml_config_file" not in sys.argv:
+        sys.argv += ["--cf", os.path.join(os.path.dirname(__file__), "fedml_config.yaml")]
+    fedml_tpu.run_simulation()

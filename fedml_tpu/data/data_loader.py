@@ -80,6 +80,12 @@ DATASET_SPECS: Dict[str, Dict[str, Any]] = {
     # NUS-WIDE multi-label low-level features (reference data/NUS_WIDE,
     # the vertical-FL dataset: 634-dim concatenated feature blocks, top-5 labels)
     "nuswide": dict(classes=5, shape=(634,), train=20000, test=4000, kind="taglr"),
+    # IoT anomaly detection (reference iot/anomaly_detection_for_cybersecurity,
+    # N-BaIoT-style benign-traffic autoencoder; classes = benign/anomaly)
+    "iot_anomaly": dict(classes=2, shape=(24,), train=8000, test=1600, kind="recon",
+                        anomaly_frac=0.1),
+    "nbaiot": dict(classes=2, shape=(115,), train=8000, test=1600, kind="recon",
+                   anomaly_frac=0.1),
     # fednlp sequence tagging / span extraction (reference app/fednlp
     # seq_tagging + span_extraction; synthetic corpora share the shapes)
     "onto_tagging": dict(classes=8, shape=(32,), train=8000, test=1600, kind="seqtag", vocab=2000),
@@ -108,9 +114,17 @@ DATASET_SPECS: Dict[str, Dict[str, Any]] = {
 
 
 def _generate(spec: Dict[str, Any], n: int, seed: int, scale_override: int = 0,
-              proto_seed: int = 0):
+              proto_seed: int = 0, is_test: bool = False):
     kind = spec["kind"]
     n = int(scale_override or n)
+    if kind == "recon":
+        # benign-only train split (targets = inputs); test split carries
+        # injected anomalies with 0/1 flags (the IoT detection setup)
+        x, flags = synthetic.make_iot_traffic(
+            n, int(spec["shape"][0]), seed=seed, proto_seed=proto_seed,
+            anomaly_frac=float(spec.get("anomaly_frac", 0.1)) if is_test else 0.0,
+        )
+        return (x, flags) if is_test else (x, x.copy())
     if kind in ("image", "feature"):
         return synthetic.make_classification(
             n, spec["classes"], tuple(spec["shape"]), seed=seed, proto_seed=proto_seed
@@ -189,7 +203,8 @@ def load_centralized(args) -> Dict[str, Any]:
         scale = int(getattr(args, "synthetic_train_size", 0))
         x_train, y_train = _generate(spec, spec["train"], seed, scale, proto_seed=seed)
         x_test, y_test = _generate(
-            spec, spec["test"], seed + 10_000, scale // 5 if scale else 0, proto_seed=seed
+            spec, spec["test"], seed + 10_000, scale // 5 if scale else 0,
+            proto_seed=seed, is_test=True,
         )
         args.dataset_is_synthetic = True
         logger.info("generated synthetic %s (no cached files under %r)", name, cache)

@@ -335,3 +335,27 @@ def make_multitask_graphs(
         observed = rng.rand(num_tasks) < label_frac
         y[i] = np.where(observed, labels, -1.0)
     return x, y
+
+
+def make_iot_traffic(
+    n: int, feat_dim: int = 24, seed: int = 0, proto_seed: int = None,
+    anomaly_frac: float = 0.0, latent_dim: int = 4,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """IoT network-traffic-shaped anomaly set (reference
+    ``iot/anomaly_detection_for_cybersecurity``'s N-BaIoT-style data):
+    benign rows live on a low-rank manifold (latent z @ W + noise) that an
+    autoencoder can compress; anomalies (``anomaly_frac``) are structure-
+    breaking uniform rows.  Returns (x [n, F], flags [n] in {0, 1}).
+    Train splits use anomaly_frac=0 (benign-only, the reference's setup)."""
+    rng = np.random.RandomState(seed)
+    prng = np.random.RandomState((seed if proto_seed is None else proto_seed) + 31)
+    w = prng.randn(latent_dim, feat_dim).astype(np.float32)
+    z = rng.randn(n, latent_dim).astype(np.float32)
+    x = z @ w + 0.05 * rng.randn(n, feat_dim).astype(np.float32)
+    flags = np.zeros(n, np.int32)
+    if anomaly_frac > 0:
+        k = max(1, int(anomaly_frac * n))
+        idx = rng.choice(n, size=k, replace=False)
+        x[idx] = rng.uniform(-4.0, 4.0, size=(k, feat_dim)).astype(np.float32)
+        flags[idx] = 1
+    return x, flags

@@ -57,4 +57,10 @@ def create_server_aggregator(model, args) -> ServerAggregator:
         from ..trainer.graph_trainers import ModelTrainerMTL
 
         return _TrainerEvalAggregator(model, args, ModelTrainerMTL)
+    from ..trainer.trainer_creator import _AE_DATASETS
+
+    if dataset in _AE_DATASETS:
+        from ..trainer.ae_trainer import ModelTrainerAE
+
+        return _TrainerEvalAggregator(model, args, ModelTrainerAE)
     return DefaultServerAggregator(model, args)

@@ -142,10 +142,25 @@ def masked_sentinel_bce_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp
     return total / count, (total, count)
 
 
+def mse_loss(logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray):
+    """Masked mean-squared error (reconstruction training — the IoT
+    anomaly-detection autoencoder family, reference
+    ``iot/anomaly_detection_for_cybersecurity``): labels are the
+    regression/reconstruction targets, same shape as logits."""
+    per = jnp.mean(
+        jnp.square(logits.astype(jnp.float32) - labels.astype(jnp.float32)),
+        axis=tuple(range(1, logits.ndim)),
+    )
+    mask = mask.astype(jnp.float32)
+    total = jnp.sum(per * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count, (total, count)
+
+
 LOSS_FNS = {"ce": softmax_ce_loss, "bce": sigmoid_bce_loss,
             "span": span_ce_loss, "det": detection_loss,
             "s2s": seq2seq_ce_loss, "linkpred": masked_sentinel_bce_loss,
-            "mtl_bce": masked_sentinel_bce_loss}
+            "mtl_bce": masked_sentinel_bce_loss, "mse": mse_loss}
 
 
 def resolve_grad_hook(args, grad_hook: Optional[Callable]) -> Optional[Callable]:

@@ -1,0 +1,64 @@
+"""Anomaly-detection ClientTrainer (reference
+``iot/anomaly_detection_for_cybersecurity``): clients train an autoencoder
+to reconstruct their (benign) local traffic; eval flags anomalies by
+reconstruction error.
+
+Training rides the engine's "mse" loss with targets = inputs (the dataset's
+train split carries y = x).  Eval is UNSUPERVISED thresholding: the cut is
+median + 3*MAD of the test-set error distribution — a robust statistic that
+needs no label peeking (the reference derives its threshold from benign
+training errors; a contaminated-set robust quantile plays the same role
+server-side)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cls_trainer import ModelTrainerCLS
+
+
+class ModelTrainerAE(ModelTrainerCLS):
+    loss_kind = "mse"
+
+    def __init__(self, model, args, grad_hook=None):
+        super().__init__(model, args, grad_hook=grad_hook)
+
+        @jax.jit
+        def evaluate(variables, x, flags):
+            recon = model.apply(variables, x, train=False).astype(jnp.float32)
+            flat = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+            err = jnp.mean(jnp.square(recon - flat), axis=-1)
+            med = jnp.median(err)
+            mad = jnp.median(jnp.abs(err - med))
+            thresh = med + 3.0 * 1.4826 * mad
+            pred = (err > thresh).astype(jnp.float32)
+            flags = flags.astype(jnp.float32)
+            correct = jnp.sum((pred == flags).astype(jnp.float32))
+            loss = jnp.sum(err)
+            # detection recall on the anomalous tail (the metric the
+            # reference's IoT example reports)
+            tp = jnp.sum(pred * flags)
+            pos = jnp.maximum(jnp.sum(flags), 1.0)
+            return loss, correct, jnp.asarray(x.shape[0], jnp.float32), tp / pos
+
+        self._ae_eval = evaluate
+
+    def train(self, train_data, device, args, extra=None):
+        x, y = train_data
+        # targets are the inputs; tolerate datasets that ship flags for train
+        if y is None or jnp.asarray(y).ndim == 1:
+            y = x.reshape((len(x), -1))
+        return super().train((x, y), device, args, extra=extra)
+
+    def test(self, test_data, device, args):
+        x, flags = test_data
+        l, correct, total, recall = self._ae_eval(
+            self.variables, jnp.asarray(x), jnp.asarray(flags)
+        )
+        return {
+            "test_correct": float(correct),
+            "test_loss": float(l),
+            "test_total": float(total),
+            "test_anomaly_recall": float(recall),
+        }

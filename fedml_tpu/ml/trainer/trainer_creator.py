@@ -15,6 +15,7 @@ _DET_DATASETS = {"synthetic_det", "coco_det"}
 _S2S_DATASETS = {"synthetic_s2s", "cornell_movie_dialogue"}
 _LINKPRED_DATASETS = {"ego_linkpred", "recsys_linkpred"}
 _MTL_DATASETS = {"moleculenet_mtl"}
+_AE_DATASETS = {"iot_anomaly", "nbaiot"}
 
 
 def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
@@ -47,6 +48,10 @@ def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
         from .graph_trainers import ModelTrainerMTL
 
         return ModelTrainerMTL(model, args, grad_hook=grad_hook)
+    if dataset in _AE_DATASETS:
+        from .ae_trainer import ModelTrainerAE
+
+        return ModelTrainerAE(model, args, grad_hook=grad_hook)
     from .cls_trainer import ModelTrainerCLS
 
     return ModelTrainerCLS(model, args, grad_hook=grad_hook)

@@ -1,0 +1,27 @@
+"""Dense autoencoder (the IoT anomaly-detection model family).
+
+Role of reference ``iot/anomaly_detection_for_cybersecurity``'s
+autoencoder (benign-traffic reconstruction; anomalies flagged by
+reconstruction error): a symmetric dense stack with a bottleneck.
+TPU-first: every layer is one MXU matmul, static shapes throughout.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class AutoEncoder(nn.Module):
+    """x [B, D] -> reconstruction [B, D]."""
+
+    feat_dim: int
+    hidden: int = 32
+    bottleneck: int = 8
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.reshape((x.shape[0], -1))
+        h = nn.relu(nn.Dense(self.hidden, name="enc1")(h))
+        z = nn.relu(nn.Dense(self.bottleneck, name="enc2")(h))
+        h = nn.relu(nn.Dense(self.hidden, name="dec1")(z))
+        return nn.Dense(self.feat_dim, name="dec2")(h)

@@ -151,6 +151,12 @@ def create(args: Any, output_dim: int) -> nn.Module:
         spec = DATASET_SPECS.get(dataset, {})
         feat_dim = int(spec.get("feat_dim", 8))
         return GCN(num_classes=int(spec.get("num_tasks", output_dim)), feat_dim=feat_dim)
+    if name in ("autoencoder", "ae", "anomaly_ae"):
+        from ..data.data_loader import DATASET_SPECS
+        from .autoencoder import AutoEncoder
+
+        feat = int(DATASET_SPECS.get(dataset, {}).get("shape", (24,))[0])
+        return AutoEncoder(feat_dim=feat)
     if name in ("transformer_s2s", "bart_s2s", "seq2seq"):
         from ..data.data_loader import DATASET_SPECS
         from .transformer import TransformerConfig, TransformerLM
