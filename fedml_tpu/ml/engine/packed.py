@@ -118,6 +118,7 @@ def build_packed_device_fn(
     slots_per_device: int,
     has_dropout: bool = True,
     loss: str = "ce",
+    pregather: bool = False,
 ):
     """The per-device round body (composed under shard_map by the simulator).
 
@@ -135,6 +136,18 @@ def build_packed_device_fn(
 
     def device_fn(variables, server_state, x_all, y_all, idx, mask, boundary,
                   weight, slot, n_steps, rng, cex):
+        if pregather:
+            # ONE vectorized gather for the whole round's stream (TPU row
+            # gathers are slow per-step; a single [S*B]-row gather amortizes
+            # to streaming HBM bandwidth), then the loop reads contiguous
+            # slices.  HBM cost: S_bucket * B * sample (the simulator trims
+            # S to a power-of-two bucket of the round's real step count).
+            bx_stream = jnp.take(x_all, idx.reshape(-1), axis=0).reshape(
+                idx.shape + x_all.shape[1:]
+            )
+            by_stream = jnp.take(y_all, idx.reshape(-1), axis=0).reshape(
+                idx.shape + y_all.shape[1:]
+            )
         params0 = variables["params"]
         other0 = {k: v for k, v in variables.items() if k != "params"}
         opt0 = tx.init(params0)
@@ -155,8 +168,11 @@ def build_packed_device_fn(
         def body(carry):
             (step, params, other, opt_state, c_steps, c_loss, c_cnt,
              acc, wsum, lsum, cnt, ext, outs) = carry
-            bx = jnp.take(x_all, idx[step], axis=0)
-            by = jnp.take(y_all, idx[step], axis=0)
+            if pregather:
+                bx, by = bx_stream[step], by_stream[step]
+            else:
+                bx = jnp.take(x_all, idx[step], axis=0)
+                by = jnp.take(y_all, idx[step], axis=0)
             bmask = mask[step]
             key = jax.random.fold_in(rng, step)
             (lval, updated), grads = jax.value_and_grad(

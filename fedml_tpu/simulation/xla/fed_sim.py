@@ -284,7 +284,8 @@ class XLASimulator:
             int(getattr(self.args, "epochs", 1)),
         )
         device_fn = build_packed_device_fn(
-            self.module, self.args, algo, self.batch_size, self.slots
+            self.module, self.args, algo, self.batch_size, self.slots,
+            pregather=bool(getattr(self.args, "xla_pregather", False)),
         )
 
         def per_device(variables, server_state, x_all, y_all, idx, mask, boundary,
@@ -325,6 +326,20 @@ class XLASimulator:
             lambda cid: self._client_rows[cid],
             self.batch_size, int(getattr(self.args, "epochs", 1)),
             int(getattr(self.args, "random_seed", 0)), round_idx, self.s_max,
+        )
+        # trim the stream buffers to a power-of-two bucket of the round's
+        # real max steps: uploads and (with xla_pregather) the round's data
+        # gather scale with the bucket, not the global worst case.  Few
+        # distinct buckets across rounds -> few recompiles.
+        s_used = max(int(sched.n_steps.max()), 1)
+        s_bucket = 1
+        while s_bucket < s_used:
+            s_bucket *= 2
+        s_bucket = min(s_bucket, self.s_max)
+        sched = sched._replace(
+            idx=sched.idx[:, :s_bucket], mask=sched.mask[:, :s_bucket],
+            boundary=sched.boundary[:, :s_bucket], weight=sched.weight[:, :s_bucket],
+            slot=sched.slot[:, :s_bucket],
         )
         return tuple(jnp.asarray(a) for a in sched)
 

@@ -209,3 +209,19 @@ class TestPackedRound:
             ),
             got, w,
         )
+
+
+class TestPregather:
+    def test_pregather_matches_per_step_gather(self):
+        """xla_pregather is a pure execution-strategy change: identical
+        round outputs to the per-step-gather packed round."""
+        outs = {}
+        for pregather in (False, True):
+            args, dataset, model = _build(_args(xla_pregather=pregather,
+                                                comm_round=2))
+            sim = XLASimulator(args, dataset, model)
+            sim.train()
+            leaves = jax.tree_util.tree_leaves(sim.variables)
+            outs[pregather] = [np.asarray(l) for l in leaves]
+        for a, b in zip(outs[False], outs[True]):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
