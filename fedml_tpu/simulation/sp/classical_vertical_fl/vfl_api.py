@@ -30,6 +30,16 @@ class VerticalFLAPI:
         self.parties = int(getattr(args, "vfl_party_num", 2))
         x_tr = np.asarray(x_tr, np.float32).reshape(len(y_tr), -1)
         x_te = np.asarray(x_te, np.float32).reshape(len(y_te), -1)
+        # multi-hot labels (NUS-WIDE, the reference's canonical VFL dataset:
+        # nus_wide_dataset.py maps concepts to a single training label) ->
+        # dominant-concept index for the guest's softmax
+        y_tr = np.asarray(y_tr)
+        y_te = np.asarray(y_te)
+        if y_tr.ndim > 1:
+            y_tr = y_tr.argmax(axis=-1)
+            y_te = y_te.argmax(axis=-1)
+        y_tr = y_tr.astype(np.int32)
+        y_te = y_te.astype(np.int32)
         self.feature_slices = np.array_split(np.arange(x_tr.shape[1]), self.parties)
         self.x_tr = [jnp.asarray(x_tr[:, s]) for s in self.feature_slices]
         self.x_te = [jnp.asarray(x_te[:, s]) for s in self.feature_slices]

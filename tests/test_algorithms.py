@@ -118,3 +118,13 @@ def test_turbo_aggregate_matches_fedavg_modulo_masks():
         lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4),
         got, want,
     )
+
+
+def test_vertical_fl_nuswide():
+    """NUS-WIDE is the reference's canonical VFL dataset
+    (data/NUS_WIDE/nus_wide_dataset.py two-party loader): multi-hot labels
+    collapse to the dominant concept for the guest's softmax."""
+    args = _args("classical_vertical", comm_round=60, dataset="nuswide",
+                 synthetic_train_size=640)
+    metrics = _run(args)
+    assert metrics["test_acc"] > 0.4
