@@ -110,6 +110,16 @@ DATASET_SPECS: Dict[str, Dict[str, Any]] = {
     # (reference research/SpreadGNN; moleculenet sider/tox21 masks)
     "moleculenet_mtl": dict(classes=8, shape=(16, 24), train=2000, test=400, kind="mtl_graph",
                             num_nodes=16, feat_dim=8, num_tasks=8),
+    # fedgraphnn node classification + graph regression (reference
+    # app/fedgraphnn/{ego_networks_node_clf,moleculenet_graph_reg})
+    "ego_nodeclf": dict(classes=3, shape=(16, 24), train=2000, test=400, kind="nodeclf",
+                        num_nodes=16, feat_dim=8),
+    "freesolv": dict(classes=1, shape=(16, 24), train=2000, test=400, kind="graphreg",
+                     num_nodes=16, feat_dim=8),
+    "esol": dict(classes=1, shape=(16, 24), train=2000, test=400, kind="graphreg",
+                 num_nodes=16, feat_dim=8),
+    "lipophilicity": dict(classes=1, shape=(16, 24), train=2000, test=400, kind="graphreg",
+                          num_nodes=16, feat_dim=8),
 }
 
 
@@ -173,6 +183,15 @@ def _generate(spec: Dict[str, Any], n: int, seed: int, scale_override: int = 0,
         return synthetic.make_multitask_graphs(
             n, spec["num_nodes"], spec["feat_dim"], spec["num_tasks"],
             seed=seed, proto_seed=proto_seed,
+        )
+    if kind == "nodeclf":
+        return synthetic.make_node_classification(
+            n, spec["num_nodes"], spec["feat_dim"], spec["classes"],
+            seed=seed, proto_seed=proto_seed,
+        )
+    if kind == "graphreg":
+        return synthetic.make_graph_regression(
+            n, spec["num_nodes"], spec["feat_dim"], seed=seed, proto_seed=proto_seed,
         )
     if kind == "taglr":
         x, y = synthetic.make_classification(
@@ -244,6 +263,15 @@ def load(args) -> Tuple[list, int]:
             )
             fg = counts[:, 1:]
             part_labels = np.where(fg.max(axis=1) > 0, fg.argmax(axis=1) + 1, 0)
+        elif kind == "graphreg":
+            # continuous target: quartile-bin the property so the Dirichlet
+            # split skews by target range (class_num is 1 for regression)
+            t = y_train.reshape(len(y_train), -1)[:, 0]
+            part_labels = np.digitize(t, np.quantile(t, [0.25, 0.5, 0.75]))
+            train_map = non_iid_partition_with_dirichlet_distribution(
+                part_labels, client_num, 4, alpha, seed=seed
+            )
+            part_labels = None  # handled
         elif kind in ("linkpred", "mtl_graph"):
             # labels carry -1 sentinels; bucket by positive-label count
             # (graph density / task profile), clipped to the class range
@@ -262,9 +290,10 @@ def load(args) -> Tuple[list, int]:
             part_labels = (
                 y_train.reshape(len(y_train), -1).mean(axis=1) % data["class_num"]
             ).astype(int)
-        train_map = non_iid_partition_with_dirichlet_distribution(
-            part_labels, client_num, data["class_num"], alpha, seed=seed
-        )
+        if part_labels is not None:
+            train_map = non_iid_partition_with_dirichlet_distribution(
+                part_labels, client_num, data["class_num"], alpha, seed=seed
+            )
     elif method in ("homo", "iid"):
         train_map = homo_partition(len(y_train), client_num, seed=seed)
     elif method == "quantity_skew":

@@ -359,3 +359,53 @@ def make_iot_traffic(
         x[idx] = rng.uniform(-4.0, 4.0, size=(k, feat_dim)).astype(np.float32)
         flags[idx] = 1
     return x, flags
+
+
+def make_node_classification(
+    n: int, num_nodes: int = 16, feat_dim: int = 8, num_classes: int = 3,
+    seed: int = 0, proto_seed: int = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-node classification graphs (reference app/fedgraphnn
+    ego_networks_node_clf): each node's class is its community; features
+    carry the community prototype, edges form mostly within-community, so
+    both feature and structure paths are informative.  x [n, N, F+N]
+    (gcn.py packing); y [n, N] int32 node labels (padding nodes get 0 and
+    are silenced by the model's node mask)."""
+    rng = np.random.RandomState(seed)
+    prng = np.random.RandomState((seed if proto_seed is None else proto_seed) + 53)
+    protos = prng.randn(num_classes, feat_dim).astype(np.float32)
+    x = np.zeros((n, num_nodes, feat_dim + num_nodes), np.float32)
+    y = np.zeros((n, num_nodes), np.int32)
+    for i in range(n):
+        comm = rng.randint(0, num_classes, num_nodes)
+        feats = protos[comm] + 0.5 * rng.randn(num_nodes, feat_dim)
+        p_edge = np.where(comm[:, None] == comm[None, :], 0.5, 0.05)
+        upper = np.triu(rng.rand(num_nodes, num_nodes) < p_edge, 1)
+        adj = (upper | upper.T).astype(np.float32)
+        x[i, :, :feat_dim] = feats
+        x[i, :, feat_dim:] = adj
+        y[i] = comm
+    return x, y
+
+
+def make_graph_regression(
+    n: int, num_nodes: int = 16, feat_dim: int = 8, seed: int = 0,
+    proto_seed: int = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Graph-level property regression (reference app/fedgraphnn
+    moleculenet_graph_reg): target = w · mean-node-features + density term
+    (both paths of a GNN carry signal).  y [n, 1] f32."""
+    rng = np.random.RandomState(seed)
+    prng = np.random.RandomState((seed if proto_seed is None else proto_seed) + 67)
+    w = prng.randn(feat_dim).astype(np.float32)
+    x = np.zeros((n, num_nodes, feat_dim + num_nodes), np.float32)
+    y = np.zeros((n, 1), np.float32)
+    for i in range(n):
+        feats = rng.randn(num_nodes, feat_dim).astype(np.float32)
+        density = rng.uniform(0.1, 0.6)
+        upper = np.triu(rng.rand(num_nodes, num_nodes) < density, 1)
+        adj = (upper | upper.T).astype(np.float32)
+        x[i, :, :feat_dim] = feats
+        x[i, :, feat_dim:] = adj
+        y[i, 0] = feats.mean(axis=0) @ w + 2.0 * density
+    return x, y

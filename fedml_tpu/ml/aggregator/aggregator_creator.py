@@ -57,10 +57,14 @@ def create_server_aggregator(model, args) -> ServerAggregator:
         from ..trainer.graph_trainers import ModelTrainerMTL
 
         return _TrainerEvalAggregator(model, args, ModelTrainerMTL)
-    from ..trainer.trainer_creator import _AE_DATASETS
+    from ..trainer.trainer_creator import _AE_DATASETS, _REG_DATASETS
 
     if dataset in _AE_DATASETS:
         from ..trainer.ae_trainer import ModelTrainerAE
 
         return _TrainerEvalAggregator(model, args, ModelTrainerAE)
+    if dataset in _REG_DATASETS:
+        from ..trainer.reg_trainer import ModelTrainerReg
+
+        return _TrainerEvalAggregator(model, args, ModelTrainerReg)
     return DefaultServerAggregator(model, args)

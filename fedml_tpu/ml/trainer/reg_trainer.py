@@ -1,0 +1,45 @@
+"""Graph/property regression ClientTrainer (reference
+``app/fedgraphnn/moleculenet_graph_reg``: freesolv/esol/lipophilicity):
+trains on the engine "mse" loss; eval reports SSE (protocol loss key) and a
+within-tolerance hit rate so the shared accuracy plumbing stays meaningful
+(RMSE is derivable from test_loss/test_total)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cls_trainer import ModelTrainerCLS
+
+
+class ModelTrainerReg(ModelTrainerCLS):
+    loss_kind = "mse"
+    tolerance = 0.5  # |err| < tol counts as a hit (test_correct)
+
+    def __init__(self, model, args, grad_hook=None):
+        super().__init__(model, args, grad_hook=grad_hook)
+        tol = float(getattr(args, "regression_tolerance", self.tolerance))
+
+        @jax.jit
+        def evaluate(variables, x, y):
+            pred = model.apply(variables, x, train=False).astype(jnp.float32)
+            y = y.astype(jnp.float32).reshape(pred.shape)
+            err = jnp.mean(jnp.square(pred - y), axis=tuple(range(1, pred.ndim)))
+            hits = (jnp.abs(pred - y).max(axis=tuple(range(1, pred.ndim))) < tol)
+            return (
+                jnp.sum(err),
+                jnp.sum(hits.astype(jnp.float32)),
+                jnp.asarray(x.shape[0], jnp.float32),
+            )
+
+        self._reg_eval = evaluate
+
+    def test(self, test_data, device, args):
+        x, y = test_data
+        l, correct, total = self._reg_eval(self.variables, jnp.asarray(x), jnp.asarray(y))
+        return {
+            "test_correct": float(correct),
+            "test_loss": float(l),
+            "test_total": float(total),
+            "test_rmse": float(jnp.sqrt(l / jnp.maximum(total, 1.0))),
+        }

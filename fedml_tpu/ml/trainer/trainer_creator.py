@@ -8,8 +8,10 @@ from ...core.alg_frame.client_trainer import ClientTrainer
 _NWP_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
 _TAG_DATASETS = {"stackoverflow_lr", "nuswide", "nus_wide"}
 # per-token classification reuses the NWP trainer (same masked per-token CE
-# and token-accuracy math — reference seq_tagging task)
-_SEQTAG_DATASETS = {"onto_tagging", "wikiner"}
+# and token-accuracy math — reference seq_tagging task); node classification
+# (ego_networks_node_clf) rides the same path with [B, N] node labels
+_SEQTAG_DATASETS = {"onto_tagging", "wikiner", "ego_nodeclf"}
+_REG_DATASETS = {"freesolv", "esol", "lipophilicity"}
 _SPAN_DATASETS = {"squad_span"}
 _DET_DATASETS = {"synthetic_det", "coco_det"}
 _S2S_DATASETS = {"synthetic_s2s", "cornell_movie_dialogue"}
@@ -52,6 +54,10 @@ def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
         from .ae_trainer import ModelTrainerAE
 
         return ModelTrainerAE(model, args, grad_hook=grad_hook)
+    if dataset in _REG_DATASETS:
+        from .reg_trainer import ModelTrainerReg
+
+        return ModelTrainerReg(model, args, grad_hook=grad_hook)
     from .cls_trainer import ModelTrainerCLS
 
     return ModelTrainerCLS(model, args, grad_hook=grad_hook)

@@ -76,3 +76,60 @@ class GCNLinkPred(nn.Module):
         scores = jnp.einsum("...ih,...jh->...ij", z, z) / jnp.sqrt(float(self.hidden))
         bias = self.param("score_bias", nn.initializers.zeros, ())
         return scores + bias
+
+
+class GCNNodeClassifier(nn.Module):
+    """Per-node classifier (reference ``app/fedgraphnn/ego_networks_node_clf``):
+    GCN layers WITHOUT pooling -> node logits [B, N, C].  The engine's
+    per-token masked CE consumes [B, N] node labels (same path as sequence
+    tagging)."""
+
+    num_classes: int
+    feat_dim: int
+    hidden: int = 64
+    n_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        feats, adj = unpack_graph(x, self.feat_dim)
+        n = adj.shape[-1]
+        a = adj + jnp.eye(n)
+        deg = jnp.clip(a.sum(-1), 1e-6, None)
+        dinv = 1.0 / jnp.sqrt(deg)
+        a_norm = a * dinv[..., :, None] * dinv[..., None, :]
+        node_mask = (jnp.abs(feats).sum(-1) > 0).astype(feats.dtype)
+
+        h = feats
+        for i in range(self.n_layers):
+            h = a_norm @ nn.Dense(self.hidden, name=f"gc{i}")(h)
+            h = nn.relu(h) * node_mask[..., None]
+        return nn.Dense(self.num_classes, name="node_head")(h)
+
+
+class GCNRegressor(nn.Module):
+    """Graph-level regressor (reference
+    ``app/fedgraphnn/moleculenet_graph_reg``: freesolv/esol/lipophilicity
+    property regression) — GCN + masked mean pooling + scalar head; trains
+    on the engine's "mse" loss."""
+
+    feat_dim: int
+    hidden: int = 64
+    n_layers: int = 2
+    out_dim: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        feats, adj = unpack_graph(x, self.feat_dim)
+        n = adj.shape[-1]
+        a = adj + jnp.eye(n)
+        deg = jnp.clip(a.sum(-1), 1e-6, None)
+        dinv = 1.0 / jnp.sqrt(deg)
+        a_norm = a * dinv[..., :, None] * dinv[..., None, :]
+        node_mask = (jnp.abs(feats).sum(-1) > 0).astype(feats.dtype)
+
+        h = feats
+        for i in range(self.n_layers):
+            h = a_norm @ nn.Dense(self.hidden, name=f"gc{i}")(h)
+            h = nn.relu(h) * node_mask[..., None]
+        pooled = h.sum(axis=-2) / jnp.clip(node_mask.sum(-1, keepdims=True), 1.0, None)
+        return nn.Dense(self.out_dim, name="reg_head")(pooled)

@@ -144,6 +144,18 @@ def create(args: Any, output_dim: int) -> nn.Module:
 
         feat_dim = int(DATASET_SPECS.get(dataset, {}).get("feat_dim", 8))
         return GCNLinkPred(feat_dim=feat_dim)
+    if name in ("gcn_nodeclf", "gcn_node"):
+        from ..data.data_loader import DATASET_SPECS
+        from .gcn import GCNNodeClassifier
+
+        feat_dim = int(DATASET_SPECS.get(dataset, {}).get("feat_dim", 8))
+        return GCNNodeClassifier(num_classes=output_dim, feat_dim=feat_dim)
+    if name in ("gcn_reg", "gcn_regressor"):
+        from ..data.data_loader import DATASET_SPECS
+        from .gcn import GCNRegressor
+
+        feat_dim = int(DATASET_SPECS.get(dataset, {}).get("feat_dim", 8))
+        return GCNRegressor(feat_dim=feat_dim)
     if name in ("gcn_mtl", "gcn_multitask"):
         from ..data.data_loader import DATASET_SPECS
         from .gcn import GCN

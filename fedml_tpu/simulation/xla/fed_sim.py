@@ -105,13 +105,13 @@ class XLASimulator:
         # losses and their task-specific evals run on the sp backend
         from ...ml.trainer.trainer_creator import (
             _AE_DATASETS, _DET_DATASETS, _LINKPRED_DATASETS, _MTL_DATASETS,
-            _S2S_DATASETS, _SPAN_DATASETS, _TAG_DATASETS,
+            _REG_DATASETS, _S2S_DATASETS, _SPAN_DATASETS, _TAG_DATASETS,
         )
 
         ds = str(getattr(args, "dataset", "")).lower()
         if ds in (_DET_DATASETS | _SPAN_DATASETS | _TAG_DATASETS
                   | _LINKPRED_DATASETS | _MTL_DATASETS | _S2S_DATASETS
-                  | _AE_DATASETS):
+                  | _AE_DATASETS | _REG_DATASETS):
             raise NotImplementedError(
                 f"dataset {ds!r} (task-specific loss) is not wired into the "
                 "in-mesh XLA round; use backend 'sp'"

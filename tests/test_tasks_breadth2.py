@@ -169,3 +169,20 @@ class TestIoTAnomaly:
         # recall on the anomalous tail must beat guessing
         assert metrics["test_acc"] > 0.85, metrics
         assert metrics["test_anomaly_recall"] > 0.7, metrics
+
+
+class TestGraphNodeClf:
+    def test_learns_node_communities(self):
+        metrics = _run(_cfg("ego_nodeclf", "gcn_nodeclf", comm_round=4,
+                            epochs=3, learning_rate=0.01))
+        # per-node accuracy above 1/3 chance (community structure + features)
+        assert metrics["test_acc"] > 0.6, metrics
+
+
+class TestGraphRegression:
+    def test_learns_property(self):
+        metrics = _run(_cfg("freesolv", "gcn_reg", comm_round=4, epochs=3,
+                            learning_rate=0.01,
+                            partition_method="hetero"))
+        # RMSE well below the target's std (signal = w.mean_feats + density)
+        assert metrics["test_rmse"] < 0.6, metrics
