@@ -7,6 +7,12 @@ graphs and adjacency matmuls on the MXU instead of sparse gather/scatter.
 Graph batch packing (matches data kind "graph"): each sample is
 ``[N, F + N]`` — node features [N, F] concatenated with the dense adjacency
 [N, N] (self-loops added by the model).  Padding nodes have all-zero rows.
+
+One shared encoder (``gcn_encode``, called inside each module's compact
+scope so layer names stay flat: ``gc0``, ``gc1``, ...) feeds four heads:
+graph classification (``GCN``), link prediction (``GCNLinkPred``), per-node
+classification (``GCNNodeClassifier``), and property regression
+(``GCNRegressor``).
 """
 
 from __future__ import annotations
@@ -20,6 +26,34 @@ def unpack_graph(x, feat_dim: int):
     return x[..., :feat_dim], x[..., feat_dim:]
 
 
+def gcn_encode(x, feat_dim: int, hidden: int, n_layers: int):
+    """Shared GCN encoder: normalized-adjacency message passing.
+
+    Must be called inside an ``nn.compact`` ``__call__`` (it creates the
+    ``gc<i>`` Dense layers on the calling module).  Returns
+    (node_states [B, N, H], node_mask [B, N]); padding nodes (all-zero
+    feature rows) stay silent."""
+    feats, adj = unpack_graph(x, feat_dim)
+    n = adj.shape[-1]
+    # normalized adjacency with self loops: D^-1/2 (A + I) D^-1/2
+    a = adj + jnp.eye(n)
+    deg = jnp.clip(a.sum(-1), 1e-6, None)
+    dinv = 1.0 / jnp.sqrt(deg)
+    a_norm = a * dinv[..., :, None] * dinv[..., None, :]
+    node_mask = (jnp.abs(feats).sum(-1) > 0).astype(feats.dtype)  # [B, N]
+
+    h = feats
+    for i in range(n_layers):
+        h = a_norm @ nn.Dense(hidden, name=f"gc{i}")(h)
+        h = nn.relu(h) * node_mask[..., None]  # keep padding nodes silent
+    return h, node_mask
+
+
+def masked_mean_pool(h, node_mask):
+    """[B, N, H] -> [B, H] mean over real nodes."""
+    return h.sum(axis=-2) / jnp.clip(node_mask.sum(-1, keepdims=True), 1.0, None)
+
+
 class GCN(nn.Module):
     """Graph-level classifier: GCN layers + masked mean pooling."""
 
@@ -30,21 +64,8 @@ class GCN(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        feats, adj = unpack_graph(x, self.feat_dim)
-        n = adj.shape[-1]
-        # normalized adjacency with self loops: D^-1/2 (A + I) D^-1/2
-        a = adj + jnp.eye(n)
-        deg = jnp.clip(a.sum(-1), 1e-6, None)
-        dinv = 1.0 / jnp.sqrt(deg)
-        a_norm = a * dinv[..., :, None] * dinv[..., None, :]
-        node_mask = (jnp.abs(feats).sum(-1) > 0).astype(feats.dtype)  # [B, N]
-
-        h = feats
-        for i in range(self.n_layers):
-            h = a_norm @ nn.Dense(self.hidden, name=f"gc{i}")(h)
-            h = nn.relu(h) * node_mask[..., None]  # keep padding nodes silent
-        pooled = h.sum(axis=-2) / jnp.clip(node_mask.sum(-1, keepdims=True), 1.0, None)
-        return nn.Dense(self.num_classes, name="readout")(pooled)
+        h, node_mask = gcn_encode(x, self.feat_dim, self.hidden, self.n_layers)
+        return nn.Dense(self.num_classes, name="readout")(masked_mean_pool(h, node_mask))
 
 
 class GCNLinkPred(nn.Module):
@@ -60,18 +81,7 @@ class GCNLinkPred(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        feats, adj = unpack_graph(x, self.feat_dim)
-        n = adj.shape[-1]
-        a = adj + jnp.eye(n)
-        deg = jnp.clip(a.sum(-1), 1e-6, None)
-        dinv = 1.0 / jnp.sqrt(deg)
-        a_norm = a * dinv[..., :, None] * dinv[..., None, :]
-        node_mask = (jnp.abs(feats).sum(-1) > 0).astype(feats.dtype)
-
-        h = feats
-        for i in range(self.n_layers):
-            h = a_norm @ nn.Dense(self.hidden, name=f"gc{i}")(h)
-            h = nn.relu(h) * node_mask[..., None]
+        h, node_mask = gcn_encode(x, self.feat_dim, self.hidden, self.n_layers)
         z = nn.Dense(self.hidden, name="embed")(h) * node_mask[..., None]
         scores = jnp.einsum("...ih,...jh->...ij", z, z) / jnp.sqrt(float(self.hidden))
         bias = self.param("score_bias", nn.initializers.zeros, ())
@@ -91,18 +101,7 @@ class GCNNodeClassifier(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        feats, adj = unpack_graph(x, self.feat_dim)
-        n = adj.shape[-1]
-        a = adj + jnp.eye(n)
-        deg = jnp.clip(a.sum(-1), 1e-6, None)
-        dinv = 1.0 / jnp.sqrt(deg)
-        a_norm = a * dinv[..., :, None] * dinv[..., None, :]
-        node_mask = (jnp.abs(feats).sum(-1) > 0).astype(feats.dtype)
-
-        h = feats
-        for i in range(self.n_layers):
-            h = a_norm @ nn.Dense(self.hidden, name=f"gc{i}")(h)
-            h = nn.relu(h) * node_mask[..., None]
+        h, _ = gcn_encode(x, self.feat_dim, self.hidden, self.n_layers)
         return nn.Dense(self.num_classes, name="node_head")(h)
 
 
@@ -119,17 +118,5 @@ class GCNRegressor(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        feats, adj = unpack_graph(x, self.feat_dim)
-        n = adj.shape[-1]
-        a = adj + jnp.eye(n)
-        deg = jnp.clip(a.sum(-1), 1e-6, None)
-        dinv = 1.0 / jnp.sqrt(deg)
-        a_norm = a * dinv[..., :, None] * dinv[..., None, :]
-        node_mask = (jnp.abs(feats).sum(-1) > 0).astype(feats.dtype)
-
-        h = feats
-        for i in range(self.n_layers):
-            h = a_norm @ nn.Dense(self.hidden, name=f"gc{i}")(h)
-            h = nn.relu(h) * node_mask[..., None]
-        pooled = h.sum(axis=-2) / jnp.clip(node_mask.sum(-1, keepdims=True), 1.0, None)
-        return nn.Dense(self.out_dim, name="reg_head")(pooled)
+        h, node_mask = gcn_encode(x, self.feat_dim, self.hidden, self.n_layers)
+        return nn.Dense(self.out_dim, name="reg_head")(masked_mean_pool(h, node_mask))

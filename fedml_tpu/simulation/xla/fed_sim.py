@@ -329,13 +329,17 @@ class XLASimulator:
         )
         # trim the stream buffers to a power-of-two bucket of the round's
         # real max steps: uploads and (with xla_pregather) the round's data
-        # gather scale with the bucket, not the global worst case.  Few
-        # distinct buckets across rounds -> few recompiles.
+        # gather scale with the bucket, not the global worst case.  The
+        # bucket only GROWS across rounds (monotone): a round near a
+        # power-of-two boundary can't flip-flop shapes and trigger
+        # recompiles inside a steady-state timing window — at most
+        # log2(s_max) recompiles per run, all early.
         s_used = max(int(sched.n_steps.max()), 1)
         s_bucket = 1
         while s_bucket < s_used:
             s_bucket *= 2
-        s_bucket = min(s_bucket, self.s_max)
+        s_bucket = min(max(s_bucket, getattr(self, "_s_bucket", 1)), self.s_max)
+        self._s_bucket = s_bucket
         sched = sched._replace(
             idx=sched.idx[:, :s_bucket], mask=sched.mask[:, :s_bucket],
             boundary=sched.boundary[:, :s_bucket], weight=sched.weight[:, :s_bucket],

@@ -123,8 +123,32 @@ def test_turbo_aggregate_matches_fedavg_modulo_masks():
 def test_vertical_fl_nuswide():
     """NUS-WIDE is the reference's canonical VFL dataset
     (data/NUS_WIDE/nus_wide_dataset.py two-party loader): multi-hot labels
-    collapse to the dominant concept for the guest's softmax."""
-    args = _args("classical_vertical", comm_round=60, dataset="nuswide",
-                 synthetic_train_size=640)
-    metrics = _run(args)
-    assert metrics["test_acc"] > 0.4
+    collapse to the dominant concept for the guest's softmax.  The dataset
+    tuple here carries REAL multi-hot [N, L] labels (the synthetic taglr
+    fallback ships int labels, which would skip the collapse branch)."""
+    import numpy as np
+
+    from fedml_tpu.simulation.sp.classical_vertical_fl.vfl_api import VerticalFLAPI
+
+    rng = np.random.RandomState(0)
+    n_tr, n_te, d, L = 640, 160, 20, 5
+    protos = np.random.RandomState(7).randn(L, d).astype(np.float32) * 2
+
+    def _mk(n, seed):
+        r = np.random.RandomState(seed)
+        dom = r.randint(0, L, n)
+        x = protos[dom] + 0.5 * r.randn(n, d).astype(np.float32)
+        y = np.zeros((n, L), np.float32)
+        y[np.arange(n), dom] = 1.0
+        extra = r.rand(n, L) < 0.2  # co-occurring secondary concepts
+        y = np.clip(y + extra * 0.0, 0, 1)  # dominant stays unique
+        return x, y
+
+    x_tr, y_tr = _mk(n_tr, 1)
+    x_te, y_te = _mk(n_te, 2)
+    args = _args("classical_vertical", comm_round=60, dataset="nuswide")
+    dataset = (n_tr, n_te, (x_tr, y_tr), (x_te, y_te), {}, {}, {}, L)
+    api = VerticalFLAPI(args, None, dataset)
+    assert api.y_tr.ndim == 1  # multi-hot collapsed to concept indices
+    metrics = api.train()
+    assert metrics["test_acc"] > 0.6, metrics
