@@ -20,6 +20,7 @@ Public API parity: ``fedml_tpu.init``, ``fedml_tpu.run_simulation``,
 from __future__ import annotations
 
 import logging
+import os
 import random as _random
 
 import numpy as _np
@@ -49,6 +50,29 @@ def init(args: Arguments | None = None, should_init_logs: bool = True) -> Argume
     _mlops.pre_setup(args)
     if getattr(args, "using_mlops", False):
         _mlops.init(args)
+
+    # multi-host mesh bootstrap (role of reference init_simulation_mpi /
+    # torchrun env parsing + NCCL pg init, __init__.py:96,228-246): when a
+    # coordinator is configured, join the jax.distributed cluster so
+    # jax.devices() spans every host's chips and the same Mesh/shard_map
+    # code runs pod-scale — collectives ride ICI within a slice and DCN
+    # across hosts, inserted by XLA from the sharding annotations.
+    coord = getattr(args, "jax_coordinator_address", None) or os.environ.get(
+        "FEDML_JAX_COORDINATOR"
+    )
+    if coord:
+        import jax as _jax
+
+        n_proc = int(getattr(args, "jax_num_processes", 0)
+                     or os.environ.get("FEDML_JAX_NUM_PROCESSES", 0))
+        pid = int(getattr(args, "jax_process_id", 0)
+                  or os.environ.get("FEDML_JAX_PROCESS_ID", 0))
+        _jax.distributed.initialize(
+            coordinator_address=str(coord),
+            num_processes=n_proc or None,
+            process_id=pid if n_proc else None,
+        )
+        _logger.info("jax.distributed up: proc %d/%s via %s", pid, n_proc, coord)
 
     seed = int(getattr(args, "random_seed", 0))
     _random.seed(seed)

@@ -143,3 +143,43 @@ class TestLoopbackComm:
         assert got.wait(timeout=5)
         t.join(timeout=5)
         assert received["value"] == 42
+
+
+class TestMultiHostInit:
+    def test_coordinator_args_plumb_into_jax_distributed(self, monkeypatch):
+        """init() joins the jax.distributed cluster when a coordinator is
+        configured (the reference's multi-host NCCL pg init role)."""
+        import fedml_tpu
+        from fedml_tpu.arguments import Arguments
+
+        calls = {}
+
+        def fake_initialize(coordinator_address=None, num_processes=None,
+                            process_id=None):
+            calls.update(addr=coordinator_address, n=num_processes, pid=process_id)
+
+        import jax
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+        args = Arguments.from_dict({"common_args": {"random_seed": 0},
+                                    "train_args": {}})
+        args.jax_coordinator_address = "10.0.0.1:1234"
+        args.jax_num_processes = 4
+        args.jax_process_id = 2
+        fedml_tpu.init(args, should_init_logs=False)
+        assert calls == {"addr": "10.0.0.1:1234", "n": 4, "pid": 2}
+
+    def test_no_coordinator_no_distributed_init(self, monkeypatch):
+        import fedml_tpu
+        from fedml_tpu.arguments import Arguments
+
+        import jax
+
+        def boom(*a, **k):
+            raise AssertionError("must not initialize without a coordinator")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        monkeypatch.delenv("FEDML_JAX_COORDINATOR", raising=False)
+        args = Arguments.from_dict({"common_args": {"random_seed": 0},
+                                    "train_args": {}})
+        fedml_tpu.init(args, should_init_logs=False)
