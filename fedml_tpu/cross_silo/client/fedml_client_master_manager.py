@@ -57,6 +57,7 @@ class ClientMasterManager(FedMLCommManager):
         global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         self.round_idx = 0
+        self._last_global = global_model_params  # delta base for compression
         self.trainer_dist_adapter.update_dataset(client_index)
         self.trainer_dist_adapter.set_model_params(global_model_params)
         self.__train()
@@ -65,6 +66,7 @@ class ClientMasterManager(FedMLCommManager):
         global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx + 1))
+        self._last_global = global_model_params
         self.trainer_dist_adapter.update_dataset(client_index)
         self.trainer_dist_adapter.set_model_params(global_model_params)
         self.__train()
@@ -81,6 +83,32 @@ class ClientMasterManager(FedMLCommManager):
         self.send_message(m)
 
     def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:
+        method = str(getattr(self.args, "compression", "") or "").lower()
+        if method and method != "none" and getattr(self, "_last_global", None) is not None:
+            # communication compression (reference utils/compression.py):
+            # top-k / EF-top-k / quantize / qsgd applied to the UPDATE
+            # (trained - global) — sparsifying raw weights would zero the
+            # model; the server adds the decompressed delta back onto the
+            # global params it distributed
+            import jax
+            import jax.numpy as jnp
+
+            from ...core.compression import compress_update
+
+            delta = jax.tree_util.tree_map(
+                lambda w, g: jnp.asarray(w) - jnp.asarray(g), weights, self._last_global
+            )
+            payload, self._compress_residuals = compress_update(
+                delta, method,
+                ratio=float(getattr(self.args, "compression_ratio", 0.05)),
+                bits=int(getattr(self.args, "quantize_level", 8)),
+                key=jax.random.PRNGKey(
+                    int(getattr(self.args, "random_seed", 0)) * 1000 + self.round_idx
+                ),
+                residuals=getattr(self, "_compress_residuals", None),
+            )
+            payload["is_delta"] = True
+            weights = payload
         m = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, receive_id)
         m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
         m.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)

@@ -89,8 +89,22 @@ class FedMLServerManager(FedMLCommManager):
             self.send_message(m)
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        from ...core.compression import is_compressed, maybe_decompress_update
+
         sender = int(msg.get_sender_id())
-        model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        raw = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        is_delta = is_compressed(raw) and bool(raw.get("is_delta"))
+        model_params = maybe_decompress_update(raw)
+        if is_delta:
+            # compressed uploads carry the UPDATE; rebase onto the global
+            # params this round distributed
+            import jax
+            import jax.numpy as jnp
+
+            base = self.aggregator.get_global_model_params()
+            model_params = jax.tree_util.tree_map(
+                lambda g, d: jnp.asarray(g) + jnp.asarray(d), base, model_params
+            )
         local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
         self.aggregator.add_local_trained_result(
             self.client_id_list_in_this_round.index(sender), model_params, local_sample_number
