@@ -22,7 +22,20 @@ def _free_port():
     return port
 
 
+def _force_child_cpu():
+    """Spawned children don't run conftest: the axon sitecustomize registers
+    the TPU backend in EVERY python process, and jax would otherwise init
+    (and possibly hang on) the tunnel inside the child."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from fedml_tpu.utils.platform import force_cpu_backend
+
+    force_cpu_backend()
+
+
 def _collective_worker(rank, world, port, q):
+    _force_child_cpu()
     pg = ProcessGroup(rank, world, addr=("127.0.0.1", port), timeout=30)
     try:
         # broadcast from 0
@@ -77,6 +90,7 @@ class TestProcessGroup:
 def _silo_proc(rank, world, port, q):
     """One silo process training its shard of a shared linear regression;
     master (rank 0) broadcasts sync like TrainerDistAdapter.train does."""
+    _force_child_cpu()
     pg = ProcessGroup(rank, world, addr=("127.0.0.1", port), timeout=30)
     try:
         rng = np.random.RandomState(0)  # same data everywhere (same mount)
@@ -112,6 +126,7 @@ class TestSiloShardRound:
 
 def _adapter_proc(rank, world, port, q):
     """Real TrainerDistAdapter master/slave round over the host pg."""
+    _force_child_cpu()
     from types import SimpleNamespace as NS
 
     import fedml_tpu
