@@ -33,6 +33,7 @@ psum (reference ``simulation/mpi/*`` parity, SURVEY.md §2.5).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from functools import partial
 from typing import Any, Dict, List, Tuple
@@ -388,6 +389,17 @@ class XLASimulator:
                 self.algo.restore_host_state(state["algo_host_state"])
             start_round = step + 1
             logger.info("resumed from checkpoint round %d", step)
+        profiling = bool(getattr(self.args, "enable_profiler", False))
+        if profiling:
+            # whole-run XLA trace (TensorBoard-viewable; the reference's
+            # profiler posts wall-clock events — on TPU the on-device
+            # timeline is the thing worth capturing)
+            prof_dir = str(getattr(self.args, "profiler_dir", "")
+                           or os.path.join(
+                               str(getattr(self.args, "log_file_dir", ".") or "."),
+                               "xla_trace"))
+            jax.profiler.start_trace(prof_dir)
+            logger.info("jax profiler trace -> %s", prof_dir)
         for round_idx in range(start_round, comm_round):
             t0 = time.time()
             sampled = self._client_sampling(round_idx)
@@ -465,6 +477,8 @@ class XLASimulator:
                 ckpt.save(round_idx, state)
             if eval_enabled and (round_idx % freq == 0 or round_idx == comm_round - 1):
                 last = self._test_global(round_idx)
+        if profiling:
+            jax.profiler.stop_trace()
         return last
 
     def _test_global(self, round_idx: int) -> Dict[str, Any]:
