@@ -1,0 +1,13 @@
+"""Import-path parity with reference ``fedml/utils/compression.py``: the
+compressor set lives in :mod:`fedml_tpu.core.compression` (functional,
+pytree-level); this module re-exports it under the reference's path."""
+
+from ..core.compression import (  # noqa: F401
+    compress_update,
+    decompress_update,
+    is_compressed,
+    maybe_decompress_update,
+    qsgd_leaf,
+    quantize_leaf,
+    topk_leaf,
+)

@@ -1,0 +1,6 @@
+"""Cross-device server one-liner (reference quick_start/beehive)."""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    fedml_tpu.run_mnn_server()

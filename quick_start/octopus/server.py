@@ -1,0 +1,6 @@
+"""Cross-silo server one-liner (reference quick_start/octopus)."""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    fedml_tpu.run_cross_silo_server()

@@ -1,0 +1,49 @@
+"""Custom data + model quick start (reference
+``quick_start/parrot/torch_fedavg_mnist_lr_custum_data_and_model_example.py``):
+bring your own arrays and flax module; everything else is the framework."""
+
+import flax.linen as nn
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu import FedMLRunner
+from fedml_tpu.core.data.noniid_partition import homo_partition
+
+
+class TwoLayerMLP(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.reshape((x.shape[0], -1))
+        h = nn.relu(nn.Dense(64)(h))
+        return nn.Dense(self.num_classes)(h)
+
+
+def load_custom_data(args):
+    """Return the reference-shaped 8-tuple from your own arrays."""
+    rng = np.random.RandomState(0)
+    n, d, classes = 2000, 64, 10
+    protos = rng.randn(classes, d).astype(np.float32) * 2
+    y = rng.randint(0, classes, n).astype(np.int32)
+    x = protos[y] + rng.randn(n, d).astype(np.float32)
+    n_tr = int(0.8 * n)
+    (x_tr, y_tr), (x_te, y_te) = (x[:n_tr], y[:n_tr]), (x[n_tr:], y[n_tr:])
+
+    clients = int(args.client_num_in_total)
+    tr_map = homo_partition(n_tr, clients, seed=0)
+    te_map = homo_partition(n - n_tr, clients, seed=1)
+    train_local = {i: (x_tr[tr_map[i]], y_tr[tr_map[i]]) for i in range(clients)}
+    test_local = {i: (x_te[te_map[i]], y_te[te_map[i]]) for i in range(clients)}
+    nums = {i: len(tr_map[i]) for i in range(clients)}
+    dataset = [n_tr, n - n_tr, (x_tr, y_tr), (x_te, y_te), nums, train_local,
+               test_local, classes]
+    return dataset, classes
+
+
+if __name__ == "__main__":
+    args = fedml_tpu.init()
+    device = fedml_tpu.device.get_device(args)
+    dataset, output_dim = load_custom_data(args)
+    model = TwoLayerMLP(num_classes=output_dim)
+    FedMLRunner(args, device, dataset, model).run()

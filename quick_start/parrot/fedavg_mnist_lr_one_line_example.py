@@ -1,0 +1,7 @@
+"""One-line quick start (reference
+``quick_start/parrot/torch_fedavg_mnist_lr_one_line_example.py``)."""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    fedml_tpu.run_simulation()

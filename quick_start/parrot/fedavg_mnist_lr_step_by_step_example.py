@@ -1,0 +1,21 @@
+"""Step-by-step quick start (reference
+``quick_start/parrot/torch_fedavg_mnist_lr_step_by_step_example.py``)."""
+
+import fedml_tpu
+from fedml_tpu import FedMLRunner
+
+if __name__ == "__main__":
+    # init the framework (reads --cf fedml_config.yaml)
+    args = fedml_tpu.init()
+
+    # init device (TPU chip / virtual CPU mesh)
+    device = fedml_tpu.device.get_device(args)
+
+    # load data (mounted real files, else shape-faithful synthetic)
+    dataset, output_dim = fedml_tpu.data.load(args)
+
+    # load model
+    model = fedml_tpu.models.create(args, output_dim)
+
+    # start training
+    FedMLRunner(args, device, dataset, model).run()
