@@ -128,3 +128,20 @@ class TestGraftEntry:
         import __graft_entry__ as ge
 
         ge.dryrun_multichip(8)
+
+
+class TestDeterministicReplay:
+    """SURVEY §5 race-detection rebuild note: JAX's functional model replaces
+    sanitizers with determinism guarantees — same seed, bitwise-same round
+    outputs, for both execution strategies."""
+
+    @pytest.mark.parametrize("pack", [False, True])
+    def test_two_runs_bitwise_identical(self, pack):
+        outs = []
+        for _ in range(2):
+            args, dataset, model = _build(_args(comm_round=2, xla_pack=pack))
+            sim = XLASimulator(args, dataset, model)
+            sim.train()
+            outs.append([np.asarray(l) for l in jax.tree_util.tree_leaves(sim.variables)])
+        for a, b in zip(*outs):
+            np.testing.assert_array_equal(a, b)
