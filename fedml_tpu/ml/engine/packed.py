@@ -119,6 +119,7 @@ def build_packed_device_fn(
     has_dropout: bool = True,
     loss: str = "ce",
     pregather: bool = False,
+    stream: str = "while",
 ):
     """The per-device round body (composed under shard_map by the simulator).
 
@@ -153,8 +154,13 @@ def build_packed_device_fn(
         opt0 = tx.init(params0)
         # where-masking of all-padding steps is only needed when state would
         # drift without it (stateful optimizer / mutable collections); plain
-        # SGD takes zero-grad no-op steps for free
-        stateless = not jax.tree_util.tree_leaves(opt0) and not other0
+        # SGD takes zero-grad no-op steps for free.  The scan stream runs the
+        # bucketed tail (step >= n_steps) as real iterations, and a grad hook
+        # (FedProx pull, SCAFFOLD correction) is nonzero even on zero grads —
+        # so scan always takes the masked path.
+        scanning = stream == "scan"
+        stateless = (not jax.tree_util.tree_leaves(opt0) and not other0
+                     and not (scanning and grad_hook is not None))
 
         zeros_vars = jax.tree_util.tree_map(
             lambda v: jnp.zeros_like(v, jnp.float32), variables
@@ -256,8 +262,20 @@ def build_packed_device_fn(
 
         init = (jnp.int32(0), params0, other0, opt0, 0.0, 0.0, 0.0,
                 zeros_vars, 0.0, 0.0, 0.0, ext0, outs0)
-        final = jax.lax.while_loop(lambda c: c[0] < n_steps, body, init)
-        (_, _, _, _, _, _, _, acc, wsum, lsum, cnt, ext, outs) = final
+        if scanning:
+            # static-length scan over the bucketed stream: XLA can pipeline
+            # iterations (no traced trip count); tail steps beyond n_steps
+            # carry all-zero masks so they are exact no-ops
+            def scan_body(carry, step):
+                return body((step,) + carry)[1:], None
+
+            final, _ = jax.lax.scan(
+                scan_body, init[1:], jnp.arange(idx.shape[0], dtype=jnp.int32)
+            )
+            (_, _, _, _, _, _, acc, wsum, lsum, cnt, ext, outs) = final
+        else:
+            final = jax.lax.while_loop(lambda c: c[0] < n_steps, body, init)
+            (_, _, _, _, _, _, _, acc, wsum, lsum, cnt, ext, outs) = final
         return acc, wsum, lsum, cnt, ext, outs
 
     return device_fn

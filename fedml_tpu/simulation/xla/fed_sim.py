@@ -287,6 +287,7 @@ class XLASimulator:
         device_fn = build_packed_device_fn(
             self.module, self.args, algo, self.batch_size, self.slots,
             pregather=bool(getattr(self.args, "xla_pregather", False)),
+            stream=str(getattr(self.args, "xla_stream", "while")),
         )
 
         def per_device(variables, server_state, x_all, y_all, idx, mask, boundary,
