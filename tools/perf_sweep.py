@@ -1,0 +1,73 @@
+"""Ablation sweep for the in-mesh round's execution strategies.
+
+Run on a real chip (default env, main thread):
+
+    python tools/perf_sweep.py [--rounds 6] [--cpr 32]
+
+Measures samples/s/chip for {padded, packed} x {while, scan} x
+{per-step gather, pregather} and prints one JSON line per configuration
+plus a final "best" line.  Use it to pick bench.py's flags after any
+engine change (see PERF.md for the current measured table)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=6)
+    p.add_argument("--cpr", type=int, default=32)
+    p.add_argument("--model", default=None, help="override bench model (CPU smoke: lr)")
+    p.add_argument("--train-size", type=int, default=0,
+                   help="override synthetic train size (CPU smoke)")
+    flags = p.parse_args()
+
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import jax
+
+    import bench
+    import fedml_tpu
+    from fedml_tpu import data
+    from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+    n_chips = len(jax.devices())
+    configs = [
+        dict(xla_pack=False),
+        dict(xla_pack=True),
+        dict(xla_pack=True, xla_pregather=True),
+        dict(xla_pack=True, xla_stream="scan"),
+        dict(xla_pack=True, xla_pregather=True, xla_stream="scan"),
+    ]
+    best = (None, 0.0)
+    for overrides in configs:
+        args = bench._bench_args(n_chips)
+        args.xla_pack = False  # reset the bench default before applying
+        args.comm_round = int(flags.rounds)
+        args.client_num_per_round = min(100, int(flags.cpr))
+        if flags.model:
+            args.model = flags.model
+        if flags.train_size:
+            args.synthetic_train_size = int(flags.train_size)
+        for k, v in overrides.items():
+            setattr(args, k, v)
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data.load(args)
+        model = fedml_tpu.models.create(args, out_dim)
+        sim = XLASimulator(args, dataset, model)
+        sim.train()
+        sps = sim.throughput()["samples_per_sec"] / max(n_chips, 1)
+        row = dict(overrides, sps_per_chip=round(sps, 1))
+        print(json.dumps(row), flush=True)
+        if sps > best[1]:
+            best = (overrides, sps)
+    print(json.dumps({"best": best[0], "sps_per_chip": round(best[1], 1)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
