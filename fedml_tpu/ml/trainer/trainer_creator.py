@@ -20,6 +20,28 @@ _MTL_DATASETS = {"moleculenet_mtl"}
 _AE_DATASETS = {"iot_anomaly", "nbaiot"}
 
 
+def loss_kind_for_dataset(dataset: str) -> str:
+    """Engine loss key for a dataset family (the in-mesh XLA round plumbs
+    this straight into the compiled engines; the sp path reaches the same
+    key through each task trainer's ``loss_kind``).  ``bce`` datasets are
+    NOT mapped here: their int->multi-hot label conversion lives in the tag
+    trainer, which only the sp path runs."""
+    dataset = dataset.lower()
+    if dataset in _SPAN_DATASETS:
+        return "span"
+    if dataset in _DET_DATASETS:
+        return "det"
+    if dataset in _S2S_DATASETS:
+        return "s2s"
+    if dataset in _LINKPRED_DATASETS:
+        return "linkpred"
+    if dataset in _MTL_DATASETS:
+        return "mtl_bce"
+    if dataset in _AE_DATASETS or dataset in _REG_DATASETS:
+        return "mse"
+    return "ce"
+
+
 def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
     dataset = str(getattr(args, "dataset", "")).lower()
     if dataset in _NWP_DATASETS or dataset in _SEQTAG_DATASETS:

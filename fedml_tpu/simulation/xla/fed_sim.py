@@ -102,21 +102,20 @@ class XLASimulator:
                 "use backend 'sp' for robustness experiments (central DP 'cdp' IS "
                 "supported on the XLA backend)"
             )
-        # the compiled round is wired for CE-style tasks; BCE/span/detection
-        # losses and their task-specific evals run on the sp backend
-        from ...ml.trainer.trainer_creator import (
-            _AE_DATASETS, _DET_DATASETS, _LINKPRED_DATASETS, _MTL_DATASETS,
-            _REG_DATASETS, _S2S_DATASETS, _SPAN_DATASETS, _TAG_DATASETS,
-        )
+        # every engine loss family runs in-mesh (the loss key is plumbed
+        # into the compiled round; eval goes through the task-aware
+        # aggregator).  The one exception: tag-prediction datasets, whose
+        # int->multi-hot label conversion lives in the sp tag trainer.
+        from ...ml.trainer.trainer_creator import _TAG_DATASETS, loss_kind_for_dataset
 
         ds = str(getattr(args, "dataset", "")).lower()
-        if ds in (_DET_DATASETS | _SPAN_DATASETS | _TAG_DATASETS
-                  | _LINKPRED_DATASETS | _MTL_DATASETS | _S2S_DATASETS
-                  | _AE_DATASETS | _REG_DATASETS):
+        if ds in _TAG_DATASETS:
             raise NotImplementedError(
-                f"dataset {ds!r} (task-specific loss) is not wired into the "
-                "in-mesh XLA round; use backend 'sp'"
+                f"dataset {ds!r} (tag prediction: host-side multi-hot label "
+                "conversion) is not wired into the in-mesh XLA round; use "
+                "backend 'sp'"
             )
+        self.loss_kind = loss_kind_for_dataset(ds)
 
         self._pack_data()
         sample = jnp.asarray(self.train_global[0][:1])
@@ -132,7 +131,9 @@ class XLASimulator:
 
         self.runtime_estimator = RuntimeEstimator(self.n_dev, uniform_devices=True)
         self.scheduler = SeqTrainScheduler(self.n_dev, estimator=self.runtime_estimator)
-        self.aggregator = DefaultServerAggregator(model, args)
+        from ...ml.aggregator.aggregator_creator import create_server_aggregator
+
+        self.aggregator = create_server_aggregator(model, args)
         self.metrics = MetricsLogger(args)
         self.round_times: List[float] = []
         self.samples_per_round: List[int] = []
@@ -199,7 +200,7 @@ class XLASimulator:
         algo = self.algo
         local_train = build_local_train(
             self.module, self.args, self.batch_size, self.padded_n,
-            grad_hook=algo.grad_hook(),
+            grad_hook=algo.grad_hook(), loss=self.loss_kind,
         )
 
         def per_device(variables, server_state, x_all, y_all, idx_l, counts_l, rngs_l, cex_l):
@@ -286,6 +287,7 @@ class XLASimulator:
         )
         device_fn = build_packed_device_fn(
             self.module, self.args, algo, self.batch_size, self.slots,
+            loss=self.loss_kind,
             pregather=bool(getattr(self.args, "xla_pregather", False)),
             stream=str(getattr(self.args, "xla_stream", "while")),
         )
@@ -490,6 +492,10 @@ class XLASimulator:
             "test_acc": round(stats["test_correct"] / stats["test_total"], 4),
             "test_loss": round(stats["test_loss"] / stats["test_total"], 4),
         }
+        # task-specific extras (mean IoU, exact match, RMSE, ...) pass through
+        for k, v in stats.items():
+            if k.startswith("test_") and k not in ("test_correct", "test_total", "test_loss"):
+                out[k] = round(float(v), 4)
         self.metrics.log(out)
         logger.info("eval: %s", out)
         return out

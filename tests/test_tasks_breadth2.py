@@ -186,3 +186,30 @@ class TestGraphRegression:
                             partition_method="hetero"))
         # RMSE well below the target's std (signal = w.mean_feats + density)
         assert metrics["test_rmse"] < 0.6, metrics
+
+
+class TestTasksOnXLABackend:
+    """Task-specific losses now ride the compiled in-mesh round: the loss
+    key is plumbed into both engines and eval goes through the task-aware
+    aggregator (previously fail-loud -> sp only)."""
+
+    @pytest.mark.parametrize("dataset,model,gate,extra", [
+        ("synthetic_det", "tiny_detector", 0.5, {}),
+        ("ego_linkpred", "gcn_linkpred", 0.62, {}),
+        ("iot_anomaly", "autoencoder", 0.85, {}),
+        ("synthetic_s2s", "transformer_s2s", 0.5, {"synthetic_train_size": 2048}),
+    ])
+    @pytest.mark.parametrize("pack", [False, True])
+    def test_task_learns_in_mesh(self, dataset, model, gate, extra, pack):
+        args = _cfg(dataset, model, comm_round=4, epochs=3, learning_rate=0.01,
+                    **extra)
+        args.backend = "XLA"
+        args.xla_pack = pack
+        metrics = _run(args)
+        assert metrics["test_acc"] > gate, (dataset, pack, metrics)
+
+    def test_tag_prediction_still_fail_loud(self):
+        args = _cfg("stackoverflow_lr", "lr", comm_round=1)
+        args.backend = "XLA"
+        with pytest.raises(NotImplementedError, match="tag prediction"):
+            _run(args)
