@@ -102,20 +102,15 @@ class XLASimulator:
                 "use backend 'sp' for robustness experiments (central DP 'cdp' IS "
                 "supported on the XLA backend)"
             )
-        # every engine loss family runs in-mesh (the loss key is plumbed
-        # into the compiled round; eval goes through the task-aware
-        # aggregator).  The one exception: tag-prediction datasets, whose
-        # int->multi-hot label conversion lives in the sp tag trainer.
+        # every engine loss family runs in-mesh: the loss key is plumbed
+        # into the compiled round and eval goes through the task-aware
+        # aggregator.  Tag prediction's int->multi-hot conversion happens
+        # host-side at pack time (_pack_data), so it rides the bce loss.
         from ...ml.trainer.trainer_creator import _TAG_DATASETS, loss_kind_for_dataset
 
         ds = str(getattr(args, "dataset", "")).lower()
-        if ds in _TAG_DATASETS:
-            raise NotImplementedError(
-                f"dataset {ds!r} (tag prediction: host-side multi-hot label "
-                "conversion) is not wired into the in-mesh XLA round; use "
-                "backend 'sp'"
-            )
-        self.loss_kind = loss_kind_for_dataset(ds)
+        self._multihot_labels = ds in _TAG_DATASETS
+        self.loss_kind = "bce" if self._multihot_labels else loss_kind_for_dataset(ds)
 
         self._pack_data()
         sample = jnp.asarray(self.train_global[0][:1])
@@ -156,6 +151,10 @@ class XLASimulator:
         cursor = 0
         for i in range(self.num_clients):
             xi, yi = self.local_train_dict[i]
+            if self._multihot_labels and np.asarray(yi).ndim == 1:
+                # tag prediction with int class ids: one-hot for the bce
+                # loss (mounted multi-label sets already arrive multi-hot)
+                yi = np.eye(self.class_num, dtype=np.float32)[np.asarray(yi)]
             n = len(yi)
             xs.append(np.asarray(xi))
             ys.append(np.asarray(yi))

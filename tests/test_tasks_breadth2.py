@@ -208,8 +208,13 @@ class TestTasksOnXLABackend:
         metrics = _run(args)
         assert metrics["test_acc"] > gate, (dataset, pack, metrics)
 
-    def test_tag_prediction_still_fail_loud(self):
-        args = _cfg("stackoverflow_lr", "lr", comm_round=1)
+    def test_tag_prediction_in_mesh(self):
+        """Int class ids are one-hot'd host-side at pack time so the bce
+        loss (and tag eval probe) run in the compiled round."""
+        args = _cfg("stackoverflow_lr", "lr", comm_round=6, epochs=3,
+                    learning_rate=0.1, synthetic_train_size=1024)
         args.backend = "XLA"
-        with pytest.raises(NotImplementedError, match="tag prediction"):
-            _run(args)
+        metrics = _run(args)
+        # per-label-position accuracy; multi-hot is sparse so the floor is
+        # high — require real learning via the F1 extra
+        assert metrics["test_f1"] > 0.3, metrics
