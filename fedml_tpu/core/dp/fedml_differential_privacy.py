@@ -88,5 +88,17 @@ class FedMLDifferentialPrivacy:
     def add_local_noise(self, local_grad: Any) -> Any:
         return self.add_noise(local_grad)
 
+    def spend_budget(self, times: int = 1) -> None:
+        """Account ``times`` mechanism applications WITHOUT noising —
+        for paths that apply the (jax-pure) mechanism inside a compiled
+        region (the in-mesh local-DP round) and account host-side."""
+        if self.accountant is None:
+            return
+        from .mechanisms import Laplace
+
+        delta = 0.0 if isinstance(self.mechanism, Laplace) else self.delta
+        for _ in range(int(times)):
+            self.accountant.spend(self.epsilon, delta)
+
     def add_global_noise(self, global_model: Any) -> Any:
         return self.add_noise(global_model)

@@ -120,6 +120,7 @@ def build_packed_device_fn(
     loss: str = "ce",
     pregather: bool = False,
     stream: str = "while",
+    post_train=None,
 ):
     """The per-device round body (composed under shard_map by the simulator).
 
@@ -219,6 +220,12 @@ def build_packed_device_fn(
                 w = weight[step]
                 real = (w > 0).astype(jnp.float32)
                 out_vars = dict(other, params=params)
+                if post_train is not None:
+                    # in-mesh local DP: noise this client's update at its
+                    # boundary, keyed by (device rng, stream position)
+                    out_vars = post_train(
+                        out_vars, jax.random.fold_in(rng, step + 104729)
+                    )
                 result = LocalTrainResult(
                     out_vars,
                     c_loss / jnp.maximum(c_cnt, 1.0),

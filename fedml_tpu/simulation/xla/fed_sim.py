@@ -96,11 +96,12 @@ class XLASimulator:
         attacker = FedMLAttacker.get_instance()
         defender = FedMLDefender.get_instance()
         dp = FedMLDifferentialPrivacy.get_instance()
-        if attacker.is_attack_enabled() or defender.is_defense_enabled() or dp.is_local_dp_enabled():
+        if attacker.is_attack_enabled() or defender.is_defense_enabled():
             raise NotImplementedError(
-                "attack/defense/local-DP hooks need per-client updates on the host; "
-                "use backend 'sp' for robustness experiments (central DP 'cdp' IS "
-                "supported on the XLA backend)"
+                "attack/defense hooks need per-client updates on the host; "
+                "use backend 'sp' for robustness experiments (both DP modes "
+                "ARE supported on the XLA backend: 'cdp' on the aggregate, "
+                "'ldp' in-mesh per client)"
             )
         # every engine loss family runs in-mesh: the loss key is plumbed
         # into the compiled round and eval goes through the task-aware
@@ -194,9 +195,19 @@ class XLASimulator:
             )
         return k
 
+    def _ldp_hook(self):
+        """Pure per-client noise fn when local DP is enabled (the mechanism's
+        add_noise is jax-traceable), else None."""
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if not dp.is_local_dp_enabled():
+            return None
+        mechanism = dp.mechanism
+        return lambda tree, key: mechanism.add_noise(tree, key)
+
     def _build_round_fn(self):
         mesh = self.mesh
         algo = self.algo
+        post_train = self._ldp_hook()
         local_train = build_local_train(
             self.module, self.args, self.batch_size, self.padded_n,
             grad_hook=algo.grad_hook(), loss=self.loss_kind,
@@ -218,6 +229,11 @@ class XLASimulator:
                     variables, x, y, n_i, rng,
                     extra=algo.engine_extra(cex, server_state),
                 )
+                if post_train is not None:
+                    # in-mesh local DP: per-client noise before aggregation
+                    result = result._replace(variables=post_train(
+                        result.variables, jax.random.fold_in(rng, 104729)
+                    ))
                 w = n_i.astype(jnp.float32)
                 real = (n_i > 0).astype(jnp.float32)
                 wv = jax.tree_util.tree_map(
@@ -289,6 +305,7 @@ class XLASimulator:
             loss=self.loss_kind,
             pregather=bool(getattr(self.args, "xla_pregather", False)),
             stream=str(getattr(self.args, "xla_stream", "while")),
+            post_train=self._ldp_hook(),
         )
 
         def per_device(variables, server_state, x_all, y_all, idx, mask, boundary,
@@ -443,6 +460,10 @@ class XLASimulator:
             dp = FedMLDifferentialPrivacy.get_instance()
             if dp.is_global_dp_enabled():
                 self.variables = dp.add_global_noise(self.variables)
+            elif dp.is_local_dp_enabled():
+                # noise was applied in-mesh; account the budget host-side
+                # (one spend per participating client, as the sp hook does)
+                dp.spend_budget(int(participated.sum()))
             jax.block_until_ready(self.variables)
             dt = time.time() - t0
             self.round_times.append(dt)

@@ -145,3 +145,37 @@ class TestDeterministicReplay:
             outs.append([np.asarray(l) for l in jax.tree_util.tree_leaves(sim.variables)])
         for a, b in zip(*outs):
             np.testing.assert_array_equal(a, b)
+
+
+class TestInMeshLocalDP:
+    """Local DP rides the compiled round: per-client noise before
+    aggregation (the mechanism's add_noise is jax-pure), budget accounted
+    host-side per participating client."""
+
+    @pytest.mark.parametrize("pack", [False, True])
+    def test_ldp_noises_and_accounts(self, pack):
+        from fedml_tpu.core.dp.fedml_differential_privacy import (
+            FedMLDifferentialPrivacy,
+        )
+
+        results = {}
+        for enable in (False, True):
+            args, dataset, model = _build(_args(comm_round=2, xla_pack=pack))
+            args.enable_dp = enable
+            args.dp_type = "ldp"
+            args.mechanism_type = "gaussian"
+            args.epsilon = 50.0
+            args.delta = 1e-5
+            FedMLDifferentialPrivacy._instance = None
+            dp = FedMLDifferentialPrivacy.get_instance()
+            dp.init(args)
+            sim = XLASimulator(args, dataset, model)
+            sim.train()
+            results[enable] = [np.asarray(l) for l in
+                               jax.tree_util.tree_leaves(sim.variables)]
+            if enable:
+                # 2 rounds x all sampled clients must be accounted
+                assert len(dp.accountant) == 2 * int(args.client_num_per_round)
+        # noise changed the trajectory
+        diffs = [np.abs(a - b).max() for a, b in zip(results[False], results[True])]
+        assert max(diffs) > 1e-6
