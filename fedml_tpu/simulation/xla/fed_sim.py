@@ -96,13 +96,26 @@ class XLASimulator:
         attacker = FedMLAttacker.get_instance()
         defender = FedMLDefender.get_instance()
         dp = FedMLDifferentialPrivacy.get_instance()
-        if attacker.is_attack_enabled() or defender.is_defense_enabled():
+        if attacker.is_attack_enabled():
             raise NotImplementedError(
-                "attack/defense hooks need per-client updates on the host; "
-                "use backend 'sp' for robustness experiments (both DP modes "
-                "ARE supported on the XLA backend: 'cdp' on the aggregate, "
-                "'ldp' in-mesh per client)"
+                "attack simulation needs per-client data/update hooks on the "
+                "host; use backend 'sp' for attack experiments (defenses and "
+                "both DP modes ARE supported on the XLA backend)"
             )
+        self.defended = defender.is_defense_enabled()
+        if self.defended:
+            # robust aggregation: clients still train in the compiled round,
+            # which returns the per-client update stack; the defender's jnp
+            # math then replaces the weighted mean.  Padded FedAvg only —
+            # the packed stream accumulates in-stream, and non-FedAvg server
+            # algorithms consume weighted sums the defenses don't produce.
+            opt = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+            if bool(getattr(args, "xla_pack", False)) or opt != "fedavg":
+                raise NotImplementedError(
+                    "in-mesh defense requires the padded round and FedAvg "
+                    f"(got xla_pack={getattr(args, 'xla_pack', False)}, "
+                    f"federated_optimizer={opt!r}); use backend 'sp' otherwise"
+                )
         # every engine loss family runs in-mesh: the loss key is plumbed
         # into the compiled round and eval goes through the task-aware
         # aggregator.  Tag prediction's int->multi-hot conversion happens
@@ -207,6 +220,7 @@ class XLASimulator:
     def _build_round_fn(self):
         mesh = self.mesh
         algo = self.algo
+        defended = self.defended
         post_train = self._ldp_hook()
         local_train = build_local_train(
             self.module, self.args, self.batch_size, self.padded_n,
@@ -241,6 +255,11 @@ class XLASimulator:
                 )
                 contrib = algo.client_contrib(variables, result, w, real, cex, server_state)
                 out = algo.client_out(variables, result, real, cex, server_state)
+                if defended:
+                    # ship the unweighted update stack out for the defender
+                    out = {"algo": out, "weight": w,
+                           "update": jax.tree_util.tree_map(
+                               lambda p: p.astype(jnp.float32), result.variables)}
                 return wv, w, result.loss * w, contrib, out
 
             vclients = jax.vmap(one_client)
@@ -431,6 +450,7 @@ class XLASimulator:
             cex = self.algo.gather_client_extras(
                 self.client_state, ids, participated, round_idx
             )
+            prev_global = self.variables  # defense reference (pre-round global)
             if self.packed:
                 packed = self._packed_inputs(np.asarray(ids), counts, round_idx)
                 dev_rngs = jax.random.split(
@@ -453,6 +473,26 @@ class XLASimulator:
                     rngs,
                     cex,
                 )
+            if self.defended:
+                # replace the round's weighted mean with the defender's
+                # robust aggregate over the per-client update stack (the
+                # defense math itself is jnp and runs on device arrays).
+                # defend_after runs here; the loop's cdp block below still
+                # applies central noise exactly once.
+                from ...core.security.fedml_defender import FedMLDefender
+
+                upd, ws = outs["update"], np.asarray(outs["weight"])
+                updates = [
+                    (float(ws[i]), jax.tree_util.tree_map(lambda t, i=i: t[i], upd))
+                    for i in range(len(ws)) if ws[i] > 0
+                ]
+                self.aggregator.set_model_params(prev_global)  # defense reference
+                updates = self.aggregator.on_before_aggregation(updates)
+                self.variables = self.aggregator.aggregate(updates)
+                self.variables = FedMLDefender.get_instance().defend_after_aggregation(
+                    self.variables
+                )
+                outs = outs["algo"]
             self.client_state = self.algo.apply_client_outs(self.client_state, ids, outs)
             self.algo.host_round_end(ids, participated, round_idx)
             # host-side hooks (attack/defense need per-client updates and run
