@@ -59,7 +59,6 @@ from ...core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
 from ...core.schedule import RuntimeEstimator, SeqTrainScheduler
 from ...core.security.fedml_attacker import FedMLAttacker
 from ...core.security.fedml_defender import FedMLDefender
-from ...ml.aggregator.default_aggregator import DefaultServerAggregator
 from ...ml.engine.train import build_local_train, init_variables
 from ...parallel.mesh import create_fl_mesh
 from ...utils.metrics import MetricsLogger
@@ -451,6 +450,12 @@ class XLASimulator:
                 self.client_state, ids, participated, round_idx
             )
             prev_global = self.variables  # defense reference (pre-round global)
+            dp = FedMLDifferentialPrivacy.get_instance()
+            if dp.is_local_dp_enabled():
+                # account BEFORE the round releases anything (matching the sp
+                # path, where add_noise spends before producing the noised
+                # update): budget exhaustion must abort the round, not trail it
+                dp.spend_budget(int(participated.sum()))
             if self.packed:
                 packed = self._packed_inputs(np.asarray(ids), counts, round_idx)
                 dev_rngs = jax.random.split(
@@ -479,8 +484,6 @@ class XLASimulator:
                 # defense math itself is jnp and runs on device arrays).
                 # defend_after runs here; the loop's cdp block below still
                 # applies central noise exactly once.
-                from ...core.security.fedml_defender import FedMLDefender
-
                 upd, ws = outs["update"], np.asarray(outs["weight"])
                 updates = [
                     (float(ws[i]), jax.tree_util.tree_map(lambda t, i=i: t[i], upd))
@@ -497,13 +500,8 @@ class XLASimulator:
             self.algo.host_round_end(ids, participated, round_idx)
             # host-side hooks (attack/defense need per-client updates and run
             # in the host path; central DP applies here)
-            dp = FedMLDifferentialPrivacy.get_instance()
             if dp.is_global_dp_enabled():
                 self.variables = dp.add_global_noise(self.variables)
-            elif dp.is_local_dp_enabled():
-                # noise was applied in-mesh; account the budget host-side
-                # (one spend per participating client, as the sp hook does)
-                dp.spend_budget(int(participated.sum()))
             jax.block_until_ready(self.variables)
             dt = time.time() - t0
             self.round_times.append(dt)
