@@ -112,23 +112,63 @@ class FedMLDaemon:
         self._threads[run_id] = sup.run_async()
         logger.info("dispatched run %s (package=%s)", run_id, req["package"])
 
+    def _recover_orphan_claims(self) -> None:
+        """Un-claim ``.claimed.<pid>`` files whose daemon died between claim
+        and accept (crash window), so the request is not orphaned forever."""
+        for fn in os.listdir(self.dispatch_dir):
+            base, _, pid = fn.rpartition(".claimed.")
+            if not base or not pid.isdigit():
+                continue
+            try:
+                os.kill(int(pid), 0)
+                continue  # claimer is alive (possibly mid-accept)
+            except (ProcessLookupError, PermissionError, ValueError):
+                pass
+            try:
+                os.replace(os.path.join(self.dispatch_dir, fn),
+                           os.path.join(self.dispatch_dir, base))
+                logger.warning("recovered orphaned dispatch claim %s", fn)
+            except OSError:
+                pass
+
     def _scan_dispatch_dir(self) -> None:
         for fn in sorted(os.listdir(self.dispatch_dir)):
             if not fn.endswith(".json"):
                 continue
             path = os.path.join(self.dispatch_dir, fn)
+            # only claim files quiet for a beat: a non-atomic writer (scp,
+            # editor save — the CLI itself writes tmp+rename) must not have
+            # its half-written file claimed and rejected
             try:
-                with open(path) as f:
+                if time.time() - os.stat(path).st_mtime < self.poll_interval:
+                    continue  # still (possibly) being written: next tick
+            except OSError:
+                continue
+            # claim FIRST (atomic rename to a per-pid name): two daemons
+            # sharing a home race on os.replace, and exactly one wins
+            claimed = f"{path}.claimed.{os.getpid()}"
+            try:
+                os.replace(path, claimed)
+            except FileNotFoundError:
+                continue  # another daemon claimed it first
+            try:
+                with open(claimed) as f:
                     req = json.load(f)
-            except (OSError, ValueError):
-                continue  # partially-written file: retry next tick
-            try:
                 self._accept_request(req)
-            finally:
+            except Exception:
+                # mirror the broker on_message handler: a malformed request
+                # (bad JSON, missing run_id/package, unreadable package) must
+                # not take the daemon down
+                logger.exception("rejecting dispatch file %s", fn)
                 try:
-                    os.replace(path, path + ".accepted")
-                except FileNotFoundError:
-                    pass  # another daemon on the same home claimed it first
+                    os.replace(claimed, path + ".rejected")
+                except OSError:
+                    pass
+            else:
+                try:
+                    os.replace(claimed, path + ".accepted")
+                except OSError:
+                    pass
 
     # -- heartbeat / introspection -------------------------------------------
     def _heartbeat(self) -> None:
@@ -164,6 +204,7 @@ class FedMLDaemon:
             signal.signal(signal.SIGTERM, lambda *_: self._stop.set())
         logger.info("daemon up: role=%s account=%s home=%s",
                     self.role, self.account_id, self.home)
+        self._recover_orphan_claims()
         try:
             while not self._stop.is_set():
                 if os.path.exists(self.stop_path):

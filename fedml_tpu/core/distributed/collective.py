@@ -15,9 +15,15 @@ topology over persistent TCP sockets (rank 0 = hub) is the right-sized
 transport: reduce-to-hub + rebroadcast is 2 model transfers per allreduce,
 and no GPU/TPU interconnect is touched.
 
-Rendezvous: rank 0 listens on ``addr``; other ranks connect and identify
-with their rank.  All ops are collective — every rank must call them in the
-same order (the torch.distributed contract).
+Rendezvous: rank 0 listens on ``addr``; other ranks connect and send a
+FIXED-FORMAT join preamble (length-prefixed raw token bytes + rank — no
+pickle) that the hub verifies BEFORE any unpickling happens on that
+connection; post-join frames are pickled, so the token is the admission
+boundary (still bind to loopback or a trusted network: the token rides
+plaintext TCP).  All ops are collective — every rank must call them in
+the same order (the torch.distributed contract).  Collective waits use
+``op_timeout`` (large but finite) so a dead peer fails the group instead
+of hanging it forever.
 """
 
 from __future__ import annotations
@@ -58,6 +64,29 @@ def _recv_frame(sock: socket.socket) -> Any:
     return pickle.loads(_recv_exact(sock, n))
 
 
+def _join_preamble(token: Optional[str], rank: int) -> bytes:
+    """Fixed-format join: [u16 token_len][token utf-8][i32 rank] — parseable
+    and verifiable WITHOUT pickle, so an unauthenticated peer never reaches
+    ``pickle.loads``."""
+    tok = (token or "").encode("utf-8")
+    if len(tok) > 256:
+        raise ValueError("pg token too long (max 256 utf-8 bytes)")
+    return struct.pack(">H", len(tok)) + tok + struct.pack(">i", rank)
+
+
+def _recv_join(sock: socket.socket, token: Optional[str]) -> int:
+    """Read + verify a join preamble; raises on token mismatch.  Returns the
+    peer's rank.  No pickle is involved."""
+    (tok_len,) = struct.unpack(">H", _recv_exact(sock, 2))
+    if tok_len > 256:
+        raise ValueError("oversized join token")
+    tok = _recv_exact(sock, tok_len).decode("utf-8", errors="replace")
+    if tok != (token or ""):
+        raise ValueError("bad join token")
+    (rank,) = struct.unpack(">i", _recv_exact(sock, 4))
+    return rank
+
+
 def _to_host(tree: Pytree) -> Pytree:
     """Device arrays -> numpy before pickling (sockets move host memory)."""
     return jax.tree_util.tree_map(np.asarray, tree)
@@ -72,11 +101,18 @@ class ProcessGroup:
     """
 
     def __init__(self, rank: int, world_size: int, addr=("127.0.0.1", 29500),
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, token: Optional[str] = None,
+                 op_timeout: float = 1800.0):
         self.rank = int(rank)
         self.world_size = int(world_size)
         self.addr = (addr[0], int(addr[1]))
         self.timeout = float(timeout)
+        self.token = token
+        # collective waits: far longer than the rendezvous window (a master
+        # legitimately blocks between syncs doing WAN round trips), but
+        # finite so a dead peer raises socket.timeout instead of hanging
+        # every other rank forever
+        self.op_timeout = float(op_timeout)
         self._peers: List[Optional[socket.socket]] = [None] * world_size
         self._server: Optional[socket.socket] = None
         if world_size > 1:
@@ -91,11 +127,28 @@ class ProcessGroup:
             srv.listen(self.world_size)
             srv.settimeout(self.timeout)
             self._server = srv
-            for _ in range(self.world_size - 1):
+            deadline = time.time() + self.timeout
+            joined = 0
+            while joined < self.world_size - 1:
+                if time.time() > deadline:
+                    raise ConnectionError(
+                        f"hub: rendezvous timed out with {joined} of "
+                        f"{self.world_size - 1} peers joined")
                 conn, _ = srv.accept()
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                peer_rank = _recv_frame(conn)
-                self._peers[int(peer_rank)] = conn
+                conn.settimeout(self.timeout)
+                try:
+                    peer_rank = _recv_join(conn, self.token)
+                    if (not 0 < peer_rank < self.world_size
+                            or self._peers[peer_rank] is not None):
+                        raise ValueError(f"bad join from rank {peer_rank}")
+                except Exception:
+                    logger.warning("pg hub: rejected a join attempt", exc_info=True)
+                    conn.close()
+                    continue
+                conn.settimeout(self.op_timeout)
+                self._peers[peer_rank] = conn
+                joined += 1
             logger.info("pg hub up: %d peers joined", self.world_size - 1)
         else:
             deadline = time.time() + self.timeout
@@ -104,11 +157,8 @@ class ProcessGroup:
                 try:
                     s = socket.create_connection(self.addr, timeout=self.timeout)
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    _send_frame(s, self.rank)
-                    # the connect timeout must NOT govern collective waits: a
-                    # slave legitimately blocks far longer than the rendezvous
-                    # window (master doing WAN round trips between syncs)
-                    s.settimeout(None)
+                    s.sendall(_join_preamble(self.token, self.rank))
+                    s.settimeout(self.op_timeout)
                     self._peers[0] = s
                     return
                 except OSError as e:  # hub not up yet: retry

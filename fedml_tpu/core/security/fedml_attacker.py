@@ -81,7 +81,12 @@ class FedMLAttacker:
 
     def get_byzantine_idxs(self, num_clients: int) -> List[int]:
         k = int(getattr(self.args, "byzantine_client_num", 1))
-        rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)))
+        # salt the stream: round-0 client sampling draws choice(N, m) from
+        # np.random.seed(round_idx) and the default random_seed is also 0 —
+        # an unsalted draw here would make the byzantine set exactly the
+        # round-0 cohort, silently turning "k of N malicious" experiments
+        # into "all of round 0 malicious"
+        rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)) + 7919)
         return sorted(rng.choice(num_clients, size=min(k, num_clients), replace=False).tolist())
 
     def set_round_clients(self, client_ids) -> None:

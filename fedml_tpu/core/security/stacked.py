@@ -1,0 +1,308 @@
+"""Stacked (client-axis) attack & defense math for the compiled round.
+
+The sp backend's security hooks walk Python lists of ``(n_i, pytree)`` —
+fine for a host round loop, wrong for the XLA simulator, whose round
+RETURNS the per-client update stack as ONE sharded array per leaf
+(``fed_sim.py``: out_specs ``P('client')``).  This module restates every
+attack/defense as a jax-pure function over that stacked representation:
+
+* ``stack_to_mat``: the stacked update pytree -> one ``[n, D]`` fp32
+  matrix (same coordinate order as ``jax.flatten_util.ravel_pytree`` of a
+  single tree, so the defense math in :mod:`defense_funcs` transfers 1:1);
+* ``build_stacked_attack``: model-side attacks (byzantine, model
+  replacement, ALIE, edge-case projection — reference
+  ``core/security/attack/*.py``) as ``[n, D]`` row edits gated by a
+  malicious-slot mask;
+* ``build_stacked_defense``: all robust-aggregation rules (reference
+  ``core/security/defense/*.py``) as one function
+  ``(stack, w, global, key, state) -> (aggregate, state)``.
+
+Everything here is built once per simulator and traced into ONE jitted
+program (``fed_sim._build_security_fn``) that consumes the round's sharded
+outputs directly — no host materialization of the update stack, which also
+keeps the path correct under multi-host ``jax.distributed`` (host-side
+slicing of non-addressable ``P('client')`` leaves would fail pod-scale).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from . import defense_funcs as F
+from .constants import (
+    ATTACK_METHOD_BACKDOOR,
+    ATTACK_METHOD_BYZANTINE_ATTACK,
+    ATTACK_METHOD_EDGE_CASE_BACKDOOR,
+    ATTACK_METHOD_MODEL_REPLACEMENT,
+    DEFENSE_BULYAN,
+    DEFENSE_CCLIP,
+    DEFENSE_COORDINATE_WISE_MEDIAN,
+    DEFENSE_COORDINATE_WISE_TRIMMED_MEAN,
+    DEFENSE_FOOLSGOLD,
+    DEFENSE_GEO_MEDIAN,
+    DEFENSE_KRUM,
+    DEFENSE_MULTI_KRUM,
+    DEFENSE_NORM_DIFF_CLIPPING,
+    DEFENSE_RFA,
+    DEFENSE_ROBUST_LEARNING_RATE,
+    DEFENSE_SLSGD,
+    DEFENSE_SOTERIA,
+    DEFENSE_THREE_SIGMA,
+    DEFENSE_WBC,
+    DEFENSE_WEAK_DP,
+)
+
+Pytree = Any
+State = Dict[str, jnp.ndarray]
+
+
+def stack_to_mat(stack: Pytree) -> jnp.ndarray:
+    """Stacked pytree (leaves ``[n, ...]``) -> ``[n, D]`` fp32 matrix in
+    ``ravel_pytree`` coordinate order (both use ``tree_flatten`` order)."""
+    leaves = jax.tree_util.tree_leaves(stack)
+    n = leaves[0].shape[0]
+    return jnp.concatenate(
+        [l.reshape(n, -1).astype(jnp.float32) for l in leaves], axis=1
+    )
+
+
+def flat_dim(tree: Pytree) -> int:
+    return sum(int(jnp.size(l)) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _wmean(mat: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    return (w @ mat) / jnp.maximum(jnp.sum(w), 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# attacks
+# ---------------------------------------------------------------------------
+def build_stacked_attack(args, attack_type: str) -> Callable:
+    """-> ``attack(mat, w, g_vec, mal, key) -> mat'`` where ``mal`` is the
+    ``[n]`` 0/1 malicious-slot mask (drawn host-side over the population so
+    it matches the data-poisoning targets — ``FedMLAttacker._malicious_slots``
+    semantics)."""
+    mode = str(getattr(args, "attack_mode", "random"))
+    scale = float(getattr(args, "attack_scale", 10.0))
+    num_std = float(getattr(args, "attack_num_std", 1.5))
+    alie_mode = str(getattr(args, "attack_mode", "craft"))
+    eps = float(getattr(args, "attack_norm_bound", 5.0))
+
+    def attack(mat, w, g_vec, mal, key):
+        m = mal[:, None]
+        if attack_type == ATTACK_METHOD_BYZANTINE_ATTACK:
+            if mode == "zero":
+                bad = jnp.zeros_like(mat)
+            elif mode == "random":
+                bad = jax.random.normal(key, mat.shape, mat.dtype)
+            elif mode == "flip":
+                bad = 2.0 * g_vec[None, :] - mat
+            else:
+                raise ValueError(f"unknown byzantine mode {mode!r}")
+            return jnp.where(m > 0, bad, mat)
+        if attack_type == ATTACK_METHOD_MODEL_REPLACEMENT:
+            pushed = g_vec[None, :] + scale * (mat - g_vec[None, :])
+            return jnp.where(m > 0, pushed, mat)
+        if attack_type == ATTACK_METHOD_BACKDOOR:
+            # ALIE in-range evasion over the BENIGN rows' (unweighted) statistics
+            den = jnp.maximum(jnp.sum(1.0 - mal), 1.0)
+            mean = jnp.sum(mat * (1.0 - mal)[:, None], 0) / den
+            var = jnp.sum(((mat - mean[None, :]) ** 2) * (1.0 - mal)[:, None], 0) / den
+            std = jnp.sqrt(var)
+            if alie_mode == "clip":
+                bad = jnp.clip(mat, (mean - num_std * std)[None, :],
+                               (mean + num_std * std)[None, :])
+            else:  # craft
+                bad = jnp.broadcast_to((mean - num_std * std)[None, :], mat.shape)
+            return jnp.where(m > 0, bad, mat)
+        if attack_type == ATTACK_METHOD_EDGE_CASE_BACKDOOR:
+            delta = scale * (mat - g_vec[None, :])
+            nrm = jnp.linalg.norm(delta, axis=1, keepdims=True)
+            delta = delta * jnp.minimum(1.0, eps / jnp.maximum(nrm, 1e-12))
+            return jnp.where(m > 0, g_vec[None, :] + delta, mat)
+        raise NotImplementedError(
+            f"attack {attack_type!r} has no stacked (XLA-backend) form"
+        )
+
+    return attack
+
+
+# ---------------------------------------------------------------------------
+# defenses
+# ---------------------------------------------------------------------------
+def init_defense_state(defense_type: Optional[str], n: int, d: int) -> State:
+    """Cross-round defense state as device arrays (replaces the host
+    dispatcher's ``_history`` / ``_wbc_prev`` attributes)."""
+    if defense_type == DEFENSE_FOOLSGOLD:
+        return {"fg_hist": jnp.zeros((n, d), jnp.float32)}
+    if defense_type == DEFENSE_WBC:
+        return {"wbc_prev": jnp.zeros((n, d), jnp.float32),
+                "wbc_has": jnp.zeros((), jnp.float32)}
+    return {}
+
+
+def build_stacked_defense(args, defense_type: str,
+                          probe_mask: Optional[jnp.ndarray] = None) -> Callable:
+    """-> ``defend(stack, w, global_vars, key, state) -> (agg_tree, state)``.
+
+    ``stack``: update pytree with a leading ``[n]`` client axis (n real
+    clients, every ``w > 0``); ``agg_tree`` replaces the round's weighted
+    mean (fp32, global-tree structure).  Semantics mirror the list-based
+    hooks in :class:`fedml_defender.FedMLDefender` rule for rule.
+    """
+    a = args
+    byz = int(getattr(a, "byzantine_client_num", 1))
+    t = defense_type
+
+    def matrix_defense(mat, w, g_vec, key, state):
+        """[n, D] robust aggregation -> (agg_vec, state)."""
+        n = mat.shape[0]
+        if t in (DEFENSE_KRUM, DEFENSE_MULTI_KRUM):
+            multi = (t == DEFENSE_MULTI_KRUM) or bool(getattr(a, "multi", False))
+            m = max(int(getattr(a, "krum_param_m", 1)), 1) if multi else 1
+            scores = F.krum_scores(mat, byz)
+            chosen = jnp.argsort(scores)[:m]
+            sel = jnp.zeros((n,), jnp.float32).at[chosen].set(1.0)
+            return _wmean(mat, w * sel), state
+        if t == DEFENSE_NORM_DIFF_CLIPPING:
+            bound = float(getattr(a, "norm_bound", 5.0))
+            diff = mat - g_vec[None, :]
+            nrm = jnp.linalg.norm(diff, axis=1, keepdims=True)
+            clipped = g_vec[None, :] + diff * jnp.minimum(
+                1.0, bound / jnp.maximum(nrm, 1e-12)
+            )
+            return _wmean(clipped, w), state
+        if t == DEFENSE_THREE_SIGMA:
+            arr = jnp.linalg.norm(mat - g_vec[None, :], axis=1)
+            mu, sigma = jnp.mean(arr), jnp.std(arr)
+            keep = (jnp.abs(arr - mu) <= 3.0 * sigma + 1e-12).astype(jnp.float32)
+            w2 = jnp.where(jnp.sum(keep) > 0, w * keep, w)  # all-outlier fallback
+            return _wmean(mat, w2), state
+        if t == DEFENSE_WBC:
+            strength = float(getattr(a, "wbc_strength", 1.0))
+            lr = float(getattr(a, "wbc_lr", 0.1))
+            noise = strength * F._laplace(key, mat.shape)
+            diff = mat - state["wbc_prev"]
+            noise = jnp.where(jnp.abs(diff) > jnp.abs(noise), 0.0, noise)
+            pert = mat + lr * noise * state["wbc_has"]  # first round: no prev
+            new_state = {"wbc_prev": mat, "wbc_has": jnp.ones((), jnp.float32)}
+            return _wmean(pert, w), new_state
+        if t in (DEFENSE_GEO_MEDIAN, DEFENSE_RFA):
+            max_iter = int(getattr(a, "geo_median_max_iter", 10))
+            wn = w / jnp.sum(w)
+
+            def body(_, z):
+                dist = jnp.linalg.norm(mat - z[None, :], axis=1)
+                inv = wn / jnp.maximum(dist, 1e-8)
+                return (inv[:, None] * mat).sum(0) / jnp.sum(inv)
+
+            z = jax.lax.fori_loop(0, max_iter, body, wn @ mat)
+            return z, state
+        if t == DEFENSE_CCLIP:
+            tau = float(getattr(a, "tau", 10.0))
+            n_iter = int(getattr(a, "bucket_iter", 1))
+            wn = w / jnp.sum(w)
+
+            def body(_, v):
+                diff = mat - v[None, :]
+                nrm = jnp.linalg.norm(diff, axis=1, keepdims=True)
+                s = jnp.minimum(1.0, tau / jnp.maximum(nrm, 1e-12))
+                return v + jnp.sum(wn[:, None] * diff * s, 0)
+
+            return jax.lax.fori_loop(0, n_iter, body, g_vec), state
+        if t == DEFENSE_SLSGD:
+            b = max(0, min(int(getattr(a, "trim_param_b", 1)), (n - 1) // 2))
+            alpha = float(getattr(a, "alpha", 0.5))
+            srt = jnp.sort(mat, axis=0)
+            agg = jnp.mean(srt[b : n - b], axis=0)
+            return (1.0 - alpha) * g_vec + alpha * agg, state
+        if t == DEFENSE_FOOLSGOLD:
+            hist = state["fg_hist"] + (mat - g_vec[None, :])
+            wv = F.foolsgold_weights(hist)
+            wv = wv / jnp.maximum(jnp.sum(wv), 1e-12)
+            return wv @ mat, {"fg_hist": hist}
+        if t == DEFENSE_ROBUST_LEARNING_RATE:
+            threshold = int(getattr(a, "robust_threshold", 4))
+            deltas = mat - g_vec[None, :]
+            wn = w / jnp.sum(w)
+            agree = jnp.abs(jnp.sum(jnp.sign(deltas), axis=0))
+            lr = jnp.where(agree >= threshold, 1.0, -1.0)
+            return g_vec + lr * (wn @ deltas), state
+        if t == DEFENSE_COORDINATE_WISE_MEDIAN:
+            return jnp.median(mat, axis=0), state
+        if t == DEFENSE_COORDINATE_WISE_TRIMMED_MEAN:
+            k = int(n * float(getattr(a, "beta", 0.1)))
+            k = max(0, min(k, (n - 1) // 2))
+            srt = jnp.sort(mat, axis=0)
+            return jnp.mean(srt[k : n - k], axis=0), state
+        if t == DEFENSE_BULYAN:
+            theta = max(n - 2 * byz, 1)
+            scores = F.krum_scores(mat, byz)
+            sel = jnp.argsort(scores)[:theta]
+            sel_mat = mat[sel]
+            beta = max(theta - 2 * byz, 1)
+            med = jnp.median(sel_mat, axis=0)
+            order = jnp.argsort(jnp.abs(sel_mat - med[None, :]), axis=0)[:beta]
+            return jnp.mean(jnp.take_along_axis(sel_mat, order, axis=0), 0), state
+        if t == DEFENSE_WEAK_DP:
+            agg = _wmean(mat, w)
+            stddev = float(getattr(a, "stddev", 0.025))
+            return agg + stddev * jax.random.normal(key, agg.shape), state
+        raise NotImplementedError(
+            f"defense {t!r} has no stacked (XLA-backend) form"
+        )
+
+    def defend(stack, w, global_vars, key, state):
+        if t == DEFENSE_SOTERIA:
+            # tree-level: prune low-sensitivity features of the defended
+            # layer's delta per client, then take the weighted mean
+            layer_path = list(getattr(a, "soteria_layer", ("classifier", "kernel")))
+            pct = float(getattr(a, "soteria_percentile", 10.0))
+            pruned = _soteria_stacked(stack, global_vars, layer_path, pct, probe_mask)
+            agg = jax.tree_util.tree_map(
+                lambda s: jnp.tensordot(w, s.astype(jnp.float32), axes=1)
+                / jnp.maximum(jnp.sum(w), 1e-9),
+                pruned,
+            )
+            return agg, state
+        g_vec, unravel = ravel_pytree(
+            jax.tree_util.tree_map(lambda v: v.astype(jnp.float32), global_vars)
+        )
+        mat = stack_to_mat(stack)
+        agg_vec, state = matrix_defense(mat, w, g_vec, key, state)
+        return unravel(agg_vec), state
+
+    return defend
+
+
+def _soteria_stacked(stack: Pytree, global_vars: Pytree, layer_path,
+                     pct: float, probe_mask: Optional[jnp.ndarray]) -> Pytree:
+    """Stacked :func:`defense_funcs.soteria_apply`: leaves carry a leading
+    client axis; the per-feature mask comes from the registered probe when
+    available, else from each client's per-feature delta magnitude."""
+    node, gnode = stack["params"], global_vars["params"]
+    for kpath in layer_path:
+        node, gnode = node[kpath], gnode[kpath]
+    n = node.shape[0]
+    if probe_mask is not None:
+        mask = jnp.broadcast_to(probe_mask[None, :], (n, probe_mask.shape[0]))
+    else:
+        delta = node.astype(jnp.float32) - gnode[None].astype(jnp.float32)
+        mag = jnp.sqrt(jnp.sum(delta.reshape(n, -1, delta.shape[-1]) ** 2, axis=1))
+        mask = jax.vmap(lambda s: F.soteria_mask(s, pct))(mag)
+
+    def walk(tree, gtree, path):
+        if not path:
+            m = mask.reshape((n,) + (1,) * (tree.ndim - 2) + (-1,))
+            return gtree[None] + (tree - gtree[None]) * m
+        out = dict(tree)
+        out[path[0]] = walk(tree[path[0]], gtree[path[0]], path[1:])
+        return out
+
+    out = dict(stack)
+    out["params"] = walk(stack["params"], global_vars["params"], list(layer_path))
+    return out

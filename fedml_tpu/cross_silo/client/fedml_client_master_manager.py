@@ -58,7 +58,7 @@ class ClientMasterManager(FedMLCommManager):
         client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         self.round_idx = 0
         self._last_global = global_model_params  # delta base for compression
-        self.trainer_dist_adapter.update_dataset(client_index)
+        self._update_client_index(client_index)
         self.trainer_dist_adapter.set_model_params(global_model_params)
         self.__train()
 
@@ -67,9 +67,17 @@ class ClientMasterManager(FedMLCommManager):
         client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx + 1))
         self._last_global = global_model_params
-        self.trainer_dist_adapter.update_dataset(client_index)
+        self._update_client_index(client_index)
         self.trainer_dist_adapter.set_model_params(global_model_params)
         self.__train()
+
+    def _update_client_index(self, client_index: int) -> None:
+        """EF-top-k residuals are per-client state: when the server reassigns
+        this process to a different simulated client, the previous client's
+        dropped-mass residual must not leak into the new client's delta."""
+        if int(client_index) != self.trainer_dist_adapter.client_index:
+            self._compress_residuals = None
+        self.trainer_dist_adapter.update_dataset(client_index)
 
     def handle_message_finish(self, msg: Message) -> None:
         logger.info("client rank %d: FINISH", self.rank)

@@ -75,7 +75,12 @@ class TrainerDistAdapter:
 
             addr = (str(getattr(args, "pg_master_address", "127.0.0.1")),
                     int(getattr(args, "pg_master_port", 29500)))
-            self.pg = ProcessGroup(self.proc_rank, self.n_proc, addr=addr)
+            # per-run shared secret: the hub rejects joins without it (frames
+            # are pickled, so only authenticated peers may reach the port)
+            token = str(getattr(args, "pg_token", None)
+                        or f"{getattr(args, 'run_id', '0')}-pg")
+            self.pg = ProcessGroup(self.proc_rank, self.n_proc, addr=addr,
+                                   token=token)
             logger.info("silo rank %d: host pg up (proc %d/%d @ %s:%d)",
                         client_rank, self.proc_rank, self.n_proc, *addr)
 

@@ -121,12 +121,19 @@ def build_packed_device_fn(
     pregather: bool = False,
     stream: str = "while",
     post_train=None,
+    capture_updates: bool = False,
 ):
     """The per-device round body (composed under shard_map by the simulator).
 
     Returns ``fn(variables, server_state, x_all, y_all, idx, mask, boundary,
     weight, slot, n_steps, rng, cex) -> (acc, wsum, lsum, cnt, ext, outs)``
     where cex has leading axis slots_per_device and outs matches it.
+
+    ``capture_updates``: also record each slot's final (post-``post_train``)
+    variables into the per-slot output buffer — ``outs`` becomes
+    ``{"algo": <algo outs>, "update": <variables tree, leading slot axis>}``.
+    The security layer (stacked attacks / robust aggregation) consumes this
+    stack instead of the in-stream weighted sum.
     """
     tx = make_optimizer(args)
     grad_hook = resolve_grad_hook(args, algo.grad_hook())
@@ -168,6 +175,8 @@ def build_packed_device_fn(
         )
         ext0 = algo.zero_contrib(variables)
         out_t = algo.out_template(variables)
+        if capture_updates:
+            out_t = {"algo": out_t, "update": variables}
         outs0 = jax.tree_util.tree_map(
             lambda t: jnp.zeros((slots_per_device,) + t.shape, jnp.float32), out_t
         )
@@ -246,6 +255,8 @@ def build_packed_device_fn(
                     algo.client_contrib(variables, result, w, real, cex_i, server_state),
                 )
                 out_i = algo.client_out(variables, result, real, cex_i, server_state)
+                if capture_updates:
+                    out_i = {"algo": out_i, "update": out_vars}
                 outs = jax.tree_util.tree_map(
                     lambda buf, o: jax.lax.dynamic_update_index_in_dim(
                         buf, o.astype(jnp.float32), s, axis=0

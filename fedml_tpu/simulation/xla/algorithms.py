@@ -47,6 +47,12 @@ class InMeshAlgorithm:
     """
 
     needs_client_state = False
+    # True when server_update consumes the weighted variables sum ``acc`` —
+    # the hook point where the security layer (stacked attack / robust
+    # aggregation, fed_sim._build_security_fn) substitutes its own aggregate.
+    # Strategies that aggregate through ``ext`` instead (FedNova, async)
+    # bypass that substitution and cannot be attacked/defended in-mesh.
+    aggregates_via_acc = True
 
     def __init__(self, args):
         self.args = args
@@ -155,6 +161,8 @@ class FedNovaInMesh(InMeshAlgorithm):
     w <- w - tau_eff * sum_i p_i d_i with d_i = (w - w_i)/tau_i,
     tau_eff = sum_i p_i tau_i, p_i = n_i / sum n.  tau_i is the engine's
     masked step count (LocalTrainResult.steps)."""
+
+    aggregates_via_acc = False
 
     def zero_contrib(self, variables):
         return {
@@ -335,6 +343,8 @@ class AsyncFedAvgInMesh(InMeshAlgorithm):
     participated, and w <- w + (1/K) sum_i a_i (w_i - w).  Unlike the
     event-driven sp path, clients train from the current model (the
     discounting models staleness; the stale-weights effect is not simulated)."""
+
+    aggregates_via_acc = False
 
     def __init__(self, args):
         super().__init__(args)

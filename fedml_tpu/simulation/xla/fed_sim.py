@@ -88,33 +88,31 @@ class XLASimulator:
         self.clients_per_round = int(args.client_num_per_round)
         self.batch_size = int(getattr(args, "batch_size", 32))
 
-        # The in-mesh fast path aggregates on device and never materializes
-        # per-client updates on the host, so host-side attack/defense hooks and
-        # local DP cannot run here yet — fail loudly instead of silently
-        # reporting clean-FedAvg results for a robustness experiment.
+        # Security layer: both rounds can return the per-client update stack
+        # (sharded over the client axis); a second jitted program then runs
+        # stacked model attacks + robust aggregation + the algorithm's server
+        # step on it (core/security/stacked.py) — updates never touch the
+        # host, which also keeps the path multi-host safe (P('client') leaves
+        # are not fully addressable under jax.distributed).  Data-poisoning
+        # attacks stamp at pack time, where each client's shard is assembled.
         attacker = FedMLAttacker.get_instance()
         defender = FedMLDefender.get_instance()
         dp = FedMLDifferentialPrivacy.get_instance()
-        if attacker.is_attack_enabled():
-            raise NotImplementedError(
-                "attack simulation needs per-client data/update hooks on the "
-                "host; use backend 'sp' for attack experiments (defenses and "
-                "both DP modes ARE supported on the XLA backend)"
-            )
         self.defended = defender.is_defense_enabled()
-        if self.defended:
-            # robust aggregation: clients still train in the compiled round,
-            # which returns the per-client update stack; the defender's jnp
-            # math then replaces the weighted mean.  Padded FedAvg only —
-            # the packed stream accumulates in-stream, and non-FedAvg server
-            # algorithms consume weighted sums the defenses don't produce.
-            opt = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
-            if bool(getattr(args, "xla_pack", False)) or opt != "fedavg":
-                raise NotImplementedError(
-                    "in-mesh defense requires the padded round and FedAvg "
-                    f"(got xla_pack={getattr(args, 'xla_pack', False)}, "
-                    f"federated_optimizer={opt!r}); use backend 'sp' otherwise"
-                )
+        self.model_attacked = attacker.is_model_attack()
+        self.dlg_attacked = (attacker.is_attack_enabled()
+                             and str(attacker.attack_type) == "dlg")
+        if (attacker.is_attack_enabled() and not self.model_attacked
+                and not self.dlg_attacked
+                and not attacker.is_data_poisoning_attack()):
+            # fail loud rather than report clean-FedAvg metrics as an
+            # attack-experiment result (e.g. the analysis-primitive attack
+            # types invert_gradient / revealing_labels)
+            raise NotImplementedError(
+                f"attack_type {attacker.attack_type!r} has no XLA-backend "
+                "hook; use backend 'sp'"
+            )
+        self.needs_stack = self.defended or self.model_attacked or self.dlg_attacked
         # every engine loss family runs in-mesh: the loss key is plumbed
         # into the compiled round and eval goes through the task-aware
         # aggregator.  Tag prediction's int->multi-hot conversion happens
@@ -129,6 +127,12 @@ class XLASimulator:
         sample = jnp.asarray(self.train_global[0][:1])
         self.variables = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
         self.algo = create_inmesh_algorithm(args)
+        if (self.defended or self.model_attacked) and not self.algo.aggregates_via_acc:
+            raise NotImplementedError(
+                "in-mesh attack/defense substitutes the weighted variables "
+                f"aggregate, but {type(self.algo).__name__} aggregates through "
+                "its ext contributions (FedNova/async); use backend 'sp'"
+            )
         self.server_state = self.algo.init_server_state(self.variables)
         self.client_state = self.algo.init_client_state(self.num_clients, self.variables)
         self.packed = bool(getattr(args, "xla_pack", False))
@@ -136,6 +140,8 @@ class XLASimulator:
             self._build_packed_round_fn()
         else:
             self._build_round_fn()
+        if self.needs_stack:
+            self._build_security_fn()
 
         self.runtime_estimator = RuntimeEstimator(self.n_dev, uniform_devices=True)
         self.scheduler = SeqTrainScheduler(self.n_dev, estimator=self.runtime_estimator)
@@ -162,8 +168,17 @@ class XLASimulator:
         xs, ys = [], []
         idx = np.zeros((self.num_clients, self.padded_n), np.int32)
         cursor = 0
+        attacker = FedMLAttacker.get_instance()
+        poisoning = attacker.is_data_poisoning_attack()
         for i in range(self.num_clients):
             xi, yi = self.local_train_dict[i]
+            if poisoning:
+                # data side of the attack matrix stamps HERE, where each
+                # malicious client's shard is assembled (the XLA round then
+                # trains on poisoned HBM rows with zero extra hooks) —
+                # reference fedml_attacker.poison_data called per client
+                xi, yi = attacker.poison_local_data(i, self.num_clients, xi, yi)
+                xi, yi = np.asarray(xi), np.asarray(yi)
             if self._multihot_labels and np.asarray(yi).ndim == 1:
                 # tag prediction with int class ids: one-hot for the bce
                 # loss (mounted multi-label sets already arrive multi-hot)
@@ -219,7 +234,7 @@ class XLASimulator:
     def _build_round_fn(self):
         mesh = self.mesh
         algo = self.algo
-        defended = self.defended
+        stacked = self.needs_stack
         post_train = self._ldp_hook()
         local_train = build_local_train(
             self.module, self.args, self.batch_size, self.padded_n,
@@ -254,9 +269,10 @@ class XLASimulator:
                 )
                 contrib = algo.client_contrib(variables, result, w, real, cex, server_state)
                 out = algo.client_out(variables, result, real, cex, server_state)
-                if defended:
-                    # ship the unweighted update stack out for the defender
-                    out = {"algo": out, "weight": w,
+                if stacked:
+                    # per-client update stack for the security program (the
+                    # weights are the host-known sample counts)
+                    out = {"algo": out,
                            "update": jax.tree_util.tree_map(
                                lambda p: p.astype(jnp.float32), result.variables)}
                 return wv, w, result.loss * w, contrib, out
@@ -284,22 +300,29 @@ class XLASimulator:
                 lambda o: o.reshape((per_dev,) + o.shape[2:]), outs
             )
             # the "fedml_nccl_reduce": one psum over ICI
-            acc = jax.lax.psum(acc, "client")
             wsum = jax.lax.psum(wsum, "client")
             lsum = jax.lax.psum(lsum, "client")
             ext = jax.lax.psum(ext, "client")
+            mean_loss = lsum / jnp.maximum(wsum, 1e-9)
+            if stacked:
+                # aggregation + server step move to the security program,
+                # which consumes the sharded update stack (XLA drops the
+                # unused acc accumulator — no wasted model-size psum)
+                return mean_loss, outs, ext
+            acc = jax.lax.psum(acc, "client")
             # algorithm server step, replicated — still inside the XLA program
             new_global, new_state = algo.server_update(
                 acc, wsum, ext, variables, server_state
             )
-            return new_global, new_state, lsum / jnp.maximum(wsum, 1e-9), outs
+            return new_global, new_state, mean_loss, outs
 
+        out_specs = (P(), P("client"), P()) if stacked else (P(), P(), P(), P("client"))
         self._round_fn = jax.jit(
             shard_map(
                 per_device,
                 mesh=mesh,
                 in_specs=(P(), P(), P(), P(), P("client"), P("client"), P("client"), P("client")),
-                out_specs=(P(), P(), P(), P("client")),
+                out_specs=out_specs,
                 check_vma=False,
             )
         )
@@ -318,12 +341,14 @@ class XLASimulator:
             self.max_client_n, self.slots, self.batch_size,
             int(getattr(self.args, "epochs", 1)),
         )
+        stacked = self.needs_stack
         device_fn = build_packed_device_fn(
             self.module, self.args, algo, self.batch_size, self.slots,
             loss=self.loss_kind,
             pregather=bool(getattr(self.args, "xla_pregather", False)),
             stream=str(getattr(self.args, "xla_stream", "while")),
             post_train=self._ldp_hook(),
+            capture_updates=stacked,
         )
 
         def per_device(variables, server_state, x_all, y_all, idx, mask, boundary,
@@ -333,26 +358,116 @@ class XLASimulator:
                 variables, server_state, x_all, y_all, idx[0], mask[0],
                 boundary[0], weight[0], slot[0], n_steps[0], rngs[0], cex,
             )
-            acc = jax.lax.psum(acc, "client")
-            wsum = jax.lax.psum(wsum, "client")
             lsum = jax.lax.psum(lsum, "client")
             cnt = jax.lax.psum(cnt, "client")
             ext = jax.lax.psum(ext, "client")
+            mean_loss = lsum / jnp.maximum(cnt, 1.0)
+            if stacked:
+                return mean_loss, outs, ext
+            acc = jax.lax.psum(acc, "client")
+            wsum = jax.lax.psum(wsum, "client")
             new_global, new_state = algo.server_update(
                 acc, wsum, ext, variables, server_state
             )
-            return new_global, new_state, lsum / jnp.maximum(cnt, 1.0), outs
+            return new_global, new_state, mean_loss, outs
 
+        out_specs = (P(), P("client"), P()) if stacked else (P(), P(), P(), P("client"))
         self._round_fn = jax.jit(
             shard_map(
                 per_device,
                 mesh=mesh,
                 in_specs=(P(), P(), P(), P(), P("client"), P("client"), P("client"),
                           P("client"), P("client"), P("client"), P("client"), P("client")),
-                out_specs=(P(), P(), P(), P("client")),
+                out_specs=out_specs,
                 check_vma=False,
             )
         )
+
+    def _build_security_fn(self):
+        """ONE jitted program for the round's security tail: stacked model
+        attacks -> robust aggregation -> the algorithm's server step, consuming
+        the round's sharded per-client update stack directly (no host
+        materialization; multi-host safe under jax.distributed because jit
+        handles the non-addressable P('client') leaves with global semantics).
+        Mirrors ServerAggregator.on_before_aggregation/aggregate/
+        defend_after_aggregation (reference fedml_attacker.py:28-30 +
+        fedml_defender.py hook order)."""
+        from jax.flatten_util import ravel_pytree
+
+        from ...core.security import defense_funcs as DF
+        from ...core.security.stacked import (
+            build_stacked_attack,
+            build_stacked_defense,
+            stack_to_mat,
+        )
+
+        algo = self.algo
+        attacker = FedMLAttacker.get_instance()
+        defender = FedMLDefender.get_instance()
+        attack_fn = (build_stacked_attack(self.args, attacker.attack_type)
+                     if self.model_attacked else None)
+        defend_fn = None
+        if self.defended:
+            probe_mask = None
+            probe = getattr(defender, "_soteria_probe", None)
+            if probe is not None:
+                feature_fn, xs = probe
+                probe_mask = DF.soteria_mask(
+                    DF.soteria_scores(feature_fn, xs),
+                    float(getattr(self.args, "soteria_percentile", 10.0)),
+                )
+            defend_fn = build_stacked_defense(
+                self.args, defender.defense_type, probe_mask=probe_mask
+            )
+        self._defense_type = defender.defense_type if self.defended else None
+        self._defense_state = None
+        self._defense_n = -1
+
+        def security_round(stack, weights, real_idx, mal_mask, prev_global,
+                           server_state, ext, key, dstate):
+            sub = jax.tree_util.tree_map(lambda t: t[real_idx], stack)
+            w = weights
+            ka, kd = jax.random.split(key)
+            g32 = jax.tree_util.tree_map(
+                lambda v: v.astype(jnp.float32), prev_global
+            )
+            if attack_fn is not None:
+                g_vec, unravel = ravel_pytree(g32)
+                mat = attack_fn(stack_to_mat(sub), w, g_vec, mal_mask, ka)
+                sub = jax.vmap(unravel)(mat)
+            if defend_fn is not None:
+                agg, dstate = defend_fn(sub, w, g32, kd, dstate)
+            else:
+                agg = jax.tree_util.tree_map(
+                    lambda s: jnp.tensordot(w, s.astype(jnp.float32), axes=1)
+                    / jnp.maximum(jnp.sum(w), 1e-9),
+                    sub,
+                )
+            # hand the robust aggregate to the algorithm's server step as a
+            # weighted sum (every aggregates_via_acc strategy divides by wsum)
+            wsum = jnp.sum(w)
+            acc = jax.tree_util.tree_map(lambda t: t * wsum, agg)
+            new_global, new_server_state = algo.server_update(
+                acc, wsum, ext, prev_global, server_state
+            )
+            return new_global, new_server_state, dstate
+
+        self._security_fn = jax.jit(security_round)
+
+    def _ensure_defense_state(self, n_real: int):
+        if not self.defended:
+            return {}
+        if self._defense_state is None or self._defense_n != n_real:
+            from ...core.security.stacked import flat_dim, init_defense_state
+
+            # cross-round per-slot state (foolsgold history, wbc prev) is
+            # positional; a changed participant count resets it, matching the
+            # host dispatcher's shape-mismatch reset
+            self._defense_state = init_defense_state(
+                self._defense_type, n_real, flat_dim(self.variables)
+            )
+            self._defense_n = n_real
+        return self._defense_state
 
     def _packed_inputs(self, ids: np.ndarray, counts: np.ndarray, round_idx: int):
         from ...ml.engine.packed import pack_round
@@ -461,41 +576,64 @@ class XLASimulator:
                 dev_rngs = jax.random.split(
                     jax.random.fold_in(sub, round_idx), self.n_dev
                 )
-                self.variables, self.server_state, mean_loss, outs = self._round_fn(
-                    self.variables, self.server_state, self.x_all, self.y_all,
-                    *packed, dev_rngs, cex,
-                )
+                round_inputs = (self.variables, self.server_state, self.x_all,
+                                self.y_all, *packed, dev_rngs, cex)
             else:
                 rngs = jax.random.split(jax.random.fold_in(sub, round_idx), len(ids))
                 idx_rows = self.client_idx[jnp.asarray(ids)]
-                self.variables, self.server_state, mean_loss, outs = self._round_fn(
-                    self.variables,
-                    self.server_state,
-                    self.x_all,
-                    self.y_all,
-                    idx_rows,
-                    jnp.asarray(counts),
-                    rngs,
-                    cex,
-                )
-            if self.defended:
-                # replace the round's weighted mean with the defender's
-                # robust aggregate over the per-client update stack (the
-                # defense math itself is jnp and runs on device arrays).
-                # defend_after runs here; the loop's cdp block below still
-                # applies central noise exactly once.
-                upd, ws = outs["update"], np.asarray(outs["weight"])
-                updates = [
-                    (float(ws[i]), jax.tree_util.tree_map(lambda t, i=i: t[i], upd))
-                    for i in range(len(ws)) if ws[i] > 0
-                ]
-                self.aggregator.set_model_params(prev_global)  # defense reference
-                updates = self.aggregator.on_before_aggregation(updates)
-                self.variables = self.aggregator.aggregate(updates)
-                self.variables = FedMLDefender.get_instance().defend_after_aggregation(
-                    self.variables
-                )
+                round_inputs = (self.variables, self.server_state, self.x_all,
+                                self.y_all, idx_rows, jnp.asarray(counts), rngs, cex)
+            if self.needs_stack:
+                # security path: the round returns the sharded per-client
+                # update stack; the second jitted program runs stacked model
+                # attacks + robust aggregation + the server step on device
+                mean_loss, outs, ext = self._round_fn(*round_inputs)
+                stack = outs["update"]
                 outs = outs["algo"]
+                real_sel = np.where(counts > 0)[0]
+                if real_sel.size > 0:
+                    attacker = FedMLAttacker.get_instance()
+                    mal = np.zeros(real_sel.size, np.float32)
+                    if self.model_attacked:
+                        bad = set(attacker.get_byzantine_idxs(self.num_clients))
+                        mal = np.array(
+                            [1.0 if int(ids[i]) in bad else 0.0 for i in real_sel],
+                            np.float32,
+                        )
+                    dstate = self._ensure_defense_state(int(real_sel.size))
+                    self._rng, skey = jax.random.split(self._rng)
+                    self.variables, self.server_state, self._defense_state = (
+                        self._security_fn(
+                            stack,
+                            jnp.asarray(counts[real_sel], jnp.float32),
+                            jnp.asarray(real_sel),
+                            jnp.asarray(mal),
+                            self.variables,
+                            self.server_state,
+                            ext,
+                            skey,
+                            dstate,
+                        )
+                    )
+                    if self.dlg_attacked:
+                        # privacy attack: reconstruct a batch from ONE
+                        # intercepted update (a single model-size host pull)
+                        bad = set(attacker.get_byzantine_idxs(self.num_clients))
+                        victims = [int(i) for i in real_sel
+                                   if int(ids[i]) in bad] or [int(real_sel[0])]
+                        row = jax.tree_util.tree_map(
+                            lambda t: t[victims[0]], stack
+                        )
+                        attacker.reconstruct_data(
+                            self.module, prev_global, row,
+                            (int(getattr(self.args, "dlg_batch_size", 1)),)
+                            + tuple(self.x_all.shape[1:]),
+                            self.class_num,
+                        )
+            else:
+                self.variables, self.server_state, mean_loss, outs = self._round_fn(
+                    *round_inputs
+                )
             self.client_state = self.algo.apply_client_outs(self.client_state, ids, outs)
             self.algo.host_round_end(ids, participated, round_idx)
             # host-side hooks (attack/defense need per-client updates and run

@@ -1,0 +1,179 @@
+"""Stacked (XLA-backend) security math == the host list-based hooks.
+
+core/security/stacked.py restates every attack/defense over the compiled
+round's ``[n, D]`` update stack; these tests pin each rule to the host
+dispatcher path (attack_model / defend_before+aggregate / defend_on /
+defend_after) on the same inputs.  Fast suite: tiny trees, CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.aggregate import weighted_mean
+from fedml_tpu.core.security import stacked as S
+from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+
+class _Args:
+    def __init__(self, **kw):
+        self.random_seed = 0
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def _tree(vec):
+    """Deterministic 10-dim test tree: params.w [2,3] + params.b [3] + extra [1]."""
+    v = np.asarray(vec, np.float32)
+    return {
+        "params": {"w": jnp.asarray(v[:6].reshape(2, 3)), "b": jnp.asarray(v[6:9])},
+        "stats": {"m": jnp.asarray(v[9:10])},
+    }
+
+
+def _make_updates(n=6, seed=0, outlier=None):
+    rng = np.random.RandomState(seed)
+    ups = []
+    for i in range(n):
+        vec = rng.normal(1.0, 0.05, 10)
+        if outlier is not None and i in outlier:
+            vec = rng.normal(8.0, 0.5, 10)
+        ups.append((float(1 + i % 3), _tree(vec)))
+    return ups
+
+
+def _stack(updates):
+    trees = [p for _, p in updates]
+    stack = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *trees)
+    w = jnp.asarray([n for n, _ in updates], jnp.float32)
+    return stack, w
+
+
+def _flat(tree):
+    from jax.flatten_util import ravel_pytree
+
+    return np.asarray(ravel_pytree(tree)[0])
+
+
+GLOBAL = _tree(np.ones(10))
+
+
+def _host_defense_agg(defender, updates, global_params):
+    """The ServerAggregator hook order on the host list path."""
+    if defender.is_defense_before_aggregation():
+        updates = defender.defend_before_aggregation(updates, global_params)
+        return weighted_mean(updates)
+    if defender.is_defense_on_aggregation():
+        return defender.defend_on_aggregation(
+            updates,
+            base_aggregation_func=lambda a, u: weighted_mean(u),
+            extra_auxiliary_info=global_params,
+        )
+    return defender.defend_after_aggregation(weighted_mean(updates))
+
+
+DEFENSE_CASES = [
+    ("krum", dict(byzantine_client_num=1)),
+    ("multi_krum", dict(byzantine_client_num=1, krum_param_m=3)),
+    ("norm_diff_clipping", dict(norm_bound=2.0)),
+    ("3sigma", {}),
+    ("geometric_median", dict(geo_median_max_iter=8)),
+    ("rfa", dict(geo_median_max_iter=8)),
+    ("cclip", dict(tau=1.5, bucket_iter=2)),
+    ("slsgd", dict(trim_param_b=1, alpha=0.5)),
+    ("foolsgold", {}),
+    ("robust_learning_rate", dict(robust_threshold=4)),
+    ("coordinate_wise_median", {}),
+    ("coordinate_wise_trimmed_mean", dict(beta=0.2)),
+    ("bulyan", dict(byzantine_client_num=1)),
+    ("weak_dp", dict(stddev=0.0)),  # stddev 0: deterministic comparison
+    ("wbc", dict(wbc_strength=0.0, client_num_in_total=6,
+                 client_num_per_round=6)),  # strength 0: deterministic
+    ("soteria", dict(soteria_layer=("w",), soteria_percentile=34.0)),
+]
+
+
+@pytest.mark.parametrize("defense,extra", DEFENSE_CASES)
+def test_stacked_defense_matches_host(defense, extra):
+    updates = _make_updates(outlier={2})
+    d = FedMLDefender.get_instance()
+    d.init(_Args(enable_defense=True, defense_type=defense, **extra))
+    host = _host_defense_agg(d, updates, GLOBAL)
+
+    stack, w = _stack(updates)
+    fn = S.build_stacked_defense(_Args(**extra), defense)
+    state = S.init_defense_state(defense, int(w.shape[0]), S.flat_dim(GLOBAL))
+    agg, _ = fn(stack, w, GLOBAL, jax.random.PRNGKey(0), state)
+
+    np.testing.assert_allclose(_flat(agg), _flat(host), rtol=2e-4, atol=2e-5)
+
+
+def test_stacked_foolsgold_state_accumulates():
+    updates = _make_updates()
+    stack, w = _stack(updates)
+    fn = S.build_stacked_defense(_Args(), "foolsgold")
+    state = S.init_defense_state("foolsgold", 6, S.flat_dim(GLOBAL))
+    _, s1 = fn(stack, w, GLOBAL, jax.random.PRNGKey(0), state)
+    _, s2 = fn(stack, w, GLOBAL, jax.random.PRNGKey(0), s1)
+    assert float(jnp.abs(s2["fg_hist"]).sum()) > float(jnp.abs(s1["fg_hist"]).sum())
+
+
+def test_stacked_wbc_perturbs_after_first_round():
+    updates = _make_updates()
+    stack, w = _stack(updates)
+    fn = S.build_stacked_defense(_Args(wbc_strength=5.0, wbc_lr=0.5), "wbc")
+    state = S.init_defense_state("wbc", 6, S.flat_dim(GLOBAL))
+    a1, s1 = fn(stack, w, GLOBAL, jax.random.PRNGKey(0), state)
+    assert float(s1["wbc_has"]) == 1.0
+    # round 1 has no prev: aggregate is the plain weighted mean
+    np.testing.assert_allclose(_flat(a1), _flat(weighted_mean(updates)), rtol=1e-5)
+    a2, _ = fn(stack, w, GLOBAL, jax.random.PRNGKey(1), s1)
+    # identical updates two rounds running = maximally persistent space:
+    # noise lands somewhere
+    assert np.abs(_flat(a2) - _flat(a1)).max() > 0
+
+
+ATTACK_CASES = [
+    ("byzantine", dict(attack_mode="zero", byzantine_client_num=2)),
+    ("byzantine", dict(attack_mode="flip", byzantine_client_num=2)),
+    ("model_replacement", dict(attack_scale=5.0, byzantine_client_num=2)),
+    ("backdoor", dict(attack_mode="craft", attack_num_std=1.5, byzantine_client_num=2)),
+    ("backdoor", dict(attack_mode="clip", attack_num_std=1.5, byzantine_client_num=2)),
+    ("edge_case_backdoor", dict(attack_scale=5.0, attack_norm_bound=2.0,
+                                byzantine_client_num=2)),
+]
+
+
+@pytest.mark.parametrize("attack,extra", ATTACK_CASES)
+def test_stacked_attack_matches_host(attack, extra):
+    n = 6
+    updates = _make_updates(n)
+    a = FedMLAttacker.get_instance()
+    a.init(_Args(enable_attack=True, attack_type=attack,
+                 client_num_in_total=n, **extra))
+    idxs = a.get_byzantine_idxs(n)
+    host = a.attack_model(list(updates), GLOBAL)
+    host_mat = np.stack([_flat(p) for _, p in host])
+
+    stack, w = _stack(updates)
+    mat = S.stack_to_mat(stack)
+    g_vec = _flat(GLOBAL)
+    mal = jnp.zeros((n,)).at[jnp.asarray(idxs)].set(1.0)
+    fn = S.build_stacked_attack(_Args(**extra), attack)
+    out = np.asarray(fn(mat, w, jnp.asarray(g_vec), mal, jax.random.PRNGKey(0)))
+
+    np.testing.assert_allclose(out, host_mat, rtol=2e-4, atol=2e-5)
+
+
+def test_stacked_attack_random_mode_corrupts_only_malicious():
+    n = 6
+    updates = _make_updates(n)
+    stack, w = _stack(updates)
+    mat = S.stack_to_mat(stack)
+    mal = jnp.zeros((n,)).at[jnp.asarray([1, 4])].set(1.0)
+    fn = S.build_stacked_attack(_Args(attack_mode="random"), "byzantine")
+    out = np.asarray(fn(mat, w, jnp.asarray(_flat(GLOBAL)), mal, jax.random.PRNGKey(0)))
+    benign = [0, 2, 3, 5]
+    np.testing.assert_allclose(out[benign], np.asarray(mat)[benign])
+    assert np.abs(out[[1, 4]] - np.asarray(mat)[[1, 4]]).max() > 0.5

@@ -181,25 +181,42 @@ class TestInMeshLocalDP:
         assert max(diffs) > 1e-6
 
 
-class TestInMeshDefense:
-    """Robust aggregation on the XLA backend: clients train in the compiled
-    round, which ships the per-client update stack out; the defender's jnp
-    math replaces the weighted mean."""
+def _reset_security():
+    from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+    from fedml_tpu.core.security.fedml_defender import FedMLDefender
 
-    def _run(self, defense=None, **dargs):
-        from fedml_tpu.core.security.fedml_defender import FedMLDefender
+    FedMLAttacker._attacker_instance = None
+    FedMLDefender._defender_instance = None
+    return FedMLAttacker.get_instance(), FedMLDefender.get_instance()
 
-        args, dataset, model = _build(_args(comm_round=2))
-        if defense:
-            args.enable_defense = True
-            args.defense_type = defense
-            for k, v in dargs.items():
-                setattr(args, k, v)
-        FedMLDefender._defender_instance = None
-        FedMLDefender.get_instance().init(args)
+
+def _run_security(attack=None, defense=None, pack=False, comm_round=2, **extra):
+    """One XLA run with the given attack/defense config; returns (sim, metrics)."""
+    args, dataset, model = _build(_args(comm_round=comm_round, xla_pack=pack))
+    for k, v in extra.items():
+        setattr(args, k, v)
+    if attack:
+        args.enable_attack = True
+        args.attack_type = attack
+    if defense:
+        args.enable_defense = True
+        args.defense_type = defense
+    attacker, defender = _reset_security()
+    try:
+        attacker.init(args)
+        defender.init(args)
         sim = XLASimulator(args, dataset, model)
         metrics = sim.train()
-        return sim, metrics
+    finally:
+        _reset_security()  # even on expected raises: singletons are global
+    return sim, metrics
+
+
+class TestInMeshDefense:
+    """Robust aggregation on the XLA backend: the compiled round returns the
+    sharded per-client update stack; a second jitted program substitutes the
+    robust aggregate (core/security/stacked.py) — both execution strategies,
+    every aggregates_via_acc algorithm."""
 
     @pytest.mark.parametrize("defense,extra", [
         ("coordinate_wise_median", {}),
@@ -207,23 +224,103 @@ class TestInMeshDefense:
         ("norm_diff_clipping", {"norm_bound": 5.0}),
     ])
     def test_defended_round_learns(self, defense, extra):
-        sim, metrics = self._run(defense, **extra)
+        sim, metrics = _run_security(defense=defense, **extra)
         assert metrics["test_acc"] > 0.5, (defense, metrics)
 
     def test_defense_changes_aggregate(self):
-        _, clean = self._run(None)
-        _, defended = self._run("coordinate_wise_median")
+        _, clean = _run_security()
+        _, defended = _run_security(defense="coordinate_wise_median")
         # median != weighted mean on heterogeneous clients
         assert clean["test_loss"] != defended["test_loss"]
 
-    def test_packed_defense_fails_loud(self):
-        from fedml_tpu.core.security.fedml_defender import FedMLDefender
+    @pytest.mark.parametrize("defense,extra", [
+        ("krum", {"byzantine_client_num": 1}),
+        ("geometric_median", {}),
+    ])
+    def test_packed_defended_round_learns(self, defense, extra):
+        sim, metrics = _run_security(defense=defense, pack=True, **extra)
+        assert metrics["test_acc"] > 0.5, (defense, metrics)
 
-        args, dataset, model = _build(_args(comm_round=1, xla_pack=True))
-        args.enable_defense = True
-        args.defense_type = "krum"
-        args.byzantine_client_num = 1
-        FedMLDefender._defender_instance = None
-        FedMLDefender.get_instance().init(args)
-        with pytest.raises(NotImplementedError, match="padded round"):
-            XLASimulator(args, dataset, model)
+    @pytest.mark.parametrize("pack", [False, True])
+    def test_defense_composes_with_scaffold(self, pack):
+        _, metrics = _run_security(
+            defense="coordinate_wise_median", pack=pack,
+            federated_optimizer="SCAFFOLD",
+        )
+        assert metrics["test_acc"] > 0.5, metrics
+
+    def test_fednova_defense_fails_loud(self):
+        with pytest.raises(NotImplementedError, match="ext"):
+            _run_security(defense="krum", byzantine_client_num=1,
+                          federated_optimizer="FedNova")
+
+
+class TestInMeshAttack:
+    """The sp security matrix reproduced on the XLA backend: data poisoning
+    stamps at pack time, model attacks run in the stacked security program
+    (reference fedml_attacker.py:28-30 — one simulator runs the whole
+    matrix)."""
+
+    @pytest.mark.parametrize("pack", [False, True])
+    def test_byzantine_degrades_and_krum_recovers(self, pack):
+        _, clean = _run_security(pack=pack, comm_round=3)
+        _, attacked = _run_security(
+            attack="byzantine", pack=pack, comm_round=3,
+            attack_mode="random", byzantine_client_num=8,
+        )
+        _, defended = _run_security(
+            attack="byzantine", defense="krum", pack=pack, comm_round=3,
+            attack_mode="random", byzantine_client_num=8,
+        )
+        # 8/16 random-garbage clients wreck plain FedAvg; krum survives
+        assert attacked["test_acc"] < clean["test_acc"] - 0.1, (clean, attacked)
+        assert defended["test_acc"] > attacked["test_acc"] + 0.1, (attacked, defended)
+
+    def test_label_flip_poisons_pack(self):
+        sim, _ = _run_security(
+            attack="label_flipping", comm_round=1,
+            original_class=1, target_class=7, byzantine_client_num=16,
+        )
+        clean_sim, _ = _run_security(comm_round=1)
+        # every client malicious: no label-1 row survives in the packed data
+        assert not bool((np.asarray(sim.y_all) == 1).any())
+        assert bool((np.asarray(clean_sim.y_all) == 1).any())
+
+    def test_model_replacement_mitigated_by_clipping(self):
+        """The scaled push drags the aggregate away from the clean trajectory;
+        norm clipping pulls it back (parameter-space distances — the LR task
+        is too easy for accuracy to separate the runs)."""
+        def _vec(sim):
+            from jax.flatten_util import ravel_pytree
+
+            return np.asarray(ravel_pytree(sim.variables)[0])
+
+        clean_sim, _ = _run_security(comm_round=2)
+        atk_sim, _ = _run_security(
+            attack="model_replacement", comm_round=2,
+            attack_scale=25.0, byzantine_client_num=4,
+        )
+        def_sim, _ = _run_security(
+            attack="model_replacement", defense="norm_diff_clipping",
+            comm_round=2, attack_scale=25.0, byzantine_client_num=4,
+            norm_bound=0.5,
+        )
+        d_atk = np.linalg.norm(_vec(atk_sim) - _vec(clean_sim))
+        d_def = np.linalg.norm(_vec(def_sim) - _vec(clean_sim))
+        assert d_atk > 2.0 * d_def, (d_atk, d_def)
+
+    def test_dlg_reconstruction_runs_in_round(self):
+        from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+
+        args, dataset, model = _build(_args(comm_round=1))
+        args.enable_attack = True
+        args.attack_type = "dlg"
+        args.dlg_steps = 20
+        attacker, _ = _reset_security()
+        attacker.init(args)
+        sim = XLASimulator(args, dataset, model)
+        sim.train()
+        x_rec, y_soft = attacker.last_reconstruction
+        assert np.all(np.isfinite(np.asarray(x_rec)))
+        assert x_rec.shape[1:] == sim.x_all.shape[1:]
+        _reset_security()
