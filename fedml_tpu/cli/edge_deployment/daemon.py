@@ -122,7 +122,9 @@ class FedMLDaemon:
             try:
                 os.kill(int(pid), 0)
                 continue  # claimer is alive (possibly mid-accept)
-            except (ProcessLookupError, PermissionError, ValueError):
+            except PermissionError:
+                continue  # alive under another user: NOT orphaned
+            except (ProcessLookupError, ValueError):
                 pass
             try:
                 os.replace(os.path.join(self.dispatch_dir, fn),
@@ -140,7 +142,9 @@ class FedMLDaemon:
             # editor save — the CLI itself writes tmp+rename) must not have
             # its half-written file claimed and rejected
             try:
-                if time.time() - os.stat(path).st_mtime < self.poll_interval:
+                # quiet-period check; abs() so a future mtime (writer clock
+                # ahead, NFS skew) claims immediately instead of never
+                if abs(time.time() - os.stat(path).st_mtime) < self.poll_interval:
                     continue  # still (possibly) being written: next tick
             except OSError:
                 continue
@@ -205,11 +209,17 @@ class FedMLDaemon:
         logger.info("daemon up: role=%s account=%s home=%s",
                     self.role, self.account_id, self.home)
         self._recover_orphan_claims()
+        last_recover = time.time()
         try:
             while not self._stop.is_set():
                 if os.path.exists(self.stop_path):
                     break
                 self._scan_dispatch_dir()
+                if time.time() - last_recover > 30.0:
+                    # periodic: a PEER daemon sharing this home may have
+                    # crashed mid-claim since we started
+                    self._recover_orphan_claims()
+                    last_recover = time.time()
                 self._heartbeat()
                 self._stop.wait(self.poll_interval)
         finally:

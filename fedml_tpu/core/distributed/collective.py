@@ -134,7 +134,14 @@ class ProcessGroup:
                     raise ConnectionError(
                         f"hub: rendezvous timed out with {joined} of "
                         f"{self.world_size - 1} peers joined")
-                conn, _ = srv.accept()
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    # surface the descriptive diagnostic, not a raw accept
+                    # traceback, when no peer ever connects
+                    raise ConnectionError(
+                        f"hub: rendezvous timed out with {joined} of "
+                        f"{self.world_size - 1} peers joined") from None
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 conn.settimeout(self.timeout)
                 try:

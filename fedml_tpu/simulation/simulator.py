@@ -30,9 +30,26 @@ class SimulatorSingleProcess:
 
 class SimulatorXLA:
     def __init__(self, args, device, dataset, model):
-        from .xla.fed_sim import XLASimulator
+        opt = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        # split-computation algorithms have their own in-mesh programs
+        # (communication-shaped structure: feature sharding / activation
+        # exchange / knowledge transfer — simulation/xla/split.py)
+        if opt == "classical_vertical":
+            from .xla.split import VFLInMeshAPI
 
-        self.sim = XLASimulator(args, dataset, model)
+            self.sim = VFLInMeshAPI(args, device, dataset, model)
+        elif opt == "split_nn":
+            from .xla.split import SplitNNInMeshAPI
+
+            self.sim = SplitNNInMeshAPI(args, device, dataset, model)
+        elif opt == "fedgkt":
+            from .xla.split import GKTInMeshAPI
+
+            self.sim = GKTInMeshAPI(args, device, dataset, model)
+        else:
+            from .xla.fed_sim import XLASimulator
+
+            self.sim = XLASimulator(args, dataset, model)
 
     def run(self):
         return self.sim.train()

@@ -57,7 +57,11 @@ class FedGKTAPI:
         self.client_net = model if isinstance(model, GKTClientNet) else GKTClientNet(
             num_classes=self.class_num
         )
-        self.server_net = GKTServerNet(num_classes=self.class_num)
+        self.server_net = GKTServerNet(
+            num_classes=self.class_num,
+            width=int(getattr(args, "gkt_server_width", 64)),
+            blocks=int(getattr(args, "gkt_server_blocks", 3)),
+        )
         key = jax.random.PRNGKey(seed)
         sample = jnp.asarray(next(iter(self.local_train.values()))[0][: self.bs])
         # per-client edge params (NEVER aggregated — GKT's defining property)

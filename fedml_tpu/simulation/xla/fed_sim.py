@@ -539,6 +539,14 @@ class XLASimulator:
                 )
             if "algo_host_state" in state:
                 self.algo.restore_host_state(state["algo_host_state"])
+            if self.defended and state.get("defense_state"):
+                # cross-round defense state (foolsgold history, wbc prev):
+                # without it a resumed run silently re-pardons attenuated
+                # sybils / loses the perturbation baseline
+                self._defense_state = {
+                    k: jnp.asarray(v) for k, v in state["defense_state"].items()
+                }
+                self._defense_n = int(state.get("defense_n", -1))
             start_round = step + 1
             logger.info("resumed from checkpoint round %d", step)
         profiling = bool(getattr(self.args, "enable_profiler", False))
@@ -673,6 +681,11 @@ class XLASimulator:
                 host = self.algo.host_state()
                 if host:
                     state["algo_host_state"] = host
+                if self.defended and self._defense_state:
+                    state["defense_state"] = {
+                        k: np.asarray(v) for k, v in self._defense_state.items()
+                    }
+                    state["defense_n"] = self._defense_n
                 ckpt.save(round_idx, state)
             if eval_enabled and (round_idx % freq == 0 or round_idx == comm_round - 1):
                 last = self._test_global(round_idx)

@@ -1,0 +1,543 @@
+"""In-mesh split-computation algorithms: VFL, SplitNN, FedGKT.
+
+The reference runs these three through dedicated MPI programs whose structure
+IS communication (``simulation/mpi/classical_vertical_fl/`` partial-logit
+exchange, ``mpi/split_nn/SplitNN_api.py`` activation/grad relay,
+``mpi/fedgkt/`` feature/logit knowledge transfer, ~2k LoC of rank
+choreography).  Here each one compiles into XLA programs over a device mesh,
+with the algorithm's defining exchange realized as a mesh collective:
+
+* **VFL** (:class:`VFLInMeshAPI`) — the feature axis is sharded over a
+  ``party`` mesh axis; each party's partial logits ``x_k @ w_k`` meet in ONE
+  ``psum`` (the guest's logit sum riding ICI), the guest's ``dL/dz`` is
+  computed replicated, and each party forms its own weight gradient from its
+  local feature shard.  Raw features never cross the party boundary — the
+  only tensor on the interconnect is ``[batch, classes]`` logits, the privacy
+  property of classical VFL made physical.
+* **SplitNN** (:class:`SplitNNInMeshAPI`) — clients are sharded over the
+  mesh; each device runs the client-side front and the server-side back with
+  the cut-layer activation/gradient exchange expressed as ``jax.vjp`` INSIDE
+  the compiled round (the seam a real deployment replaces with transport).
+  The reference's strictly sequential client relay becomes parallel relay
+  chains (one per device, sequential within) whose halves are
+  weight-averaged by a ``psum`` at the round boundary — the split-learning
+  analogue of parallel FedAvg over relay groups.
+* **FedGKT** (:class:`GKTInMeshAPI`) — per-client edge networks live in an
+  HBM-resident stacked parameter table (gather participants / scatter back,
+  never aggregated — GKT's defining property); the client phase (edge
+  training + feature/logit extraction) is shard_mapped over the client axis,
+  and the transfer set arrives at the replicated server tower as sharded
+  arrays, not a message queue.
+
+Dispatched from :class:`fedml_tpu.simulation.simulator.SimulatorXLA` for
+``federated_optimizer`` in {classical_vertical, split_nn, fedgkt} — the
+same config that picks the sp twin picks these on ``backend: XLA``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...utils.metrics import MetricsLogger
+from .fed_sim import shard_map
+
+logger = logging.getLogger(__name__)
+
+
+def _pad_clients(local_train, local_num, num_clients: int, batch_size: int):
+    """Concatenate client shards into HBM arrays + per-client padded index
+    rows (the fed_sim._pack_data layout, standalone)."""
+    counts = np.array([local_num[i] for i in range(num_clients)], np.int32)
+    padded_n = max(batch_size, -(-int(counts.max()) // batch_size) * batch_size)
+    xs, ys = [], []
+    idx = np.zeros((num_clients, padded_n), np.int32)
+    cursor = 0
+    for i in range(num_clients):
+        xi, yi = local_train[i]
+        n = len(yi)
+        xs.append(np.asarray(xi, np.float32))
+        ys.append(np.asarray(yi))
+        if n > 0:
+            idx[i, :n] = np.arange(cursor, cursor + n, dtype=np.int32)
+            idx[i, n:] = cursor
+        cursor += n
+    return (jnp.asarray(np.concatenate(xs, 0)), jnp.asarray(np.concatenate(ys, 0)),
+            jnp.asarray(idx), counts, padded_n)
+
+
+# ---------------------------------------------------------------------------
+# Vertical FL: feature-sharded party mesh
+# ---------------------------------------------------------------------------
+class VFLInMeshAPI:
+    """Classical vertical FL with the feature axis sharded over the mesh.
+
+    ``vfl_party_num`` stays the LOGICAL party count (who owns which feature
+    slice — API parity with the sp twin / reference
+    ``simulation/sp/classical_vertical_fl``); physically every logical slice
+    is sub-sharded over the mesh's ``party`` axis, which only strengthens
+    the isolation: no device ever holds another shard's raw features, and
+    the single cross-shard tensor is the psum'd ``[batch, classes]`` logits.
+    """
+
+    def __init__(self, args, device, dataset, model=None, mesh: Mesh = None):
+        self.args = args
+        (_, _, (x_tr, y_tr), (x_te, y_te), *_rest, self.class_num) = dataset
+        self.mesh = mesh if mesh is not None else Mesh(np.array(jax.devices()), ("party",))
+        n_dev = self.mesh.devices.size
+        x_tr = np.asarray(x_tr, np.float32).reshape(len(y_tr), -1)
+        x_te = np.asarray(x_te, np.float32).reshape(len(y_te), -1)
+        y_tr, y_te = np.asarray(y_tr), np.asarray(y_te)
+        if y_tr.ndim > 1:  # multi-hot (NUS-WIDE style) -> dominant concept
+            y_tr, y_te = y_tr.argmax(-1), y_te.argmax(-1)
+        self.parties = int(getattr(args, "vfl_party_num", 2))
+        # pad the feature axis to the mesh size (zero features are inert:
+        # their weights receive zero gradient forever)
+        f = x_tr.shape[1]
+        f_pad = -(-f // n_dev) * n_dev
+        if f_pad != f:
+            x_tr = np.pad(x_tr, ((0, 0), (0, f_pad - f)))
+            x_te = np.pad(x_te, ((0, 0), (0, f_pad - f)))
+        shard_x = NamedSharding(self.mesh, P(None, "party"))
+        shard_w = NamedSharding(self.mesh, P("party", None))
+        self.x_tr = jax.device_put(jnp.asarray(x_tr), shard_x)
+        self.x_te = jax.device_put(jnp.asarray(x_te), shard_x)
+        self.y_tr = jnp.asarray(y_tr.astype(np.int32))
+        self.y_te = jnp.asarray(y_te.astype(np.int32))
+        key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        self.w = jax.device_put(
+            0.01 * jax.random.normal(key, (f_pad, self.class_num)), shard_w
+        )
+        self.b = jnp.zeros((self.class_num,))
+        lr = float(getattr(args, "learning_rate", 0.1))
+        classes = self.class_num
+        self.metrics = MetricsLogger(args)
+
+        def step(w_l, b, x_l, y):
+            # each party's partial logits meet in one psum (the guest's sum)
+            z = jax.lax.psum(x_l @ w_l, "party") + b
+            logp = jax.nn.log_softmax(z)
+            loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+            # guest computes dL/dz once, replicated — the gradient message of
+            # the reference protocol; each party forms dw from ITS shard only
+            dz = (jnp.exp(logp) - jax.nn.one_hot(y, classes)) / y.shape[0]
+            dw = x_l.T @ dz
+            db = jnp.sum(dz, axis=0)
+            return w_l - lr * dw, b - lr * db, loss
+
+        self._step = jax.jit(shard_map(
+            step, mesh=self.mesh,
+            in_specs=(P("party", None), P(), P(None, "party"), P()),
+            out_specs=(P("party", None), P(), P()),
+            check_vma=False,
+        ))
+
+        def infer(w_l, b, x_l):
+            return jax.lax.psum(x_l @ w_l, "party") + b
+
+        self._infer = jax.jit(shard_map(
+            infer, mesh=self.mesh,
+            in_specs=(P("party", None), P(), P(None, "party")),
+            out_specs=P(),
+            check_vma=False,
+        ))
+
+    def train(self) -> Dict[str, Any]:
+        rounds = int(self.args.comm_round)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        last: Dict[str, Any] = {}
+        for r in range(rounds):
+            self.w, self.b, loss = self._step(self.w, self.b, self.x_tr, self.y_tr)
+            if r % freq == 0 or r == rounds - 1:
+                z = self._infer(self.w, self.b, self.x_te)
+                acc = float(jnp.mean(jnp.argmax(z, 1) == self.y_te))
+                last = {"round": r, "test_acc": round(acc, 4),
+                        "train_loss": round(float(loss), 4)}
+                self.metrics.log(last)
+        return last
+
+
+# ---------------------------------------------------------------------------
+# SplitNN: compiled activation/gradient exchange, clients over the mesh
+# ---------------------------------------------------------------------------
+class SplitNNInMeshAPI:
+    """Split learning with the cut-layer exchange compiled into the round.
+
+    Front/back topology and hyperparameters match the sp twin
+    (``simulation/sp/split_nn/split_nn_api.py``, reference
+    ``simulation/mpi/split_nn/SplitNN_api.py``).  Parallelization: the
+    reference relays ONE front sequentially through all clients; here each
+    mesh slot runs that relay over ITS scheduled clients inside one compiled
+    program (activation up / cut-gradient down via ``jax.vjp`` per batch),
+    and the relay chains' (front, back) pairs are sample-weight psum-averaged
+    at the round boundary."""
+
+    def __init__(self, args, device, dataset, model=None, mesh: Mesh = None):
+        from ..sp.split_nn.split_nn_api import _Back, _Front
+
+        self.args = args
+        (_, _, _tg, (x_te, y_te), local_num, local_train, _lt, self.class_num) = dataset
+        self.mesh = mesh if mesh is not None else Mesh(np.array(jax.devices()), ("client",))
+        self.n_dev = self.mesh.devices.size
+        self.num_clients = int(args.client_num_in_total)
+        self.bs = int(getattr(args, "batch_size", 32))
+        self.x_te = jnp.asarray(np.asarray(x_te, np.float32))
+        self.y_te = jnp.asarray(y_te)
+        (self.x_all, self.y_all, self.client_idx, self.counts, self.padded_n
+         ) = _pad_clients(local_train, local_num, self.num_clients, self.bs)
+        self.front = _Front(int(getattr(args, "split_hidden", 128)))
+        self.back = _Back(self.class_num)
+        x0 = self.x_all[:1]
+        self.front_params = self.front.init(jax.random.PRNGKey(0), x0)
+        h0 = self.front.apply(self.front_params, x0)
+        self.back_params = self.back.init(jax.random.PRNGKey(999), h0)
+        lr = float(getattr(args, "learning_rate", 0.1))
+        front, back = self.front, self.back
+        bs, padded_n = self.bs, self.padded_n
+        n_batches = padded_n // bs
+        self.metrics = MetricsLogger(args)
+
+        def split_batch(fp, bp, x, y, m):
+            # client forward to the cut layer; vjp IS the exchange seam
+            h, client_vjp = jax.vjp(lambda p: front.apply(p, x), fp)
+
+            def server_loss(bp, h):
+                logits = back.apply(bp, h)
+                logp = jax.nn.log_softmax(logits)
+                per = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+                return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+            loss, (gbp, gh) = jax.value_and_grad(server_loss, argnums=(0, 1))(bp, h)
+            (gfp,) = client_vjp(gh)  # cut-layer gradient travels down
+            fp = jax.tree_util.tree_map(lambda p, g: p - lr * g, fp, gfp)
+            bp = jax.tree_util.tree_map(lambda p, g: p - lr * g, bp, gbp)
+            return fp, bp, loss
+
+        def per_device(fp, bp, x_all, y_all, idx_l, counts_l):
+            w_dev = jnp.sum(counts_l.astype(jnp.float32))
+
+            def one_client(carry, inp):
+                fp, bp = carry
+                idx_row, n_i = inp
+                x = jnp.take(x_all, idx_row, axis=0)
+                y = jnp.take(y_all, idx_row, axis=0)
+                mask = (jnp.arange(padded_n) < n_i).astype(jnp.float32)
+
+                def one_batch(c, b_i):
+                    fp, bp = c
+                    sl = b_i * bs
+                    xb = jax.lax.dynamic_slice_in_dim(x, sl, bs)
+                    yb = jax.lax.dynamic_slice_in_dim(y, sl, bs)
+                    mb = jax.lax.dynamic_slice_in_dim(mask, sl, bs)
+                    fp, bp, loss = split_batch(fp, bp, xb, yb, mb)
+                    return (fp, bp), loss * jnp.sum(mb)
+
+                (fp, bp), wl = jax.lax.scan(
+                    one_batch, (fp, bp), jnp.arange(n_batches, dtype=jnp.int32)
+                )
+                return (fp, bp), jnp.sum(wl)
+
+            (fp, bp), wl = jax.lax.scan(one_client, (fp, bp), (idx_l, counts_l))
+            # weight-averaged merge of the relay chains (weight-0 devices
+            # contribute nothing; their unchanged params are masked out)
+            wsum = jax.lax.psum(w_dev, "client")
+            merge = lambda t: jax.lax.psum(w_dev * t, "client") / jnp.maximum(wsum, 1e-9)
+            fp = jax.tree_util.tree_map(merge, fp)
+            bp = jax.tree_util.tree_map(merge, bp)
+            lsum = jax.lax.psum(jnp.sum(wl), "client")
+            return fp, bp, lsum / jnp.maximum(wsum, 1e-9)
+
+        self._round_fn = jax.jit(shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(), P("client"), P("client")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        ))
+
+    def train(self) -> Dict[str, Any]:
+        rounds = int(self.args.comm_round)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        last: Dict[str, Any] = {}
+        # all clients participate each round (the reference relay walks the
+        # full population), padded to fill the mesh
+        c_pad = -(-self.num_clients // self.n_dev) * self.n_dev
+        ids = np.resize(np.arange(self.num_clients), c_pad)
+        counts = np.where(np.arange(c_pad) < self.num_clients,
+                          self.counts[ids], 0).astype(np.int32)
+        idx_rows = self.client_idx[jnp.asarray(ids)]
+        counts_j = jnp.asarray(counts)
+        for r in range(rounds):
+            self.front_params, self.back_params, loss = self._round_fn(
+                self.front_params, self.back_params, self.x_all, self.y_all,
+                idx_rows, counts_j,
+            )
+            if r % freq == 0 or r == rounds - 1:
+                last = self._evaluate(r, float(loss))
+        return last
+
+    def _evaluate(self, r: int, loss: float) -> Dict[str, Any]:
+        h = self.front.apply(self.front_params, self.x_te)
+        logits = self.back.apply(self.back_params, h)
+        acc = float(jnp.mean(jnp.argmax(logits, 1) == self.y_te))
+        out = {"round": r, "test_acc": round(acc, 4), "train_loss": round(loss, 4)}
+        self.metrics.log(out)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# FedGKT: sharded edge phase + replicated server tower
+# ---------------------------------------------------------------------------
+class GKTInMeshAPI:
+    """Group knowledge transfer with the client phase shard_mapped over the
+    mesh.  Per-client edge params live in a stacked HBM table (gathered for
+    the round's participants, scattered back after — never averaged), the
+    transfer set (features/logits/labels) is produced sharded over the
+    client axis, and the server tower trains replicated on the union.
+    Hyperparameters and loss structure match the sp twin
+    (``simulation/sp/fedgkt/gkt_api.py``, reference ``simulation/mpi/fedgkt``)."""
+
+    def __init__(self, args, device, dataset, model=None, mesh: Mesh = None):
+        from ...models.gkt import GKTClientNet, GKTServerNet
+
+        self.args = args
+        (_tn, _ten, _tg, self.test_global, local_num, local_train, _lt,
+         self.class_num) = dataset
+        self.mesh = mesh if mesh is not None else Mesh(np.array(jax.devices()), ("client",))
+        self.n_dev = self.mesh.devices.size
+        self.num_clients = int(args.client_num_in_total)
+        self.cpr = int(args.client_num_per_round)
+        self.bs = int(getattr(args, "batch_size", 32))
+        self.temperature = float(getattr(args, "gkt_temperature", 3.0))
+        self.alpha = float(getattr(args, "gkt_alpha", 1.0))
+        self.server_epochs = int(getattr(args, "gkt_server_epochs", 1))
+        self.epochs = int(getattr(args, "epochs", 1))
+        lr = float(getattr(args, "learning_rate", 0.01))
+        seed = int(getattr(args, "random_seed", 0))
+        (self.x_all, self.y_all, self.client_idx, self.counts, self.padded_n
+         ) = _pad_clients(local_train, local_num, self.num_clients, self.bs)
+
+        self.client_net = model if isinstance(model, GKTClientNet) else GKTClientNet(
+            num_classes=self.class_num
+        )
+        self.server_net = GKTServerNet(
+            num_classes=self.class_num,
+            width=int(getattr(args, "gkt_server_width", 64)),
+            blocks=int(getattr(args, "gkt_server_blocks", 3)),
+        )
+        key = jax.random.PRNGKey(seed)
+        sample = self.x_all[: self.bs]
+        proto = self.client_net.init(key, sample)
+        # stacked per-client edge table: every client starts from the proto
+        # (reference model_hub ResNet-8 init), diverges privately forever
+        self.edge_table = jax.tree_util.tree_map(
+            lambda p: jnp.broadcast_to(p, (self.num_clients,) + p.shape), proto
+        )
+        feats, _ = self.client_net.apply(proto, sample)
+        self.feat_shape = feats.shape[1:]
+        self.server_params = self.server_net.init(jax.random.fold_in(key, 1), feats)
+        # downloaded knowledge: per-client per-row server logits + validity
+        self.logit_table = jnp.zeros(
+            (self.num_clients, self.padded_n, self.class_num), jnp.float32
+        )
+        self.has_kd = jnp.zeros((self.num_clients,), jnp.float32)
+        self.client_tx = optax.sgd(lr, momentum=0.9)
+        self.server_tx = optax.sgd(lr, momentum=0.9)
+        self.metrics = MetricsLogger(args)
+        self.eval_history = []
+        self._build_fns(proto)
+        # canonical placements: tables + data mesh-replicated (the client
+        # phase shards them per its in_specs); scatter results from mixed
+        # dev0/sharded sources are re-placed here every round to keep jit
+        # from seeing conflicting committed devices
+        self._rep_mesh = lambda t: jax.device_put(
+            t, NamedSharding(self.mesh, P())
+        )
+        self.x_all = self._rep_mesh(self.x_all)
+        self.y_all = self._rep_mesh(self.y_all)
+        self.client_idx = self._rep_mesh(self.client_idx)
+        self.edge_table = self._rep_mesh(self.edge_table)
+        self.logit_table = self._rep_mesh(self.logit_table)
+        self.has_kd = self._rep_mesh(self.has_kd)
+
+    def _build_fns(self, proto):
+        cnet, snet = self.client_net, self.server_net
+        ctx, stx = self.client_tx, self.server_tx
+        alpha, T = self.alpha, self.temperature
+        bs, padded_n = self.bs, self.padded_n
+        n_batches = padded_n // bs
+        epochs, server_epochs = self.epochs, self.server_epochs
+
+        def _kl(p_logits, q_logits, m):
+            p = jax.nn.log_softmax(p_logits / T)
+            q = jax.nn.log_softmax(q_logits / T)
+            per = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+            return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0) * T**2
+
+        def _ce(logits, y, m):
+            per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+        def client_phase(edge_l, x_all, y_all, idx_l, counts_l, slog_l, haskd_l):
+            """Per device: train each of its clients' edge nets, then extract
+            the transfer set.  edge_l leaves: [slots, ...]."""
+
+            def one_client(_, inp):
+                params, idx_row, n_i, s_log, has_kd = inp
+                x = jnp.take(x_all, idx_row, axis=0)
+                y = jnp.take(y_all, idx_row, axis=0)
+                mask = (jnp.arange(padded_n) < n_i).astype(jnp.float32)
+                opt = ctx.init(params)
+
+                def one_batch(c, b_i):
+                    params, opt = c
+                    sl = (b_i % n_batches) * bs
+                    xb = jax.lax.dynamic_slice_in_dim(x, sl, bs)
+                    yb = jax.lax.dynamic_slice_in_dim(y, sl, bs)
+                    mb = jax.lax.dynamic_slice_in_dim(mask, sl, bs)
+                    sb = jax.lax.dynamic_slice_in_dim(s_log, sl, bs)
+
+                    def loss_fn(p):
+                        _, logits = cnet.apply(p, xb)
+                        return _ce(logits, yb, mb) + alpha * has_kd * _kl(sb, logits, mb)
+
+                    grads = jax.grad(loss_fn)(params)
+                    updates, opt = ctx.update(grads, opt, params)
+                    return (optax.apply_updates(params, updates), opt), 0.0
+
+                (params, _), _ = jax.lax.scan(
+                    one_batch, (params, opt),
+                    jnp.arange(n_batches * epochs, dtype=jnp.int32),
+                )
+                feats, logits = cnet.apply(params, x)  # transfer extraction
+                return None, (params, feats, logits, y, mask)
+
+            _, (new_edge, feats, logits, ys, masks) = jax.lax.scan(
+                one_client, None, (edge_l, idx_l, counts_l, slog_l, haskd_l)
+            )
+            return new_edge, feats, logits, ys, masks
+
+        self._client_phase = jax.jit(shard_map(
+            client_phase, mesh=self.mesh,
+            in_specs=(P("client"), P(), P(), P("client"), P("client"),
+                      P("client"), P("client")),
+            out_specs=(P("client"), P("client"), P("client"), P("client"),
+                       P("client")),
+            check_vma=False,
+        ))
+
+        def server_phase(sp, feats, c_logits, ys, masks):
+            """Replicated tower training on the union of the transfer set
+            (client-by-client, batch-by-batch — the sp ordering), then fresh
+            knowledge inference for every transfer row."""
+            c_pad = feats.shape[0]
+            f_flat = feats.reshape((c_pad * n_batches, bs) + feats.shape[2:])
+            l_flat = c_logits.reshape((c_pad * n_batches, bs, -1))
+            y_flat = ys.reshape((c_pad * n_batches, bs))
+            m_flat = masks.reshape((c_pad * n_batches, bs))
+            opt = stx.init(sp)
+
+            def one_batch(c, inp):
+                sp, opt = c
+                fb, lb, yb, mb = inp
+
+                def loss_fn(p):
+                    logits = snet.apply(p, fb)
+                    return _ce(logits, yb, mb) + alpha * _kl(lb, logits, mb)
+
+                loss, grads = jax.value_and_grad(loss_fn)(sp)
+                updates, opt = stx.update(grads, opt, sp)
+                return (optax.apply_updates(sp, updates), opt), loss
+
+            def one_epoch(c, _):
+                c, losses = jax.lax.scan(one_batch, c, (f_flat, l_flat, y_flat, m_flat))
+                return c, losses[-1]
+
+            (sp, _), losses = jax.lax.scan(one_epoch, (sp, opt), None,
+                                           length=server_epochs)
+            fresh = jax.vmap(lambda f: snet.apply(sp, f))(f_flat)
+            fresh = fresh.reshape((c_pad, padded_n, -1))
+            return sp, fresh, losses[-1]
+
+        # the transfer set arrives client-sharded; the server tower trains on
+        # ONE device (GKT's server is a separate machine — and replicating
+        # the tower across the mesh would just run the same sequential-SGD
+        # work redundantly on every device).  device_put here IS the
+        # "clients upload knowledge" hop; features are small by design.
+        dev0 = self.mesh.devices.reshape(-1)[0]
+        self._replicate = lambda t: jax.device_put(t, dev0)
+        self._server_phase = jax.jit(server_phase)
+
+        def probe_eval(edge_params, sp, x, y):
+            feats, _ = cnet.apply(edge_params, x)
+            logits = snet.apply(sp, feats)
+            return jnp.sum(jnp.argmax(logits, -1) == y)
+
+        self._probe_eval = jax.jit(probe_eval)
+
+    def train(self) -> Dict[str, Any]:
+        from ...core.sampling import client_sampling
+
+        comm_round = int(self.args.comm_round)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        last: Dict[str, Any] = {}
+        for round_idx in range(comm_round):
+            sampled = np.asarray(client_sampling(
+                round_idx, self.num_clients, self.cpr
+            ))
+            c_pad = -(-len(sampled) // self.n_dev) * self.n_dev
+            ids = np.resize(sampled, c_pad)
+            real = np.arange(c_pad) < len(sampled)
+            counts = np.where(real, self.counts[ids], 0).astype(np.int32)
+            idsj = jnp.asarray(ids)
+            edge_l = jax.tree_util.tree_map(lambda t: t[idsj], self.edge_table)
+            new_edge, feats, logits, ys, masks = self._client_phase(
+                edge_l, self.x_all, self.y_all, self.client_idx[idsj],
+                jnp.asarray(counts), self.logit_table[idsj],
+                self.has_kd[idsj],
+            )
+            self.server_params, fresh, loss = self._server_phase(
+                self.server_params, self._replicate(feats),
+                self._replicate(logits), self._replicate(ys),
+                self._replicate(masks),
+            )
+            # scatter: edge params + downloaded knowledge back to the tables
+            # (real slots only — a padding dup must not clobber its original)
+            upd = jnp.asarray(ids[real])
+            sel = jnp.asarray(np.where(real)[0])
+            self.edge_table = self._rep_mesh(jax.tree_util.tree_map(
+                lambda t, n: t.at[upd].set(n[sel]), self.edge_table, new_edge
+            ))
+            self.logit_table = self._rep_mesh(
+                self.logit_table.at[upd].set(self._rep_mesh(fresh)[sel])
+            )
+            self.has_kd = self._rep_mesh(self.has_kd.at[upd].set(1.0))
+            self.metrics.log({"round": round_idx, "server_loss": float(loss)})
+            if round_idx % freq == 0 or round_idx == comm_round - 1:
+                last = self._test_global(round_idx, int(sampled[0]))
+        return last
+
+    def _test_global(self, round_idx: int, probe_cid: int) -> Dict[str, Any]:
+        x, y = self.test_global
+        # probe edge params join the server tower on its device
+        probe = self._replicate(
+            jax.tree_util.tree_map(lambda t: t[probe_cid], self.edge_table)
+        )
+        correct = total = 0
+        for s in range(0, len(y), 256):
+            e = min(s + 256, len(y))
+            correct += int(self._probe_eval(
+                probe, self.server_params,
+                jnp.asarray(np.asarray(x[s:e], np.float32)), jnp.asarray(y[s:e]),
+            ))
+            total += e - s
+        out = {"round": round_idx, "test_acc": round(correct / max(total, 1), 4)}
+        self.eval_history.append(out)
+        self.metrics.log(out)
+        logger.info("gkt in-mesh eval: %s", out)
+        return out
