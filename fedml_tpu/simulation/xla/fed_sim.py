@@ -480,18 +480,23 @@ class XLASimulator:
             self.batch_size, int(getattr(self.args, "epochs", 1)),
             int(getattr(self.args, "random_seed", 0)), round_idx, self.s_max,
         )
-        # trim the stream buffers to a power-of-two bucket of the round's
-        # real max steps: uploads and (with xla_pregather) the round's data
-        # gather scale with the bucket, not the global worst case.  The
-        # bucket only GROWS across rounds (monotone): a round near a
-        # power-of-two boundary can't flip-flop shapes and trigger
-        # recompiles inside a steady-state timing window — at most
-        # log2(s_max) recompiles per run, all early.
+        # trim the stream buffers to a quantized bucket of the round's real
+        # max steps: uploads, the scan-stream tail, and (with xla_pregather)
+        # the round's data gather all scale with the bucket, not the global
+        # worst case.  Quantum = s_max/8 -> at most 8 distinct shapes per
+        # run (each compiles once, then caches — flip-flopping between
+        # already-compiled levels costs nothing) and <= one quantum of
+        # overshoot, vs up to 2x for the old monotone power-of-two ladder.
         s_used = max(int(sched.n_steps.max()), 1)
-        s_bucket = 1
-        while s_bucket < s_used:
-            s_bucket *= 2
-        s_bucket = min(max(s_bucket, getattr(self, "_s_bucket", 1)), self.s_max)
+        quantum = max(1, -(-self.s_max // 8))
+        s_bucket = min(-(-s_used // quantum) * quantum, self.s_max)
+        seen = getattr(self, "_seen_buckets", None)
+        if seen is None:
+            seen = self._seen_buckets = set()
+        # first round at a new bucket shape pays an XLA recompile: flag it so
+        # train() keeps that wall time out of the runtime model's fit
+        self._bucket_compiling = s_bucket not in seen
+        seen.add(s_bucket)
         self._s_bucket = s_bucket
         sched = sched._replace(
             idx=sched.idx[:, :s_bucket], mask=sched.mask[:, :s_bucket],
@@ -500,12 +505,28 @@ class XLASimulator:
         )
         return tuple(jnp.asarray(a) for a in sched)
 
+    def _client_steps(self, n: int) -> int:
+        """A client's cost in the packed round's native unit: compiled steps
+        (ceil(n/B) per epoch) — the quantity the while_loop actually runs."""
+        if n <= 0:
+            return 0
+        return -(-int(n) // self.batch_size) * int(getattr(self.args, "epochs", 1))
+
     def _schedule(self, sampled: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Balance sampled clients across mesh slots via core/schedule
         (SeqTrainScheduler; runtime-model-aware once rounds have been
         observed).  Returns (client_ids [C_pad], is_real [C_pad]) laid out so
-        that reshape(n_dev, -1) gives each device its contiguous schedule."""
-        sizes = [self.local_num_dict[int(c)] for c in sampled]
+        that reshape(n_dev, -1) gives each device its contiguous schedule.
+
+        Cost units match what each round variant executes: the packed stream
+        runs ceil(n/B)*E steps per client (a 1-sample client costs a whole
+        batch step), the padded round always runs padded_n/B steps, so LPT
+        balances packed rounds on STEP counts and the runtime model is fed
+        the same unit (see the record() call in train())."""
+        if self.packed:
+            sizes = [self._client_steps(self.local_num_dict[int(c)]) for c in sampled]
+        else:
+            sizes = [self.local_num_dict[int(c)] for c in sampled]
         ids2d, mask2d, _ = self.scheduler.schedule(sampled, sizes)
         return ids2d.reshape(-1), mask2d.reshape(-1)
 
@@ -653,13 +674,25 @@ class XLASimulator:
             self.round_times.append(dt)
             if round_idx > 0:  # round 0 is dominated by XLA compile
                 # The round's wall time is set by the heaviest mesh slot.
-                # Note: with a single size bucket the compiled round runs a
-                # static number of steps, so the fitted slope tends to ~0 and
-                # the schedule degenerates to count-balancing (correct for
-                # that regime); the model earns its keep once multiple shape
-                # buckets / ragged schedules make round time load-dependent.
-                dev_loads = counts.reshape(self.n_dev, -1).sum(axis=1)
-                self.runtime_estimator.record(0, int(dev_loads.max()), dt)
+                # Packed: record max device STEPS — the while_loop's actual
+                # trip count, so round time is genuinely load-dependent and
+                # the fitted slope drives next rounds' LPT balancing (in the
+                # same step units _schedule passes as costs).  Padded: the
+                # round is shape-static (every client pays padded_n), so the
+                # model degenerates to count-balancing there by design.
+                if self.packed:
+                    if getattr(self, "_bucket_compiling", False):
+                        pass  # compile-dominated round: would poison the fit
+                    else:
+                        epochs_ = int(getattr(self.args, "epochs", 1))
+                        steps2d = -(-counts.reshape(self.n_dev, -1)
+                                    // self.batch_size) * epochs_
+                        self.runtime_estimator.record(
+                            0, int(steps2d.sum(axis=1).max()), dt
+                        )
+                else:
+                    dev_loads = counts.reshape(self.n_dev, -1).sum(axis=1)
+                    self.runtime_estimator.record(0, int(dev_loads.max()), dt)
             epochs = int(getattr(self.args, "epochs", 1))
             self.samples_per_round.append(int(counts.sum()) * epochs)
             self.samples_trained += int(counts.sum()) * epochs

@@ -253,3 +253,66 @@ class TestScanStream:
             outs[stream] = [np.asarray(l) for l in jax.tree_util.tree_leaves(sim.variables)]
         for a, b in zip(outs["while"], outs["scan"]):
             np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+class TestStepScheduling:
+    """The packed round schedules and models runtime in its native unit:
+    compiled steps (ceil(n/B)*E), with a quantized stream bucket."""
+
+    def test_scheduler_receives_step_costs(self):
+        args, dataset, model = _build(_args(comm_round=1))
+        sim = XLASimulator(args, dataset, model)
+        captured = {}
+        orig = sim.scheduler.schedule
+
+        def spy(ids, sizes):
+            captured["sizes"] = list(sizes)
+            return orig(ids, sizes)
+
+        sim.scheduler.schedule = spy
+        sampled = sim._client_sampling(0)
+        sim._schedule(sampled)
+        b, e = int(args.batch_size), int(args.epochs)
+        expect = [-(-int(sim.local_num_dict[int(c)]) // b) * e for c in sampled]
+        assert captured["sizes"] == expect
+
+    def test_runtime_model_records_steps(self):
+        args, dataset, model = _build(_args(comm_round=4))
+        sim = XLASimulator(args, dataset, model)
+        sim.train()
+        obs = sim.runtime_estimator._obs[0]
+        # rounds 1..3, minus any round whose bucket shape first compiled
+        assert 1 <= len(obs) <= 3
+        max_steps_possible = sim.slots * (-(-sim.max_client_n // sim.batch_size)) \
+            * int(args.epochs)
+        for x, t in obs:
+            assert 1 <= x <= max_steps_possible
+            assert x == int(x)  # step counts, not raw sample sums
+            assert t > 0
+
+    def test_bucket_quantized_not_power_of_two(self):
+        args, dataset, model = _build(_args(comm_round=2))
+        sim = XLASimulator(args, dataset, model)
+        sim.train()
+        quantum = max(1, -(-sim.s_max // 8))
+        assert sim._s_bucket % quantum == 0 or sim._s_bucket == sim.s_max
+        assert sim._s_bucket <= sim.s_max
+
+    def test_bucket_tracks_round_usage(self):
+        """The bucket equals the quantized round usage — computed from the
+        actual schedule, not assumed from the sampling draw."""
+        args, dataset, model = _build(
+            _args(comm_round=1, client_num_per_round=2, epochs=1)
+        )
+        sim = XLASimulator(args, dataset, model)
+        sim.train()
+        sampled = sim._client_sampling(0)
+        ids, real = sim._schedule(sampled)
+        steps = np.array([
+            sim._client_steps(sim.local_num_dict[int(c)]) if r else 0
+            for c, r in zip(ids, real)
+        ])
+        s_used = max(int(steps.reshape(sim.n_dev, -1).sum(axis=1).max()), 1)
+        quantum = max(1, -(-sim.s_max // 8))
+        expect = min(-(-s_used // quantum) * quantum, sim.s_max)
+        assert sim._s_bucket == expect, (sim._s_bucket, expect, s_used, sim.s_max)
