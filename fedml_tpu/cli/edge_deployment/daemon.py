@@ -67,7 +67,9 @@ class FedMLDaemon:
 
     # -- broker channel ------------------------------------------------------
     def _connect_broker(self, host: str, port: int) -> None:
-        from ...core.distributed.communication.mqtt_s3.broker import BrokerClient
+        from ...core.distributed.communication.mqtt_s3.adapters import (
+            create_broker_client,
+        )
 
         def on_message(topic: str, payload) -> None:
             try:
@@ -75,7 +77,10 @@ class FedMLDaemon:
             except Exception:
                 logger.exception("bad dispatch payload on %s", topic)
 
-        self._client = BrokerClient(host, port, on_message)
+        self._client = create_broker_client(
+            host, port, on_message,
+            client_id=f"fedml_daemon_{self.role}_{self.account_id}",
+        )
         self._client.subscribe(f"mlops/deploy/{self.role}/{self.account_id}")
 
     def _publish_status(self, run_id: str, status: str) -> None:

@@ -1,0 +1,198 @@
+"""Real-transport adapters: paho-mqtt client + boto3 S3 blob store.
+
+The in-repo :class:`~.broker.LocalBroker`/:class:`~.broker.BrokerClient` pair
+is the zero-dependency transport; production deployments of the reference
+speak real MQTT (paho) to a hosted broker and real S3 (boto3) for blobs
+(``mqtt_s3_multi_clients_comm_manager.py:214-284``,
+``s3/remote_storage.py``).  Neither library ships in this image, so this
+module provides the SEAM: two factories that return the in-repo
+implementations by default and drop in the real clients — behind the exact
+same surface — when the libraries are importable and the config asks for
+them.
+
+Surface contract (what :class:`~.mqtt_s3_comm_manager.MqttS3CommManager`,
+the edge daemon, and the mlops sink consume):
+
+* client: ``subscribe(topic) / unsubscribe(topic) / publish(topic, payload)
+  / set_last_will(topic, payload) / disconnect()`` + an ``on_message(topic,
+  payload)`` callback, where payload is an arbitrary python object
+  (pickled to bytes on the MQTT wire) and ``#`` works as a trailing prefix
+  wildcard (MQTT's multi-level wildcard is a superset);
+* blob store: ``write_model(key, pytree) -> url`` / ``read_model(url)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+from typing import Any, Callable, Optional
+
+from .blob_store import BlobStore
+from .broker import BrokerClient
+
+logger = logging.getLogger(__name__)
+
+
+def _paho():
+    try:
+        import paho.mqtt.client as mqtt  # type: ignore
+
+        return mqtt
+    except ImportError:
+        return None
+
+
+def _boto3():
+    try:
+        import boto3  # type: ignore
+
+        return boto3
+    except ImportError:
+        return None
+
+
+class PahoBrokerClient:
+    """paho-mqtt behind the BrokerClient surface.
+
+    Connection is LAZY (first subscribe/publish): paho's ``will_set`` must
+    precede ``connect``, while the in-repo surface sets the will after
+    construction — deferring the connect lets both orders work.  Payloads are
+    pickled to bytes on publish and unpickled on receive, so handlers see the
+    same python objects the in-repo broker delivers.  Only unpickle from a
+    broker you trust (same trust model as the reference's pickled S3 blobs).
+    """
+
+    def __init__(self, host: str, port: int,
+                 on_message: Callable[[str, object], None],
+                 client_id: str = "", keepalive: int = 180, mqtt_module=None):
+        self._mqtt = mqtt_module if mqtt_module is not None else _paho()
+        if self._mqtt is None:
+            raise ImportError("paho-mqtt is not installed")
+        self.host, self.port, self.keepalive = host, int(port), int(keepalive)
+        self.on_message = on_message
+        self._connected = False
+        self._subs: set = set()  # re-armed after any reconnect
+        self._lock = threading.Lock()
+        self._client = self._make_client(client_id)
+        self._client.on_message = self._handle
+
+    def _make_client(self, client_id: str):
+        mqtt = self._mqtt
+        try:  # paho >= 2.0 requires an api-version argument
+            return mqtt.Client(mqtt.CallbackAPIVersion.VERSION1, client_id=client_id)
+        except (AttributeError, TypeError):
+            return mqtt.Client(client_id=client_id)
+
+    def _handle(self, client, userdata, msg) -> None:
+        try:
+            payload = pickle.loads(msg.payload)
+        except Exception:
+            payload = msg.payload  # non-pickle producer (foreign publisher)
+        try:
+            self.on_message(str(msg.topic), payload)
+        except Exception:
+            logger.exception("paho client on_message raised")
+
+    def _ensure_connected(self) -> None:
+        with self._lock:
+            if self._connected:
+                return
+            self._client.connect(self.host, self.port, keepalive=self.keepalive)
+            self._client.loop_start()
+            self._connected = True
+            # a reconnect (e.g. set_last_will re-arm) starts a clean session:
+            # restore every tracked subscription or handlers silently go deaf
+            for t in sorted(self._subs):
+                self._client.subscribe(t)
+
+    # -- BrokerClient surface ------------------------------------------------
+    def subscribe(self, topic: str) -> None:
+        self._subs.add(str(topic))
+        self._ensure_connected()
+        self._client.subscribe(str(topic))
+
+    def unsubscribe(self, topic: str) -> None:
+        self._subs.discard(str(topic))
+        self._ensure_connected()
+        self._client.unsubscribe(str(topic))
+
+    def publish(self, topic: str, payload) -> None:
+        self._ensure_connected()
+        self._client.publish(
+            str(topic), pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        )
+
+    def set_last_will(self, topic: str, payload) -> None:
+        blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            if self._connected:
+                # paho cannot change the will mid-session: re-arm by
+                # reconnecting with the will installed
+                logger.warning("re-connecting to install last will on %s", topic)
+                self._client.loop_stop()
+                self._client.disconnect()
+                self._connected = False
+            self._client.will_set(str(topic), blob)
+
+    def disconnect(self) -> None:
+        with self._lock:
+            if self._connected:
+                self._client.loop_stop()
+                self._client.disconnect()
+                self._connected = False
+
+
+class S3BlobStore:
+    """boto3-backed blob store behind the BlobStore surface: ``s3://`` URLs,
+    pickled pytrees (reference ``s3/remote_storage.py:42,63``)."""
+
+    def __init__(self, root: str, boto3_module=None):
+        b3 = boto3_module if boto3_module is not None else _boto3()
+        if b3 is None:
+            raise ImportError("boto3 is not installed")
+        assert root.startswith("s3://"), root
+        rest = root[len("s3://"):]
+        self.bucket, _, self.prefix = rest.partition("/")
+        self._s3 = b3.client("s3")
+
+    def write_model(self, key: str, pytree: Any) -> str:
+        import uuid
+
+        from ..serialization import device_get_tree
+
+        name = f"{self.prefix.rstrip('/')}/{key}-{uuid.uuid4().hex}.pkl".lstrip("/")
+        blob = pickle.dumps(device_get_tree(pytree), protocol=pickle.HIGHEST_PROTOCOL)
+        self._s3.put_object(Bucket=self.bucket, Key=name, Body=blob)
+        return f"s3://{self.bucket}/{name}"
+
+    def read_model(self, url: str) -> Any:
+        assert url.startswith("s3://"), url
+        bucket, _, key = url[len("s3://"):].partition("/")
+        body = self._s3.get_object(Bucket=bucket, Key=key)["Body"].read()
+        return pickle.loads(body)
+
+
+# -- factories ---------------------------------------------------------------
+def create_broker_client(host: str, port: int,
+                         on_message: Callable[[str, object], None],
+                         transport: Optional[str] = None,
+                         client_id: str = ""):
+    """One constructor for both transports.
+
+    ``transport``: ``"paho"`` speaks real MQTT via paho-mqtt (raises if the
+    library is missing); anything else — including the default — uses the
+    in-repo broker client.  Selection is EXPLICIT config, never import
+    availability: the host:port in a config points at a specific kind of
+    broker, and silently switching wire protocols because paho-mqtt appeared
+    in the environment would hang both sides against a LocalBroker."""
+    if (transport or "").lower() == "paho":
+        return PahoBrokerClient(host, port, on_message, client_id=client_id)
+    return BrokerClient(host, port, on_message)
+
+
+def create_blob_store(root: Optional[str] = None):
+    """``s3://bucket/prefix`` + boto3 available -> S3; else file-backed."""
+    if root and str(root).startswith("s3://"):
+        return S3BlobStore(str(root))
+    return BlobStore(root)

@@ -26,8 +26,7 @@ from typing import List
 
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message
-from .blob_store import BlobStore
-from .broker import BrokerClient
+from .adapters import create_blob_store, create_broker_client
 
 logger = logging.getLogger(__name__)
 
@@ -55,12 +54,18 @@ class MqttS3CommManager(BaseCommunicationManager):
                 "fedml_tpu...mqtt_s3.broker.LocalBroker and pass its port)"
             )
         blob_root = getattr(args, "s3_blob_root", None)
-        self.blob_store = BlobStore(blob_root)
+        # adapter seams: s3:// root + boto3 -> real S3; mqtt_transport=paho
+        # (or auto with paho installed) -> real MQTT broker
+        self.blob_store = create_blob_store(blob_root)
         self._observers: List[Observer] = []
         self._inbox: "queue.Queue" = queue.Queue()
         self._running = False
 
-        self._client = BrokerClient(host, port, self._on_broker_message)
+        self._client = create_broker_client(
+            host, port, self._on_broker_message,
+            transport=getattr(args, "mqtt_transport", None),
+            client_id=f"fedml_{self.run_id}_r{self.rank}",
+        )
         # liveness parity: last-will marks this rank offline if the socket dies
         self._client.set_last_will(
             self._status_topic(), json.dumps({"rank": self.rank, "status": "OFFLINE"})
@@ -68,16 +73,20 @@ class MqttS3CommManager(BaseCommunicationManager):
         self._client.subscribe(self._recv_pattern())
 
     # -- topics -------------------------------------------------------------
+    # '/'-separated levels so the subscribe pattern is a VALID MQTT topic
+    # filter ('#' must occupy a whole level — a real broker rejects
+    # 'prefix_#'); the in-repo broker treats trailing-# as a prefix
+    # wildcard, which coincides with MQTT's multi-level wildcard for these
+    # level-aligned patterns
     def _topic(self, sender: int, receiver: int) -> str:
-        return f"fedml_{self.run_id}_{sender}_{receiver}"
+        return f"fedml/{self.run_id}/{sender}/{receiver}"
 
     def _recv_pattern(self) -> str:
-        # trailing-# prefix wildcard; precise receiver filtering happens in
-        # _on_broker_message (topic tail parse)
-        return f"fedml_{self.run_id}_#"
+        # precise receiver filtering happens in _on_broker_message
+        return f"fedml/{self.run_id}/#"
 
     def _status_topic(self) -> str:
-        return f"fedml_{self.run_id}_status"
+        return f"fedml/{self.run_id}/status"
 
     # -- BaseCommunicationManager -------------------------------------------
     def send_message(self, msg: Message) -> None:
@@ -121,12 +130,12 @@ class MqttS3CommManager(BaseCommunicationManager):
     def _on_broker_message(self, topic: str, payload) -> None:
         if topic == self._status_topic():
             return  # status topic is observed by managers via their own sub
-        # topic = fedml_{run_id}_{sender}_{receiver}
-        parts = topic.rsplit("_", 2)
-        if len(parts) != 3:
+        # topic = fedml/{run_id}/{sender}/{receiver}
+        parts = topic.split("/")
+        if len(parts) != 4:
             return
         try:
-            receiver = int(parts[2])
+            receiver = int(parts[3])
         except ValueError:
             return
         if receiver != self.rank:

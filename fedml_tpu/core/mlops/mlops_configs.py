@@ -1,0 +1,67 @@
+"""Platform config-fetch client (the device side of the MLOps wire protocol).
+
+Parity with reference ``core/mlops/mlops_configs.py`` (``MLOpsConfigs``):
+devices bootstrap by POSTing ``{"config_name": [...]}`` to the platform's
+``/fedmlOpsServer/configs/fetch`` and receive their transport credentials
+(MQTT broker, S3 bucket, log-server URL).  stdlib urllib only (zero extra
+deps); point ``url`` at :class:`.platform_fake.MLOpsPlatformFake` locally or
+at the hosted platform in production.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class MLOpsConfigs:
+    FETCH_PATH = "/fedmlOpsServer/configs/fetch"
+    ALL = ("mqtt_config", "s3_config", "ml_ops_config", "docker_config")
+
+    def __init__(self, url: str, timeout_s: float = 10.0):
+        self.base_url = url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            out = json.loads(resp.read())
+        if out.get("code") != "SUCCESS":
+            raise RuntimeError(f"config fetch failed: {out!r}")
+        return out
+
+    def fetch_configs(self, names: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+        names = list(names if names is not None else self.ALL)
+        return self._post(self.FETCH_PATH, {"config_name": names})["data"]
+
+    def fetch_mqtt_config(self) -> Dict[str, Any]:
+        return self.fetch_configs(["mqtt_config"])["mqtt_config"]
+
+    def fetch_s3_config(self) -> Dict[str, Any]:
+        return self.fetch_configs(["s3_config"])["s3_config"]
+
+
+def post_log_chunk(log_server_url: str, run_id, rank: int, lines: List[str],
+                   timeout_s: float = 10.0) -> None:
+    """Log-upload RPC (reference ``mlops_runtime_log_daemon.py:276-346``)."""
+    import time
+
+    req = urllib.request.Request(
+        log_server_url,
+        data=json.dumps({
+            "run_id": str(run_id), "edge_id": int(rank), "logs": list(lines),
+            "create_time": time.time(),
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        out = json.loads(resp.read())
+    if out.get("code") != "SUCCESS":
+        raise RuntimeError(f"log upload failed: {out!r}")

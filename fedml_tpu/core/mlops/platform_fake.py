@@ -1,0 +1,104 @@
+"""Loopback MLOps platform server — proves the hosted-platform wire protocol.
+
+The reference's daemons speak two HTTP endpoints to the hosted MLOps
+platform: a config-fetch RPC that hands devices their transport credentials
+(``core/mlops/mlops_configs.py`` — POST ``/fedmlOpsServer/configs/fetch``
+with ``{"config_name": [...]}``) and a log-upload RPC the runtime log
+processor batches into (``mlops_runtime_log_daemon.py:276-346`` — POST
+``/fedmlLogsServer/logs/update``).  The hosted platform is unreachable in a
+zero-egress build, so this module ships a localhost fake implementing both
+endpoints — the same role the fake-device harness plays for the Beehive
+cross-device stack: the PROTOCOL is tested, the hosted peer is swapped in by
+changing one URL.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+
+class MLOpsPlatformFake:
+    """``MLOpsPlatformFake().start()`` -> ``.url``; records every upload."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 mqtt_port: int = 1883, s3_root: str = ""):
+        self.host, self.port = host, port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.log_uploads: List[Dict[str, Any]] = []
+        self.config_fetches: List[List[str]] = []
+        self._lock = threading.Lock()
+        # what the fetch endpoint hands out (reference: MQTT + S3 credentials
+        # and the log-server address)
+        self.configs: Dict[str, Any] = {
+            "mqtt_config": {"BROKER_HOST": host, "BROKER_PORT": int(mqtt_port),
+                            "MQTT_USER": "fedml", "MQTT_PWD": "", "MQTT_KEEPALIVE": 180},
+            "s3_config": {"BUCKET_NAME": s3_root or "fedml-local",
+                          "CN_S3_AKI": "", "CN_S3_SAK": "", "CN_REGION_NAME": "local"},
+            "ml_ops_config": {},  # LOG_SERVER_URL filled in start()
+            "docker_config": {},
+        }
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MLOpsPlatformFake":
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, obj: Dict[str, Any]) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    return self._json(400, {"code": "FAILED", "message": "bad json"})
+                if self.path == "/fedmlOpsServer/configs/fetch":
+                    names = list(req.get("config_name", []))
+                    with fake._lock:
+                        fake.config_fetches.append(names)
+                    data = {k: fake.configs[k] for k in names if k in fake.configs}
+                    return self._json(200, {"code": "SUCCESS", "data": data})
+                if self.path == "/fedmlLogsServer/logs/update":
+                    with fake._lock:
+                        fake.log_uploads.append(req)
+                    return self._json(200, {"code": "SUCCESS"})
+                return self._json(404, {"code": "FAILED", "message": "unknown path"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.configs["ml_ops_config"]["LOG_SERVER_URL"] = (
+            f"{self.url}/fedmlLogsServer/logs/update"
+        )
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="mlops-platform-fake"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    def logs_for_run(self, run_id) -> List[str]:
+        with self._lock:
+            out: List[str] = []
+            for up in self.log_uploads:
+                if str(up.get("run_id")) == str(run_id):
+                    out.extend(up.get("logs", []))
+            return out

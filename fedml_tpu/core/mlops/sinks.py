@@ -60,15 +60,55 @@ class JsonlFileSink(Sink):
             self._fh.close()
 
 
+class HttpLogSink(Sink):
+    """Ships ``log_chunk`` records to a platform log server over HTTP — the
+    reference's log-POST leg (``mlops_runtime_log_daemon.py:276-346``).
+    Plug into the log daemon's fanout; point at
+    :class:`..mlops.platform_fake.MLOpsPlatformFake` locally or the hosted
+    platform's LOG_SERVER_URL in production.  Ship failures are counted,
+    logged once per streak, and never take the training process down."""
+
+    def __init__(self, log_server_url: str, timeout_s: float = 10.0):
+        self.url = str(log_server_url)
+        self.timeout_s = float(timeout_s)
+        self.ship_failures = 0
+        self._failing = False
+
+    def emit(self, topic: str, record: Dict[str, Any]) -> None:
+        if topic != "log_chunk":
+            return
+        from .mlops_configs import post_log_chunk
+
+        try:
+            post_log_chunk(
+                self.url, record.get("run_id", "0"), int(record.get("rank", 0)),
+                list(record.get("lines", [])), timeout_s=self.timeout_s,
+            )
+            self._failing = False
+        except Exception:
+            self.ship_failures += 1
+            if not self._failing:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "log upload to %s failing (telemetry only; run continues)",
+                    self.url,
+                )
+                self._failing = True
+
+
 class BrokerSink(Sink):
     """Publishes records to the in-tree pub/sub broker (MQTT-reporting
     parity): topic ``fedml_mlops/<run_id>/<kind>``."""
 
     def __init__(self, host: str, port: int, run_id: str):
-        from ..distributed.communication.mqtt_s3.broker import BrokerClient
+        from ..distributed.communication.mqtt_s3.adapters import create_broker_client
 
         self.run_id = str(run_id)
-        self._client = BrokerClient(host, int(port), on_message=lambda t, p: None)
+        self._client = create_broker_client(
+            host, int(port), on_message=lambda t, p: None,
+            client_id=f"fedml_mlops_{run_id}",
+        )
 
     def emit(self, topic: str, record: Dict[str, Any]) -> None:
         self._client.publish(f"fedml_mlops/{self.run_id}/{topic}", dict(record))

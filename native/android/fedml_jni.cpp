@@ -1,0 +1,204 @@
+// JNI bridge for the native edge runtime — the Android integration surface.
+//
+// Role of the reference's android/fedmlsdk/src/main/jni/OnLoad.cpp +
+// JniFedMLClientManager.cpp: expose the C++ trainer/client-manager to a
+// Java/Kotlin service.  This shim is a thin adapter over the stable C ABI
+// (../capi.cpp) — every entry point maps 1:1 onto a fedml_* function, so
+// the Java layer, the ctypes layer, and any other host binding share one
+// runtime surface.
+//
+// Java side (package ai.fedml.tpu):
+//
+//   public final class NativeFedMLTrainer {
+//     static { System.loadLibrary("fedml_jni"); }
+//     public static native long create(String modelPath, String dataPath,
+//                                      int batch, double lr, int epochs, long seed);
+//     public static native int train(long handle);
+//     public static native int save(long handle, String outPath);
+//     public static native long[] evaluate(long handle);  // [acc*1e6, loss*1e6], -1 on error
+//     public static native long[] epochLoss(long handle);    // [epoch, loss*1e6]
+//     public static native long numSamples(long handle);
+//     public static native void stop(long handle);
+//     public static native void destroy(long handle);
+//     public static native String lastError();
+//     // LightSecAgg leg (secure aggregation on-device):
+//     public static native long clientCreate(String modelPath, String dataPath,
+//                                            int batch, double lr, int epochs, long seed);
+//     public static native int clientTrain(long handle);
+//     public static native int clientSaveMasked(long handle, int qBits,
+//                                               long maskSeed, String outPath);
+//     public static native long clientMaskDim(long handle);
+//     public static native long[] clientEncodeMask(long handle, int n, int t,
+//                                                  int u, long maskSeed);
+//     public static native void clientDestroy(long handle);
+//   }
+//
+// Build: cmake with the Android toolchain (see CMakeLists.txt next to this
+// file); host CI compile-checks against ../android/jni_stub/jni.h (same
+// declarations as the NDK header — `make -C native jni_check`).
+
+#include <jni.h>
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+// the C ABI from capi.cpp (kept extern "C" so the .so exports one runtime)
+extern "C" {
+const char* fedml_last_error();
+void* fedml_trainer_create(const char*, const char*, int, double, int,
+                           unsigned long long);
+int fedml_trainer_train(void*);
+void fedml_trainer_epoch_loss(void*, int*, double*);
+void fedml_trainer_stop(void*);
+long long fedml_trainer_num_samples(void*);
+int fedml_trainer_save(void*, const char*);
+int fedml_trainer_eval(void*, double*, double*);
+void fedml_trainer_destroy(void*);
+void* fedml_client_create(const char*, const char*, int, double, int,
+                          unsigned long long);
+int fedml_client_train(void*);
+int fedml_client_save_masked_model(void*, int, unsigned long long, const char*);
+long long fedml_client_mask_dim(void*);
+int fedml_client_encode_mask(void*, int, int, int, unsigned long long, long long*);
+void fedml_client_destroy(void*);
+int fedml_lsa_chunk(int, int, int);
+}
+
+namespace {
+
+// RAII UTF-8 view of a jstring
+class Utf {
+ public:
+  Utf(JNIEnv* env, jstring s) : env_(env), s_(s), c_(nullptr) {
+    if (s_ != nullptr) c_ = env_->GetStringUTFChars(s_, nullptr);
+  }
+  ~Utf() {
+    if (c_ != nullptr) env_->ReleaseStringUTFChars(s_, c_);
+  }
+  const char* get() const { return c_ != nullptr ? c_ : ""; }
+
+ private:
+  JNIEnv* env_;
+  jstring s_;
+  const char* c_;
+};
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_create(
+    JNIEnv* env, jclass, jstring model, jstring data, jint batch, jdouble lr,
+    jint epochs, jlong seed) {
+  Utf m(env, model), d(env, data);
+  return reinterpret_cast<jlong>(fedml_trainer_create(
+      m.get(), d.get(), batch, lr, epochs,
+      static_cast<unsigned long long>(seed)));
+}
+
+JNIEXPORT jint JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_train(
+    JNIEnv*, jclass, jlong h) {
+  return fedml_trainer_train(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT jint JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_save(
+    JNIEnv* env, jclass, jlong h, jstring out) {
+  Utf o(env, out);
+  return fedml_trainer_save(reinterpret_cast<void*>(h), o.get());
+}
+
+JNIEXPORT jlongArray JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_epochLoss(
+    JNIEnv* env, jclass, jlong h) {
+  int epoch = 0;
+  double loss = 0.0;
+  fedml_trainer_epoch_loss(reinterpret_cast<void*>(h), &epoch, &loss);
+  jlong out[2] = {epoch, static_cast<jlong>(loss * 1e6)};
+  jlongArray arr = env->NewLongArray(2);
+  env->SetLongArrayRegion(arr, 0, 2, out);
+  return arr;
+}
+
+JNIEXPORT jlong JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_numSamples(
+    JNIEnv*, jclass, jlong h) {
+  return fedml_trainer_num_samples(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT void JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_stop(
+    JNIEnv*, jclass, jlong h) {
+  fedml_trainer_stop(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT void JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_destroy(
+    JNIEnv*, jclass, jlong h) {
+  fedml_trainer_destroy(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT jstring JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_lastError(
+    JNIEnv* env, jclass) {
+  return env->NewStringUTF(fedml_last_error());
+}
+
+// evaluate -> long[2] of fixed-point (acc*1e6, loss*1e6); -1 marker on error
+JNIEXPORT jlongArray JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_evaluate(
+    JNIEnv* env, jclass, jlong h) {
+  double acc = 0.0, loss = 0.0;
+  int rc = fedml_trainer_eval(reinterpret_cast<void*>(h), &acc, &loss);
+  jlong out[2] = {rc == 0 ? static_cast<jlong>(acc * 1e6) : -1,
+                  rc == 0 ? static_cast<jlong>(loss * 1e6) : -1};
+  jlongArray arr = env->NewLongArray(2);
+  env->SetLongArrayRegion(arr, 0, 2, out);
+  return arr;
+}
+
+// -- client manager (LightSecAgg leg) ---------------------------------------
+JNIEXPORT jlong JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_clientCreate(
+    JNIEnv* env, jclass, jstring model, jstring data, jint batch, jdouble lr,
+    jint epochs, jlong seed) {
+  Utf m(env, model), d(env, data);
+  return reinterpret_cast<jlong>(fedml_client_create(
+      m.get(), d.get(), batch, lr, epochs,
+      static_cast<unsigned long long>(seed)));
+}
+
+JNIEXPORT jint JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_clientTrain(
+    JNIEnv*, jclass, jlong h) {
+  return fedml_client_train(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT jint JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_clientSaveMasked(
+    JNIEnv* env, jclass, jlong h, jint q_bits, jlong mask_seed, jstring out) {
+  Utf o(env, out);
+  return fedml_client_save_masked_model(
+      reinterpret_cast<void*>(h), q_bits,
+      static_cast<unsigned long long>(mask_seed), o.get());
+}
+
+JNIEXPORT jlong JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_clientMaskDim(
+    JNIEnv*, jclass, jlong h) {
+  return fedml_client_mask_dim(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT jlongArray JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_clientEncodeMask(
+    JNIEnv* env, jclass, jlong h, jint n, jint t, jint u, jlong mask_seed) {
+  const int d = static_cast<int>(fedml_client_mask_dim(reinterpret_cast<void*>(h)));
+  const int chunk = fedml_lsa_chunk(d, t, u);
+  std::vector<long long> rows(static_cast<size_t>(n) * chunk);
+  int rc = fedml_client_encode_mask(reinterpret_cast<void*>(h), n, t, u,
+                                    static_cast<unsigned long long>(mask_seed),
+                                    rows.data());
+  if (rc != 0) return env->NewLongArray(0);
+  jlongArray arr = env->NewLongArray(static_cast<jsize>(rows.size()));
+  env->SetLongArrayRegion(arr, 0, static_cast<jsize>(rows.size()),
+                          reinterpret_cast<const jlong*>(rows.data()));
+  return arr;
+}
+
+JNIEXPORT void JNICALL Java_ai_fedml_tpu_NativeFedMLTrainer_clientDestroy(
+    JNIEnv*, jclass, jlong h) {
+  fedml_client_destroy(reinterpret_cast<void*>(h));
+}
+
+JNIEXPORT jint JNICALL JNI_OnLoad(JavaVM*, void*) { return JNI_VERSION_1_6; }
+
+}  // extern "C"

@@ -1,0 +1,68 @@
+// Host-compile stub of the JNI ABI (subset used by fedml_jni.cpp).
+//
+// This image has no JDK/NDK, so CI compile-checks the JNI shim against this
+// header; the declarations mirror the real <jni.h> C++ surface exactly
+// (same names, same member-function signatures), so the identical
+// fedml_jni.cpp builds unmodified against the Android NDK's jni.h — this
+// stub never ships to a device.  Member functions are declarations only:
+// the shim links as a shared object (undefined symbols are resolved by the
+// JVM at load time on-device; the host check builds with -shared, where
+// undefined symbols are permitted).
+#ifndef FEDML_JNI_STUB_H_
+#define FEDML_JNI_STUB_H_
+
+#include <cstdint>
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef int8_t jbyte;
+typedef uint8_t jboolean;
+typedef uint16_t jchar;
+typedef int16_t jshort;
+typedef float jfloat;
+typedef double jdouble;
+typedef jint jsize;
+
+class _jobject {};
+class _jclass : public _jobject {};
+class _jstring : public _jobject {};
+class _jarray : public _jobject {};
+class _jlongArray : public _jarray {};
+class _jintArray : public _jarray {};
+
+typedef _jobject* jobject;
+typedef _jclass* jclass;
+typedef _jstring* jstring;
+typedef _jarray* jarray;
+typedef _jlongArray* jlongArray;
+typedef _jintArray* jintArray;
+
+#define JNI_FALSE 0
+#define JNI_TRUE 1
+#define JNI_VERSION_1_6 0x00010006
+#define JNI_OK 0
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNIIMPORT
+#define JNICALL
+
+struct JNIEnv {
+  const char* GetStringUTFChars(jstring str, jboolean* isCopy);
+  void ReleaseStringUTFChars(jstring str, const char* chars);
+  jstring NewStringUTF(const char* utf);
+  jsize GetArrayLength(jarray array);
+  jlong* GetLongArrayElements(jlongArray array, jboolean* isCopy);
+  void ReleaseLongArrayElements(jlongArray array, jlong* elems, jint mode);
+  jint* GetIntArrayElements(jintArray array, jboolean* isCopy);
+  void ReleaseIntArrayElements(jintArray array, jint* elems, jint mode);
+  jlongArray NewLongArray(jsize length);
+  void SetLongArrayRegion(jlongArray array, jsize start, jsize len, const jlong* buf);
+  jint ThrowNew(jclass clazz, const char* message);
+  jclass FindClass(const char* name);
+};
+
+struct JavaVM {
+  jint GetEnv(void** env, jint version);
+};
+
+#endif  // FEDML_JNI_STUB_H_

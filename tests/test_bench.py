@@ -1,0 +1,25 @@
+"""bench.py helpers (the driver runs bench.py itself on the real chip; these
+cover the opt-in metric paths at smoke scale on CPU)."""
+
+import json
+import sys
+
+import pytest
+
+
+@pytest.mark.heavy
+def test_transformer_bench_metric_line(monkeypatch):
+    sys.path.insert(0, ".")
+    import bench
+
+    for k, v in {"BENCH_TF_DMODEL": "64", "BENCH_TF_LAYERS": "2",
+                 "BENCH_TF_HEADS": "4", "BENCH_TF_DFF": "256",
+                 "BENCH_TF_SEQ": "128", "BENCH_TF_BATCH": "2",
+                 "BENCH_TF_STEPS": "3"}.items():
+        monkeypatch.setenv(k, v)
+    out = bench._measure_transformer()
+    json.dumps(out)  # one JSON-serializable line
+    assert out["unit"] == "tokens/s/chip"
+    assert out["value"] > 0
+    assert 0 <= out["mfu"] <= 1
+    assert out["n_params"] > 0

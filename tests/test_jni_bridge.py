@@ -1,0 +1,33 @@
+"""Android JNI bridge (native/android/fedml_jni.cpp): the shim must compile
+against the ABI-faithful stub header and export the full
+ai.fedml.tpu.NativeFedMLTrainer surface over the C runtime (reference
+android/fedmlsdk/src/main/jni/OnLoad.cpp + JniFedMLClientManager.cpp)."""
+
+import os
+import subprocess
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+
+EXPECTED = {
+    "create", "train", "save", "evaluate", "epochLoss", "numSamples", "stop",
+    "destroy", "lastError", "clientCreate", "clientTrain", "clientSaveMasked",
+    "clientMaskDim", "clientEncodeMask", "clientDestroy",
+}
+
+
+@pytest.mark.heavy
+def test_jni_shim_compiles_and_exports_surface(tmp_path):
+    subprocess.run(["make", "-C", NATIVE, "jni_check"], check=True,
+                   capture_output=True)
+    so = os.path.join(NATIVE, "android", "libfedml_jni_check.so")
+    out = subprocess.run(["nm", "-D", so], check=True, capture_output=True,
+                         text=True).stdout
+    exported = {
+        line.rsplit("Java_ai_fedml_tpu_NativeFedMLTrainer_", 1)[1]
+        for line in out.splitlines()
+        if "Java_ai_fedml_tpu_NativeFedMLTrainer_" in line
+    }
+    assert exported == EXPECTED, exported.symmetric_difference(EXPECTED)
+    assert "JNI_OnLoad" in out

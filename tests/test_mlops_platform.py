@@ -1,0 +1,64 @@
+"""MLOps platform wire protocol against the loopback fake
+(core/mlops/platform_fake.py): config fetch hands out transport credentials,
+the log daemon ships chunks through the HTTP log sink, uploads land keyed by
+run.  Reference: mlops_configs.py + mlops_runtime_log_daemon.py:276-346."""
+
+import pytest
+
+from fedml_tpu.core.mlops.mlops_configs import MLOpsConfigs, post_log_chunk
+from fedml_tpu.core.mlops.platform_fake import MLOpsPlatformFake
+from fedml_tpu.core.mlops.sinks import FanoutSink, HttpLogSink
+
+
+@pytest.fixture
+def platform():
+    fake = MLOpsPlatformFake(mqtt_port=18830).start()
+    yield fake
+    fake.stop()
+
+
+class TestConfigFetch:
+    def test_fetch_all_hands_out_credentials(self, platform):
+        cfg = MLOpsConfigs(platform.url).fetch_configs()
+        assert cfg["mqtt_config"]["BROKER_PORT"] == 18830
+        assert cfg["ml_ops_config"]["LOG_SERVER_URL"].endswith("/logs/update")
+        assert platform.config_fetches == [list(MLOpsConfigs.ALL)]
+
+    def test_fetch_subset(self, platform):
+        mqtt = MLOpsConfigs(platform.url).fetch_mqtt_config()
+        assert mqtt["BROKER_HOST"] == "127.0.0.1"
+        assert platform.config_fetches[-1] == ["mqtt_config"]
+
+    def test_unknown_path_fails_loud(self, platform):
+        c = MLOpsConfigs(platform.url)
+        with pytest.raises(Exception):
+            c._post("/nope", {})
+
+
+class TestLogUpload:
+    def test_post_log_chunk(self, platform):
+        url = MLOpsConfigs(platform.url).fetch_configs()["ml_ops_config"]["LOG_SERVER_URL"]
+        post_log_chunk(url, run_id="42", rank=1, lines=["a", "b"])
+        assert platform.logs_for_run("42") == ["a", "b"]
+        assert platform.log_uploads[0]["edge_id"] == 1
+
+    def test_log_daemon_ships_through_http_sink(self, platform, tmp_path):
+        from fedml_tpu.core.mlops.mlops_runtime_log_daemon import MLOpsRuntimeLogDaemon
+
+        log = tmp_path / "run.log"
+        log.write_text("line-0\nline-1\nline-2\n")
+        url = platform.configs["ml_ops_config"]["LOG_SERVER_URL"]
+        sink = FanoutSink([HttpLogSink(url)])
+        daemon = MLOpsRuntimeLogDaemon(str(log), sink=sink, run_id="7", rank=0)
+        daemon.flush()
+        assert platform.logs_for_run("7") == ["line-0", "line-1", "line-2"]
+        # tail continues from the shipped offset
+        with open(log, "a") as f:
+            f.write("line-3\n")
+        daemon.flush()
+        assert platform.logs_for_run("7")[-1] == "line-3"
+
+    def test_ship_failure_does_not_raise(self, tmp_path):
+        sink = HttpLogSink("http://127.0.0.1:9/nope", timeout_s=0.2)
+        sink.emit("log_chunk", {"run_id": "1", "rank": 0, "lines": ["x"]})
+        assert sink.ship_failures == 1
