@@ -129,6 +129,52 @@ def _measure_eager_baseline(args, dataset, n_batches: int = 24) -> float:
     return n_batches * b / max(dt, 1e-9)
 
 
+# the packed round's execution-strategy levers, shared with
+# tools/perf_sweep.py so the two grids cannot drift
+AUTOTUNE_VARIANTS = (
+    {},
+    {"xla_pregather": True},
+    {"xla_stream": "scan"},
+    {"xla_pregather": True, "xla_stream": "scan"},
+)
+
+
+def _autotune(args, dataset, model):
+    """Pick the fastest round execution strategy ON THIS CHIP before the
+    real measurement: AUTOTUNE_VARIANTS at the bench config, 5 rounds each
+    (round 0 compiles; throughput() medians rounds 1-4, riding out the
+    round-1 dataset upload).  The levers are equivalence-tested
+    (tests/test_packed_round.py) but their win is hardware-dependent —
+    self-tuning lands the measured winner in the BENCH artifact even when
+    no interactive chip session was possible beforehand.  Disable with
+    BENCH_AUTOTUNE=0.  Returns the winning override dict, or None if every
+    variant (including the baseline) failed."""
+    import copy
+
+    from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+    best = (0.0, None)
+    for overrides in AUTOTUNE_VARIANTS:
+        a = copy.deepcopy(args)
+        a.comm_round = 5
+        for k, v in overrides.items():
+            setattr(a, k, v)
+        try:
+            sim = XLASimulator(a, dataset, model)
+            sim.train()
+            sps = sim.throughput()["samples_per_sec"]
+            print(f"autotune {overrides}: {sps:.1f} samples/s", file=sys.stderr)
+        except Exception as e:
+            # a broken lever must not kill the bench, but it must be VISIBLE
+            # (an artifact claiming "baseline won" when the lever crashed
+            # would mislead the next perf investigation)
+            print(f"autotune {overrides}: FAILED ({e})", file=sys.stderr)
+            continue
+        if best[1] is None or sps > best[0]:
+            best = (sps, overrides)
+    return best[1]
+
+
 def main() -> None:
     import jax
 
@@ -146,6 +192,10 @@ def main() -> None:
     eager_sps = _measure_eager_baseline(base_args, dataset)
 
     model = fedml_tpu.models.create(args, out_dim)
+    autotune_on = os.environ.get("BENCH_AUTOTUNE", "1") != "0"
+    tuned = _autotune(args, dataset, model) if autotune_on else None
+    for k, v in (tuned or {}).items():
+        setattr(args, k, v)
     sim = XLASimulator(args, dataset, model)
     sim.train()
 
@@ -168,6 +218,10 @@ def main() -> None:
         "mfu": round(achieved_tflops / PEAK_TFLOPS, 5),
         "compute_dtype": "bf16",
     }
+    if autotune_on:
+        # {} = baseline won; {...} = winning flags; null = every variant
+        # failed (distinct from BENCH_AUTOTUNE=0, where the key is absent)
+        out["autotuned"] = tuned
     if os.environ.get("BENCH_SP"):
         out["sp_samples_per_sec"] = round(_measure_sp(args, dataset), 2)
     print(json.dumps(out))

@@ -8,6 +8,37 @@ import pytest
 
 
 @pytest.mark.heavy
+def test_autotune_picks_a_valid_strategy():
+    """bench autotune must return a subset of the two lever flags and leave
+    the simulator runnable with the winner (CPU smoke at lr scale)."""
+    import jax
+
+    sys.path.insert(0, ".")
+    import bench
+    import fedml_tpu
+    from fedml_tpu import data
+    from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+    n = len(jax.devices())
+    args = bench._bench_args(n)
+    args.model = "lr"
+    args.dataset = "mnist"
+    args.synthetic_train_size = 800
+    args.client_num_per_round = 8
+    args.comm_round = 2
+    args = fedml_tpu.init(args, should_init_logs=False)
+    dataset, out_dim = data.load(args)
+    model = fedml_tpu.models.create(args, out_dim)
+    tuned = bench._autotune(args, dataset, model)
+    assert tuned is not None and set(tuned) <= {"xla_pregather", "xla_stream"}
+    for k, v in tuned.items():
+        setattr(args, k, v)
+    sim = XLASimulator(args, dataset, model)
+    sim.train()
+    assert sim.throughput()["samples_per_sec"] > 0
+
+
+@pytest.mark.heavy
 def test_transformer_bench_metric_line(monkeypatch):
     sys.path.insert(0, ".")
     import bench

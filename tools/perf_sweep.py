@@ -36,12 +36,10 @@ def main() -> int:
     from fedml_tpu.simulation.xla.fed_sim import XLASimulator
 
     n_chips = len(jax.devices())
-    configs = [
-        dict(xla_pack=False),
-        dict(xla_pack=True),
-        dict(xla_pack=True, xla_pregather=True),
-        dict(xla_pack=True, xla_stream="scan"),
-        dict(xla_pack=True, xla_pregather=True, xla_stream="scan"),
+    # padded baseline + the packed lever grid shared with bench._autotune
+    # (one definition: the grids cannot drift)
+    configs = [dict(xla_pack=False)] + [
+        dict({"xla_pack": True}, **v) for v in bench.AUTOTUNE_VARIANTS
     ]
     best = (None, 0.0)
     for overrides in configs:
