@@ -113,6 +113,22 @@ def run_simulation(backend: str = "sp") -> None:
     runner.run()
 
 
+def run_mpi_simulation(config, world_size: int, port: int = 0,
+                       deadline_s: float = 3600.0, retries: int = 2):
+    """``mpirun -np N`` replacement (reference MPI simulator workflow): spawn
+    ``world_size`` rank processes over the host-plane ProcessGroup and return
+    rank 0's metrics.  ``config``: nested args dict (the YAML shape).
+
+    Call from under ``if __name__ == "__main__":`` — ranks are spawned
+    multiprocessing children, which re-import the caller's main module (the
+    standard Python spawn contract; an unguarded top-level call would
+    recursively re-launch itself in every child)."""
+    from .simulation.mpi_proc import run_mpi_simulation as _run
+
+    return _run(config, world_size, port=port, deadline_s=deadline_s,
+                retries=retries)
+
+
 def run_cross_silo_server() -> None:
     from .launch_cross_silo import run_cross_silo
 

@@ -1,0 +1,3 @@
+from .mpi_sim import MPIProcessSimulator, run_mpi_simulation
+
+__all__ = ["MPIProcessSimulator", "run_mpi_simulation"]

@@ -59,6 +59,12 @@ def create_simulator(args, device, dataset, model):
     backend = str(getattr(args, "backend", FEDML_SIMULATION_TYPE_SP))
     if backend == FEDML_SIMULATION_TYPE_SP:
         return SimulatorSingleProcess(args, device, dataset, model)
+    if backend == "MPI_PROC":
+        # process-real MPI rank plane (reference mpirun -np N parity); this
+        # constructs ONE rank — fedml_tpu.run_mpi_simulation spawns the set
+        from .mpi_proc import MPIProcessSimulator
+
+        return MPIProcessSimulator(args, dataset, model)
     if backend in (
         FEDML_SIMULATION_TYPE_XLA,
         FEDML_SIMULATION_TYPE_MPI,
