@@ -1,0 +1,61 @@
+"""Beehive cross-device example: one ServerMNN-role server + two devices.
+
+The devices here are the in-process fake-device harness (the protocol twin
+of a phone running the native agent); swap them for real devices by running
+`fedml_edge_agent` (native/agent.cpp) against the same model-file plane, or
+the Java service over the JNI bridge (native/android/).
+
+    python main.py --cf fedml_config.yaml
+"""
+import os
+import sys
+
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+
+
+def _separable(n, d=12, classes=4, seed=0):
+    centers = np.random.RandomState(1234).randn(classes, d) * 3
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, d) * 0.5
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def main(cfg_path: str, workdir: str = "./beehive_run"):
+    import yaml
+
+    from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+    from fedml_tpu.cross_device.fake_device import FakeDeviceManager
+    from fedml_tpu.cross_device.fedml_aggregator import FedMLAggregator
+    from fedml_tpu.cross_device.fedml_server_manager import FedMLServerManager
+    from fedml_tpu.models.linear import LogisticRegression
+
+    with open(cfg_path) as f:
+        args = Arguments.from_dict(yaml.safe_load(f)).validate()
+    LoopbackHub.reset()
+    n_dev = int(args.client_num_in_total)
+    model = LogisticRegression(output_dim=4)
+    aggregator = FedMLAggregator(args, model, _separable(128, seed=9),
+                                 worker_num=n_dev,
+                                 model_dir=os.path.join(workdir, "models"))
+    server = FedMLServerManager(args, aggregator, client_rank=0, client_num=n_dev)
+    devices = [
+        FakeDeviceManager(args, rank, _separable(96, seed=rank), client_num=n_dev,
+                          upload_dir=os.path.join(workdir, f"dev{rank}"))
+        for rank in range(1, n_dev + 1)
+    ]
+    threads = [server.run_async()] + [d.run_async() for d in devices]
+    for t in threads:
+        t.join(timeout=120)
+    print("eval history:", aggregator.eval_history)
+    return aggregator.eval_history
+
+
+if __name__ == "__main__":
+    cf = "fedml_config.yaml"
+    if "--cf" in sys.argv:
+        cf = sys.argv[sys.argv.index("--cf") + 1]
+    main(cf)

@@ -1,0 +1,24 @@
+"""Multi-process simulation (the mpirun -np N workflow, process-real):
+
+    python main.py --cf fedml_config.yaml --np 2
+
+Each rank is an OS process joined over the TCP ProcessGroup; see
+fedml_tpu.run_mpi_simulation.  The __main__ guard is REQUIRED: ranks are
+spawned multiprocessing children, which re-import this module.
+"""
+import sys
+
+import yaml
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    cf = "fedml_config.yaml"
+    world = 2
+    if "--cf" in sys.argv:
+        cf = sys.argv[sys.argv.index("--cf") + 1]
+    if "--np" in sys.argv:
+        world = int(sys.argv[sys.argv.index("--np") + 1])
+    with open(cf) as f:
+        config = yaml.safe_load(f)
+    print(fedml_tpu.run_mpi_simulation(config, world_size=world))

@@ -25,6 +25,7 @@ tests/test_mpi_proc.py::test_matches_single_process).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Tuple
 
 import jax
@@ -51,14 +52,19 @@ class MPIProcessSimulator:
         self.rank = int(getattr(args, "mpi_rank", 0))
         self.world = int(getattr(args, "mpi_world_size", 1))
         # honest surface: this backend implements the weighted-mean family
-        # only (FedAvg + engine-hook variants); the algorithm zoo and the
-        # attack/defense matrix ride sp or the in-mesh XLA simulator
+        # only (FedAvg + the engine's proximal hook); the algorithm zoo
+        # (incl. FedSGD, whose server averages GRADIENTS, not parameters)
+        # and the attack/defense matrix ride sp or the in-mesh simulator
         opt = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
-        if opt not in ("fedavg", "fedprox", "fedsgd"):
+        if opt not in ("fedavg", "fedprox"):
             raise NotImplementedError(
-                f"backend MPI_PROC supports FedAvg/FedProx/FedSGD, not {opt!r}; "
+                f"backend MPI_PROC supports FedAvg/FedProx, not {opt!r}; "
                 "use backend 'sp' or 'XLA' for the algorithm zoo"
             )
+        if opt == "fedprox" and not float(getattr(args, "proximal_mu", 0) or 0):
+            # match the sp FedProxAPI default, or the engine hook never
+            # installs and FedProx silently degrades to FedAvg
+            args.proximal_mu = 0.1
         from ...core.security.fedml_attacker import FedMLAttacker
         from ...core.security.fedml_defender import FedMLDefender
 
@@ -227,12 +233,20 @@ def run_mpi_simulation(config: Dict[str, Any], world_size: int, port: int = 0,
     port up to ``retries`` times; pass an explicit reserved ``port`` for
     deterministic placement."""
     for attempt in range(int(retries) + 1):
+        t0 = time.time()
         try:
             return _run_once(config, world_size, port, deadline_s)
         except RuntimeError:
-            if attempt == retries or port != 0:
+            # only a crash in the RENDEZVOUS window smells like a port race;
+            # a world that died mid-training is a real failure — re-spawning
+            # it would triple time-to-failure and bury the actual traceback
+            rendezvous_window = float(
+                config.get("comm_args", {}).get("pg_timeout", 60.0)) + 30.0
+            if (attempt == retries or port != 0
+                    or time.time() - t0 > rendezvous_window):
                 raise
-            logger.warning("mpi run failed (possible port race); retrying")
+            logger.warning("mpi ranks died during rendezvous (possible port "
+                           "race); retrying on a fresh port")
     raise AssertionError("unreachable")
 
 

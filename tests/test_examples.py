@@ -96,6 +96,19 @@ def test_cross_silo_example(cfg, tmp_path):
             broker.stop()
 
 
+def test_cross_device_example(tmp_path):
+    """Beehive example: server + fake devices over the file model plane."""
+    import importlib.util as ilu
+
+    ex = os.path.join(EXAMPLES, "cross_device", "beehive_fedavg_synthetic_lr")
+    spec = ilu.spec_from_file_location("beehive_example", os.path.join(ex, "main.py"))
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    history = mod.main(os.path.join(ex, "fedml_config.yaml"),
+                       workdir=str(tmp_path))
+    assert history and history[-1]["test_acc"] > 0.5
+
+
 def test_lightsecagg_example():
     cfg = os.path.join(EXAMPLES, "cross_silo", "lightsecagg_mnist_lr", "fedml_config.yaml")
     args = _load(cfg, run_id="ex-lsa")
