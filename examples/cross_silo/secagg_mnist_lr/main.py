@@ -1,0 +1,26 @@
+"""SecAgg cross-silo example: pairwise-masked aggregation — the server only
+ever sees the masked sum (reference Octopus SecAgg scenario).
+
+    python main.py --cf fedml_config.yaml
+"""
+import sys
+
+import yaml
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+
+if __name__ == "__main__":
+    cf = "fedml_config.yaml"
+    if "--cf" in sys.argv:
+        cf = sys.argv[sys.argv.index("--cf") + 1]
+    with open(cf) as f:
+        args = fedml_tpu.init(Arguments.from_dict(yaml.safe_load(f)).validate(),
+                              should_init_logs=False)
+    from fedml_tpu.cross_silo.secagg import run_secagg_topology_in_threads
+
+    history = run_secagg_topology_in_threads(
+        args, fedml_tpu.data.load,
+        lambda a, out_dim: fedml_tpu.models.create(a, out_dim),
+    )
+    print(history[-1] if history else {})

@@ -93,3 +93,7 @@ MESH_AXIS_TP = "tp"
 MESH_AXIS_SP = "sp"
 MESH_AXIS_PP = "pp"
 MESH_AXIS_EP = "ep"
+
+# FedProx default proximal term when the optimizer is selected without an
+# explicit mu (shared by every backend so configs train the same objective)
+FEDPROX_DEFAULT_MU = 0.1

@@ -33,7 +33,7 @@ from .sa_message_define import SAMessage
 
 logger = logging.getLogger(__name__)
 
-Q_BITS = 16
+Q_BITS = 16  # default; configs override via secagg_quantize_bits
 
 
 class SecAggServerManager(FedMLCommManager):
@@ -49,6 +49,7 @@ class SecAggServerManager(FedMLCommManager):
 
         sample = jnp.asarray(self.test_global[0][:1])
         self.global_params = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
+        self.q_bits = int(getattr(args, "secagg_quantize_bits", Q_BITS))
         self.online: Dict[int, bool] = {}
         self.pk_table: Dict[int, int] = {}
         self.masked: Dict[int, np.ndarray] = {}
@@ -102,7 +103,7 @@ class SecAggServerManager(FedMLCommManager):
         for v in self.masked.values():
             total = np.mod(total + v, FIELD_PRIME)
         # clients pre-scale by n_i/N, so the field sum IS the weighted mean
-        self.global_params = unflatten_from_finite(total, self.treedef, self.shapes, q_bits=Q_BITS)
+        self.global_params = unflatten_from_finite(total, self.treedef, self.shapes, q_bits=self.q_bits)
         self.masked.clear()
         self.pk_table.clear()
         self.eval_history.append(self._evaluate())
@@ -143,6 +144,7 @@ class SecAggClientManager(FedMLCommManager):
         self.args = args
         self.client_num = client_num
         self.trainer = ModelTrainerCLS(model, args)
+        self.q_bits = int(getattr(args, "secagg_quantize_bits", Q_BITS))
         self.client_index = rank - 1
         self.sk = int(np.random.default_rng(1000 + rank).integers(2, 2**30))
         self.total_samples = float(sum(self.train_num_dict[i] for i in range(client_num)))
@@ -191,7 +193,7 @@ class SecAggClientManager(FedMLCommManager):
 
         w = self.trainer.get_model_params()
         scaled = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float64) * (n / self.total_samples), w)
-        z, treedef, shapes = flatten_to_finite(scaled, q_bits=Q_BITS)
+        z, treedef, shapes = flatten_to_finite(scaled, q_bits=self.q_bits)
         self._pending_train = {"z": z, "treedef": treedef, "shapes": shapes, "n": n}
 
     def _on_pks(self, msg: Message) -> None:

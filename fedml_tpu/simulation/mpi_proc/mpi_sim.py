@@ -62,9 +62,11 @@ class MPIProcessSimulator:
                 "use backend 'sp' or 'XLA' for the algorithm zoo"
             )
         if opt == "fedprox" and not float(getattr(args, "proximal_mu", 0) or 0):
-            # match the sp FedProxAPI default, or the engine hook never
-            # installs and FedProx silently degrades to FedAvg
-            args.proximal_mu = 0.1
+            # shared default (constants.FEDPROX_DEFAULT_MU), or the engine
+            # hook never installs and FedProx silently degrades to FedAvg
+            from ...constants import FEDPROX_DEFAULT_MU
+
+            args.proximal_mu = FEDPROX_DEFAULT_MU
         from ...core.security.fedml_attacker import FedMLAttacker
         from ...core.security.fedml_defender import FedMLDefender
 
@@ -193,10 +195,13 @@ class MPIProcessSimulator:
         return self.train()
 
 
-def _rank_entry(cfg: Dict[str, Any], rank: int, world: int, port: int, q) -> None:
+def _rank_entry(cfg: Dict[str, Any], rank: int, world: int, port: int, q,
+                joined) -> None:
     """Child-process entry: rebuild args/data/model from the config dict
     (spawn-safe) and run one rank.  Honors FEDML_FORCE_CPU=1 (test harness:
-    the axon sitecustomize would otherwise init the TPU tunnel per child)."""
+    the axon sitecustomize would otherwise init the TPU tunnel per child).
+    ``joined`` (mp.Event) is set once this rank's ProcessGroup rendezvous
+    succeeded — the parent's retry logic keys on it."""
     import os
 
     if os.environ.get("FEDML_FORCE_CPU") == "1":
@@ -214,9 +219,16 @@ def _rank_entry(cfg: Dict[str, Any], rank: int, world: int, port: int, q) -> Non
     args.pg_master_port = port
     dataset, out_dim = fedml_tpu.data.load(args)
     model = fedml_tpu.models.create(args, out_dim)
-    sim = MPIProcessSimulator(args, dataset, model)
+    sim = MPIProcessSimulator(args, dataset, model)  # PG joins in here
+    joined.set()
     metrics = sim.train()
     q.put((rank, metrics))
+
+
+class _RanksDiedError(RuntimeError):
+    def __init__(self, msg: str, rendezvous_done: bool):
+        super().__init__(msg)
+        self.rendezvous_done = rendezvous_done
 
 
 def run_mpi_simulation(config: Dict[str, Any], world_size: int, port: int = 0,
@@ -233,17 +245,14 @@ def run_mpi_simulation(config: Dict[str, Any], world_size: int, port: int = 0,
     port up to ``retries`` times; pass an explicit reserved ``port`` for
     deterministic placement."""
     for attempt in range(int(retries) + 1):
-        t0 = time.time()
         try:
             return _run_once(config, world_size, port, deadline_s)
-        except RuntimeError:
-            # only a crash in the RENDEZVOUS window smells like a port race;
-            # a world that died mid-training is a real failure — re-spawning
-            # it would triple time-to-failure and bury the actual traceback
-            rendezvous_window = float(
-                config.get("comm_args", {}).get("pg_timeout", 60.0)) + 30.0
-            if (attempt == retries or port != 0
-                    or time.time() - t0 > rendezvous_window):
+        except _RanksDiedError as e:
+            # only a crash BEFORE every rank finished rendezvous smells like
+            # a port race; a world that died mid-training is a real failure —
+            # re-spawning it would triple time-to-failure and bury the
+            # actual traceback
+            if attempt == retries or port != 0 or e.rendezvous_done:
                 raise
             logger.warning("mpi ranks died during rendezvous (possible port "
                            "race); retrying on a fresh port")
@@ -264,8 +273,10 @@ def _run_once(config: Dict[str, Any], world_size: int, port: int,
         s.close()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
+    joined = [ctx.Event() for _ in range(world_size)]
     procs = [
-        ctx.Process(target=_rank_entry, args=(config, r, world_size, port, q))
+        ctx.Process(target=_rank_entry,
+                    args=(config, r, world_size, port, q, joined[r]))
         for r in range(world_size)
     ]
     for p in procs:
@@ -283,7 +294,10 @@ def _run_once(config: Dict[str, Any], world_size: int, port: int,
                 if dead:
                     # fail FAST on a crashed rank instead of starving on the
                     # queue until the deadline
-                    raise RuntimeError(f"mpi rank process(es) died: {dead}")
+                    raise _RanksDiedError(
+                        f"mpi rank process(es) died: {dead}",
+                        rendezvous_done=all(e.is_set() for e in joined),
+                    )
                 if time.time() > deadline:
                     raise TimeoutError("mpi simulation timed out")
     finally:

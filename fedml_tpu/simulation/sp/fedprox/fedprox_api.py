@@ -14,5 +14,7 @@ from ..fedavg.fedavg_api import FedAvgAPI
 class FedProxAPI(FedAvgAPI):
     def __init__(self, args, device, dataset, model):
         if not float(getattr(args, "proximal_mu", 0.0) or 0.0):
-            args.proximal_mu = 0.1  # sensible default when FedProx selected
+            from ....constants import FEDPROX_DEFAULT_MU
+
+            args.proximal_mu = FEDPROX_DEFAULT_MU
         super().__init__(args, device, dataset, model)

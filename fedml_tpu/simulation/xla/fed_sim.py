@@ -644,9 +644,12 @@ class XLASimulator:
                             dstate,
                         )
                     )
-                    if self.dlg_attacked:
+                    if self.dlg_attacked and round_idx % max(
+                        1, int(getattr(self.args, "dlg_frequency", 1))
+                    ) == 0:
                         # privacy attack: reconstruct a batch from ONE
-                        # intercepted update (a single model-size host pull)
+                        # intercepted update (a single model-size host pull;
+                        # dlg_frequency gates the ~dlg_steps-GD cost per round)
                         bad = set(attacker.get_byzantine_idxs(self.num_clients))
                         victims = [int(i) for i in real_sel
                                    if int(ids[i]) in bad] or [int(real_sel[0])]

@@ -53,7 +53,8 @@ def test_simulation_example(cfg):
 
 @pytest.mark.parametrize(
     "cfg",
-    [c for c in _all_configs("cross_silo") if "lightsecagg" not in c],
+    [c for c in _all_configs("cross_silo")
+     if "lightsecagg" not in c and "secagg" not in c],  # own protocol harnesses
     ids=lambda p: p.split(os.sep)[-2],
 )
 def test_cross_silo_example(cfg, tmp_path):
@@ -121,3 +122,20 @@ def test_lightsecagg_example():
         lambda a, out_dim: fedml_tpu.models.create(a, out_dim),
     )
     assert history
+
+
+def test_secagg_example():
+    from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+
+    LoopbackHub.reset()
+    cfg = os.path.join(EXAMPLES, "cross_silo", "secagg_mnist_lr", "fedml_config.yaml")
+    args = _load(cfg, run_id="ex-sa")
+    args = fedml_tpu.init(args, should_init_logs=False)
+    from fedml_tpu.cross_silo.secagg import run_secagg_topology_in_threads
+
+    history = run_secagg_topology_in_threads(
+        args,
+        lambda a: fedml_tpu.data.load(a),
+        lambda a, out_dim: fedml_tpu.models.create(a, out_dim),
+    )
+    assert history and history[-1]["test_acc"] > 0.2
