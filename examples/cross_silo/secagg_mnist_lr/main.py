@@ -1,26 +1,19 @@
 """SecAgg cross-silo example: pairwise-masked aggregation — the server only
-ever sees the masked sum (reference Octopus SecAgg scenario).
-
+ever sees the masked sum (reference Octopus SecAgg scenario).  Runs the
+full topology in-process:
     python main.py --cf fedml_config.yaml
 """
-import sys
-
-import yaml
-
 import fedml_tpu
-from fedml_tpu.arguments import Arguments
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu.constants import FEDML_TRAINING_PLATFORM_CROSS_SILO
+from fedml_tpu.cross_silo.secagg import run_secagg_topology_in_threads
 
 if __name__ == "__main__":
-    cf = "fedml_config.yaml"
-    if "--cf" in sys.argv:
-        cf = sys.argv[sys.argv.index("--cf") + 1]
-    with open(cf) as f:
-        args = fedml_tpu.init(Arguments.from_dict(yaml.safe_load(f)).validate(),
-                              should_init_logs=False)
-    from fedml_tpu.cross_silo.secagg import run_secagg_topology_in_threads
-
+    args = load_arguments(FEDML_TRAINING_PLATFORM_CROSS_SILO)
+    args = fedml_tpu.init(args)
     history = run_secagg_topology_in_threads(
-        args, fedml_tpu.data.load,
+        args,
+        lambda a: fedml_tpu.data.load(a),
         lambda a, out_dim: fedml_tpu.models.create(a, out_dim),
     )
-    print(history[-1] if history else {})
+    print("history:", history)

@@ -148,6 +148,15 @@ class Arguments:
                     "client_num_per_round must be <= client_num_in_total "
                     f"({self.client_num_per_round} > {self.client_num_in_total})"
                 )
+            # selecting FedProx without a mu means "use the default", on
+            # EVERY backend — the engine's proximal hook only installs when
+            # mu > 0, so injecting here (the one chokepoint all backends
+            # pass through) keeps sp/XLA/MPI_PROC training the same objective
+            opt = str(getattr(self, "federated_optimizer", "")).lower()
+            if opt == "fedprox" and not float(getattr(self, "proximal_mu", 0) or 0):
+                from .constants import FEDPROX_DEFAULT_MU
+
+                self.proximal_mu = FEDPROX_DEFAULT_MU
         return self
 
 

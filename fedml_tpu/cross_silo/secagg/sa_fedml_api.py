@@ -36,6 +36,18 @@ logger = logging.getLogger(__name__)
 Q_BITS = 16  # default; configs override via secagg_quantize_bits
 
 
+def _check_q_bits(q_bits: int) -> int:
+    """Quantized weights must fit the 31-bit field with headroom for the
+    n-client sum — out-of-range bits would WRAP under the modulus and
+    silently corrupt the aggregate rather than erroring."""
+    if not 1 <= q_bits <= 24:
+        raise ValueError(
+            f"secagg_quantize_bits={q_bits} out of range [1, 24] "
+            "(field is 31-bit; the client sum needs headroom)"
+        )
+    return q_bits
+
+
 class SecAggServerManager(FedMLCommManager):
     def __init__(self, args, dataset, model, backend: str = "LOOPBACK"):
         client_num = int(getattr(args, "client_num_in_total", 1))
@@ -49,7 +61,7 @@ class SecAggServerManager(FedMLCommManager):
 
         sample = jnp.asarray(self.test_global[0][:1])
         self.global_params = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
-        self.q_bits = int(getattr(args, "secagg_quantize_bits", Q_BITS))
+        self.q_bits = _check_q_bits(int(getattr(args, "secagg_quantize_bits", Q_BITS)))
         self.online: Dict[int, bool] = {}
         self.pk_table: Dict[int, int] = {}
         self.masked: Dict[int, np.ndarray] = {}
@@ -144,7 +156,7 @@ class SecAggClientManager(FedMLCommManager):
         self.args = args
         self.client_num = client_num
         self.trainer = ModelTrainerCLS(model, args)
-        self.q_bits = int(getattr(args, "secagg_quantize_bits", Q_BITS))
+        self.q_bits = _check_q_bits(int(getattr(args, "secagg_quantize_bits", Q_BITS)))
         self.client_index = rank - 1
         self.sk = int(np.random.default_rng(1000 + rank).integers(2, 2**30))
         self.total_samples = float(sum(self.train_num_dict[i] for i in range(client_num)))

@@ -61,12 +61,6 @@ class MPIProcessSimulator:
                 f"backend MPI_PROC supports FedAvg/FedProx, not {opt!r}; "
                 "use backend 'sp' or 'XLA' for the algorithm zoo"
             )
-        if opt == "fedprox" and not float(getattr(args, "proximal_mu", 0) or 0):
-            # shared default (constants.FEDPROX_DEFAULT_MU), or the engine
-            # hook never installs and FedProx silently degrades to FedAvg
-            from ...constants import FEDPROX_DEFAULT_MU
-
-            args.proximal_mu = FEDPROX_DEFAULT_MU
         from ...core.security.fedml_attacker import FedMLAttacker
         from ...core.security.fedml_defender import FedMLDefender
 

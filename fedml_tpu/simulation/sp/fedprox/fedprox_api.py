@@ -13,8 +13,6 @@ from ..fedavg.fedavg_api import FedAvgAPI
 
 class FedProxAPI(FedAvgAPI):
     def __init__(self, args, device, dataset, model):
-        if not float(getattr(args, "proximal_mu", 0.0) or 0.0):
-            from ....constants import FEDPROX_DEFAULT_MU
-
-            args.proximal_mu = FEDPROX_DEFAULT_MU
+        # proximal_mu default injection lives in Arguments.validate (one
+        # chokepoint for every backend)
         super().__init__(args, device, dataset, model)

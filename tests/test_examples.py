@@ -54,7 +54,7 @@ def test_simulation_example(cfg):
 @pytest.mark.parametrize(
     "cfg",
     [c for c in _all_configs("cross_silo")
-     if "lightsecagg" not in c and "secagg" not in c],  # own protocol harnesses
+     if "secagg" not in c],  # (light)secagg: own protocol harnesses below
     ids=lambda p: p.split(os.sep)[-2],
 )
 def test_cross_silo_example(cfg, tmp_path):

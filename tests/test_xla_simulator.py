@@ -255,6 +255,41 @@ class TestInMeshDefense:
                           federated_optimizer="FedNova")
 
 
+class TestDefenseStateCheckpoint:
+    def test_foolsgold_history_survives_resume(self, tmp_path):
+        """Cross-round defense state (foolsgold similarity history) must ride
+        the checkpoint: a resumed run that re-zeroed it would silently
+        re-pardon already-attenuated sybils."""
+        from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+        def build(rounds):
+            args, dataset, model = _build(_args(
+                comm_round=rounds, client_num_per_round=16,
+                client_num_in_total=16,  # full participation: stable slots
+            ))
+            args.enable_defense = True
+            args.defense_type = "foolsgold"
+            args.checkpoint_dir = str(tmp_path / "ckpt")
+            FedMLDefender._defender_instance = None
+            FedMLDefender.get_instance().init(args)
+            return XLASimulator(args, dataset, model)
+
+        try:
+            sim = build(2)
+            sim.train()
+            hist_before = np.asarray(sim._defense_state["fg_hist"])
+            assert np.abs(hist_before).sum() > 0
+            # resume into a fresh simulator: state must come back from disk
+            sim2 = build(3)
+            sim2.train()  # restores round 0-1, runs round 2
+            assert sim2._defense_n == 16
+            hist_after = np.asarray(sim2._defense_state["fg_hist"])
+            # history kept accumulating from the restored value, not from zero
+            assert np.abs(hist_after).sum() > np.abs(hist_before).sum()
+        finally:
+            FedMLDefender._defender_instance = None
+
+
 class TestInMeshAttack:
     """The sp security matrix reproduced on the XLA backend: data poisoning
     stamps at pack time, model attacks run in the stacked security program
