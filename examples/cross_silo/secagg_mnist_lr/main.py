@@ -13,7 +13,7 @@ if __name__ == "__main__":
     args = fedml_tpu.init(args)
     history = run_secagg_topology_in_threads(
         args,
-        lambda a: fedml_tpu.data.load(a),
+        fedml_tpu.data.load,
         lambda a, out_dim: fedml_tpu.models.create(a, out_dim),
     )
     print("history:", history)

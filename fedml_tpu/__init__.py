@@ -40,6 +40,12 @@ def init(args: Arguments | None = None, should_init_logs: bool = True) -> Argume
     init security/DP singletons, per-platform setup."""
     if args is None:
         args = load_arguments()
+    if hasattr(args, "validate"):
+        # validation is part of init, not an optional extra step: config
+        # errors must surface HERE, and validate() also injects
+        # cross-backend defaults (e.g. FedProx's mu) that every launch
+        # path must see.  Idempotent, so pre-validated args are fine.
+        args.validate(for_training=bool(getattr(args, "training_type", None)))
     if should_init_logs:
         logging.basicConfig(
             level=logging.INFO, format="[%(asctime)s %(name)s] %(message)s"

@@ -36,14 +36,18 @@ logger = logging.getLogger(__name__)
 Q_BITS = 16  # default; configs override via secagg_quantize_bits
 
 
-def _check_q_bits(q_bits: int) -> int:
-    """Quantized weights must fit the 31-bit field with headroom for the
+def _check_q_bits(q_bits: int, n_clients: int) -> int:
+    """Quantized weights must fit the 31-bit field WITH headroom for the
     n-client sum — out-of-range bits would WRAP under the modulus and
     silently corrupt the aggregate rather than erroring."""
-    if not 1 <= q_bits <= 24:
+    import math
+
+    headroom = math.ceil(math.log2(max(int(n_clients), 1) + 1))
+    limit = 31 - headroom
+    if not 1 <= q_bits <= limit:
         raise ValueError(
-            f"secagg_quantize_bits={q_bits} out of range [1, 24] "
-            "(field is 31-bit; the client sum needs headroom)"
+            f"secagg_quantize_bits={q_bits} out of range [1, {limit}] for "
+            f"{n_clients} clients (31-bit field minus {headroom} sum-headroom bits)"
         )
     return q_bits
 
@@ -61,7 +65,9 @@ class SecAggServerManager(FedMLCommManager):
 
         sample = jnp.asarray(self.test_global[0][:1])
         self.global_params = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
-        self.q_bits = _check_q_bits(int(getattr(args, "secagg_quantize_bits", Q_BITS)))
+        self.q_bits = _check_q_bits(
+            int(getattr(args, "secagg_quantize_bits", Q_BITS)), client_num
+        )
         self.online: Dict[int, bool] = {}
         self.pk_table: Dict[int, int] = {}
         self.masked: Dict[int, np.ndarray] = {}
@@ -156,7 +162,9 @@ class SecAggClientManager(FedMLCommManager):
         self.args = args
         self.client_num = client_num
         self.trainer = ModelTrainerCLS(model, args)
-        self.q_bits = _check_q_bits(int(getattr(args, "secagg_quantize_bits", Q_BITS)))
+        self.q_bits = _check_q_bits(
+            int(getattr(args, "secagg_quantize_bits", Q_BITS)), client_num
+        )
         self.client_index = rank - 1
         self.sk = int(np.random.default_rng(1000 + rank).integers(2, 2**30))
         self.total_samples = float(sum(self.train_num_dict[i] for i in range(client_num)))
