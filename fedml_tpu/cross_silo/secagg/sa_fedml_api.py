@@ -37,13 +37,16 @@ Q_BITS = 16  # default; configs override via secagg_quantize_bits
 
 
 def _check_q_bits(q_bits: int, n_clients: int) -> int:
-    """Quantized weights must fit the 31-bit field WITH headroom for the
-    n-client sum — out-of-range bits would WRAP under the modulus and
-    silently corrupt the aggregate rather than erroring."""
+    """Quantized weights must fit the field's SIGNED range WITH headroom for
+    the n-client sum — out-of-range bits would WRAP under the modulus and
+    silently corrupt the aggregate rather than erroring.  Decoding is signed
+    (transform_finite_to_tensor maps the upper half of the field to negative
+    values), so the usable magnitude is (p-1)/2 ~ 2^30, not the full 31
+    bits: the bound is 30 minus the sum headroom."""
     import math
 
     headroom = math.ceil(math.log2(max(int(n_clients), 1) + 1))
-    limit = 31 - headroom
+    limit = 30 - headroom
     if not 1 <= q_bits <= limit:
         raise ValueError(
             f"secagg_quantize_bits={q_bits} out of range [1, {limit}] for "

@@ -67,4 +67,10 @@ def create_server_aggregator(model, args) -> ServerAggregator:
         from ..trainer.reg_trainer import ModelTrainerReg
 
         return _TrainerEvalAggregator(model, args, ModelTrainerReg)
+    from ..trainer.trainer_creator import _SEG_DATASETS
+
+    if dataset in _SEG_DATASETS:
+        from ..trainer.seg_trainer import ModelTrainerSeg
+
+        return _TrainerEvalAggregator(model, args, ModelTrainerSeg)
     return DefaultServerAggregator(model, args)

@@ -1,0 +1,60 @@
+"""Semantic-segmentation ClientTrainer (reference ``simulation/mpi/fedseg``
+eval protocol / ``app/fedcv/image_segmentation``): per-pixel CE rides the
+engine's "ce" loss (the [B] sample mask broadcasts over the [B, H, W]
+per-pixel loss), eval reports pixel accuracy + dataset-level mean IoU
+accumulated as per-class (intersection, union) counts across batches."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .cls_trainer import ModelTrainerCLS
+
+
+class ModelTrainerSeg(ModelTrainerCLS):
+    loss_kind = "ce"
+
+    def __init__(self, model, args, grad_hook=None):
+        super().__init__(model, args, grad_hook=grad_hook)
+
+        @jax.jit
+        def evaluate(variables, x, masks):
+            import optax
+
+            from ...models.unet import iou_counts
+
+            logits = model.apply(variables, x, train=False).astype(jnp.float32)
+            per = optax.softmax_cross_entropy_with_integer_labels(logits, masks)
+            pred = jnp.argmax(logits, axis=-1)
+            correct = jnp.sum(pred == masks).astype(jnp.float32)
+            inter, union = iou_counts(logits, masks, logits.shape[-1])
+            return (jnp.sum(per), correct, jnp.asarray(masks.size, jnp.float32),
+                    inter, union)
+
+        self._seg_eval = evaluate
+
+    def test(self, test_data, device, args):
+        import numpy as np
+
+        x, masks = test_data
+        bs = 64
+        loss = correct = total = 0.0
+        inter = union = None
+        for s in range(0, len(masks), bs):
+            l, c, t, i, u = self._seg_eval(
+                self.variables, jnp.asarray(x[s:s + bs]), jnp.asarray(masks[s:s + bs])
+            )
+            loss += float(l)
+            correct += float(c)
+            total += float(t)
+            inter = np.asarray(i) if inter is None else inter + np.asarray(i)
+            union = np.asarray(u) if union is None else union + np.asarray(u)
+        present = union > 0
+        miou = float(np.mean(inter[present] / union[present])) if present.any() else 0.0
+        return {
+            "test_correct": correct,  # pixel-correct count
+            "test_loss": loss,
+            "test_total": total,  # pixel count
+            "test_miou": miou,
+        }

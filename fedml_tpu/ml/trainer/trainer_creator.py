@@ -18,6 +18,9 @@ _S2S_DATASETS = {"synthetic_s2s", "cornell_movie_dialogue"}
 _LINKPRED_DATASETS = {"ego_linkpred", "recsys_linkpred"}
 _MTL_DATASETS = {"moleculenet_mtl"}
 _AE_DATASETS = {"iot_anomaly", "nbaiot"}
+# per-pixel CE rides the "ce" engine loss (mask broadcasts over H, W);
+# the seg trainer only changes EVAL (pixel acc + dataset-level mIoU)
+_SEG_DATASETS = {"synthetic_seg", "fets2021", "pascal_voc"}
 
 
 def loss_kind_for_dataset(dataset: str) -> str:
@@ -76,6 +79,10 @@ def create_model_trainer(model, args, grad_hook=None) -> ClientTrainer:
         from .ae_trainer import ModelTrainerAE
 
         return ModelTrainerAE(model, args, grad_hook=grad_hook)
+    if dataset in _SEG_DATASETS:
+        from .seg_trainer import ModelTrainerSeg
+
+        return ModelTrainerSeg(model, args, grad_hook=grad_hook)
     if dataset in _REG_DATASETS:
         from .reg_trainer import ModelTrainerReg
 

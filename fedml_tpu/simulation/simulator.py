@@ -46,6 +46,14 @@ class SimulatorXLA:
             from .xla.split import GKTInMeshAPI
 
             self.sim = GKTInMeshAPI(args, device, dataset, model)
+        elif opt == "fedgan":
+            from .xla.gan_nas import GANInMeshAPI
+
+            self.sim = GANInMeshAPI(args, device, dataset, model)
+        elif opt == "fednas":
+            from .xla.gan_nas import NASInMeshAPI
+
+            self.sim = NASInMeshAPI(args, device, dataset, model)
         else:
             from .xla.fed_sim import XLASimulator
 

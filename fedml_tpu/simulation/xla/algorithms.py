@@ -401,6 +401,9 @@ _REGISTRY = {
     "fedavg": FedAvgInMesh,
     "fedprox": FedAvgInMesh,  # engine grad hook from args.proximal_mu
     "fedsgd": FedAvgInMesh,  # E=1, full batch — configured via args
+    # FedSeg IS FedAvg round-wise (reference simulation/mpi/fedseg); the seg
+    # task head (per-pixel ce + mIoU eval) comes from the dataset family
+    "fedseg": FedAvgInMesh,
     "fedopt": FedOptInMesh,
     "fednova": FedNovaInMesh,
     "scaffold": ScaffoldInMesh,

@@ -1,0 +1,326 @@
+"""In-mesh FedGAN and FedNAS: the generative/search zoo members compiled
+onto the client mesh.
+
+The reference runs both through per-process MPI programs
+(``simulation/mpi/fedgan`` 790 LoC — every client trains its (G, D) pair
+locally, the server FedAvg-aggregates both nets;  ``simulation/mpi/fednas``
+890 LoC — DARTS search steps update weights w AND architecture logits alpha,
+the server averages both).  Here each round is ONE XLA program over the
+``client`` mesh axis, the same shape as the main simulator's round
+(fed_sim.py): sampled clients are sharded over devices, each device scans
+its slots sequentially, local training is a compiled ``fori_loop``, and the
+server aggregate is a weighted ``psum`` riding ICI — for FedGAN the psum
+carries BOTH parameter pytrees (G and D), for FedNAS it carries (w, alpha).
+
+Dispatched from :class:`fedml_tpu.simulation.simulator.SimulatorXLA` for
+``federated_optimizer`` in {fedgan, fednas} — the same configs that pick the
+sp twins (simulation/sp/{fedgan,fednas}) pick these on ``backend: XLA``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from ...utils.metrics import MetricsLogger
+from .fed_sim import shard_map
+from jax.sharding import PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+def _client_mesh(mesh: Mesh = None) -> Mesh:
+    if mesh is not None:
+        return mesh
+    from ...parallel.mesh import create_fl_mesh
+
+    return create_fl_mesh()
+
+
+def _schedule_round(sampled: np.ndarray, counts_all: np.ndarray, n_dev: int):
+    """Balance sampled clients over devices via the shared core/schedule
+    scheduler (the same one the main simulator uses — one balancing
+    implementation to maintain); dummy slots get count 0.  Returns
+    (ids [n_dev*slots], counts [n_dev*slots]) laid out so that
+    reshape(n_dev, slots) gives each device its contiguous schedule."""
+    from ...core.schedule import SeqTrainScheduler
+
+    sizes = [int(counts_all[int(c)]) for c in sampled]
+    ids2d, mask2d, _ = SeqTrainScheduler(n_dev).schedule(sampled, sizes)
+    ids = ids2d.reshape(-1).astype(np.int32)
+    cnt = np.where(mask2d.reshape(-1) > 0, counts_all[ids], 0).astype(np.int32)
+    return ids, cnt
+
+
+class GANInMeshAPI:
+    """Federated GAN with the client axis on the mesh: each slot runs the
+    alternating D/G local loop on its HBM-gathered shard, the weighted psum
+    averages BOTH networks (reference ``simulation/mpi/fedgan`` server)."""
+
+    def __init__(self, args, device, dataset, model=None, mesh: Mesh = None):
+        from ...models.gan import MNISTDiscriminator, MNISTGenerator
+        from .split import _pad_clients
+
+        self.args = args
+        (_, _, _tg, _teg, local_num, local_train, _lt, _cn) = dataset
+        self.num_clients = int(args.client_num_in_total)
+        self.mesh = _client_mesh(mesh)
+        self.n_dev = self.mesh.devices.size
+        self.bs = int(getattr(args, "batch_size", 32))
+        self.latent = int(getattr(args, "gan_latent_dim", 100))
+        self.steps = int(getattr(args, "gan_local_steps", 20))
+        seed = int(getattr(args, "random_seed", 0))
+
+        x_all, _y, self.idx, self.counts, self.padded_n = _pad_clients(
+            local_train, local_num, self.num_clients, self.bs
+        )
+        # tanh range + channel axis, once, on device
+        if x_all.ndim == 3:
+            x_all = x_all[..., None]
+        self.x_all = x_all * 2.0 - 1.0
+
+        self.G, self.D = MNISTGenerator(self.latent), MNISTDiscriminator()
+        key = jax.random.PRNGKey(seed)
+        z0 = jnp.zeros((1, self.latent))
+        self.g_params = self.G.init(key, z0)
+        self.d_params = self.D.init(jax.random.fold_in(key, 1), self.G.apply(self.g_params, z0))
+        lr = float(getattr(args, "learning_rate", 2e-4))
+        g_tx, d_tx = optax.adam(lr, b1=0.5), optax.adam(lr, b1=0.5)
+        self.metrics = MetricsLogger(args)
+        self._rng = jax.random.fold_in(key, 2)
+
+        G, D, bs, latent, steps = self.G, self.D, self.bs, self.latent, self.steps
+
+        def bce(logits, target):
+            return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, target))
+
+        def local_gan(gp, dp, x, n, rng):
+            """Alternating D/G steps on one client's gathered rows; batch i
+            slides over the client's REAL rows only (start mod n-bs)."""
+            g_opt, d_opt = g_tx.init(gp), d_tx.init(dp)
+            span = jnp.maximum(jnp.minimum(n, x.shape[0]) - bs, 1)
+
+            def body(i, carry):
+                gp, dp, g_opt, d_opt, rng = carry
+                rng, kz1, kz2 = jax.random.split(rng, 3)
+                real = jax.lax.dynamic_slice_in_dim(x, (i * bs) % span, bs)
+
+                def d_loss(dp):
+                    fake = G.apply(gp, jax.random.normal(kz1, (bs, latent)))
+                    lr_ = D.apply(dp, real)
+                    lf = D.apply(dp, fake)
+                    return bce(lr_, jnp.ones_like(lr_)) + bce(lf, jnp.zeros_like(lf))
+
+                gd = jax.grad(d_loss)(dp)
+                du, d_opt = d_tx.update(gd, d_opt, dp)
+                dp = optax.apply_updates(dp, du)
+
+                def g_loss(gp):
+                    fake = G.apply(gp, jax.random.normal(kz2, (bs, latent)))
+                    return bce(D.apply(dp, fake), jnp.ones((bs, 1)))
+
+                gg = jax.grad(g_loss)(gp)
+                gu, g_opt = g_tx.update(gg, g_opt, gp)
+                return optax.apply_updates(gp, gu), dp, g_opt, d_opt, rng
+
+            gp, dp, _, _, _ = jax.lax.fori_loop(0, steps, body, (gp, dp, g_opt, d_opt, rng))
+            return gp, dp
+
+        def per_device(gp, dp, x_all, idx_l, counts_l, rngs_l):
+            def one_slot(carry, inp):
+                g_acc, d_acc, wsum = carry
+                idx_row, n, rng = inp
+                x = jnp.take(x_all, idx_row, axis=0)
+                gp2, dp2 = local_gan(gp, dp, x, n, rng)
+                w = n.astype(jnp.float32)
+                g_acc = jax.tree_util.tree_map(lambda a, p: a + w * p, g_acc, gp2)
+                d_acc = jax.tree_util.tree_map(lambda a, p: a + w * p, d_acc, dp2)
+                return (g_acc, d_acc, wsum + w), 0.0
+
+            zeros_g = jax.tree_util.tree_map(jnp.zeros_like, gp)
+            zeros_d = jax.tree_util.tree_map(jnp.zeros_like, dp)
+            (g_acc, d_acc, wsum), _ = jax.lax.scan(
+                one_slot, (zeros_g, zeros_d, 0.0), (idx_l, counts_l, rngs_l)
+            )
+            g_acc = jax.lax.psum(g_acc, "client")
+            d_acc = jax.lax.psum(d_acc, "client")
+            wsum = jnp.maximum(jax.lax.psum(wsum, "client"), 1e-9)
+            new_g = jax.tree_util.tree_map(lambda a: a / wsum, g_acc)
+            new_d = jax.tree_util.tree_map(lambda a: a / wsum, d_acc)
+            return new_g, new_d
+
+        self._round_fn = jax.jit(shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(), P(), P(), P("client"), P("client"), P("client")),
+            out_specs=(P(), P()),
+            check_vma=False,
+        ))
+
+    def train(self) -> Dict[str, Any]:
+        from ...core.sampling import client_sampling
+
+        rounds = int(self.args.comm_round)
+        per_round = int(self.args.client_num_per_round)
+        counts_all = np.asarray(self.counts)
+        last: Dict[str, Any] = {}
+        for r in range(rounds):
+            sampled = client_sampling(r, self.num_clients, per_round)
+            ids, counts = _schedule_round(sampled, counts_all, self.n_dev)
+            self._rng, sub = jax.random.split(self._rng)
+            rngs = jax.random.split(jax.random.fold_in(sub, r), len(ids))
+            self.g_params, self.d_params = self._round_fn(
+                self.g_params, self.d_params, self.x_all,
+                self.idx[jnp.asarray(ids)], jnp.asarray(counts), rngs,
+            )
+            self._rng, sub = jax.random.split(self._rng)
+            fake = self.G.apply(self.g_params, jax.random.normal(sub, (64, self.latent)))
+            d_fake = float(jnp.mean(jax.nn.sigmoid(self.D.apply(self.d_params, fake))))
+            last = {"round": r, "d_fake_score": round(d_fake, 4)}
+            self.metrics.log(last)
+        return last
+
+
+class NASInMeshAPI:
+    """Federated DARTS search on the mesh: each slot runs joint (w, alpha)
+    search steps on its shard (MiLeNAS-style single-level, matching the sp
+    twin), the weighted psum averages BOTH pytrees, and the final genotype is
+    derived host-side (reference ``simulation/mpi/fednas`` round protocol)."""
+
+    def __init__(self, args, device, dataset, model=None, mesh: Mesh = None):
+        from ...models.darts import DARTSNetwork, init_alphas
+        from .split import _pad_clients
+
+        self.args = args
+        (_tn, _ten, _tg, self.test_global, local_num, local_train, _lt,
+         self.class_num) = dataset
+        self.num_clients = int(args.client_num_in_total)
+        self.mesh = _client_mesh(mesh)
+        self.n_dev = self.mesh.devices.size
+        self.bs = int(getattr(args, "batch_size", 32))
+        self.epochs = int(getattr(args, "epochs", 1))
+        seed = int(getattr(args, "random_seed", 0))
+
+        self.x_all, self.y_all, self.idx, self.counts, self.padded_n = _pad_clients(
+            local_train, local_num, self.num_clients, self.bs
+        )
+        self.y_all = self.y_all.astype(jnp.int32)
+
+        self.net = model if isinstance(model, DARTSNetwork) else DARTSNetwork(
+            num_classes=self.class_num
+        )
+        self.alphas = init_alphas(seed)
+        sample = self.x_all[: self.bs]
+        self.params = self.net.init(jax.random.PRNGKey(seed), sample, self.alphas)
+        w_tx = optax.sgd(float(getattr(args, "learning_rate", 0.025)), momentum=0.9)
+        a_tx = optax.adam(float(getattr(args, "arch_learning_rate", 3e-3)))
+        self.metrics = MetricsLogger(args)
+        self.eval_history: List[Dict[str, Any]] = []
+
+        net, bs, epochs = self.net, self.bs, self.epochs
+        steps_per_epoch = self.padded_n // bs
+
+        def local_search(params, alphas, x, y, n):
+            """sp semantics: floor(n/bs) full batches per epoch; steps past a
+            client's real batches leave (w, alpha, opts) untouched."""
+            w_opt, a_opt = w_tx.init(params), a_tx.init(alphas)
+            real_batches = jnp.minimum(n, x.shape[0]) // bs
+
+            def body(i, carry):
+                params, alphas, w_opt, a_opt = carry
+                s = i % steps_per_epoch
+                valid = s < real_batches
+                bx = jax.lax.dynamic_slice_in_dim(x, s * bs, bs)
+                by = jax.lax.dynamic_slice_in_dim(y, s * bs, bs)
+
+                def loss_fn(p, a):
+                    logits = net.apply(p, bx, a)
+                    return jnp.mean(
+                        optax.softmax_cross_entropy_with_integer_labels(logits, by)
+                    )
+
+                gw, ga = jax.grad(loss_fn, argnums=(0, 1))(params, alphas)
+                wu, w_opt2 = w_tx.update(gw, w_opt, params)
+                au, a_opt2 = a_tx.update(ga, a_opt, alphas)
+                sel = lambda new, old: jax.tree_util.tree_map(
+                    lambda a_, b_: jnp.where(valid, a_, b_), new, old
+                )
+                return (sel(optax.apply_updates(params, wu), params),
+                        jnp.where(valid, optax.apply_updates(alphas, au), alphas),
+                        sel(w_opt2, w_opt), sel(a_opt2, a_opt))
+
+            params, alphas, _, _ = jax.lax.fori_loop(
+                0, steps_per_epoch * epochs, body, (params, alphas, w_opt, a_opt)
+            )
+            return params, alphas
+
+        def per_device(params, alphas, x_all, y_all, idx_l, counts_l):
+            def one_slot(carry, inp):
+                p_acc, a_acc, wsum = carry
+                idx_row, n = inp
+                x = jnp.take(x_all, idx_row, axis=0)
+                y = jnp.take(y_all, idx_row, axis=0)
+                p2, a2 = local_search(params, alphas, x, y, n)
+                w = n.astype(jnp.float32)
+                p_acc = jax.tree_util.tree_map(lambda a, p: a + w * p, p_acc, p2)
+                a_acc = a_acc + w * a2
+                return (p_acc, a_acc, wsum + w), 0.0
+
+            zeros_p = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (p_acc, a_acc, wsum), _ = jax.lax.scan(
+                one_slot, (zeros_p, jnp.zeros_like(alphas), 0.0), (idx_l, counts_l)
+            )
+            p_acc = jax.lax.psum(p_acc, "client")
+            a_acc = jax.lax.psum(a_acc, "client")
+            wsum = jnp.maximum(jax.lax.psum(wsum, "client"), 1e-9)
+            return (jax.tree_util.tree_map(lambda a: a / wsum, p_acc), a_acc / wsum)
+
+        self._round_fn = jax.jit(shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(), P("client"), P("client")),
+            out_specs=(P(), P()),
+            check_vma=False,
+        ))
+        self._infer = jax.jit(lambda p, a, x: net.apply(p, x, a))
+
+    def train(self) -> Dict[str, Any]:
+        from ...core.sampling import client_sampling
+        from ...models.darts import derive_architecture
+
+        comm_round = int(self.args.comm_round)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        counts_all = np.asarray(self.counts)
+        last: Dict[str, Any] = {}
+        for round_idx in range(comm_round):
+            sampled = client_sampling(
+                round_idx, self.num_clients, int(self.args.client_num_per_round)
+            )
+            ids, counts = _schedule_round(sampled, counts_all, self.n_dev)
+            self.params, self.alphas = self._round_fn(
+                self.params, self.alphas, self.x_all, self.y_all,
+                self.idx[jnp.asarray(ids)], jnp.asarray(counts),
+            )
+            self.metrics.log({"round": round_idx})
+            if freq > 0 and (round_idx % freq == 0 or round_idx == comm_round - 1):
+                last = self._test_global(round_idx)
+        last["genotype"] = derive_architecture(self.alphas)
+        logger.info("derived architecture: %s", last["genotype"])
+        return last
+
+    def _test_global(self, round_idx: int) -> Dict[str, Any]:
+        x, y = self.test_global
+        correct = total = 0
+        for s in range(0, len(y), 256):
+            logits = self._infer(self.params, self.alphas, jnp.asarray(x[s:s + 256]))
+            correct += int(jnp.sum(jnp.argmax(logits, -1) == jnp.asarray(y[s:s + 256])))
+            total += len(y[s:s + 256])
+        out = {"round": round_idx, "test_acc": round(correct / max(total, 1), 4)}
+        self.eval_history.append(out)
+        self.metrics.log(out)
+        logger.info("fednas in-mesh eval: %s", out)
+        return out

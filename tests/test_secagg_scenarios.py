@@ -97,3 +97,21 @@ def test_lightsecagg_with_dropout():
     history = run_lightsecagg_topology_in_threads(args, _dataset_fn, _model_fn, drop_ranks=[2])
     assert len(history) == 1
     assert history[-1]["test_acc"] > 0.15
+
+
+def test_q_bits_bound_respects_signed_field():
+    """The quantize-bits guard must bound n * 2^q by the SIGNED usable range
+    (p-1)/2 ~ 2^30 — transform_finite_to_tensor decodes the upper half of the
+    field as negatives, so a sum whose magnitude crosses half the field
+    sign-flips silently.  For 2 clients (2-bit headroom) the limit is 28."""
+    import pytest
+
+    from fedml_tpu.cross_silo.secagg.sa_fedml_api import _check_q_bits
+
+    assert _check_q_bits(28, 2) == 28
+    with pytest.raises(ValueError):
+        _check_q_bits(29, 2)  # would fit 31 bits but not the signed range
+    # growing the cohort costs headroom bits
+    assert _check_q_bits(23, 100) == 23
+    with pytest.raises(ValueError):
+        _check_q_bits(24, 100)
