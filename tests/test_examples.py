@@ -48,7 +48,8 @@ def test_simulation_example(cfg):
     from fedml_tpu.runner import FedMLRunner
 
     metrics = FedMLRunner(args, device, dataset, model).run()
-    assert metrics and "test_acc" in metrics
+    # FedGAN reports adversarial health (d_fake_score), not accuracy
+    assert metrics and ("test_acc" in metrics or "d_fake_score" in metrics)
 
 
 @pytest.mark.parametrize(
