@@ -195,15 +195,42 @@ def _norm(args: Any) -> str:
     return str(getattr(args, "model_norm", "gn")).lower()
 
 
-def _dtype(args: Any):
-    """Compute dtype from ``args.compute_dtype`` — 'bf16' runs activations
-    and MXU passes in bfloat16 while parameters stay fp32 (mixed precision:
-    halves HBM traffic on the usual bandwidth-bound TPU regime)."""
+def _parse_dtype(name: str, arg_name: str):
+    """One dtype-string table for every dtype knob (compute/storage)."""
     import jax.numpy as jnp
 
-    name = str(getattr(args, "compute_dtype", "fp32") or "fp32").lower()
     if name in ("fp32", "float32"):
         return jnp.float32
     if name in ("bf16", "bfloat16"):
         return jnp.bfloat16
-    raise ValueError(f"unknown compute_dtype {name!r} (use fp32 or bf16)")
+    raise ValueError(f"unknown {arg_name} {name!r} (use fp32 or bf16)")
+
+
+def _dtype(args: Any):
+    """Compute dtype from ``args.compute_dtype`` — 'bf16' runs activations
+    and MXU passes in bfloat16 while parameters stay fp32 (mixed precision:
+    halves HBM traffic on the usual bandwidth-bound TPU regime)."""
+    return _parse_dtype(
+        str(getattr(args, "compute_dtype", "fp32") or "fp32").lower(), "compute_dtype"
+    )
+
+
+def data_storage_dtype(args: Any):
+    """HBM storage dtype for the simulator's packed dataset (fed_sim
+    _pack_data).  The per-step row gather from the HBM-resident dataset is
+    the measured #1 cost of the compiled round (PERF.md term 1) and it is
+    bandwidth-bound, so the stored element width IS the gather cost.  When
+    the model's entry cast sends the batch to bf16 anyway (compute_dtype
+    bf16 + a model that plumbs it), storing bf16 halves that traffic with
+    bitwise-identical model input: bf16(gather(fp32_x)) == gather(bf16_x).
+    ``args.xla_data_dtype`` in {auto, fp32, bf16} overrides; 'auto' (default)
+    applies exactly the condition under which the numerics cannot change."""
+    import jax.numpy as jnp
+
+    req = str(getattr(args, "xla_data_dtype", "auto") or "auto").lower()
+    if req != "auto":
+        return _parse_dtype(req, "xla_data_dtype")
+    name = str(getattr(args, "model", "lr")).lower()
+    if _dtype(args) is jnp.bfloat16 and name in _BF16_MODELS:
+        return jnp.bfloat16
+    return jnp.float32

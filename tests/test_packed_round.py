@@ -316,3 +316,33 @@ class TestStepScheduling:
         quantum = max(1, -(-sim.s_max // 8))
         expect = min(-(-s_used // quantum) * quantum, sim.s_max)
         assert sim._s_bucket == expect, (sim._s_bucket, expect, s_used, sim.s_max)
+
+
+class TestDataStorageDtype:
+    def test_bf16_storage_matches_fp32_storage(self):
+        """Under bf16 compute the model's entry cast makes a stored-bf16
+        gather bitwise-identical to gather-then-cast of fp32 storage, so
+        halving the dataset's HBM footprint/gather traffic must not change
+        the round outputs at all."""
+        outs = {}
+        for store in ("fp32", "bf16"):
+            args, dataset, model = _build(_args(
+                dataset="cifar10", model="resnet20", compute_dtype="bf16",
+                xla_data_dtype=store, synthetic_train_size=256,
+                client_num_in_total=4, client_num_per_round=4,
+                comm_round=2, epochs=1, batch_size=16,
+                frequency_of_the_test=0,
+            ))
+            sim = XLASimulator(args, dataset, model)
+            assert str(sim.x_all.dtype) == ("bfloat16" if store == "bf16" else "float32")
+            sim.train()
+            outs[store] = [np.asarray(l) for l in jax.tree_util.tree_leaves(sim.variables)]
+        for a, b in zip(outs["fp32"], outs["bf16"]):
+            np.testing.assert_allclose(a, b, rtol=0, atol=0)
+
+    def test_auto_keeps_fp32_for_unplumbed_models(self):
+        """'auto' must not downcast the dataset for models that ignore
+        compute_dtype (they'd consume degraded fp32 inputs)."""
+        args, dataset, model = _build(_args(compute_dtype="bf16"))  # lr model
+        sim = XLASimulator(args, dataset, model)
+        assert str(sim.x_all.dtype) == "float32"
