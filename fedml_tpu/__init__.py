@@ -80,6 +80,29 @@ def init(args: Arguments | None = None, should_init_logs: bool = True) -> Argume
         )
         _logger.info("jax.distributed up: proc %d/%s via %s", pid, n_proc, coord)
 
+    # multi-process-silo cross-silo: a launcher (torchrun-style or the
+    # example main.py spawner) places each silo process by env — parse it
+    # HERE so one config file serves every process of the silo (reference
+    # init_cross_silo_hierarchical reads the torchrun env the same way,
+    # __init__.py:217,228-246).  Gated on the platform, NOT on
+    # scenario=='hierarchical': the adapter's pg plane activates on
+    # n_proc_in_silo > 1 for any scenario, and n_proc itself may arrive by
+    # env.  Explicit args keys win over env; empty env values are ignored.
+    if str(getattr(args, "training_type", "")) == "cross_silo":
+        for attr, envs in (
+            ("proc_rank_in_silo", ("FEDML_PROC_RANK_IN_SILO", "LOCAL_RANK")),
+            ("n_proc_in_silo", ("FEDML_N_PROC_IN_SILO", "LOCAL_WORLD_SIZE")),
+        ):
+            if getattr(args, attr, None) is None:
+                for e in envs:
+                    if os.environ.get(e):
+                        setattr(args, attr, int(os.environ[e]))
+                        break
+        if getattr(args, "pg_master_address", None) is None and os.environ.get("MASTER_ADDR"):
+            args.pg_master_address = os.environ["MASTER_ADDR"]
+        if getattr(args, "pg_master_port", None) is None and os.environ.get("MASTER_PORT"):
+            args.pg_master_port = int(os.environ["MASTER_PORT"])
+
     seed = int(getattr(args, "random_seed", 0))
     _random.seed(seed)
     _np.random.seed(seed)

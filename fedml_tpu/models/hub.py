@@ -215,7 +215,7 @@ def _dtype(args: Any):
     )
 
 
-def data_storage_dtype(args: Any):
+def data_storage_dtype(args: Any, module: Any = None):
     """HBM storage dtype for the simulator's packed dataset (fed_sim
     _pack_data).  The per-step row gather from the HBM-resident dataset is
     the measured #1 cost of the compiled round (PERF.md term 1) and it is
@@ -231,6 +231,12 @@ def data_storage_dtype(args: Any):
     if req != "auto":
         return _parse_dtype(req, "xla_data_dtype")
     name = str(getattr(args, "model", "lr")).lower()
-    if _dtype(args) is jnp.bfloat16 and name in _BF16_MODELS:
-        return jnp.bfloat16
-    return jnp.float32
+    if _dtype(args) is not jnp.bfloat16 or name not in _BF16_MODELS:
+        return jnp.float32
+    # key the guarantee off the ACTUAL module in use, not just the config
+    # name: a user-supplied custom module (FedMLRunner accepts any flax
+    # module) has no hub-made entry-cast promise — only downcast when the
+    # module itself declares bf16 compute (the hub models' dtype field)
+    if module is not None and getattr(module, "dtype", None) is not jnp.bfloat16:
+        return jnp.float32
+    return jnp.bfloat16

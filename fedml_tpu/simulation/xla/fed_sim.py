@@ -198,7 +198,7 @@ class XLASimulator:
         # bf16 storage halves the per-step gather traffic (the measured #1
         # round cost) whenever the model casts its input to bf16 anyway —
         # the gathered batch is then bitwise-identical to the fp32 path
-        x_dtype = data_storage_dtype(self.args)
+        x_dtype = data_storage_dtype(self.args, self.module)
         self.x_all = jnp.asarray(np.concatenate(xs, 0), dtype=x_dtype)
         self.y_all = jnp.asarray(np.concatenate(ys, 0))
         logger.info(

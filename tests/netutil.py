@@ -1,0 +1,12 @@
+"""Shared test networking helpers."""
+
+import socket
+
+
+def free_port() -> int:
+    """An ephemeral localhost port (bind 0, read, release)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -5,21 +5,13 @@ built on it (reference fedml_client_slave_manager.py)."""
 
 import multiprocessing as mp
 import pickle
-import socket
 import time
 
 import numpy as np
 import pytest
+from netutil import free_port as _free_port
 
 from fedml_tpu.core.distributed.collective import ProcessGroup
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _force_child_cpu():

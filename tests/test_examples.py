@@ -58,7 +58,9 @@ def test_simulation_example(cfg):
 @pytest.mark.parametrize(
     "cfg",
     [c for c in _all_configs("cross_silo")
-     if "secagg" not in c],  # (light)secagg: own protocol harnesses below
+     # (light)secagg: own protocol harnesses below; hierarchical: needs
+     # spawned silo slave processes (test_hierarchical_cross_silo_example)
+     if "secagg" not in c and "hierarchical" not in c],
     ids=lambda p: p.split(os.sep)[-2],
 )
 def test_cross_silo_example(cfg, tmp_path):
@@ -99,6 +101,83 @@ def test_cross_silo_example(cfg, tmp_path):
     finally:
         if broker is not None:
             broker.stop()
+
+
+def _hier_slave_proc(cfg_path, rank, pg_port, run_id):
+    """One silo slave process: joins the silo's host pg, trains stride-shards
+    until FINISH.  Spawned children skip conftest, so force CPU first."""
+    import os as _os
+
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    from fedml_tpu.utils.platform import force_cpu_backend
+
+    force_cpu_backend()
+    import yaml as _yaml
+
+    import fedml_tpu as _f
+    from fedml_tpu.arguments import Arguments as _Args
+
+    with open(cfg_path) as f:
+        cfg = _yaml.safe_load(f)
+    args = _Args.from_dict(cfg)
+    args.role, args.rank, args.run_id = "client", rank, run_id
+    args.proc_rank_in_silo = 1
+    args.pg_master_port = pg_port
+    args = _f.init(args.validate(), should_init_logs=False)
+    ds, out_dim = _f.data.load(args)
+    from fedml_tpu.cross_silo.client.client import Client as _Client
+
+    _Client(args, None, ds, _f.models.create(args, out_dim)).run()
+
+
+def test_hierarchical_cross_silo_example():
+    """Hierarchical Octopus: 1 server + 2 client silos over GRPC, each silo
+    = master thread + one spawned slave process synchronized over the host
+    ProcessGroup plane (reference torchrun-spawned ClientSlaveManager)."""
+    import multiprocessing as mp
+
+    from netutil import free_port
+
+    cfg = os.path.join(EXAMPLES, "cross_silo", "hierarchical_fedavg_mnist_lr",
+                       "fedml_config.yaml")
+    run_id = "ex-hier"
+    args_s = _load(cfg, role="server", rank=0, run_id=run_id)
+    args_s = fedml_tpu.init(args_s, should_init_logs=False)
+    dataset, out_dim = fedml_tpu.data.load(args_s)
+    model = fedml_tpu.models.create(args_s, out_dim)
+    from fedml_tpu.cross_silo.server.server import Server
+
+    server = Server(args_s, None, dataset, model)
+
+    ctx = mp.get_context("spawn")
+    pg_ports = {rank: free_port() for rank in (1, 2)}
+    slaves = [ctx.Process(target=_hier_slave_proc,
+                          args=(cfg, rank, pg_ports[rank], run_id), daemon=True)
+              for rank in (1, 2)]
+    for p in slaves:
+        p.start()
+
+    masters = []
+    for rank in (1, 2):
+        args_c = _load(cfg, role="client", rank=rank, run_id=run_id,
+                       proc_rank_in_silo=0, pg_master_port=pg_ports[rank])
+        args_c = fedml_tpu.init(args_c, should_init_logs=False)
+        ds_c, od_c = fedml_tpu.data.load(args_c)
+        from fedml_tpu.cross_silo.client.client import Client
+
+        masters.append(Client(args_c, None, ds_c, fedml_tpu.models.create(args_c, od_c)))
+
+    threads = [threading.Thread(target=c.run, daemon=True) for c in masters]
+    for t in threads:
+        t.start()
+    history = server.run()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    for p in slaves:
+        p.join(timeout=60)
+        assert p.exitcode == 0
+    assert history and 0.0 <= history[-1]["test_acc"] <= 1.0
 
 
 def test_cross_device_example(tmp_path):
