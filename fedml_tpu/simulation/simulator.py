@@ -54,6 +54,10 @@ class SimulatorXLA:
             from .xla.gan_nas import NASInMeshAPI
 
             self.sim = NASInMeshAPI(args, device, dataset, model)
+        elif opt == "decentralized_fl":
+            from .xla.decentralized import DecentralizedInMeshAPI
+
+            self.sim = DecentralizedInMeshAPI(args, device, dataset, model)
         else:
             from .xla.fed_sim import XLASimulator
 

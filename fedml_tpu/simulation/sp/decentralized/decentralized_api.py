@@ -50,6 +50,10 @@ class DecentralizedFLAPI(FedAvgAPI):
         slot = self.client_list[0]
         last: Dict[str, Any] = {}
         for round_idx in range(comm_round):
+            # deterministic per-round RNG stream (same contract as the
+            # FedAvgAPI loop): without this every round replays the round-0
+            # shuffle/dropout keys
+            self.trainer.round_idx = round_idx
             trained: List[Any] = []
             for cid in range(n):
                 slot.update_local_dataset(

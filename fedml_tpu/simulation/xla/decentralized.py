@@ -1,0 +1,193 @@
+"""In-mesh decentralized (gossip) FL: the whole serverless round — every
+node's local training AND the neighbor mixing — compiles into ONE XLA
+program over the ``client`` mesh axis.
+
+The reference runs decentralized FL as per-process actors exchanging
+neighbor messages (``simulation/sp/decentralized``, topology managers
+``core/distributed/topology/symmetric_topology_manager.py:21-56``).  Here
+node models live in a stacked HBM table sharded over the mesh; a round is:
+
+* per-device ``lax.scan`` over its node slots — each node trains ITS OWN
+  params on its shard via the shared engine (ml/engine/train.py), so the
+  local step math is identical to every other backend;
+* the gossip exchange: one ``all_gather`` of the freshly-trained node stack
+  along the ``client`` axis (XLA lowers it to a ppermute ring over ICI —
+  the physical neighbor exchange), then each device applies its rows of the
+  row-normalized mixing matrix as a single matmul.  Works for ANY topology
+  the managers emit (ring + Watts-Strogatz rewires), not just the ring;
+* consensus (plain node mean, the sp twin's evaluation model) comes out of
+  the same program via ``psum``.
+
+Equivalence: with a shared topology seed the mix matrix matches the sp
+twin's, per-node keys are the same pure function of (seed, round, node id)
+as ModelTrainerCLS (cls_trainer.py:70-72), and the engine masks padding, so
+the in-mesh round reproduces sp results exactly when padded shapes agree
+(tests/test_xla_decentralized.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.distributed.topology.topology_manager import SymmetricTopologyManager
+from ...ml.engine.train import build_local_train, init_variables
+from ...utils.metrics import MetricsLogger
+from .fed_sim import shard_map
+
+logger = logging.getLogger(__name__)
+
+
+class DecentralizedInMeshAPI:
+    def __init__(self, args, device, dataset, model=None, mesh: Mesh = None):
+        from ...ml.trainer.trainer_creator import loss_kind_for_dataset
+        from .split import _pad_clients
+
+        self.args = args
+        (_tn, _ten, _tg, self.test_global, local_num, local_train, _lt,
+         self.class_num) = dataset
+        self.module = model
+        self.n_nodes = int(args.client_num_in_total)
+        if mesh is None:
+            from ...parallel.mesh import create_fl_mesh
+
+            mesh = create_fl_mesh()
+        self.mesh = mesh
+        self.n_dev = mesh.devices.size
+        self.bs = int(getattr(args, "batch_size", 32))
+        seed = int(getattr(args, "random_seed", 0))
+
+        self.x_all, self.y_all, self.idx, self.counts, self.padded_n = _pad_clients(
+            local_train, local_num, self.n_nodes, self.bs
+        )
+
+        # topology -> row-normalized mixing matrix, padded to the mesh with
+        # identity rows/cols (pad nodes mix only with themselves: inert)
+        self.topo = SymmetricTopologyManager(
+            self.n_nodes, int(getattr(args, "topology_neighbor_num", 2)), seed=seed
+        )
+        self.topo.generate_topology()
+        self.slots = -(-self.n_nodes // self.n_dev)
+        n_pad = self.n_dev * self.slots
+        mix = np.eye(n_pad, dtype=np.float32)
+        mix[: self.n_nodes, : self.n_nodes] = np.asarray(self.topo.topology, np.float32)
+        self.n_pad = n_pad
+
+        # stacked node-model table, every node starting from the same init
+        proto = init_variables(model, jnp.asarray(self.x_all[:1], jnp.float32), seed=seed)
+        shard = NamedSharding(mesh, P("client"))
+        self.table = jax.tree_util.tree_map(
+            lambda p: jax.device_put(
+                jnp.broadcast_to(p, (n_pad,) + p.shape), shard
+            ),
+            proto,
+        )
+        self.consensus = proto
+        pad_ids = np.concatenate(
+            [np.arange(self.n_nodes), np.zeros(n_pad - self.n_nodes, np.int64)]
+        )
+        self._idx_rows = jnp.asarray(np.asarray(self.idx)[pad_ids])
+        self._counts = jnp.asarray(
+            np.where(np.arange(n_pad) < self.n_nodes, np.asarray(self.counts)[pad_ids], 0)
+        )
+        self._mix = jax.device_put(jnp.asarray(mix), shard)  # rows sharded
+        self._real = jnp.asarray((np.arange(n_pad) < self.n_nodes).astype(np.float32))
+
+        loss_kind = loss_kind_for_dataset(str(getattr(args, "dataset", "")).lower())
+        local_train_fn = build_local_train(
+            model, args, self.bs, self.padded_n, loss=loss_kind
+        )
+        n_real = self.n_nodes
+
+        def per_device(table_l, x_all, y_all, idx_l, counts_l, rngs_l, mix_l, real_l):
+            def one_node(carry, inp):
+                lsum, wsum = carry
+                node_vars, idx_row, n_i, rng, real = inp
+                x = jnp.take(x_all, idx_row, axis=0)
+                y = jnp.take(y_all, idx_row, axis=0)
+                result = local_train_fn(node_vars, x, y, n_i, rng)
+                w = n_i.astype(jnp.float32) * real
+                return (lsum + result.loss * w, wsum + w), result.variables
+
+            (lsum, wsum), trained_l = jax.lax.scan(
+                one_node, (0.0, 0.0),
+                (table_l, idx_l, counts_l, rngs_l, real_l),
+            )
+            # the gossip exchange: gather the trained node stack over ICI,
+            # then this device's rows of the mixing matrix in one matmul
+            gathered = jax.tree_util.tree_map(
+                lambda t: jax.lax.all_gather(t, "client", tiled=True), trained_l
+            )
+            new_l = jax.tree_util.tree_map(
+                lambda g: jnp.tensordot(
+                    mix_l, g.astype(jnp.float32).reshape((g.shape[0], -1)), axes=(1, 0)
+                ).reshape((mix_l.shape[0],) + g.shape[1:]),
+                gathered,
+            )
+            # consensus = plain mean over REAL nodes (sp eval model)
+            cons = jax.tree_util.tree_map(
+                lambda nl: jax.lax.psum(
+                    jnp.tensordot(real_l, nl.reshape((nl.shape[0], -1)), axes=(0, 0)),
+                    "client",
+                ).reshape(nl.shape[1:]) / n_real,
+                new_l,
+            )
+            lsum = jax.lax.psum(lsum, "client")
+            wsum = jax.lax.psum(wsum, "client")
+            return new_l, cons, lsum / jnp.maximum(wsum, 1e-9)
+
+        self._round_fn = jax.jit(shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P("client"), P(), P(), P("client"), P("client"),
+                      P("client"), P("client"), P("client")),
+            out_specs=(P("client"), P(), P()),
+            check_vma=False,
+        ))
+        from ...ml.aggregator.aggregator_creator import create_server_aggregator
+
+        self.aggregator = create_server_aggregator(model, args)
+        self.metrics = MetricsLogger(args)
+        self.eval_history: List[Dict[str, Any]] = []
+        self._base_key = jax.random.PRNGKey(seed)
+
+    def train(self) -> Dict[str, Any]:
+        comm_round = int(self.args.comm_round)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        last: Dict[str, Any] = {}
+        for round_idx in range(comm_round):
+            # same pure per-(seed, round, node) key function as the sp
+            # trainers (cls_trainer.py:70-72) — exact-equivalence seam
+            rk = jax.random.fold_in(self._base_key, round_idx)
+            rngs = jax.vmap(lambda i: jax.random.fold_in(rk, i))(
+                jnp.arange(self.n_pad)
+            )
+            self.table, self.consensus, mean_loss = self._round_fn(
+                self.table, self.x_all, self.y_all, self._idx_rows,
+                self._counts, rngs, self._mix, self._real,
+            )
+            self.metrics.log({"round": round_idx, "train_loss": float(mean_loss)})
+            if freq > 0 and (round_idx % freq == 0 or round_idx == comm_round - 1):
+                last = self._test_global(round_idx)
+        return last
+
+    def node_params(self, node_id: int):
+        """One node's current model (host copy) — test/debug surface."""
+        return jax.tree_util.tree_map(lambda t: t[node_id], self.table)
+
+    def _test_global(self, round_idx: int) -> Dict[str, Any]:
+        self.aggregator.set_model_params(self.consensus)
+        stats = self.aggregator.test(self.test_global, None, self.args)
+        out = {
+            "round": round_idx,
+            "test_acc": round(stats["test_correct"] / stats["test_total"], 4),
+            "test_loss": round(stats["test_loss"] / stats["test_total"], 4),
+        }
+        self.eval_history.append(out)
+        self.metrics.log(out)
+        logger.info("decentralized in-mesh eval: %s", out)
+        return out

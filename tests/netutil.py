@@ -1,4 +1,4 @@
-"""Shared test networking helpers."""
+"""Shared test helpers for multi-process/networked tests."""
 
 import socket
 
@@ -10,3 +10,16 @@ def free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def force_child_cpu() -> None:
+    """Force a SPAWNED child onto the CPU backend.  Spawned children don't
+    run conftest: the axon sitecustomize registers the TPU backend in EVERY
+    python process, and jax would otherwise init (and possibly hang on) the
+    tunnel inside the child.  Call FIRST in every spawn target."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from fedml_tpu.utils.platform import force_cpu_backend
+
+    force_cpu_backend()

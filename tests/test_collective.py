@@ -9,21 +9,9 @@ import time
 
 import numpy as np
 import pytest
-from netutil import free_port as _free_port
+from netutil import force_child_cpu as _force_child_cpu, free_port as _free_port
 
 from fedml_tpu.core.distributed.collective import ProcessGroup
-
-
-def _force_child_cpu():
-    """Spawned children don't run conftest: the axon sitecustomize registers
-    the TPU backend in EVERY python process, and jax would otherwise init
-    (and possibly hang on) the tunnel inside the child."""
-    import os
-
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    from fedml_tpu.utils.platform import force_cpu_backend
-
-    force_cpu_backend()
 
 
 def _collective_worker(rank, world, port, q):

@@ -106,12 +106,9 @@ def test_cross_silo_example(cfg, tmp_path):
 def _hier_slave_proc(cfg_path, rank, pg_port, run_id):
     """One silo slave process: joins the silo's host pg, trains stride-shards
     until FINISH.  Spawned children skip conftest, so force CPU first."""
-    import os as _os
+    from netutil import force_child_cpu
 
-    _os.environ["JAX_PLATFORMS"] = "cpu"
-    from fedml_tpu.utils.platform import force_cpu_backend
-
-    force_cpu_backend()
+    force_child_cpu()
     import yaml as _yaml
 
     import fedml_tpu as _f
