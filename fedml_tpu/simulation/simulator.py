@@ -58,6 +58,10 @@ class SimulatorXLA:
             from .xla.decentralized import DecentralizedInMeshAPI
 
             self.sim = DecentralizedInMeshAPI(args, device, dataset, model)
+        elif opt == "hierarchicalfl":
+            from .xla.hierarchical import HierarchicalInMeshAPI
+
+            self.sim = HierarchicalInMeshAPI(args, device, dataset, model)
         else:
             from .xla.fed_sim import XLASimulator
 

@@ -48,6 +48,10 @@ class AsyncFedAvgAPI(FedAvgAPI):
         last: Dict[str, Any] = {}
         while applied < total_updates:
             t, _, cid, v_dispatch = heapq.heappop(events)
+            # deterministic per-update RNG stream (same contract as the
+            # FedAvgAPI loop's per-round round_idx): without this every
+            # update replays client cid's round-0 shuffle/dropout keys
+            self.trainer.round_idx = applied
             slot.update_local_dataset(
                 cid,
                 self.train_data_local_dict[cid],

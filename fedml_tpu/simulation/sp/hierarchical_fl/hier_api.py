@@ -35,6 +35,9 @@ class HierarchicalFLAPI(FedAvgAPI):
         slot = self.client_list[0]
         last: Dict[str, Any] = {}
         for round_idx in range(comm_round):
+            # deterministic per-round RNG stream (same contract as the
+            # FedAvgAPI loop; without this every round replays round-0 keys)
+            self.trainer.round_idx = round_idx
             for g, members in enumerate(self.groups):
                 rng = np.random.RandomState(
                     int(getattr(self.args, "random_seed", 0)) * 100003 + round_idx * 131 + g

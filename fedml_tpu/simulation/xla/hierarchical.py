@@ -1,0 +1,228 @@
+"""In-mesh hierarchical FL: the two-level (client -> group -> global)
+round compiles into one XLA program over the ``client`` mesh axis.
+
+The reference's hierarchical simulator (``simulation/sp/hierarchical_fl``,
+244 LoC; mirrored by our sp twin ``sp/hierarchical_fl/hier_api.py``) runs
+group-local FedAvg rounds and periodically averages group models into a
+global.  Here the sampled clients of ALL groups train in one shard_mapped
+pass — each slot gathers ITS group's current model from a replicated
+``[G, ...]`` group stack — and the group-level aggregation is a one-hot
+(group-id) contraction accumulated through the per-device scan and psum'd
+over ICI: the two reduce levels of the hierarchy collapse into a single
+collective.  On global-sync rounds (every ``group_comm_round``-th) the same
+program also folds the size-weighted global average and resets the group
+stack — a second traced variant, selected host-side (the schedule is
+static per round).
+
+Equivalence: group membership, per-group sampling, and per-(round, client)
+keys reproduce the sp twin bit-for-bit (tests/test_xla_hierarchical.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ...ml.engine.train import build_local_train, init_variables
+from ...utils.metrics import MetricsLogger
+from .fed_sim import shard_map
+from jax.sharding import PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+class HierarchicalInMeshAPI:
+    def __init__(self, args, device, dataset, model=None, mesh: Mesh = None):
+        from ...ml.trainer.trainer_creator import loss_kind_for_dataset
+        from .split import _pad_clients
+
+        self.args = args
+        (_tn, _ten, _tg, self.test_global, local_num, local_train, _lt,
+         self.class_num) = dataset
+        self.module = model
+        self.num_clients = int(args.client_num_in_total)
+        if mesh is None:
+            from ...parallel.mesh import create_fl_mesh
+
+            mesh = create_fl_mesh()
+        self.mesh = mesh
+        self.n_dev = mesh.devices.size
+        self.bs = int(getattr(args, "batch_size", 32))
+        self.seed = int(getattr(args, "random_seed", 0))
+        self.group_num = int(getattr(args, "group_num", 2))
+        self.group_comm_round = int(getattr(args, "group_comm_round", 2))
+
+        self.x_all, self.y_all, self.idx, self.counts, self.padded_n = _pad_clients(
+            local_train, local_num, self.num_clients, self.bs
+        )
+        # same membership draw as the sp twin (exact-equivalence seam)
+        rng = np.random.RandomState(self.seed)
+        ids = rng.permutation(self.num_clients)
+        self.groups = np.array_split(ids, self.group_num)
+        self.group_sizes = jnp.asarray(
+            [float(sum(int(local_num[int(c)]) for c in m)) for m in self.groups]
+        )
+        self.client_group = np.zeros(self.num_clients, np.int32)
+        for g, members in enumerate(self.groups):
+            self.client_group[members] = g
+
+        proto = init_variables(model, jnp.asarray(self.x_all[:1], jnp.float32),
+                               seed=self.seed)
+        self.group_stack = jax.tree_util.tree_map(
+            lambda p: jnp.broadcast_to(p, (self.group_num,) + p.shape), proto
+        )
+        self.w_global = proto
+
+        loss_kind = loss_kind_for_dataset(str(getattr(args, "dataset", "")).lower())
+        local_train_fn = build_local_train(
+            model, args, self.bs, self.padded_n, loss=loss_kind
+        )
+        G = self.group_num
+        group_sizes = self.group_sizes
+
+        def make_per_device(sync: bool):
+            def per_device(group_stack, x_all, y_all, idx_l, counts_l, gids_l, rngs_l):
+                def one_slot(carry, inp):
+                    gacc, gw, lsum = carry
+                    idx_row, n_i, gid, rng = inp
+                    start = jax.tree_util.tree_map(
+                        lambda t: t[gid], group_stack
+                    )
+                    x = jnp.take(x_all, idx_row, axis=0)
+                    y = jnp.take(y_all, idx_row, axis=0)
+                    result = local_train_fn(start, x, y, n_i, rng)
+                    w = n_i.astype(jnp.float32)
+                    hot = jax.nn.one_hot(gid, G) * w  # [G]
+                    # the client->group reduce level: one-hot(group) outer
+                    # product accumulates each group's weighted param sum
+                    gacc = jax.tree_util.tree_map(
+                        lambda a, p: a + hot.reshape((G,) + (1,) * p.ndim)
+                        * p.astype(jnp.float32)[None, ...],
+                        gacc, result.variables,
+                    )
+                    return (gacc, gw + hot, lsum + result.loss * w), 0.0
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((G,) + p.shape[1:], jnp.float32), group_stack
+                )
+                (gacc, gw, lsum), _ = jax.lax.scan(
+                    one_slot, (zeros, jnp.zeros(G), 0.0),
+                    (idx_l, counts_l, gids_l, rngs_l),
+                )
+                gacc = jax.lax.psum(gacc, "client")
+                gw = jax.lax.psum(gw, "client")
+                lsum = jax.lax.psum(lsum, "client")
+                # group models: weighted mean where the group trained, else kept
+                new_stack = jax.tree_util.tree_map(
+                    lambda a, old: jnp.where(
+                        (gw > 0).reshape((G,) + (1,) * (a.ndim - 1)),
+                        a / jnp.maximum(gw, 1e-9).reshape((G,) + (1,) * (a.ndim - 1)),
+                        old.astype(jnp.float32),
+                    ),
+                    gacc, group_stack,
+                )
+                mean_loss = lsum / jnp.maximum(jnp.sum(gw), 1e-9)
+                if not sync:
+                    return new_stack, new_stack, mean_loss  # global slot unused
+                # global sync: size-weighted mean of group models, reset stack
+                wsum = jnp.sum(group_sizes)
+                glob = jax.tree_util.tree_map(
+                    lambda s: jnp.tensordot(group_sizes, s, axes=(0, 0)) / wsum,
+                    new_stack,
+                )
+                reset = jax.tree_util.tree_map(
+                    lambda g_, s: jnp.broadcast_to(g_, s.shape), glob, new_stack
+                )
+                return reset, glob, mean_loss
+
+            return per_device
+
+        specs = dict(
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P("client"), P("client"), P("client"), P("client")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        self._round_fn = jax.jit(shard_map(make_per_device(False), **specs))
+        self._sync_round_fn = jax.jit(shard_map(make_per_device(True), **specs))
+
+        from ...core.schedule import SeqTrainScheduler
+
+        self._scheduler = SeqTrainScheduler(self.n_dev)
+        from ...ml.aggregator.aggregator_creator import create_server_aggregator
+
+        self.aggregator = create_server_aggregator(model, args)
+        self.aggregator.set_model_params(self.w_global)
+        self.metrics = MetricsLogger(args)
+        self.eval_history: List[Dict[str, Any]] = []
+        self._base_key = jax.random.PRNGKey(self.seed)
+
+    def _sample_round(self, round_idx: int) -> np.ndarray:
+        """Per-group draws with the sp twin's exact RandomState streams."""
+        per_group = max(1, int(self.args.client_num_per_round) // self.group_num)
+        chosen: List[int] = []
+        for g, members in enumerate(self.groups):
+            rng = np.random.RandomState(self.seed * 100003 + round_idx * 131 + g)
+            chosen.extend(int(c) for c in rng.choice(
+                members, min(per_group, len(members)), replace=False
+            ))
+        return np.asarray(chosen, np.int64)
+
+    def train(self) -> Dict[str, Any]:
+        comm_round = int(self.args.comm_round)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5))
+        counts_all = np.asarray(self.counts)
+        last: Dict[str, Any] = {}
+        for round_idx in range(comm_round):
+            sampled = self._sample_round(round_idx)
+            sizes = [int(counts_all[c]) for c in sampled]
+            ids2d, mask2d, _ = self._scheduler.schedule(sampled, sizes)
+            ids = ids2d.reshape(-1).astype(np.int64)
+            cnt = np.where(mask2d.reshape(-1) > 0, counts_all[ids], 0).astype(np.int32)
+            gids = self.client_group[ids]
+            rk = jax.random.fold_in(self._base_key, round_idx)
+            rngs = jnp.stack([jax.random.fold_in(rk, int(c)) for c in ids])
+            sync = (round_idx + 1) % self.group_comm_round == 0
+            fn = self._sync_round_fn if sync else self._round_fn
+            self.group_stack, glob, mean_loss = fn(
+                self.group_stack, self.x_all, self.y_all,
+                self.idx[jnp.asarray(ids)], jnp.asarray(cnt),
+                jnp.asarray(gids), rngs,
+            )
+            if sync:
+                # sp twin applies on_after_aggregation at sync (central DP);
+                # if the hook transformed the global, the group reset must
+                # carry the post-hook model too
+                hooked = self.aggregator.on_after_aggregation(glob)
+                if hooked is not glob:
+                    self.group_stack = jax.tree_util.tree_map(
+                        lambda g_, s: jnp.broadcast_to(g_, s.shape),
+                        hooked, self.group_stack,
+                    )
+                self.w_global = hooked
+                self.aggregator.set_model_params(self.w_global)
+            self.metrics.log({"round": round_idx, "train_loss": float(mean_loss)})
+            if freq > 0 and (round_idx % freq == 0 or round_idx == comm_round - 1):
+                last = self._test_global(round_idx)
+        return last
+
+    def group_model(self, g: int):
+        """One group's current model (host copy) — test/debug surface."""
+        return jax.tree_util.tree_map(lambda t: t[g], self.group_stack)
+
+    def _test_global(self, round_idx: int) -> Dict[str, Any]:
+        stats = self.aggregator.test(self.test_global, None, self.args)
+        out = {
+            "round": round_idx,
+            "test_acc": round(stats["test_correct"] / stats["test_total"], 4),
+            "test_loss": round(stats["test_loss"] / stats["test_total"], 4),
+        }
+        self.eval_history.append(out)
+        self.metrics.log(out)
+        logger.info("hierarchical in-mesh eval: %s", out)
+        return out

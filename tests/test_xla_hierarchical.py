@@ -1,0 +1,110 @@
+"""In-mesh hierarchical FL (simulation/xla/hierarchical.py): both reduce
+levels (client -> group -> global) compile into one XLA program; gated by
+exact equivalence against the sp twin."""
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.parallel.mesh import create_fl_mesh
+
+pytestmark = pytest.mark.heavy
+
+
+def _args(**over):
+    base = {
+        "common_args": {"training_type": "simulation", "random_seed": 0, "run_id": "hier"},
+        "data_args": {
+            "dataset": "mnist",
+            "data_cache_dir": "",
+            # homo => equal client sizes => identical padded shapes on both
+            # backends (the exact-equality precondition)
+            "partition_method": "homo",
+            "synthetic_train_size": 512,
+        },
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "HierarchicalFL",
+            "client_num_in_total": 8,
+            "client_num_per_round": 4,
+            "comm_round": 4,
+            "epochs": 1,
+            "batch_size": 16,
+            "client_optimizer": "sgd",
+            "learning_rate": 0.1,
+            "group_num": 2,
+            "group_comm_round": 2,
+        },
+        "validation_args": {"frequency_of_the_test": 1},
+        "comm_args": {"backend": "XLA"},
+    }
+    args = Arguments.from_dict(base)
+    for k, v in over.items():
+        setattr(args, k, v)
+    return args.validate()
+
+
+def _build(**over):
+    args = fedml_tpu.init(_args(**over), should_init_logs=False)
+    dataset, out_dim = fedml_tpu.data.load(args)
+    model = fedml_tpu.models.create(args, out_dim)
+    return args, dataset, model
+
+
+class TestHierarchicalInMesh:
+    def test_matches_sp_twin_exactly(self):
+        """Same membership permutation, same per-group sampling streams,
+        same per-(round, client) keys, same engine: the compiled two-level
+        round must reproduce the sp group loop."""
+        import jax
+
+        from fedml_tpu.simulation.sp.hierarchical_fl.hier_api import HierarchicalFLAPI
+        from fedml_tpu.simulation.xla.hierarchical import HierarchicalInMeshAPI
+
+        args, dataset, model = _build()
+        sp = HierarchicalFLAPI(args, None, dataset, model)
+        sp.train()
+
+        args2, dataset2, model2 = _build()
+        api = HierarchicalInMeshAPI(args2, None, dataset2, model2,
+                                    mesh=create_fl_mesh(4))
+        api.train()
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(api.w_global),
+            jax.tree_util.tree_leaves(sp.w_global),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        # group models agree too (round 4 synced: stack == global)
+        for g in range(2):
+            for a, b in zip(
+                jax.tree_util.tree_leaves(api.group_model(g)),
+                jax.tree_util.tree_leaves(sp.group_models[g]),
+            ):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_groups_diverge_between_syncs(self):
+        import jax
+
+        from fedml_tpu.simulation.xla.hierarchical import HierarchicalInMeshAPI
+
+        # 3 rounds with sync every 2: the last round leaves groups diverged
+        args, dataset, model = _build(comm_round=3)
+        api = HierarchicalInMeshAPI(args, None, dataset, model,
+                                    mesh=create_fl_mesh(4))
+        out = api.train()
+        assert out["test_acc"] > 0.5
+        a = jax.tree_util.tree_leaves(api.group_model(0))
+        b = jax.tree_util.tree_leaves(api.group_model(1))
+        assert any(not np.allclose(np.asarray(x), np.asarray(y)) for x, y in zip(a, b))
+
+    def test_runner_dispatch(self):
+        from fedml_tpu.simulation.simulator import SimulatorXLA
+        from fedml_tpu.simulation.xla.hierarchical import HierarchicalInMeshAPI
+
+        args, dataset, model = _build()
+        sim = SimulatorXLA(args, None, dataset, model)
+        assert isinstance(sim.sim, HierarchicalInMeshAPI)
