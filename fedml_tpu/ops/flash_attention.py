@@ -9,9 +9,11 @@ long contexts aren't VMEM-capped).  Composes with ring attention
 (parallel/ring_attention.py): ring moves K/V shards across chips, this
 kernel does the per-chip block math.
 
-Differentiation: a ``jax.custom_vjp`` whose backward recomputes through the
-fused-XLA reference — exact gradients, O(L²) memory on the backward only (a
-dedicated pallas backward kernel is the planned upgrade).
+Differentiation: a ``jax.custom_vjp`` over dedicated pallas backward
+kernels — the forward additionally emits the per-row log-sum-exp, and the
+backward re-materializes P blockwise from (q, k, lse) in two passes (a dQ
+pass with k innermost, a dK/dV pass with q innermost), so backward memory
+is O(block²) per core like the forward, never the O(L²) probs matrix.
 
 ``interpret=True`` runs the same kernel on CPU (how tests exercise it);
 :func:`attention` picks the kernel on TPU and the fused-XLA reference
@@ -49,7 +51,7 @@ def reference_attention(q, k, v, causal: bool = True):
     return jnp.einsum("bhlm,bmhd->blhd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
                   block_q, block_k, n_kb, causal, scale, valid_len):
     """Grid cell (bh, qi, kj): fold K/V block kj into q block qi's online
     softmax state (scratch persists across the sequential kj dimension)."""
@@ -104,30 +106,50 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     @pl.when(kj == n_kb - 1)
     def _finish():
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-20)[:, None]).astype(o_ref.dtype)
+        # per-row log-sum-exp of the SCALED scores — the softmax statistic
+        # the backward kernels re-materialize P from (-inf for dead rows)
+        l = l_ref[:]
+        m = m_ref[:]
+        lse_ref[0] = jnp.where(
+            l > 0, jnp.where(jnp.isfinite(m), m, 0.0) + jnp.log(jnp.maximum(l, 1e-38)),
+            -jnp.inf,
+        )
 
 
-def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
-    if not _HAS_PALLAS:
-        raise RuntimeError("pallas is unavailable in this jax build; use reference_attention")
+def _pad_geometry(q, block_q, block_k):
     B, L, H, D = q.shape
     block_q = min(block_q, L)
     block_k = min(block_k, L)
     Lp = -(-L // max(block_q, block_k)) * max(block_q, block_k)
+    return B, L, H, D, block_q, block_k, Lp
 
-    def to_bh(x):  # [B, L, H, D] -> [B*H, Lp, D]
-        x = x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
-        if Lp != L:
-            x = jnp.pad(x, ((0, 0), (0, Lp - L), (0, 0)))
-        return x
 
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+def _to_bh(x, B, L, H, D, Lp):  # [B, L, H, D] -> [B*H, Lp, D]
+    x = x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    if Lp != L:
+        x = jnp.pad(x, ((0, 0), (0, Lp - L), (0, 0)))
+    return x
+
+
+def _from_bh(x, B, L, H, D):  # [B*H, Lp, D] -> [B, L, H, D]
+    return x[:, :L].reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                   with_lse: bool = False):
+    if not _HAS_PALLAS:
+        raise RuntimeError("pallas is unavailable in this jax build; use reference_attention")
+    B, L, H, D, block_q, block_k, Lp = _pad_geometry(q, block_q, block_k)
+    qb = _to_bh(q, B, L, H, D, Lp)
+    kb = _to_bh(k, B, L, H, D, Lp)
+    vb = _to_bh(v, B, L, H, D, Lp)
     scale = float(1.0 / (D**0.5))  # python float: traced scalars can't be closed over
     n_kb = Lp // block_k
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_kb=n_kb,
         causal=causal, scale=scale, valid_len=L,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Lp // block_q, n_kb),
         in_specs=[
@@ -135,22 +157,201 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Lp), jnp.float32),
+        ],
         scratch_shapes=_scratch(block_q, D),
         interpret=interpret,
     )(qb, kb, vb)
-    out = out[:, :L]
-    return out.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+    out = _from_bh(out, B, L, H, D)
+    return (out, lse) if with_lse else out
+
+
+def _block_grads(q, k, v, do, lse, delta, qi, kj, *, block_q, block_k, causal,
+                 scale, valid_len):
+    """Shared backward block math: re-materialize this (qi, kj) block's probs
+    P from (q, k, lse) and form dS — used identically by the dQ and dK/dV
+    kernels so the two gradients cannot desynchronize.
+
+    ``lse`` is finite for any q row that attends >=1 live key — which
+    includes padded q-tail rows (the live mask constrains keys, not
+    queries).  Padded-tail GRADIENT correctness therefore rests on dO (and
+    hence delta) being zero-padded by _to_bh, not on lse masking; the
+    isfinite guard only covers rows with no live keys at all (e.g. the
+    first rows of a fully-masked causal block)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    live = (k_pos < valid_len) & jnp.isfinite(lse)[:, None]
+    if causal:
+        live = live & (q_pos >= k_pos)
+    p = jnp.where(live, jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0)[:, None]), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bq, bk]
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, block_q, block_k, n_kb, causal,
+                         scale, valid_len):
+    """Grid cell (bh, qi, kj): accumulate q block qi's gradient over k blocks
+    (sequential innermost kj; acc persists in VMEM scratch)."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    block_live = kj * block_k < valid_len
+    if causal:
+        block_live = jnp.logical_and(block_live, kj * block_k <= (qi + 1) * block_q - 1)
+
+    @pl.when(block_live)
+    def _accum():
+        k = k_ref[0]
+        _, ds = _block_grads(
+            q_ref[0], k, v_ref[0], do_ref[0], lse_ref[0], delta_ref[0], qi, kj,
+            block_q=block_q, block_k=block_k, causal=causal, scale=scale,
+            valid_len=valid_len,
+        )
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == n_kb - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
+                          n_qb, causal, scale, valid_len):
+    """Grid cell (bh, kj, qi): accumulate k/v block kj's gradients over q
+    blocks (sequential innermost qi)."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    block_live = qi * block_q < valid_len
+    if causal:
+        # p is zero wherever q_pos < k_pos: skip q blocks entirely above kj
+        block_live = jnp.logical_and(block_live, (qi + 1) * block_q - 1 >= kj * block_k)
+
+    @pl.when(block_live)
+    def _accum():
+        q = q_ref[0]
+        do = do_ref[0]
+        p, ds = _block_grads(
+            q, k_ref[0], v_ref[0], do, lse_ref[0], delta_ref[0], qi, kj,
+            block_q=block_q, block_k=block_k, causal=causal, scale=scale,
+            valid_len=valid_len,
+        )
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+    """Pallas flash backward: same blockwise structure as the forward — P is
+    re-materialized per block from (q, k, lse), so backward memory is
+    O(block² ) per core instead of the O(L²) probs matrix."""
+    B, L, H, D, block_q, block_k, Lp = _pad_geometry(q, block_q, block_k)
+    qb = _to_bh(q, B, L, H, D, Lp)
+    kb = _to_bh(k, B, L, H, D, Lp)
+    vb = _to_bh(v, B, L, H, D, Lp)
+    dob = _to_bh(g.astype(q.dtype), B, L, H, D, Lp)
+    ob = _to_bh(out, B, L, H, D, Lp)
+    # delta_i = rowsum(dO * O): tiny elementwise pass, fused by XLA
+    delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1)
+    scale = float(1.0 / (D**0.5))
+    n_qb, n_kb = Lp // block_q, Lp // block_k
+    row_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),  # q
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),  # k
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),  # v
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),  # dO
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),        # lse
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),        # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k, n_kb=n_kb,
+            causal=causal, scale=scale, valid_len=L,
+        ),
+        grid=(B * H, n_qb, n_kb),
+        in_specs=row_specs,
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
+        scratch_shapes=[pltpu_vmem((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+    col_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),  # q
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # k
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # v
+        pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),  # dO
+        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),        # lse
+        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),        # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k, n_qb=n_qb,
+            causal=causal, scale=scale, valid_len=L,
+        ),
+        grid=(B * H, n_kb, n_qb),
+        in_specs=col_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
+        ],
+        scratch_shapes=[pltpu_vmem((block_k, D), jnp.float32),
+                        pltpu_vmem((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+    return (_from_bh(dq, B, L, H, D), _from_bh(dk, B, L, H, D),
+            _from_bh(dv, B, L, H, D))
+
+
+def pltpu_vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
 
 
 def _scratch(block_q, D):
-    from jax.experimental.pallas import tpu as pltpu
-
     return [
-        pltpu.VMEM((block_q,), jnp.float32),
-        pltpu.VMEM((block_q,), jnp.float32),
-        pltpu.VMEM((block_q, D), jnp.float32),
+        pltpu_vmem((block_q,), jnp.float32),
+        pltpu_vmem((block_q,), jnp.float32),
+        pltpu_vmem((block_q, D), jnp.float32),
     ]
 
 
@@ -170,15 +371,15 @@ def flash_attention(
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                              with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # exact gradients via the fused-XLA reference (recompute; O(L^2) memory
-    # on the backward pass only — pallas backward kernel is the upgrade path)
-    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                           interpret)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -186,7 +186,7 @@ class HierarchicalInMeshAPI:
             cnt = np.where(mask2d.reshape(-1) > 0, counts_all[ids], 0).astype(np.int32)
             gids = self.client_group[ids]
             rk = jax.random.fold_in(self._base_key, round_idx)
-            rngs = jnp.stack([jax.random.fold_in(rk, int(c)) for c in ids])
+            rngs = jax.vmap(lambda c: jax.random.fold_in(rk, c))(jnp.asarray(ids))
             sync = (round_idx + 1) % self.group_comm_round == 0
             fn = self._sync_round_fn if sync else self._round_fn
             self.group_stack, glob, mean_loss = fn(

@@ -122,6 +122,24 @@ class TestFlashAttentionKernel:
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_kernel_grad_ragged_and_noncausal(self, causal):
+        """The pallas backward kernels must keep exact gradients through the
+        internal pad-to-block path (dead lse rows, padded key tails) and for
+        both mask modes."""
+        q, k, v = _qkv(B=1, L=24, H=2, D=8, seed=17)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal, 16, 16, True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
     def test_ragged_length_padded(self):
         """L not divisible by block size is padded internally."""
         q, k, v = _qkv(B=1, L=24, H=2, D=8, seed=13)
