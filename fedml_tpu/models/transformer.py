@@ -50,11 +50,13 @@ def rope(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0) -> jnp.n
 
 
 def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
-    """Default fused attention: [B, L, H, D] -> [B, L, H, D], causal.
+    """Default attention: [B, L, H, D] -> [B, L, H, D], causal — the pallas
+    flash kernel on TPU (fwd + bwd, ops/flash_attention.py), the fused XLA
+    reference elsewhere.
     Single definition lives in ops (also the pallas kernel's oracle)."""
-    from ..ops.flash_attention import reference_attention
+    from ..ops.flash_attention import attention
 
-    return reference_attention(q, k, v, causal=True)
+    return attention(q, k, v, causal=True)
 
 
 AttentionFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]

@@ -117,10 +117,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
 
 def _pad_geometry(q, block_q, block_k):
+    import math
+
     B, L, H, D = q.shape
     block_q = min(block_q, L)
     block_k = min(block_k, L)
-    Lp = -(-L // max(block_q, block_k)) * max(block_q, block_k)
+    # pad to a common multiple of BOTH blocks: the grid is (Lp//block_q,
+    # Lp//block_k), so a padded length only one block divides would silently
+    # truncate the other axis (keys never folded in / rows never written)
+    m = math.lcm(block_q, block_k)
+    Lp = -(-L // m) * m
     return B, L, H, D, block_q, block_k, Lp
 
 
@@ -307,7 +313,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
         in_specs=row_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
-        scratch_shapes=[pltpu_vmem((block_q, D), jnp.float32)],
+        scratch_shapes=[_vmem((block_q, D), jnp.float32)],
         interpret=interpret,
     )(qb, kb, vb, dob, lse, delta)
     col_specs = [
@@ -333,15 +339,15 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
         ],
-        scratch_shapes=[pltpu_vmem((block_k, D), jnp.float32),
-                        pltpu_vmem((block_k, D), jnp.float32)],
+        scratch_shapes=[_vmem((block_k, D), jnp.float32),
+                        _vmem((block_k, D), jnp.float32)],
         interpret=interpret,
     )(qb, kb, vb, dob, lse, delta)
     return (_from_bh(dq, B, L, H, D), _from_bh(dk, B, L, H, D),
             _from_bh(dv, B, L, H, D))
 
 
-def pltpu_vmem(shape, dtype):
+def _vmem(shape, dtype):
     from jax.experimental.pallas import tpu as pltpu
 
     return pltpu.VMEM(shape, dtype)
@@ -349,9 +355,9 @@ def pltpu_vmem(shape, dtype):
 
 def _scratch(block_q, D):
     return [
-        pltpu_vmem((block_q,), jnp.float32),
-        pltpu_vmem((block_q,), jnp.float32),
-        pltpu_vmem((block_q, D), jnp.float32),
+        _vmem((block_q,), jnp.float32),
+        _vmem((block_q,), jnp.float32),
+        _vmem((block_q, D), jnp.float32),
     ]
 
 
@@ -385,8 +391,23 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _on_tpu() -> bool:
+    """True when the default backend is TPU hardware — including tunneled
+    PJRT plugins whose *platform name* is not literally 'tpu' (the axon
+    backend reports its own name; the device kind still says TPU)."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        d = jax.devices()[0]
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+    return "tpu" in (getattr(d, "platform", "") or "").lower() or (
+        "tpu" in (getattr(d, "device_kind", "") or "").lower()
+    )
+
+
 def attention(q, k, v, causal: bool = True):
     """Dispatch: pallas kernel on TPU, XLA reference elsewhere."""
-    if _HAS_PALLAS and jax.default_backend() == "tpu":
+    if _HAS_PALLAS and _on_tpu():
         return flash_attention(q, k, v, causal=causal)
     return reference_attention(q, k, v, causal=causal)

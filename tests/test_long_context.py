@@ -153,6 +153,18 @@ class TestFlashAttentionKernel:
                                interpret=True)
         np.testing.assert_allclose(np.asarray(outn), np.asarray(refn), atol=2e-5)
 
+    def test_mismatched_block_sizes(self):
+        """block_q != block_k where the smaller does not divide the padded
+        length: geometry must pad to a common multiple, not silently truncate
+        one grid axis (keys never folded in / rows never written)."""
+        q, k, v = _qkv(B=1, L=32, H=1, D=8, seed=19)
+        for bq, bk in ((32, 24), (24, 32)):
+            ref = reference_attention(q, k, v, causal=False)
+            out = flash_attention(q, k, v, causal=False, block_q=bq,
+                                  block_k=bk, interpret=True)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5, err_msg=f"bq={bq} bk={bk}")
+
     def test_transformer_with_flash_attention(self):
         """The kernel slots in as the transformer's attention_fn."""
         from functools import partial
