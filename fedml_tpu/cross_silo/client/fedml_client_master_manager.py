@@ -120,6 +120,9 @@ class ClientMasterManager(FedMLCommManager):
         m = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, receive_id)
         m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, weights)
         m.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
+        # round tag: lets a straggler-tolerant server drop uploads that
+        # arrive after their round was closed by round_timeout_s
+        m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
         self.send_message(m)
 
     def __train(self) -> None:

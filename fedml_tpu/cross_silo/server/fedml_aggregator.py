@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -50,10 +50,28 @@ class FedMLAggregator:
             self.flag_client_model_uploaded_dict[i] = False
         return True
 
-    def aggregate(self):
+    def received_indices(self) -> List[int]:
+        """Silo indices whose model arrived this round (unconsumed flags)."""
+        return [i for i in range(self.client_num)
+                if self.flag_client_model_uploaded_dict.get(i, False)]
+
+    def consume_received(self) -> List[int]:
+        """Straggler-tolerant round close: return the received indices and
+        reset their flags (the partial-aggregation analogue of
+        check_whether_all_receive's reset)."""
+        got = self.received_indices()
+        for i in got:
+            self.flag_client_model_uploaded_dict[i] = False
+        return got
+
+    def aggregate(self, indices: Optional[List[int]] = None):
+        """Weighted aggregate over ``indices`` (default: every silo — the
+        reference's all-received path)."""
         t0 = time.time()
+        if indices is None:
+            indices = list(range(self.client_num))
         raw: List[Tuple[float, Any]] = [
-            (self.sample_num_dict[i], self.model_dict[i]) for i in range(self.client_num)
+            (self.sample_num_dict[i], self.model_dict[i]) for i in indices
         ]
         raw = self.aggregator.on_before_aggregation(raw)
         averaged = self.aggregator.aggregate(raw)

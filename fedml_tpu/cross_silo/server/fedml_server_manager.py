@@ -5,11 +5,20 @@ wait for every client's ONLINE status, push init config (round-0 model +
 assigned client index), then per round: collect models → aggregate → test →
 select next participants → sync model; after the final round send FINISH and
 stop.  Message vocabulary in :mod:`..message_define`.
+
+Beyond-reference: straggler tolerance.  The reference (and our default)
+blocks a round forever on a dead client; setting ``round_timeout_s`` arms a
+per-round timer — on expiry, if at least ``round_timeout_min_clients``
+models arrived, the round closes with the partial cohort (weighted
+aggregate over the received silos) and stale uploads from the previous
+round are dropped by their round tag; with fewer, the timer re-arms and
+waits (aggregating nothing is worse than waiting).
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Any, Dict, List, Optional
 
 from ...core.distributed.comm_manager import FedMLCommManager
@@ -31,6 +40,16 @@ class FedMLServerManager(FedMLCommManager):
         self.client_id_list_in_this_round: List[int] = []
         self.data_silo_index_of_client: Dict[int, int] = {}
         self.eval_history: List[Dict[str, Any]] = []
+        # straggler tolerance (0 = reference semantics: wait forever)
+        self.round_timeout_s = float(getattr(args, "round_timeout_s", 0) or 0)
+        self.round_timeout_min_clients = int(
+            getattr(args, "round_timeout_min_clients", 1) or 1
+        )
+        self._round_lock = threading.Lock()  # handler thread vs timeout timer
+        self._round_timer: Optional[threading.Timer] = None
+        self._handshake_timer: Optional[threading.Timer] = None
+        self._gen = 0  # phase generation: stale timer callbacks no-op
+        self._finished = False
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -51,23 +70,50 @@ class FedMLServerManager(FedMLCommManager):
         # until every silo reports ONLINE, fedml_server_manager.py:58-79).
         for client_id in range(1, self.client_num + 1):
             m = Message(MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.rank, client_id)
-            self.send_message(m)
+            self._send_safe(m)
 
     def handle_message_client_status_update(self, msg: Message) -> None:
         status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
         sender = int(msg.get_sender_id())
-        if status == MyMessage.CLIENT_STATUS_ONLINE:
-            self.client_online_status[sender] = True
-        logger.info("client %s status=%s (%d/%d online)", sender, status,
-                    sum(self.client_online_status.values()), self.client_num)
-        if not self.is_initialized and all(
-            self.client_online_status.get(cid, False) for cid in range(1, self.client_num + 1)
-        ):
+        with self._round_lock:
+            if status == MyMessage.CLIENT_STATUS_ONLINE:
+                self.client_online_status[sender] = True
+            logger.info("client %s status=%s (%d/%d online)", sender, status,
+                        sum(self.client_online_status.values()), self.client_num)
+            if self.is_initialized:
+                return
+            if all(self.client_online_status.get(cid, False)
+                   for cid in range(1, self.client_num + 1)):
+                self.is_initialized = True
+                self.send_init_msg()
+            elif self.round_timeout_s > 0 and self._handshake_timer is None:
+                # a client that never comes ONLINE must not wedge the run:
+                # bound the handshake wait with the same round timeout
+                self._start_phase_timer("_handshake_timer", self._on_handshake_timeout)
+
+    def _on_handshake_timeout(self, gen: int) -> None:
+        with self._round_lock:
+            if self.is_initialized or self._finished or gen != self._gen:
+                return
+            online = sum(self.client_online_status.values())
+            if online < max(1, self.round_timeout_min_clients):
+                logger.warning(
+                    "handshake timeout with %d/%d online (< min %d): waiting on",
+                    online, self.client_num, self.round_timeout_min_clients,
+                )
+                self._start_phase_timer("_handshake_timer", self._on_handshake_timeout)
+                return
+            logger.warning(
+                "handshake timeout: starting round 0 with %d/%d clients online "
+                "(the round timer covers their missing uploads)",
+                online, self.client_num,
+            )
             self.is_initialized = True
             self.send_init_msg()
 
     def send_init_msg(self) -> None:
         """Round-0 kick-off (reference send_message_init_config :182)."""
+        self._gen += 1  # the handshake phase closes; its timers go stale
         self.client_id_list_in_this_round = self.aggregator.client_selection(
             self.args.round_idx, list(range(1, self.client_num + 1)),
             int(getattr(self.args, "client_num_per_round", self.client_num)),
@@ -86,32 +132,57 @@ class FedMLServerManager(FedMLCommManager):
             m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
             m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
             m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
-            self.send_message(m)
+            self._send_safe(m)
+        self._arm_round_timer()
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         from ...core.compression import is_compressed, maybe_decompress_update
 
         sender = int(msg.get_sender_id())
-        raw = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
-        is_delta = is_compressed(raw) and bool(raw.get("is_delta"))
-        model_params = maybe_decompress_update(raw)
-        if is_delta:
-            # compressed uploads carry the UPDATE; rebase onto the global
-            # params this round distributed
-            import jax
-            import jax.numpy as jnp
+        with self._round_lock:
+            if self._finished:
+                return
+            msg_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, None)
+            if msg_round is not None and int(msg_round) != int(self.args.round_idx):
+                # straggler upload for an already-closed round: the client
+                # will pick up the current sync next (reference has no tag
+                # and would silently fold it into the wrong round)
+                logger.warning("dropping stale round-%s upload from client %d "
+                               "(current round %d)", msg_round, sender,
+                               self.args.round_idx)
+                return
+            if sender not in self.client_id_list_in_this_round:
+                logger.warning("dropping upload from non-participant %d", sender)
+                return
+            raw = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+            is_delta = is_compressed(raw) and bool(raw.get("is_delta"))
+            model_params = maybe_decompress_update(raw)
+            if is_delta:
+                # compressed uploads carry the UPDATE; rebase onto the global
+                # params this round distributed
+                import jax
+                import jax.numpy as jnp
 
-            base = self.aggregator.get_global_model_params()
-            model_params = jax.tree_util.tree_map(
-                lambda g, d: jnp.asarray(g) + jnp.asarray(d), base, model_params
+                base = self.aggregator.get_global_model_params()
+                model_params = jax.tree_util.tree_map(
+                    lambda g, d: jnp.asarray(g) + jnp.asarray(d), base, model_params
+                )
+            local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+            self.aggregator.add_local_trained_result(
+                self.client_id_list_in_this_round.index(sender), model_params,
+                local_sample_number,
             )
-        local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
-        self.aggregator.add_local_trained_result(
-            self.client_id_list_in_this_round.index(sender), model_params, local_sample_number
-        )
-        if not self.aggregator.check_whether_all_receive():
-            return
-        self.aggregator.aggregate()
+            if not self.aggregator.check_whether_all_receive():
+                return
+            self._cancel_round_timer()
+            self._finalize_round(None)
+
+    def _finalize_round(self, indices: Optional[List[int]]) -> None:
+        """Close the current round (caller holds the lock): aggregate the
+        ``indices`` cohort (None = every silo), eval, then either finish or
+        open the next round."""
+        self._gen += 1  # this round's phase closes; its timers go stale
+        self.aggregator.aggregate(indices)
         freq = int(getattr(self.args, "frequency_of_the_test", 1) or 0)
         if freq and (self.args.round_idx % freq == 0 or self.args.round_idx == self.round_num - 1):
             self.eval_history.append(
@@ -120,6 +191,7 @@ class FedMLServerManager(FedMLCommManager):
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
+            self._finished = True
             self.send_finish_msg()
             self.finish()
             return
@@ -143,8 +215,72 @@ class FedMLServerManager(FedMLCommManager):
             m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
             m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
             m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+            self._send_safe(m)
+        self._arm_round_timer()
+
+    def _send_safe(self, m: Message) -> None:
+        """Fan-out send that survives a dead receiver: a transport error for
+        one client (e.g. gRPC connection-refused after its process died)
+        must not abort the loop delivering to the live ones."""
+        try:
             self.send_message(m)
+        except Exception as e:
+            logger.warning("send %s -> client %s failed: %s",
+                           m.get_type(), m.get_receiver_id(), e)
+
+    # -- straggler tolerance ------------------------------------------------
+    def _start_phase_timer(self, attr: str, callback) -> None:
+        """(lock held) Arm the daemon timer stored at ``attr``, tagging the
+        callback with the CURRENT phase generation: ``Timer.cancel`` cannot
+        stop a callback that already fired and is waiting on the lock, so
+        every phase change bumps ``self._gen`` and a stale callback no-ops
+        on the mismatch instead of closing the next phase prematurely."""
+        old = getattr(self, attr)
+        if old is not None:
+            old.cancel()
+        t = threading.Timer(self.round_timeout_s, callback, args=(self._gen,))
+        t.daemon = True
+        t.start()
+        setattr(self, attr, t)
+
+    def _arm_round_timer(self) -> None:
+        if self.round_timeout_s <= 0 or self._finished:
+            return
+        self._start_phase_timer("_round_timer", self._on_round_timeout)
+
+    def _cancel_round_timer(self) -> None:
+        if self._round_timer is not None:
+            self._round_timer.cancel()
+            self._round_timer = None
+
+    def _on_round_timeout(self, gen: int) -> None:
+        with self._round_lock:
+            if self._finished or gen != self._gen:
+                return  # stale callback: its phase already closed
+            got = self.aggregator.received_indices()
+            if len(got) < max(1, self.round_timeout_min_clients):
+                logger.warning(
+                    "round %d timeout with %d/%d models (< min %d): waiting on",
+                    self.args.round_idx, len(got), len(self.client_id_list_in_this_round),
+                    self.round_timeout_min_clients,
+                )
+                self._arm_round_timer()
+                return
+            logger.warning(
+                "round %d timeout: closing with %d/%d silos (stragglers dropped)",
+                self.args.round_idx, len(got), len(self.client_id_list_in_this_round),
+            )
+            try:
+                self._finalize_round(self.aggregator.consume_received())
+            except Exception:
+                # a failure here would otherwise die silently with the timer
+                # thread and wedge the run (flags already consumed, no timer
+                # armed) — shut down cleanly instead
+                logger.exception("partial-round finalize failed; shutting down")
+                self._finished = True
+                self.send_finish_msg()
+                self.finish()
 
     def send_finish_msg(self) -> None:
         for client_id in range(1, self.client_num + 1):
-            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
+            self._send_safe(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))

@@ -1,0 +1,134 @@
+"""Straggler/fault tolerance in the cross-silo round (beyond-reference:
+the reference server blocks a round forever on a dead client — SURVEY.md §5
+'failure detection').  With ``round_timeout_s`` set, a silo that goes
+silent after its ONLINE handshake must not wedge training: the server
+closes each round with the cohort that responded and drops stale uploads
+by round tag."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.distributed.comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+from fedml_tpu.core.distributed.communication.message import Message
+from fedml_tpu.cross_silo.message_define import MyMessage
+
+
+def _args(run_id: str, n_clients: int, **extra):
+    cfg = {
+        "common_args": {"training_type": "cross_silo", "random_seed": 0, "run_id": run_id},
+        "data_args": {"dataset": "synthetic", "data_cache_dir": "", "partition_method": "homo",
+                      "synthetic_train_size": 240},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": n_clients,
+            "client_num_per_round": n_clients,
+            "comm_round": 2,
+            "epochs": 1,
+            "batch_size": 16,
+            "client_optimizer": "sgd",
+            "learning_rate": 0.1,
+            **extra,
+        },
+        "validation_args": {"frequency_of_the_test": 1},
+        "comm_args": {"backend": "LOOPBACK"},
+    }
+    return Arguments.from_dict(cfg).validate()
+
+
+class _SilentClient(FedMLCommManager):
+    """A faulty silo: completes the ONLINE handshake, then never trains —
+    the failure mode round_timeout_s exists for."""
+
+    def __init__(self, args, rank, size):
+        super().__init__(args, None, rank, size, backend="LOOPBACK")
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler("connection_ready", self._on_ready)
+        self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_FINISH, self._on_finish)
+
+    def _on_ready(self, msg: Message) -> None:
+        m = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, MyMessage.CLIENT_STATUS_ONLINE)
+        self.send_message(m)
+
+    def _on_finish(self, msg: Message) -> None:
+        self.finish()
+
+
+def _build_client(run_id: str, rank: int, n_clients: int, **extra):
+    args_c = _args(run_id, n_clients, **extra)
+    args_c.role = "client"
+    args_c.rank = rank
+    args_c = fedml_tpu.init(args_c, should_init_logs=False)
+    ds, out_dim = fedml_tpu.data.load(args_c)
+    from fedml_tpu.cross_silo.client.client import Client
+
+    return Client(args_c, None, ds, fedml_tpu.models.create(args_c, out_dim))
+
+
+def test_round_survives_silent_silo():
+    """1 server + 2 live silos + 1 silent silo: with round_timeout_s the
+    run completes, aggregating the 2 live silos each round."""
+    LoopbackHub.reset()
+    n = 3
+    extra = dict(round_timeout_s=3.0, round_timeout_min_clients=2)
+    args_s = _args("ft-1", n, **extra)
+    args_s.role = "server"
+    args_s.rank = 0
+    args_s = fedml_tpu.init(args_s, should_init_logs=False)
+    ds, out_dim = fedml_tpu.data.load(args_s)
+    from fedml_tpu.cross_silo.server.server import Server
+
+    server = Server(args_s, None, ds, fedml_tpu.models.create(args_s, out_dim))
+
+    live = [_build_client("ft-1", r, n, **extra) for r in (1, 2)]
+    silent = _SilentClient(_args("ft-1", n, **extra), rank=3, size=n + 1)
+
+    threads = [threading.Thread(target=c.run, daemon=True) for c in live]
+    threads.append(threading.Thread(target=silent.run, daemon=True))
+    for t in threads:
+        t.start()
+    t0 = time.time()
+    history = server.run()  # must NOT block forever
+    assert len(history) == 2
+    assert 0.0 <= history[-1]["test_acc"] <= 1.0
+    # both rounds paid ~one timeout each, not an unbounded wait
+    assert time.time() - t0 < 30
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+
+def test_all_silos_alive_is_unchanged():
+    """With every silo healthy the timeout path must never fire — rounds
+    close on the all-received fast path exactly as without the knob."""
+    LoopbackHub.reset()
+    n = 2
+    extra = dict(round_timeout_s=60.0)
+    args_s = _args("ft-2", n, **extra)
+    args_s.role = "server"
+    args_s.rank = 0
+    args_s = fedml_tpu.init(args_s, should_init_logs=False)
+    ds, out_dim = fedml_tpu.data.load(args_s)
+    from fedml_tpu.cross_silo.server.server import Server
+
+    server = Server(args_s, None, ds, fedml_tpu.models.create(args_s, out_dim))
+    clients = [_build_client("ft-2", r, n, **extra) for r in (1, 2)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    t0 = time.time()
+    history = server.run()
+    assert len(history) == 2
+    assert time.time() - t0 < 50  # no 60s timeout ever fired
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
