@@ -147,22 +147,31 @@ def _autotune(args, dataset, model):
     (tests/test_packed_round.py) but their win is hardware-dependent —
     self-tuning lands the measured winner in the BENCH artifact even when
     no interactive chip session was possible beforehand.  Disable with
-    BENCH_AUTOTUNE=0.  Returns the winning override dict, or None if every
-    variant (including the baseline) failed."""
+    BENCH_AUTOTUNE=0.  Returns ``(winning override dict, winning simulator
+    or None)``.  Only ONE candidate simulator is ever alive (peak HBM stays
+    one simulator, exactly as without autotune), so the compiled winner can
+    only be handed back when it is the LAST variant trained — which the
+    grid orders it to be in the expected case (both levers on); otherwise
+    the caller rebuilds it (one compile, the pre-reuse behavior).  ``(None,
+    None)`` if every variant (including the baseline) failed."""
     import copy
 
     from fedml_tpu.simulation.xla.fed_sim import XLASimulator
 
     best = (0.0, None)
+    sim = None
+    last_overrides = None
     for overrides in AUTOTUNE_VARIANTS:
         a = copy.deepcopy(args)
         a.comm_round = 5
         for k, v in overrides.items():
             setattr(a, k, v)
         try:
+            sim = None  # free the previous candidate BEFORE building the next
             sim = XLASimulator(a, dataset, model)
             sim.train()
             sps = sim.throughput()["samples_per_sec"]
+            last_overrides = overrides
             print(f"autotune {overrides}: {sps:.1f} samples/s", file=sys.stderr)
         except Exception as e:
             # a broken lever must not kill the bench, but it must be VISIBLE
@@ -172,7 +181,9 @@ def _autotune(args, dataset, model):
             continue
         if best[1] is None or sps > best[0]:
             best = (sps, overrides)
-    return best[1]
+    if best[1] is None:
+        return None, None
+    return best[1], (sim if last_overrides == best[1] else None)
 
 
 def main() -> None:
@@ -180,6 +191,17 @@ def main() -> None:
 
     import fedml_tpu
     from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+    try:
+        # persistent XLA compile cache: a re-run on the same chip (or a
+        # bench retry after a tunnel hiccup) skips the big compiles
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception as e:  # cache support varies by backend; never fatal
+        print(f"compilation cache unavailable: {e}", file=sys.stderr)
 
     n_chips = len(jax.devices())
     args = fedml_tpu.init(_bench_args(n_chips), should_init_logs=False)
@@ -193,11 +215,21 @@ def main() -> None:
 
     model = fedml_tpu.models.create(args, out_dim)
     autotune_on = os.environ.get("BENCH_AUTOTUNE", "1") != "0"
-    tuned = _autotune(args, dataset, model) if autotune_on else None
+    tuned, sim = _autotune(args, dataset, model) if autotune_on else (None, None)
     for k, v in (tuned or {}).items():
         setattr(args, k, v)
-    sim = XLASimulator(args, dataset, model)
-    sim.train()
+    if sim is not None:
+        # keep training the autotune winner: its round fn is already
+        # compiled, so the extra rounds below are pure steady-state
+        # measurement (one big XLA compile saved — matters when the chip
+        # window is short).  train() re-runs rounds 0..comm_round-1 and
+        # APPENDS to round_times; throughput() medians over all recorded
+        # post-warmup rounds.
+        sim.args.comm_round = int(args.comm_round)
+        sim.train()
+    else:
+        sim = XLASimulator(args, dataset, model)
+        sim.train()
 
     # median per-round throughput over post-compile rounds: the steady-state
     # rate (compile + one-time dataset upload amortized out; see

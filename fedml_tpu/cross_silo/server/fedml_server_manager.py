@@ -175,7 +175,25 @@ class FedMLServerManager(FedMLCommManager):
             if not self.aggregator.check_whether_all_receive():
                 return
             self._cancel_round_timer()
-            self._finalize_round(None)
+            self._finalize_safely(None)
+
+    def _finalize_safely(self, indices: Optional[List[int]]) -> None:
+        """(lock held) Finalize with the error policy both close paths share:
+        with straggler tolerance on, a finalize failure shuts the run down
+        cleanly (flags are already consumed and no timer may be armed — an
+        escaped exception would wedge the run the feature exists to prevent);
+        with the knob off, the exception propagates loudly as the reference
+        semantics would."""
+        if self.round_timeout_s <= 0:
+            self._finalize_round(indices)
+            return
+        try:
+            self._finalize_round(indices)
+        except Exception:
+            logger.exception("round finalize failed; shutting down")
+            self._finished = True
+            self.send_finish_msg()
+            self.finish()
 
     def _finalize_round(self, indices: Optional[List[int]]) -> None:
         """Close the current round (caller holds the lock): aggregate the
@@ -221,12 +239,17 @@ class FedMLServerManager(FedMLCommManager):
     def _send_safe(self, m: Message) -> None:
         """Fan-out send that survives a dead receiver: a transport error for
         one client (e.g. gRPC connection-refused after its process died)
-        must not abort the loop delivering to the live ones."""
+        must not abort the loop delivering to the live ones.  Swallowing is
+        only safe when the round timer covers the lost message — with the
+        knob off (reference wait-forever semantics) the error re-raises, a
+        loud failure instead of a silent infinite wait."""
         try:
             self.send_message(m)
         except Exception as e:
             logger.warning("send %s -> client %s failed: %s",
                            m.get_type(), m.get_receiver_id(), e)
+            if self.round_timeout_s <= 0:
+                raise
 
     # -- straggler tolerance ------------------------------------------------
     def _start_phase_timer(self, attr: str, callback) -> None:
@@ -270,16 +293,7 @@ class FedMLServerManager(FedMLCommManager):
                 "round %d timeout: closing with %d/%d silos (stragglers dropped)",
                 self.args.round_idx, len(got), len(self.client_id_list_in_this_round),
             )
-            try:
-                self._finalize_round(self.aggregator.consume_received())
-            except Exception:
-                # a failure here would otherwise die silently with the timer
-                # thread and wedge the run (flags already consumed, no timer
-                # armed) — shut down cleanly instead
-                logger.exception("partial-round finalize failed; shutting down")
-                self._finished = True
-                self.send_finish_msg()
-                self.finish()
+            self._finalize_safely(self.aggregator.consume_received())
 
     def send_finish_msg(self) -> None:
         for client_id in range(1, self.client_num + 1):

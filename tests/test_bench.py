@@ -29,12 +29,22 @@ def test_autotune_picks_a_valid_strategy():
     args = fedml_tpu.init(args, should_init_logs=False)
     dataset, out_dim = data.load(args)
     model = fedml_tpu.models.create(args, out_dim)
-    tuned = bench._autotune(args, dataset, model)
+    tuned, sim = bench._autotune(args, dataset, model)
     assert tuned is not None and set(tuned) <= {"xla_pregather", "xla_stream"}
-    for k, v in tuned.items():
-        setattr(args, k, v)
-    sim = XLASimulator(args, dataset, model)
-    sim.train()
+    if sim is not None:
+        # winner == last variant: main() keeps training the compiled sim —
+        # more rounds append without a rebuild
+        n_before = len(sim.round_times)
+        sim.args.comm_round = 2
+        sim.train()
+        assert len(sim.round_times) == n_before + 2
+    else:
+        # winner was an earlier variant (only one candidate is kept alive):
+        # main() rebuilds it from the returned flags
+        for k, v in tuned.items():
+            setattr(args, k, v)
+        sim = XLASimulator(args, dataset, model)
+        sim.train()
     assert sim.throughput()["samples_per_sec"] > 0
 
 
