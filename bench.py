@@ -178,6 +178,7 @@ def _autotune(args, dataset, model):
             # (an artifact claiming "baseline won" when the lever crashed
             # would mislead the next perf investigation)
             print(f"autotune {overrides}: FAILED ({e})", file=sys.stderr)
+            sim = None  # never hand a failed variant's sim to the caller
             continue
         if best[1] is None or sps > best[0]:
             best = (sps, overrides)

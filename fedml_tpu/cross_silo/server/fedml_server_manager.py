@@ -248,7 +248,10 @@ class FedMLServerManager(FedMLCommManager):
         except Exception as e:
             logger.warning("send %s -> client %s failed: %s",
                            m.get_type(), m.get_receiver_id(), e)
-            if self.round_timeout_s <= 0:
+            if self.round_timeout_s <= 0 and not self._finished:
+                # loud failure in the wait-forever default — but never on
+                # the FINISH fan-out, where aborting the loop would leave
+                # the surviving clients (and this server) hanging instead
                 raise
 
     # -- straggler tolerance ------------------------------------------------

@@ -197,9 +197,14 @@ class XLASimulator:
 
         # bf16 storage halves the per-step gather traffic (the measured #1
         # round cost) whenever the model casts its input to bf16 anyway —
-        # the gathered batch is then bitwise-identical to the fp32 path
-        x_dtype = data_storage_dtype(self.args, self.module)
-        self.x_all = jnp.asarray(np.concatenate(xs, 0), dtype=x_dtype)
+        # the gathered batch is then bitwise-identical to the fp32 path.
+        # Only FLOAT data participates: integer inputs are token/class ids
+        # (transformer Embed requires integers) and keep their dtype.
+        x_np = np.concatenate(xs, 0)
+        if np.issubdtype(x_np.dtype, np.floating):
+            self.x_all = jnp.asarray(x_np, dtype=data_storage_dtype(self.args, self.module))
+        else:
+            self.x_all = jnp.asarray(x_np)
         self.y_all = jnp.asarray(np.concatenate(ys, 0))
         logger.info(
             "packed %d clients (max_n=%d padded_n=%d) data %s (%s) into HBM",

@@ -346,3 +346,17 @@ class TestDataStorageDtype:
         args, dataset, model = _build(_args(compute_dtype="bf16"))  # lr model
         sim = XLASimulator(args, dataset, model)
         assert str(sim.x_all.dtype) == "float32"
+
+    def test_integer_token_data_never_downcast(self):
+        """Token-id inputs (s2s/NWP) must keep their integer dtype even
+        under an explicit bf16 storage request — nn.Embed requires ints
+        (regression: the first bf16-storage cut cast them to float and the
+        in-mesh s2s task crashed)."""
+        args, dataset, model = _build(_args(
+            dataset="synthetic_s2s", model="transformer_s2s",
+            xla_data_dtype="bf16", synthetic_train_size=128,
+            client_num_in_total=4, client_num_per_round=4, batch_size=16,
+            comm_round=1, frequency_of_the_test=0,
+        ))
+        sim = XLASimulator(args, dataset, model)
+        assert np.issubdtype(np.asarray(sim.x_all[:1]).dtype, np.integer)
