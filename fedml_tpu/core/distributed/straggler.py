@@ -1,0 +1,173 @@
+"""Round/handshake straggler tolerance — the one copy of the
+concurrency-critical timer machinery shared by the cross-silo and
+cross-device server managers.
+
+The reference server managers block a round forever on a dead client
+(``check_whether_all_receive`` with no timer anywhere).  This mixin bounds
+both waits when ``args.round_timeout_s`` is set:
+
+* the per-round collect: on expiry with >= ``round_timeout_min_clients``
+  uploads, the round closes with the partial cohort; below the floor the
+  timer re-arms (aggregating nothing is worse than waiting);
+* the ONLINE handshake: a client that never comes up cannot wedge round 0.
+
+Concurrency contract: the receive loop's handler thread and the timer
+thread synchronize on ``self._round_lock``; every phase change (handshake
+completes, a round closes) bumps ``self._gen`` so a timer callback that
+already fired but lost the lock race no-ops on the generation mismatch
+(``threading.Timer.cancel`` cannot stop an in-flight callback).
+
+Host manager requirements (both server managers satisfy them):
+``self.args`` (round_idx), ``self.aggregator`` with
+``received_indices()``/``consume_received(got)``/partial ``aggregate``,
+``self.client_online_status``/``self.client_num``/``self.is_initialized``,
+``self.client_id_list_in_this_round``, ``self.send_message``,
+``self.finish``, plus ``_finalize_round(indices)`` (lock held; bumps come
+from here via ``_finalize_safely``), ``send_init_msg()`` and
+``send_finish_msg()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class RoundTimeoutMixin:
+    def init_straggler_tolerance(self, args) -> None:
+        """Call from the manager's __init__ (0 = reference wait-forever)."""
+        self.round_timeout_s = float(getattr(args, "round_timeout_s", 0) or 0)
+        self.round_timeout_min_clients = int(
+            getattr(args, "round_timeout_min_clients", 1) or 1
+        )
+        self._round_lock = threading.Lock()
+        self._round_timer: Optional[threading.Timer] = None
+        self._handshake_timer: Optional[threading.Timer] = None
+        self._gen = 0  # phase generation: stale timer callbacks no-op
+        self._finished = False
+
+    # -- sends ---------------------------------------------------------------
+    def _send_safe(self, m) -> None:
+        """Fan-out send that survives a dead receiver.  Swallowing is only
+        safe when the round timer covers the lost message — with the knob
+        off (reference semantics) the error re-raises loudly, EXCEPT on the
+        FINISH fan-out where aborting the loop would leave the surviving
+        clients (and this server) hanging instead."""
+        try:
+            self.send_message(m)
+        except Exception as e:
+            logger.warning("send %s -> %s failed: %s",
+                           m.get_type(), m.get_receiver_id(), e)
+            if self.round_timeout_s <= 0 and not self._finished:
+                raise
+
+    def _is_stale_upload(self, msg_round, sender) -> bool:
+        """(lock held) True when an upload's round tag does not match the
+        current round — a straggler upload for an already-closed round: the
+        client will pick up the current sync next (the reference has no tag
+        and would silently fold it into the wrong round).  Untagged uploads
+        (older clients) are accepted for compatibility."""
+        if msg_round is None or int(msg_round) == int(self.args.round_idx):
+            return False
+        logger.warning("dropping stale round-%s upload from client %s "
+                       "(current round %d)", msg_round, sender,
+                       self.args.round_idx)
+        return True
+
+    # -- timers --------------------------------------------------------------
+    def _start_phase_timer(self, attr: str, callback) -> None:
+        """(lock held) Arm the daemon timer at ``attr``, generation-tagged."""
+        old = getattr(self, attr)
+        if old is not None:
+            old.cancel()
+        t = threading.Timer(self.round_timeout_s, callback, args=(self._gen,))
+        t.daemon = True
+        t.start()
+        setattr(self, attr, t)
+
+    def _arm_round_timer(self) -> None:
+        if self.round_timeout_s <= 0 or self._finished:
+            return
+        self._start_phase_timer("_round_timer", self._on_round_timeout)
+
+    def _cancel_round_timer(self) -> None:
+        if self._round_timer is not None:
+            self._round_timer.cancel()
+            self._round_timer = None
+
+    def _on_round_timeout(self, gen: int) -> None:
+        with self._round_lock:
+            if self._finished or gen != self._gen:
+                return  # stale callback: its phase already closed
+            got = self.aggregator.received_indices()
+            if len(got) < max(1, self.round_timeout_min_clients):
+                logger.warning(
+                    "round %d timeout with %d/%d uploads (< min %d): waiting on",
+                    self.args.round_idx, len(got),
+                    len(self.client_id_list_in_this_round),
+                    self.round_timeout_min_clients,
+                )
+                self._arm_round_timer()
+                return
+            logger.warning(
+                "round %d timeout: closing with %d/%d clients (stragglers dropped)",
+                self.args.round_idx, len(got), len(self.client_id_list_in_this_round),
+            )
+            self._finalize_safely(self.aggregator.consume_received(got))
+
+    # -- round close ----------------------------------------------------------
+    def _finalize_safely(self, indices: Optional[List[int]]) -> None:
+        """(lock held) Finalize with the shared error policy: with tolerance
+        on, a finalize failure shuts the run down cleanly (flags are already
+        consumed, no timer may be armed — an escaped exception would wedge
+        the run this machinery exists to prevent); with the knob off it
+        propagates loudly, as the reference semantics would."""
+        if self.round_timeout_s <= 0:
+            self._finalize_round(indices)
+            return
+        try:
+            self._finalize_round(indices)
+        except Exception:
+            logger.exception("round finalize failed; shutting down")
+            self._finished = True
+            self.send_finish_msg()
+            self.finish()
+
+    # -- handshake -------------------------------------------------------------
+    def _handshake_check(self) -> None:
+        """(lock held) Call from the status handler after recording ONLINE:
+        starts round 0 when everyone is up, else bounds the wait."""
+        if self.is_initialized:
+            return
+        if all(self.client_online_status.get(cid, False)
+               for cid in range(1, self.client_num + 1)):
+            self._start_round0()
+        elif self.round_timeout_s > 0 and self._handshake_timer is None:
+            self._start_phase_timer("_handshake_timer", self._on_handshake_timeout)
+
+    def _start_round0(self) -> None:
+        self.is_initialized = True
+        self._gen += 1  # the handshake phase closes; its timers go stale
+        self.send_init_msg()
+
+    def _on_handshake_timeout(self, gen: int) -> None:
+        with self._round_lock:
+            if self.is_initialized or self._finished or gen != self._gen:
+                return
+            online = sum(self.client_online_status.values())
+            if online < max(1, self.round_timeout_min_clients):
+                logger.warning(
+                    "handshake timeout with %d/%d online (< min %d): waiting on",
+                    online, self.client_num, self.round_timeout_min_clients,
+                )
+                self._start_phase_timer("_handshake_timer", self._on_handshake_timeout)
+                return
+            logger.warning(
+                "handshake timeout: starting round 0 with %d/%d clients online "
+                "(the round timer covers their missing uploads)",
+                online, self.client_num,
+            )
+            self._start_round0()

@@ -150,6 +150,9 @@ class AgentDeviceManager(FakeDeviceManager):
         out_path, n = self._train_local_file(model_file, round_idx)
         self.rounds_trained += 1
         m = Message(MNNMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        # round tag: lets a straggler-tolerant server drop uploads that
+        # arrive after their round was closed by round_timeout_s
+        m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, round_idx)
         m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, out_path)
         m.add_params(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES, n)
         self.send_message(m)

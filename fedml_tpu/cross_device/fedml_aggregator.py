@@ -78,11 +78,31 @@ class FedMLAggregator:
         self.flag_client_model_uploaded_dict = {}
         return True
 
+    def received_indices(self) -> List[int]:
+        """Device slots whose upload arrived this round (unconsumed flags)."""
+        return sorted(i for i, f in self.flag_client_model_uploaded_dict.items() if f)
+
+    def consume_received(self, got: Optional[List[int]] = None) -> List[int]:
+        """Straggler-tolerant round close: the received slots, flags reset.
+        ``got`` lets a caller that already scanned under the lock skip the
+        second scan.  Only ``got``'s flags reset (matching the cross-silo
+        implementation of this mixin-required API): a caller closing with a
+        subset must not discard received-but-unconsumed uploads."""
+        if got is None:
+            got = self.received_indices()
+        for i in got:
+            self.flag_client_model_uploaded_dict.pop(i, None)
+        return got
+
     # -- aggregation (reference :59-115) -------------------------------------
-    def aggregate(self) -> Dict[str, np.ndarray]:
-        total = sum(self.sample_num_dict[i] for i in range(self.worker_num)) or 1.0
+    def aggregate(self, indices: Optional[List[int]] = None) -> Dict[str, np.ndarray]:
+        """Weighted aggregate over ``indices`` (default: every device — the
+        reference's all-received path)."""
+        if indices is None:
+            indices = list(range(self.worker_num))
+        total = sum(self.sample_num_dict[i] for i in indices) or 1.0
         acc: Dict[str, np.ndarray] = {}
-        for i in range(self.worker_num):
+        for i in indices:
             flat = load_edge_model(self.model_file_dict[i])
             w = self.sample_num_dict[i] / total
             for name, arr in flat.items():

@@ -5,21 +5,28 @@ the same ONLINE-handshake → init-config → collect/aggregate/test/sync loop a
 cross-silo, except the model rides as a FILE reference
 (``MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE``) that devices download and
 upload — the message plane never carries tensors.
+
+Beyond-reference: the same ``round_timeout_s`` straggler tolerance as the
+cross-silo server — on a fleet of phones, devices dropping mid-round is the
+NORM, not a fault; the timer closes each round with the devices that
+uploaded (>= ``round_timeout_min_clients``) and stale uploads are dropped
+by round tag.  Default (knob unset) keeps reference wait-forever semantics.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..core.distributed.comm_manager import FedMLCommManager
 from ..core.distributed.communication.message import Message
+from ..core.distributed.straggler import RoundTimeoutMixin
 from .message_define import MNNMessage
 
 logger = logging.getLogger(__name__)
 
 
-class FedMLServerManager(FedMLCommManager):
+class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0,
                  backend: str = "LOOPBACK"):
         super().__init__(args, comm, client_rank, client_num + 1, backend)
@@ -30,6 +37,9 @@ class FedMLServerManager(FedMLCommManager):
         self.client_online_status: Dict[int, bool] = {}
         self.is_initialized = False
         self.client_id_list_in_this_round: List[int] = list(range(1, self.client_num + 1))
+        # straggler tolerance (0 = reference semantics: wait forever) —
+        # the shared machinery lives in core/distributed/straggler.py
+        self.init_straggler_tolerance(args)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler("connection_ready", self._on_connection_ready)
@@ -43,18 +53,22 @@ class FedMLServerManager(FedMLCommManager):
     # -- handshake ------------------------------------------------------------
     def _on_connection_ready(self, msg: Message) -> None:
         for client_id in range(1, self.client_num + 1):
-            self.send_message(
+            self._send_safe(
                 Message(MNNMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.rank, client_id)
             )
 
     def _on_client_status(self, msg: Message) -> None:
-        if msg.get(MNNMessage.MSG_ARG_KEY_CLIENT_STATUS) == MNNMessage.CLIENT_STATUS_ONLINE:
-            self.client_online_status[int(msg.get_sender_id())] = True
-        if not self.is_initialized and all(
-            self.client_online_status.get(cid, False) for cid in range(1, self.client_num + 1)
-        ):
-            self.is_initialized = True
-            self._send_round(MNNMessage.MSG_TYPE_S2C_INIT_CONFIG)
+        with self._round_lock:
+            if msg.get(MNNMessage.MSG_ARG_KEY_CLIENT_STATUS) == MNNMessage.CLIENT_STATUS_ONLINE:
+                self.client_online_status[int(msg.get_sender_id())] = True
+            self._handshake_check()
+
+    def send_init_msg(self) -> None:
+        self._send_round(MNNMessage.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def send_finish_msg(self) -> None:
+        for client_id in range(1, self.client_num + 1):
+            self._send_safe(Message(MNNMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
 
     # -- round loop -----------------------------------------------------------
     def _send_round(self, msg_type) -> None:
@@ -64,26 +78,41 @@ class FedMLServerManager(FedMLCommManager):
             m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, model_file)
             m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_INDEX, client_id - 1)
             m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
-            self.send_message(m)
+            self._send_safe(m)
+        self._arm_round_timer()
 
     def _on_model_from_client(self, msg: Message) -> None:
         sender = int(msg.get_sender_id())
-        model_file = msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE)
-        n = msg.get(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES)
-        self.aggregator.add_local_trained_result(
-            self.client_id_list_in_this_round.index(sender), model_file, n
-        )
-        if not self.aggregator.check_whether_all_receive():
-            return
-        self.aggregator.aggregate()
+        with self._round_lock:
+            if self._finished:
+                return
+            if self._is_stale_upload(msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, None), sender):
+                return
+            if sender not in self.client_id_list_in_this_round:
+                logger.warning("dropping upload from non-participant device %d", sender)
+                return
+            model_file = msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE)
+            n = msg.get(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES)
+            self.aggregator.add_local_trained_result(
+                self.client_id_list_in_this_round.index(sender), model_file, n
+            )
+            if not self.aggregator.check_whether_all_receive():
+                return
+            self._cancel_round_timer()
+            self._finalize_safely(None)
+
+    def _finalize_round(self, indices: Optional[List[int]]) -> None:
+        """(lock held) Aggregate the cohort, eval, finish-or-sync."""
+        self._gen += 1  # this round's phase closes; its timers go stale
+        self.aggregator.aggregate(indices)
         freq = int(getattr(self.args, "frequency_of_the_test", 1) or 0)
         if freq and (self.args.round_idx % freq == 0 or self.args.round_idx == self.round_num - 1):
             self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
-            for client_id in range(1, self.client_num + 1):
-                self.send_message(Message(MNNMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
+            self._finished = True
+            self.send_finish_msg()
             self.finish()
             return
         self._send_round(MNNMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)

@@ -55,11 +55,13 @@ class FedMLAggregator:
         return [i for i in range(self.client_num)
                 if self.flag_client_model_uploaded_dict.get(i, False)]
 
-    def consume_received(self) -> List[int]:
+    def consume_received(self, got: Optional[List[int]] = None) -> List[int]:
         """Straggler-tolerant round close: return the received indices and
         reset their flags (the partial-aggregation analogue of
-        check_whether_all_receive's reset)."""
-        got = self.received_indices()
+        check_whether_all_receive's reset).  ``got`` lets a caller that
+        already scanned under the lock skip the second scan."""
+        if got is None:
+            got = self.received_indices()
         for i in got:
             self.flag_client_model_uploaded_dict[i] = False
         return got

@@ -18,17 +18,17 @@ waits (aggregating nothing is worse than waiting).
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Any, Dict, List, Optional
 
 from ...core.distributed.comm_manager import FedMLCommManager
 from ...core.distributed.communication.message import Message
+from ...core.distributed.straggler import RoundTimeoutMixin
 from ..message_define import MyMessage
 
 logger = logging.getLogger(__name__)
 
 
-class FedMLServerManager(FedMLCommManager):
+class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0, backend: str = "LOOPBACK"):
         super().__init__(args, comm, client_rank, client_num + 1, backend)
         self.aggregator = aggregator
@@ -40,16 +40,9 @@ class FedMLServerManager(FedMLCommManager):
         self.client_id_list_in_this_round: List[int] = []
         self.data_silo_index_of_client: Dict[int, int] = {}
         self.eval_history: List[Dict[str, Any]] = []
-        # straggler tolerance (0 = reference semantics: wait forever)
-        self.round_timeout_s = float(getattr(args, "round_timeout_s", 0) or 0)
-        self.round_timeout_min_clients = int(
-            getattr(args, "round_timeout_min_clients", 1) or 1
-        )
-        self._round_lock = threading.Lock()  # handler thread vs timeout timer
-        self._round_timer: Optional[threading.Timer] = None
-        self._handshake_timer: Optional[threading.Timer] = None
-        self._gen = 0  # phase generation: stale timer callbacks no-op
-        self._finished = False
+        # straggler tolerance (0 = reference semantics: wait forever) —
+        # the shared machinery lives in core/distributed/straggler.py
+        self.init_straggler_tolerance(args)
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -80,40 +73,10 @@ class FedMLServerManager(FedMLCommManager):
                 self.client_online_status[sender] = True
             logger.info("client %s status=%s (%d/%d online)", sender, status,
                         sum(self.client_online_status.values()), self.client_num)
-            if self.is_initialized:
-                return
-            if all(self.client_online_status.get(cid, False)
-                   for cid in range(1, self.client_num + 1)):
-                self.is_initialized = True
-                self.send_init_msg()
-            elif self.round_timeout_s > 0 and self._handshake_timer is None:
-                # a client that never comes ONLINE must not wedge the run:
-                # bound the handshake wait with the same round timeout
-                self._start_phase_timer("_handshake_timer", self._on_handshake_timeout)
-
-    def _on_handshake_timeout(self, gen: int) -> None:
-        with self._round_lock:
-            if self.is_initialized or self._finished or gen != self._gen:
-                return
-            online = sum(self.client_online_status.values())
-            if online < max(1, self.round_timeout_min_clients):
-                logger.warning(
-                    "handshake timeout with %d/%d online (< min %d): waiting on",
-                    online, self.client_num, self.round_timeout_min_clients,
-                )
-                self._start_phase_timer("_handshake_timer", self._on_handshake_timeout)
-                return
-            logger.warning(
-                "handshake timeout: starting round 0 with %d/%d clients online "
-                "(the round timer covers their missing uploads)",
-                online, self.client_num,
-            )
-            self.is_initialized = True
-            self.send_init_msg()
+            self._handshake_check()
 
     def send_init_msg(self) -> None:
         """Round-0 kick-off (reference send_message_init_config :182)."""
-        self._gen += 1  # the handshake phase closes; its timers go stale
         self.client_id_list_in_this_round = self.aggregator.client_selection(
             self.args.round_idx, list(range(1, self.client_num + 1)),
             int(getattr(self.args, "client_num_per_round", self.client_num)),
@@ -142,14 +105,7 @@ class FedMLServerManager(FedMLCommManager):
         with self._round_lock:
             if self._finished:
                 return
-            msg_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, None)
-            if msg_round is not None and int(msg_round) != int(self.args.round_idx):
-                # straggler upload for an already-closed round: the client
-                # will pick up the current sync next (reference has no tag
-                # and would silently fold it into the wrong round)
-                logger.warning("dropping stale round-%s upload from client %d "
-                               "(current round %d)", msg_round, sender,
-                               self.args.round_idx)
+            if self._is_stale_upload(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, None), sender):
                 return
             if sender not in self.client_id_list_in_this_round:
                 logger.warning("dropping upload from non-participant %d", sender)
@@ -176,24 +132,6 @@ class FedMLServerManager(FedMLCommManager):
                 return
             self._cancel_round_timer()
             self._finalize_safely(None)
-
-    def _finalize_safely(self, indices: Optional[List[int]]) -> None:
-        """(lock held) Finalize with the error policy both close paths share:
-        with straggler tolerance on, a finalize failure shuts the run down
-        cleanly (flags are already consumed and no timer may be armed — an
-        escaped exception would wedge the run the feature exists to prevent);
-        with the knob off, the exception propagates loudly as the reference
-        semantics would."""
-        if self.round_timeout_s <= 0:
-            self._finalize_round(indices)
-            return
-        try:
-            self._finalize_round(indices)
-        except Exception:
-            logger.exception("round finalize failed; shutting down")
-            self._finished = True
-            self.send_finish_msg()
-            self.finish()
 
     def _finalize_round(self, indices: Optional[List[int]]) -> None:
         """Close the current round (caller holds the lock): aggregate the
@@ -235,68 +173,6 @@ class FedMLServerManager(FedMLCommManager):
             m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
             self._send_safe(m)
         self._arm_round_timer()
-
-    def _send_safe(self, m: Message) -> None:
-        """Fan-out send that survives a dead receiver: a transport error for
-        one client (e.g. gRPC connection-refused after its process died)
-        must not abort the loop delivering to the live ones.  Swallowing is
-        only safe when the round timer covers the lost message — with the
-        knob off (reference wait-forever semantics) the error re-raises, a
-        loud failure instead of a silent infinite wait."""
-        try:
-            self.send_message(m)
-        except Exception as e:
-            logger.warning("send %s -> client %s failed: %s",
-                           m.get_type(), m.get_receiver_id(), e)
-            if self.round_timeout_s <= 0 and not self._finished:
-                # loud failure in the wait-forever default — but never on
-                # the FINISH fan-out, where aborting the loop would leave
-                # the surviving clients (and this server) hanging instead
-                raise
-
-    # -- straggler tolerance ------------------------------------------------
-    def _start_phase_timer(self, attr: str, callback) -> None:
-        """(lock held) Arm the daemon timer stored at ``attr``, tagging the
-        callback with the CURRENT phase generation: ``Timer.cancel`` cannot
-        stop a callback that already fired and is waiting on the lock, so
-        every phase change bumps ``self._gen`` and a stale callback no-ops
-        on the mismatch instead of closing the next phase prematurely."""
-        old = getattr(self, attr)
-        if old is not None:
-            old.cancel()
-        t = threading.Timer(self.round_timeout_s, callback, args=(self._gen,))
-        t.daemon = True
-        t.start()
-        setattr(self, attr, t)
-
-    def _arm_round_timer(self) -> None:
-        if self.round_timeout_s <= 0 or self._finished:
-            return
-        self._start_phase_timer("_round_timer", self._on_round_timeout)
-
-    def _cancel_round_timer(self) -> None:
-        if self._round_timer is not None:
-            self._round_timer.cancel()
-            self._round_timer = None
-
-    def _on_round_timeout(self, gen: int) -> None:
-        with self._round_lock:
-            if self._finished or gen != self._gen:
-                return  # stale callback: its phase already closed
-            got = self.aggregator.received_indices()
-            if len(got) < max(1, self.round_timeout_min_clients):
-                logger.warning(
-                    "round %d timeout with %d/%d models (< min %d): waiting on",
-                    self.args.round_idx, len(got), len(self.client_id_list_in_this_round),
-                    self.round_timeout_min_clients,
-                )
-                self._arm_round_timer()
-                return
-            logger.warning(
-                "round %d timeout: closing with %d/%d silos (stragglers dropped)",
-                self.args.round_idx, len(got), len(self.client_id_list_in_this_round),
-            )
-            self._finalize_safely(self.aggregator.consume_received())
 
     def send_finish_msg(self) -> None:
         for client_id in range(1, self.client_num + 1):

@@ -8,7 +8,9 @@ import numpy as np
 import pytest
 
 from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.distributed.comm_manager import FedMLCommManager
 from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+from fedml_tpu.core.distributed.communication.message import Message
 from fedml_tpu.cross_device.edge_model import (
     flatten_params,
     load_edge_model,
@@ -135,3 +137,152 @@ class TestCrossDeviceE2E:
         logits = x.reshape(len(y), -1) @ trained["params/Dense_0/kernel"] + trained["params/Dense_0/bias"]
         acc = (logits.argmax(1) == y).mean()
         assert acc > 0.9
+
+
+class _SilentDevice(FedMLCommManager):
+    """A device that comes ONLINE then never uploads — the normal phone
+    failure mode round_timeout_s exists for (backgrounded app, dead radio)."""
+
+    def __init__(self, args, rank, client_num):
+        super().__init__(args, None, rank, client_num + 1, backend="LOOPBACK")
+
+    def register_message_receive_handlers(self) -> None:
+        from fedml_tpu.cross_device.message_define import MNNMessage
+
+        self.register_message_receive_handler(
+            MNNMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self._on_check
+        )
+        self.register_message_receive_handler(
+            MNNMessage.MSG_TYPE_S2C_FINISH, lambda m: self.finish()
+        )
+
+    def _on_check(self, msg) -> None:
+        from fedml_tpu.cross_device.message_define import MNNMessage
+
+        m = Message(MNNMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_STATUS, MNNMessage.CLIENT_STATUS_ONLINE)
+        self.send_message(m)
+
+
+class TestCrossDeviceFaultTolerance:
+    def test_round_survives_silent_device(self, tmp_path):
+        """2 live fake devices + 1 silent: with round_timeout_s the fleet
+        round closes on the uploads that arrived (beehive straggler path)."""
+        import time
+
+        from fedml_tpu.cross_device.fake_device import FakeDeviceManager
+        from fedml_tpu.cross_device.fedml_aggregator import FedMLAggregator
+        from fedml_tpu.cross_device.fedml_server_manager import FedMLServerManager
+        from fedml_tpu.models.linear import LogisticRegression
+
+        LoopbackHub.reset()
+        args = Arguments.from_dict(
+            {
+                "common_args": {"training_type": "cross_device", "random_seed": 0,
+                                "run_id": "beehive-ft"},
+                "data_args": {"dataset": "synthetic"},
+                "model_args": {"model": "lr"},
+                "train_args": {
+                    "federated_optimizer": "FedAvg",
+                    "client_num_in_total": 3,
+                    "client_num_per_round": 3,
+                    "comm_round": 2,
+                    "epochs": 2,
+                    "batch_size": 16,
+                    "learning_rate": 0.2,
+                    "round_timeout_s": 3.0,
+                    "round_timeout_min_clients": 2,
+                },
+                "validation_args": {"frequency_of_the_test": 1},
+                "comm_args": {"backend": "LOOPBACK"},
+            }
+        ).validate()
+
+        x_test, y_test = _separable(128, seed=9)
+        model = LogisticRegression(output_dim=4)
+        aggregator = FedMLAggregator(args, model, (x_test, y_test), worker_num=3,
+                                     model_dir=str(tmp_path / "models"))
+        server = FedMLServerManager(args, aggregator, client_rank=0, client_num=3)
+        devices = [
+            FakeDeviceManager(args, rank, _separable(96, seed=rank), client_num=3,
+                              upload_dir=str(tmp_path / f"dev{rank}"))
+            for rank in (1, 2)
+        ]
+        silent = _SilentDevice(args, rank=3, client_num=3)
+
+        t0 = time.time()
+        threads = ([server.run_async()] + [d.run_async() for d in devices]
+                   + [silent.run_async()])
+        for t in threads:
+            t.join(timeout=60)
+        for t in threads:
+            assert not t.is_alive(), "protocol did not terminate"
+        assert time.time() - t0 < 45  # bounded by ~2 timeouts, not forever
+        assert all(d.rounds_trained == 2 for d in devices)
+        assert aggregator.eval_history and 0.0 <= aggregator.eval_history[-1]["test_acc"] <= 1.0
+
+    def test_slow_device_upload_dropped_by_round_tag(self, tmp_path, caplog):
+        """A SLOW (not dead) device whose upload lands after its round was
+        closed: the round tag must drop it instead of folding a round-N
+        model into round N+1."""
+        import logging as _logging
+        import time
+
+        from fedml_tpu.cross_device.fake_device import FakeDeviceManager
+        from fedml_tpu.cross_device.fedml_aggregator import FedMLAggregator
+        from fedml_tpu.cross_device.fedml_server_manager import FedMLServerManager
+        from fedml_tpu.models.linear import LogisticRegression
+
+        class SlowDevice(FakeDeviceManager):
+            def _on_model(self, msg):
+                time.sleep(4.5)  # > round_timeout_s: round closes without us
+                super()._on_model(msg)
+
+        LoopbackHub.reset()
+        args = Arguments.from_dict(
+            {
+                "common_args": {"training_type": "cross_device", "random_seed": 0,
+                                "run_id": "beehive-slow"},
+                "data_args": {"dataset": "synthetic"},
+                "model_args": {"model": "lr"},
+                "train_args": {
+                    "federated_optimizer": "FedAvg",
+                    "client_num_in_total": 3,
+                    "client_num_per_round": 3,
+                    "comm_round": 2,
+                    "epochs": 1,
+                    "batch_size": 16,
+                    "learning_rate": 0.2,
+                    "round_timeout_s": 3.0,
+                    "round_timeout_min_clients": 2,
+                },
+                "validation_args": {"frequency_of_the_test": 1},
+                "comm_args": {"backend": "LOOPBACK"},
+            }
+        ).validate()
+        x_test, y_test = _separable(128, seed=9)
+        aggregator = FedMLAggregator(args, LogisticRegression(output_dim=4),
+                                     (x_test, y_test), worker_num=3,
+                                     model_dir=str(tmp_path / "models"))
+        server = FedMLServerManager(args, aggregator, client_rank=0, client_num=3)
+        devices = [
+            FakeDeviceManager(args, rank, _separable(96, seed=rank), client_num=3,
+                              upload_dir=str(tmp_path / f"dev{rank}"))
+            for rank in (1, 2)
+        ]
+        slow = SlowDevice(args, 3, _separable(96, seed=3), client_num=3,
+                          upload_dir=str(tmp_path / "dev3"))
+        with caplog.at_level(_logging.WARNING,
+                             logger="fedml_tpu.core.distributed.straggler"):
+            with caplog.at_level(_logging.WARNING,
+                                 logger="fedml_tpu.cross_device.fedml_server_manager"):
+                threads = ([server.run_async()] + [d.run_async() for d in devices]
+                           + [slow.run_async()])
+                for t in threads:
+                    t.join(timeout=90)
+        for t in threads:
+            assert not t.is_alive(), "protocol did not terminate"
+        assert aggregator.eval_history
+        # the slow device's late round-0 upload was dropped by its tag
+        assert any("dropping stale round-0 upload" in r.getMessage()
+                   for r in caplog.records), [r.getMessage() for r in caplog.records]
