@@ -286,3 +286,74 @@ class TestCrossDeviceFaultTolerance:
         # the slow device's late round-0 upload was dropped by its tag
         assert any("dropping stale round-0 upload" in r.getMessage()
                    for r in caplog.records), [r.getMessage() for r in caplog.records]
+
+    def test_straggler_rejoins_next_round(self, tmp_path, caplog):
+        """Elastic re-membership: a device that misses round 0 (slow once)
+        picks up the round-1 sync and participates normally — later rounds
+        close on the all-received fast path, not by timeout."""
+        import logging as _logging
+        import time
+
+        from fedml_tpu.cross_device.fake_device import FakeDeviceManager
+        from fedml_tpu.cross_device.fedml_aggregator import FedMLAggregator
+        from fedml_tpu.cross_device.fedml_server_manager import FedMLServerManager
+        from fedml_tpu.models.linear import LogisticRegression
+
+        class SlowOnce(FakeDeviceManager):
+            _slept = False
+
+            def _on_model(self, msg):
+                if not self._slept:
+                    self._slept = True
+                    time.sleep(4.5)  # only round 0's upload misses the window
+                super()._on_model(msg)
+
+        LoopbackHub.reset()
+        args = Arguments.from_dict(
+            {
+                "common_args": {"training_type": "cross_device", "random_seed": 0,
+                                "run_id": "beehive-rejoin"},
+                "data_args": {"dataset": "synthetic"},
+                "model_args": {"model": "lr"},
+                "train_args": {
+                    "federated_optimizer": "FedAvg",
+                    "client_num_in_total": 3,
+                    "client_num_per_round": 3,
+                    "comm_round": 3,
+                    "epochs": 1,
+                    "batch_size": 16,
+                    "learning_rate": 0.2,
+                    "round_timeout_s": 3.0,
+                    "round_timeout_min_clients": 2,
+                },
+                "validation_args": {"frequency_of_the_test": 1},
+                "comm_args": {"backend": "LOOPBACK"},
+            }
+        ).validate()
+        x_test, y_test = _separable(128, seed=9)
+        aggregator = FedMLAggregator(args, LogisticRegression(output_dim=4),
+                                     (x_test, y_test), worker_num=3,
+                                     model_dir=str(tmp_path / "models"))
+        server = FedMLServerManager(args, aggregator, client_rank=0, client_num=3)
+        devices = [
+            FakeDeviceManager(args, rank, _separable(96, seed=rank), client_num=3,
+                              upload_dir=str(tmp_path / f"dev{rank}"))
+            for rank in (1, 2)
+        ]
+        slow = SlowOnce(args, 3, _separable(96, seed=3), client_num=3,
+                        upload_dir=str(tmp_path / "dev3"))
+        with caplog.at_level(_logging.WARNING,
+                             logger="fedml_tpu.core.distributed.straggler"):
+            threads = ([server.run_async()] + [d.run_async() for d in devices]
+                       + [slow.run_async()])
+            for t in threads:
+                t.join(timeout=90)
+        for t in threads:
+            assert not t.is_alive(), "protocol did not terminate"
+        assert len(aggregator.eval_history) == 3
+        # the slow device handled every sync (late round-0 + rounds 1, 2)
+        assert slow.rounds_trained == 3
+        # only round 0 closed by timeout; rounds 1-2 were all-received
+        closes = [r.getMessage() for r in caplog.records
+                  if "timeout: closing" in r.getMessage()]
+        assert len(closes) == 1 and "round 0 timeout" in closes[0], closes
