@@ -58,6 +58,10 @@ class SimulatorXLA:
             from .xla.decentralized import DecentralizedInMeshAPI
 
             self.sim = DecentralizedInMeshAPI(args, device, dataset, model)
+        elif opt == "spreadgnn":
+            from .xla.decentralized import SpreadGNNInMeshAPI
+
+            self.sim = SpreadGNNInMeshAPI(args, device, dataset, model)
         elif opt == "hierarchicalfl":
             from .xla.hierarchical import HierarchicalInMeshAPI
 

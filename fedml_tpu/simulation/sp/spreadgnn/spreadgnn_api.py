@@ -28,17 +28,25 @@ logger = logging.getLogger(__name__)
 
 
 def _is_local_head(path: Tuple, head_names: Tuple[str, ...]) -> bool:
+    """One head-matching rule for BOTH backends (the in-mesh SpreadGNN
+    imports this): a leaf is a personalized head iff any path segment is an
+    exact head-name match."""
     keys = {getattr(k, "key", getattr(k, "name", None)) for k in path}
     return any(h in keys for h in head_names)
+
+
+def head_names_from(args) -> Tuple[str, ...]:
+    """Shared ``mtl_local_head_names`` parsing (default: 'readout')."""
+    heads = getattr(args, "mtl_local_head_names", None) or ("readout",)
+    if isinstance(heads, str):
+        heads = (heads,)
+    return tuple(heads)
 
 
 class SpreadGNNAPI(DecentralizedFLAPI):
     def __init__(self, args, device, dataset, model):
         super().__init__(args, device, dataset, model)
-        heads = getattr(args, "mtl_local_head_names", None) or ("readout",)
-        if isinstance(heads, str):
-            heads = (heads,)
-        self.head_names = tuple(heads)
+        self.head_names = head_names_from(args)
 
         @jax.jit
         def gossip(stacked, mix):

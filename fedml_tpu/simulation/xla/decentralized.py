@@ -44,6 +44,15 @@ logger = logging.getLogger(__name__)
 
 
 class DecentralizedInMeshAPI:
+    _needs_consensus = True  # eval reads the consensus mean; SpreadGNN's
+    # personalized eval does not — its rounds skip the full-model psum
+
+    def _mix_leaf(self, path) -> bool:
+        """Whether a parameter leaf participates in the gossip mix (called at
+        trace time, per leaf path).  SpreadGNN overrides to keep task heads
+        node-local."""
+        return True
+
     def __init__(self, args, device, dataset, model=None, mesh: Mesh = None):
         from ...ml.trainer.trainer_creator import loss_kind_for_dataset
         from .split import _pad_clients
@@ -118,25 +127,31 @@ class DecentralizedInMeshAPI:
                 one_node, (0.0, 0.0),
                 (table_l, idx_l, counts_l, rngs_l, real_l),
             )
-            # the gossip exchange: gather the trained node stack over ICI,
-            # then this device's rows of the mixing matrix in one matmul
-            gathered = jax.tree_util.tree_map(
-                lambda t: jax.lax.all_gather(t, "client", tiled=True), trained_l
-            )
-            new_l = jax.tree_util.tree_map(
-                lambda g: jnp.tensordot(
+            # the gossip exchange, leaf by leaf: gather the trained node
+            # stack over ICI, then this device's rows of the mixing matrix
+            # in one matmul.  Leaves excluded by _mix_leaf (SpreadGNN's
+            # personalized task heads) stay node-local and skip the
+            # collective entirely.
+            def gossip_leaf(path, t):
+                if not self._mix_leaf(path):
+                    return t.astype(jnp.float32)  # personalized: never averaged
+                g = jax.lax.all_gather(t, "client", tiled=True)
+                return jnp.tensordot(
                     mix_l, g.astype(jnp.float32).reshape((g.shape[0], -1)), axes=(1, 0)
-                ).reshape((mix_l.shape[0],) + g.shape[1:]),
-                gathered,
-            )
-            # consensus = plain mean over REAL nodes (sp eval model)
-            cons = jax.tree_util.tree_map(
-                lambda nl: jax.lax.psum(
-                    jnp.tensordot(real_l, nl.reshape((nl.shape[0], -1)), axes=(0, 0)),
-                    "client",
-                ).reshape(nl.shape[1:]) / n_real,
-                new_l,
-            )
+                ).reshape((mix_l.shape[0],) + g.shape[1:])
+
+            new_l = jax.tree_util.tree_map_with_path(gossip_leaf, trained_l)
+            if self._needs_consensus:
+                # consensus = plain mean over REAL nodes (sp eval model)
+                cons = jax.tree_util.tree_map(
+                    lambda nl: jax.lax.psum(
+                        jnp.tensordot(real_l, nl.reshape((nl.shape[0], -1)), axes=(0, 0)),
+                        "client",
+                    ).reshape(nl.shape[1:]) / n_real,
+                    new_l,
+                )
+            else:
+                cons = jnp.float32(0)  # structure-stable placeholder
             lsum = jax.lax.psum(lsum, "client")
             wsum = jax.lax.psum(wsum, "client")
             return new_l, cons, lsum / jnp.maximum(wsum, 1e-9)
@@ -190,4 +205,46 @@ class DecentralizedInMeshAPI:
         self.eval_history.append(out)
         self.metrics.log(out)
         logger.info("decentralized in-mesh eval: %s", out)
+        return out
+
+
+class SpreadGNNInMeshAPI(DecentralizedInMeshAPI):
+    """SpreadGNN on the mesh (reference ``research/SpreadGNN`` serverless
+    decentralized multi-task periodic averaging): the same compiled gossip
+    round, but task-head leaves (``mtl_local_head_names``, default
+    'readout') are EXCLUDED from the mix — they never enter the all_gather
+    and stay node-personalized, the paper's defining property.  Eval is the
+    per-node mean with each node's own head (sp twin
+    ``sp/spreadgnn/spreadgnn_api.py``)."""
+
+    _needs_consensus = False  # personalized eval never reads a consensus
+
+    def __init__(self, args, device, dataset, model=None, mesh: Mesh = None):
+        from ..sp.spreadgnn.spreadgnn_api import head_names_from
+
+        self.head_names = head_names_from(args)
+        super().__init__(args, device, dataset, model, mesh=mesh)
+
+    def _mix_leaf(self, path) -> bool:
+        from ..sp.spreadgnn.spreadgnn_api import _is_local_head
+
+        return not _is_local_head(path, self.head_names)
+
+    def _test_global(self, round_idx: int) -> Dict[str, Any]:
+        """Personalized eval: mean over nodes, each with its own head."""
+        corr = loss = tot = 0.0
+        for nid in range(self.n_nodes):
+            self.aggregator.set_model_params(self.node_params(nid))
+            stats = self.aggregator.test(self.test_global, None, self.args)
+            corr += stats["test_correct"]
+            loss += stats["test_loss"]
+            tot += stats["test_total"]
+        out = {
+            "round": round_idx,
+            "test_acc": round(corr / max(tot, 1.0), 4),
+            "test_loss": round(loss / max(tot, 1.0), 4),
+        }
+        self.eval_history.append(out)
+        self.metrics.log(out)
+        logger.info("spreadgnn in-mesh eval (per-node mean): %s", out)
         return out

@@ -7,7 +7,7 @@ set -u
 OUT=/tmp/tpu_results
 mkdir -p "$OUT"
 while true; do
-  if timeout 60 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  if timeout 60 python -c "import jax; d = jax.devices()[0]; assert 'cpu' not in (d.platform or '').lower(), d" >/dev/null 2>&1; then
     echo "$(date -u) tunnel OK — running sweep" >> "$OUT/watch.log"
     cd /root/repo
     python tools/perf_sweep.py --rounds 6 --cpr 32 \
