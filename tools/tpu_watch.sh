@@ -12,7 +12,13 @@ while true; do
     cd /root/repo
     python tools/perf_sweep.py --rounds 6 --cpr 32 \
       > "$OUT/sweep.json" 2> "$OUT/sweep.err"
-    echo "$(date -u) sweep rc=$?" >> "$OUT/watch.log"
+    rc=$?
+    echo "$(date -u) sweep rc=$rc" >> "$OUT/watch.log"
+    if [ "$rc" -ne 0 ]; then
+      # tunnel died mid-sweep: wait out the wedge and try again
+      sleep 900
+      continue
+    fi
     BENCH_TF_STEPS=12 python - > "$OUT/transformer.json" 2> "$OUT/transformer.err" <<'EOF'
 import json, sys
 sys.path.insert(0, "/root/repo")
