@@ -51,6 +51,11 @@ _CONFIG_SECTIONS = (
     "defense_args",
     "dp_args",
     "parallel_args",
+    # algorithm-family knob sections used by the example configs — an
+    # unlisted section would be kept as a dict attr and its knobs silently
+    # ignored (the value would quietly fall back to the in-code default)
+    "ta_args",
+    "vfl_args",
 )
 
 

@@ -62,6 +62,10 @@ class SimulatorXLA:
             from .xla.decentralized import SpreadGNNInMeshAPI
 
             self.sim = SpreadGNNInMeshAPI(args, device, dataset, model)
+        elif opt == "turbo_aggregate":
+            from .xla.turbo import TurboAggregateInMeshAPI
+
+            self.sim = TurboAggregateInMeshAPI(args, device, dataset, model)
         elif opt == "hierarchicalfl":
             from .xla.hierarchical import HierarchicalInMeshAPI
 

@@ -1,0 +1,142 @@
+"""In-mesh Turbo-Aggregate (simulation/xla/turbo.py): training + the
+multi-group masked-ring aggregation compile into one XLA program; gated by
+exact equivalence against the sp twin (the telescoping masks cancel, so the
+round output must equal the sp protocol's)."""
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.parallel.mesh import create_fl_mesh
+
+pytestmark = pytest.mark.heavy
+
+
+def _args(**over):
+    base = {
+        "common_args": {"training_type": "simulation", "random_seed": 0, "run_id": "ta"},
+        "data_args": {
+            "dataset": "mnist",
+            "data_cache_dir": "",
+            # homo => identical padded shapes on both backends (the
+            # exact-equality precondition)
+            "partition_method": "homo",
+            "synthetic_train_size": 512,
+        },
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "turbo_aggregate",
+            "client_num_in_total": 8,
+            "client_num_per_round": 8,
+            "comm_round": 3,
+            "epochs": 1,
+            "batch_size": 16,
+            "client_optimizer": "sgd",
+            "learning_rate": 0.1,
+            "ta_group_num": 3,
+        },
+        "validation_args": {"frequency_of_the_test": 1},
+        "comm_args": {"backend": "XLA"},
+    }
+    args = Arguments.from_dict(base)
+    for k, v in over.items():
+        setattr(args, k, v)
+    return args.validate()
+
+
+def _build(**over):
+    args = fedml_tpu.init(_args(**over), should_init_logs=False)
+    dataset, out_dim = fedml_tpu.data.load(args)
+    model = fedml_tpu.models.create(args, out_dim)
+    return args, dataset, model
+
+
+class TestTurboInMesh:
+    def test_matches_sp_twin(self):
+        """Same sampling, grouping-by-position, per-(round, client) keys,
+        and engine; the ring masks cancel — the compiled protocol must land
+        on the sp twin's global model (small fp slack: mask add/subtract
+        cancellation)."""
+        import jax
+
+        from fedml_tpu.simulation.sp.turboaggregate.ta_api import TurboAggregateAPI
+        from fedml_tpu.simulation.xla.turbo import TurboAggregateInMeshAPI
+
+        args, dataset, model = _build()
+        sp = TurboAggregateAPI(args, None, dataset, model)
+        sp.train()
+
+        args2, dataset2, model2 = _build()
+        api = TurboAggregateInMeshAPI(args2, None, dataset2, model2,
+                                      mesh=create_fl_mesh(4))
+        api.train()
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(api.variables),
+            jax.tree_util.tree_leaves(sp.w_global),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_masks_cancel_to_weighted_mean(self):
+        """The protocol must be transparent: identical final model to plain
+        sp FedAvg on the same config (same trainer key chain; the masks
+        telescope to zero, leaving the weighted mean)."""
+        import jax
+
+        from fedml_tpu.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+        from fedml_tpu.simulation.xla.turbo import TurboAggregateInMeshAPI
+
+        args, dataset, model = _build(comm_round=2)
+        api = TurboAggregateInMeshAPI(args, None, dataset, model,
+                                      mesh=create_fl_mesh(4))
+        api.train()
+
+        args2, dataset2, model2 = _build(comm_round=2,
+                                         federated_optimizer="FedAvg")
+        sp = FedAvgAPI(args2, None, dataset2, model2)
+        sp.train()
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(api.variables),
+            jax.tree_util.tree_leaves(sp.w_global),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_runner_dispatch(self):
+        from fedml_tpu.simulation.simulator import SimulatorXLA
+        from fedml_tpu.simulation.xla.turbo import TurboAggregateInMeshAPI
+
+        args, dataset, model = _build()
+        sim = SimulatorXLA(args, None, dataset, model)
+        assert isinstance(sim.sim, TurboAggregateInMeshAPI)
+
+    def test_padded_slots_with_unsampled_client_zero(self):
+        """cpr < total and not a multiple of the mesh: padding slots carry
+        id 0 even when client 0 was not sampled — they must stay inert, not
+        KeyError (regression)."""
+        from fedml_tpu.simulation.xla.turbo import TurboAggregateInMeshAPI
+
+        args, dataset, model = _build(client_num_in_total=16,
+                                      client_num_per_round=10, comm_round=3)
+        api = TurboAggregateInMeshAPI(args, None, dataset, model,
+                                      mesh=create_fl_mesh(4))
+        out = api.train()
+        assert out["test_acc"] > 0.8
+
+    def test_ta_args_section_flattens(self):
+        """The example's ta_args section must land on args (an unlisted
+        section would silently fall back to the in-code default)."""
+        import os
+
+        import yaml
+
+        cfg = os.path.join(os.path.dirname(__file__), os.pardir, "examples",
+                           "simulation", "xla_turbo_aggregate_mnist_lr",
+                           "fedml_config.yaml")
+        with open(cfg) as f:
+            args = Arguments.from_dict(yaml.safe_load(f))
+        assert args.ta_group_num == 2
+        assert not isinstance(getattr(args, "ta_args", None), dict) or "ta_group_num" not in args.ta_args

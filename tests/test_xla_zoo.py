@@ -255,11 +255,12 @@ class TestXLAZoo:
         assert_trees_close(got, w)
 
     def test_unsupported_zoo_algorithm_fails_loud(self):
-        # TurboAggregate's multi-group secure protocol is host-side by design;
-        # XLASimulator must refuse it rather than run plain FedAvg (FedGAN /
-        # FedNAS no longer qualify: SimulatorXLA routes them to their own
-        # in-mesh programs, simulation/xla/gan_nas.py)
-        args = fedml_tpu.init(_args(federated_optimizer="turboaggregate"), should_init_logs=False)
+        # XLASimulator owns only the shared FedAvg-family round; every
+        # structurally-distinct optimizer (turbo/GAN/NAS/gossip/...) has its
+        # own mesh program reached through SimulatorXLA's dispatch.  Handed
+        # such an optimizer DIRECTLY, XLASimulator must refuse rather than
+        # silently run plain FedAvg.
+        args = fedml_tpu.init(_args(federated_optimizer="turbo_aggregate"), should_init_logs=False)
         dataset, out_dim = fedml_tpu.data.load(args)
         model = fedml_tpu.models.create(args, out_dim)
         with pytest.raises(NotImplementedError, match="in-mesh"):
