@@ -69,16 +69,25 @@ def init(args: Arguments | None = None, should_init_logs: bool = True) -> Argume
     if coord:
         import jax as _jax
 
-        n_proc = int(getattr(args, "jax_num_processes", 0)
-                     or os.environ.get("FEDML_JAX_NUM_PROCESSES", 0))
-        pid = int(getattr(args, "jax_process_id", 0)
-                  or os.environ.get("FEDML_JAX_PROCESS_ID", 0))
-        _jax.distributed.initialize(
-            coordinator_address=str(coord),
-            num_processes=n_proc or None,
-            process_id=pid if n_proc else None,
-        )
-        _logger.info("jax.distributed up: proc %d/%s via %s", pid, n_proc, coord)
+        # explicit args keys win over env (same convention as the cross-silo
+        # env parse below) — and 0 is a VALID process id, so test `is None`
+        n_proc = getattr(args, "jax_num_processes", None)
+        if n_proc is None:
+            n_proc = int(os.environ.get("FEDML_JAX_NUM_PROCESSES", 0) or 0)
+        n_proc = int(n_proc)
+        pid = getattr(args, "jax_process_id", None)
+        if pid is None:
+            pid = int(os.environ.get("FEDML_JAX_PROCESS_ID", 0) or 0)
+        pid = int(pid)
+        # idempotent: a process calling init() again (new Arguments, second
+        # simulator) must not re-bootstrap the cluster
+        if not _jax.distributed.is_initialized():
+            _jax.distributed.initialize(
+                coordinator_address=str(coord),
+                num_processes=n_proc or None,
+                process_id=pid if n_proc else None,
+            )
+            _logger.info("jax.distributed up: proc %d/%s via %s", pid, n_proc, coord)
 
     # multi-process-silo cross-silo: a launcher (torchrun-style or the
     # example main.py spawner) places each silo process by env — parse it

@@ -1,0 +1,71 @@
+"""Child process for the multi-host (2-process jax.distributed) round test.
+
+Run as:  python multihost_child.py <rank> <coordinator_port>
+Env must set JAX_PLATFORMS=cpu and XLA_FLAGS device-count BEFORE jax loads
+(the parent test does this via the subprocess env).  Prints one final line
+``MHOK <padded_norm> <packed_norm>`` consumed by the parent.
+"""
+
+import os
+import sys
+
+
+def main(rank: int, port: str) -> None:
+    os.environ["FEDML_JAX_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["FEDML_JAX_NUM_PROCESSES"] = "2"
+    os.environ["FEDML_JAX_PROCESS_ID"] = str(rank)
+
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.arguments import Arguments
+
+    def build_args(**over):
+        args = Arguments.from_dict({
+            "common_args": {"training_type": "simulation", "random_seed": 0,
+                            "run_id": "mh"},
+            "data_args": {"dataset": "mnist", "data_cache_dir": "",
+                          "partition_method": "homo",
+                          "synthetic_train_size": 128},
+            "model_args": {"model": "lr"},
+            "train_args": {"federated_optimizer": "FedAvg",
+                           "client_num_in_total": 16,
+                           "client_num_per_round": 16, "comm_round": 2,
+                           "epochs": 1, "batch_size": 16,
+                           "client_optimizer": "sgd", "learning_rate": 0.1},
+            "validation_args": {"frequency_of_the_test": 0},
+            "comm_args": {"backend": "XLA"},
+        })
+        for k, v in over.items():
+            setattr(args, k, v)
+        return args.validate()
+
+    args = fedml_tpu.init(build_args(), should_init_logs=False)
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    from fedml_tpu import data, models
+    from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+    def norm(sim):
+        return sum(float(np.sum(np.abs(np.asarray(l))))
+                   for l in jax.tree_util.tree_leaves(sim.variables))
+
+    dataset, out_dim = data.load(args)
+    model = models.create(args, out_dim)
+    sim = XLASimulator(args, dataset, model)
+    sim.train()
+    padded = norm(sim)
+
+    args2 = fedml_tpu.init(build_args(xla_pack=True), should_init_logs=False)
+    sim2 = XLASimulator(args2, dataset, model)
+    sim2.train()
+    packed = norm(sim2)
+
+    print(f"MHOK {padded:.6f} {packed:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), sys.argv[2])
