@@ -64,7 +64,29 @@ def main(rank: int, port: str) -> None:
     sim2.train()
     packed = norm(sim2)
 
-    print(f"MHOK {padded:.6f} {packed:.6f}", flush=True)
+    # the security path: the per-client update stack stays P('client')-
+    # sharded (NOT fully addressable from either process) and the stacked
+    # attack + robust-aggregation program consumes it with global
+    # semantics — the multi-host-safety claim, executed for real
+    from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+    from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+    args3 = build_args(xla_pack=True, enable_attack=True,
+                       attack_type="byzantine", attack_mode="random",
+                       byzantine_client_num=2, enable_defense=True,
+                       defense_type="krum")
+    FedMLAttacker._attacker_instance = None
+    FedMLDefender._defender_instance = None
+    args3 = fedml_tpu.init(args3, should_init_logs=False)
+    try:
+        sim3 = XLASimulator(args3, dataset, model)
+        sim3.train()
+        defended = norm(sim3)
+    finally:
+        FedMLAttacker._attacker_instance = None
+        FedMLDefender._defender_instance = None
+
+    print(f"MHOK {padded:.6f} {packed:.6f} {defended:.6f}", flush=True)
 
 
 if __name__ == "__main__":

@@ -54,7 +54,10 @@ def test_two_process_round_executes_and_agrees():
         assert line, f"no MHOK line:\n{out}\n{err}"
         outs.append(tuple(float(x) for x in line[0].split()[1:]))
 
-    # both processes computed the identical global model (padded AND packed)
+    # both processes computed the identical global model (padded, packed,
+    # AND the defended round whose P('client') update stack is not fully
+    # addressable from either process)
+    assert len(outs[0]) == 3, outs
     assert outs[0] == outs[1], outs
 
 
