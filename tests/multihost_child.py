@@ -3,7 +3,7 @@
 Run as:  python multihost_child.py <rank> <coordinator_port>
 Env must set JAX_PLATFORMS=cpu and XLA_FLAGS device-count BEFORE jax loads
 (the parent test does this via the subprocess env).  Prints one final line
-``MHOK <padded_norm> <packed_norm>`` consumed by the parent.
+``MHOK <padded_norm> <packed_norm> <defended_norm>`` consumed by the parent.
 """
 
 import os

@@ -115,3 +115,23 @@ def test_single_process_oracle_matches_two_process():
     sim2 = XLASimulator(args2, dataset, model)
     sim2.train()
     np.testing.assert_allclose(norm(sim2), mh[1], rtol=1e-6)
+
+    # defended (stacked attack + krum) oracle: cross-process agreement alone
+    # would also pass for an identically-wrong result — pin it to the
+    # single-process run of the same program
+    from fedml_tpu.core.security.fedml_attacker import FedMLAttacker
+    from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+    args3 = build(xla_pack=True, enable_attack=True, attack_type="byzantine",
+                  attack_mode="random", byzantine_client_num=2,
+                  enable_defense=True, defense_type="krum")
+    FedMLAttacker._attacker_instance = None
+    FedMLDefender._defender_instance = None
+    args3 = fedml_tpu.init(args3, should_init_logs=False)
+    try:
+        sim3 = XLASimulator(args3, dataset, model)
+        sim3.train()
+        np.testing.assert_allclose(norm(sim3), mh[2], rtol=1e-6)
+    finally:
+        FedMLAttacker._attacker_instance = None
+        FedMLDefender._defender_instance = None

@@ -1,0 +1,25 @@
+"""Transport micro-benchmark harness (tools/transport_bench.py — the
+counterpart of the reference's python/tests/grpc_benchmark): smoke the
+measurement loop per backend at tiny scale."""
+
+import sys
+
+import pytest
+
+from netutil import free_port
+
+pytestmark = pytest.mark.heavy
+
+
+@pytest.mark.parametrize("backend", ["loopback", "tcp", "grpc"])
+def test_backend_measures(backend):
+    sys.path.insert(0, ".")
+    from tools.transport_bench import bench_backend
+
+    rows = bench_backend(backend, sizes=[1024, 65536], iters=5,
+                         base_port=free_port())
+    assert len(rows) == 2
+    for r in rows:
+        assert r["backend"] == backend
+        assert r["round_trips_per_s"] > 0
+        assert r["mb_per_s"] > 0

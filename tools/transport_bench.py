@@ -1,0 +1,172 @@
+"""Transport micro-benchmark — counterpart of the reference's
+``python/tests/grpc_benchmark/`` (gRPC vs torch-RPC throughput harness,
+SURVEY.md §4): round-trip latency and model-payload throughput for the
+in-repo message backends, two endpoints on localhost.
+
+    python tools/transport_bench.py [--backends loopback,tcp,grpc]
+                                    [--sizes 1024,1048576,8388608]
+                                    [--iters 30]
+
+Prints one JSON line per (backend, payload-size) with msgs/s and MB/s, and
+a final summary line.  The payload mimics a model sync: a dict of float32
+numpy arrays, pickled by the transport exactly as a real round would.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _payload(total_bytes: int):
+    n = max(1, total_bytes // 4)
+    return {"w": np.arange(n, dtype=np.float32)}
+
+
+def _make_pair(backend: str, base_port: int):
+    """Two connected endpoints (rank 0 and 1) of the given backend."""
+    if backend == "loopback":
+        from fedml_tpu.core.distributed.communication.loopback import (
+            LoopbackCommManager,
+            LoopbackHub,
+        )
+
+        LoopbackHub.reset()
+        return (LoopbackCommManager("tb", 0, 2), LoopbackCommManager("tb", 1, 2))
+    if backend == "tcp":
+        from fedml_tpu.core.distributed.communication.tcp.tcp_comm_manager import (
+            TCPCommManager,
+        )
+
+        return (TCPCommManager(base_port=base_port, rank=0, size=2),
+                TCPCommManager(base_port=base_port, rank=1, size=2))
+    if backend == "grpc":
+        from fedml_tpu.core.distributed.communication.grpc.grpc_comm_manager import (
+            GRPCCommManager,
+        )
+
+        return (GRPCCommManager(port=base_port, client_id=0, client_num=2,
+                                base_port=base_port),
+                GRPCCommManager(port=base_port + 1, client_id=1, client_num=2,
+                                base_port=base_port))
+    raise ValueError(backend)
+
+
+class _Echo:
+    """Rank-1 observer: echo every PING back to rank 0."""
+
+    def __init__(self, mgr):
+        self.mgr = mgr
+
+    def receive_message(self, msg_type, msg) -> None:
+        from fedml_tpu.core.distributed.communication.message import Message
+
+        if msg.get_type() == "ping":
+            m = Message("pong", 1, 0)
+            m.add_params("payload", msg.get("payload"))
+            self.mgr.send_message(m)
+
+
+class _Collect:
+    """Rank-0 observer: queue of received PONGS only (transports also emit
+    a connection_ready self-notification at startup; counting it would
+    offset the timed loop by one in-flight message)."""
+
+    def __init__(self):
+        self.q: "queue.Queue" = queue.Queue()
+
+    def receive_message(self, msg_type, msg) -> None:
+        if msg.get_type() == "pong":
+            self.q.put(msg)
+
+
+def bench_backend(backend: str, sizes, iters: int, base_port: int):
+    from fedml_tpu.core.distributed.communication.message import Message
+
+    a, b = _make_pair(backend, base_port)
+    col = _Collect()
+    a.add_observer(col)
+    b.add_observer(_Echo(b))
+    ta = threading.Thread(target=a.handle_receive_message, daemon=True)
+    tb = threading.Thread(target=b.handle_receive_message, daemon=True)
+    ta.start()
+    tb.start()
+    time.sleep(0.3)
+    rows = []
+    try:
+        for size in sizes:
+            payload = _payload(size)
+            # warmup
+            m = Message("ping", 0, 1)
+            m.add_params("payload", payload)
+            a.send_message(m)
+            col.q.get(timeout=30)
+            t0 = time.time()
+            for _ in range(iters):
+                m = Message("ping", 0, 1)
+                m.add_params("payload", payload)
+                a.send_message(m)
+                col.q.get(timeout=60)
+            dt = time.time() - t0
+            row = {
+                "backend": backend,
+                "payload_bytes": int(size),
+                "round_trips_per_s": round(iters / dt, 2),
+                "mb_per_s": round(2 * size * iters / dt / 1e6, 2),  # both legs
+                "rtt_ms": round(dt / iters * 1e3, 3),
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    finally:
+        a.stop_receive_message()
+        b.stop_receive_message()
+    return rows
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--backends", default="loopback,tcp,grpc")
+    p.add_argument("--sizes", default="1024,1048576,8388608")
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--base-port", type=int, default=0)
+    flags = p.parse_args()
+    sizes = [int(s) for s in flags.sizes.split(",")]
+    def _free_pair() -> int:
+        """A base port whose base AND base+1 are both bindable (the
+        two-endpoint backends use base+rank)."""
+        import socket
+
+        for _ in range(64):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+            s.close()
+            try:
+                s2 = socket.socket()
+                s2.bind(("127.0.0.1", base + 1))
+                s2.close()
+                return base
+            except OSError:
+                continue
+        raise RuntimeError("no free port pair found")
+
+    all_rows = []
+    for i, backend in enumerate(flags.backends.split(",")):
+        base_port = flags.base_port + 10 * i if flags.base_port else _free_pair()
+        all_rows += bench_backend(backend.strip(), sizes, flags.iters, base_port)
+    best = max(all_rows, key=lambda r: r["mb_per_s"])
+    print(json.dumps({"summary": "best_throughput", **best}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
