@@ -6,8 +6,10 @@ the innermost grid dimension sequentially per core, so the running
 (max, denom, out) accumulators live in VMEM scratch across k-steps and only
 [block_q, D] / [block_k, D] tiles are VMEM-resident (never the full K/V, so
 long contexts aren't VMEM-capped).  Composes with ring attention
-(parallel/ring_attention.py): ring moves K/V shards across chips, this
-kernel does the per-chip block math.
+(parallel/ring_attention.py): the ring moves K/V shards across chips via
+ppermute and :func:`flash_shard_update` folds each shard into the running
+online-softmax state per chip (wired as
+``ring_attention(..., block_fn=pallas_block_attend)``).
 
 Differentiation: a ``jax.custom_vjp`` over dedicated pallas backward
 kernels — the forward additionally emits the per-row log-sum-exp, and the
@@ -389,6 +391,215 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def shard_update_reference(q, k, v, q_pos, k_pos, causal, m, l, o):
+    """Fused-XLA online-softmax shard update — the SINGLE canonical
+    definition of ring attention's per-shard math (parallel/ring_attention
+    aliases this as ``_block_attend``), and the recompute path for
+    :func:`flash_shard_update`'s backward.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; q_pos/k_pos: [Lq]/[Lk] global
+    positions; (m, l, o): running (max [B,H,Lq], denom [B,H,Lq],
+    UNNORMALIZED out [B,Lq,H,D]) accumulators, all float32."""
+    d = q.shape[-1]
+    scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    live = (k_pos >= 0)[None, :]  # k_pos < 0 marks padding
+    if causal:
+        live = live & (q_pos[:, None] >= k_pos[None, :])
+    scores = jnp.where(live[None, None], scores, -jnp.inf)
+    block_max = jnp.max(scores, axis=-1)  # [B, H, Lq]
+    new_m = jnp.maximum(m, block_max)
+    # guard: rows with every position masked keep -inf max; exp(-inf - -inf)
+    # would be nan, so shift by a finite max in that case
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    p = jnp.exp(scores - safe_m[..., None])  # [B, H, Lq, Lk]
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    correction = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+    correction = jnp.where(jnp.isfinite(m), correction, 0.0)  # first block: no history
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhlm,bmhd->blhd", p, v.astype(jnp.float32))
+    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return new_m, new_l, new_o
+
+
+def _flash_update_kernel(q_ref, k_ref, v_ref, qp_ref, kp_ref, mi_ref, li_ref,
+                         oi_ref, mo_ref, lo_ref, oo_ref, m_s, l_s, acc_s, *,
+                         block_q, block_k, n_kb, causal, scale):
+    """Grid cell (bh, qi, kj): fold K/V block kj into the RUNNING online-
+    softmax state (m, l, unnormalized o) carried in from outside — the
+    per-chip block update of ring attention.  Positions come from the
+    q_pos/k_pos arrays (global ring offsets), not program ids; k_pos < 0
+    marks padding and is always dead."""
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _seed():
+        m_s[:] = mi_ref[0]
+        l_s[:] = li_ref[0]
+        acc_s[:] = oi_ref[0].astype(jnp.float32)
+
+    q_pos = qp_ref[0]  # [bq] i32
+    k_pos = kp_ref[0]  # [bk] i32
+    # dead-block skip (mirrors _flash_kernel's block_live): an all-padded
+    # key block, or a causal block whose earliest live key lies after this
+    # q block's last row, contributes nothing — skip both matmuls
+    any_live_key = jnp.any(k_pos >= 0)
+    block_live = any_live_key
+    if causal:
+        first_live_k = jnp.min(jnp.where(k_pos >= 0, k_pos, 2**30))
+        block_live = jnp.logical_and(block_live, jnp.max(q_pos) >= first_live_k)
+
+    @pl.when(block_live)
+    def _attend():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        live = (k_pos >= 0)[None, :]
+        if causal:
+            live = live & (q_pos[:, None] >= k_pos[None, :])
+        s = jnp.where(live, s, -jnp.inf)
+        m = m_s[:]
+        l = l_s[:]
+        block_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, block_max)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(s - safe_m[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        m_s[:] = new_m
+        l_s[:] = l * corr + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_s[:] = acc_s[:] * corr[:, None] + pv
+
+    @pl.when(kj == n_kb - 1)
+    def _finish():
+        mo_ref[0] = m_s[:]
+        lo_ref[0] = l_s[:]
+        oo_ref[0] = acc_s[:].astype(oo_ref.dtype)
+
+
+def _flash_shard_update_impl(q, k, v, q_pos, k_pos, m, l, o, causal,
+                            block_q, block_k, interpret):
+    """Pallas block update for ring attention: fold ONE K/V shard into the
+    running (m, l, unnormalized o) online-softmax state.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; q_pos/k_pos: [Lq]/[Lk] global
+    positions (i32); m, l: [B, H, Lq] f32; o: [B, Lq, H, D] f32
+    (UNNORMALIZED accumulator).  Returns updated (m, l, o) — the exact
+    math of :func:`fedml_tpu.parallel.ring_attention._block_attend`, block
+    by block in VMEM.  Pallas-kernel side of the ring+flash composition:
+    the ring moves K/V shards over ICI, this folds each shard locally."""
+    if not _HAS_PALLAS:
+        raise RuntimeError("pallas is unavailable in this jax build")
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    block_q = min(block_q, Lq)
+    block_k = min(block_k, Lk)
+    # q and k pad independently here: the grid axes are separate, so no
+    # common-multiple constraint (unlike _pad_geometry's shared L)
+    Lqp = -(-Lq // block_q) * block_q
+    Lkp = -(-Lk // block_k) * block_k
+
+    qb = _to_bh(q, B, Lq, H, D, Lqp)
+    kb = _to_bh(k, B, Lk, H, D, Lkp)
+    vb = _to_bh(v, B, Lk, H, D, Lkp)
+    qp = jnp.pad(q_pos.astype(jnp.int32), (0, Lqp - Lq))[None, :]
+    kp = jnp.pad(k_pos.astype(jnp.int32), (0, Lkp - Lk),
+                 constant_values=-1)[None, :]  # padded keys: always dead
+    mb = jnp.pad(m.reshape(B * H, Lq), ((0, 0), (0, Lqp - Lq)),
+                 constant_values=-jnp.inf)
+    lb = jnp.pad(l.reshape(B * H, Lq), ((0, 0), (0, Lqp - Lq)))
+    ob = _to_bh(o, B, Lq, H, D, Lqp)
+    scale = float(1.0 / (D**0.5))
+    n_kb = Lkp // block_k
+    kernel = functools.partial(
+        _flash_update_kernel, block_q=block_q, block_k=block_k, n_kb=n_kb,
+        causal=causal, scale=scale,
+    )
+    mo, lo, oo = pl.pallas_call(
+        kernel,
+        grid=(B * H, Lqp // block_q, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),   # q
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, block_q), lambda b, i, j: (0, i)),         # q_pos
+            pl.BlockSpec((1, block_k), lambda b, i, j: (0, j)),         # k_pos
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),         # m in
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),         # l in
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),   # o in
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Lqp), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Lqp), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Lqp, D), jnp.float32),
+        ],
+        scratch_shapes=_scratch(block_q, D),
+        interpret=interpret,
+    )(qb, kb, vb, qp, kp, mb, lb, ob)
+    m_out = mo[:, :Lq].reshape(B, H, Lq)
+    l_out = lo[:, :Lq].reshape(B, H, Lq)
+    o_out = _from_bh(oo, B, Lq, H, D)
+    return m_out, l_out, o_out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11))
+def _flash_shard_update_vjp(q, k, v, q_pos, k_pos, m, l, o, causal, block_q,
+                            block_k, interpret):
+    return _flash_shard_update_impl(q, k, v, q_pos, k_pos, m, l, o, causal,
+                                    block_q, block_k, interpret)
+
+
+def _shard_update_fwd(q, k, v, q_pos, k_pos, m, l, o, causal, block_q,
+                      block_k, interpret):
+    out = _flash_shard_update_impl(q, k, v, q_pos, k_pos, m, l, o, causal,
+                                   block_q, block_k, interpret)
+    return out, (q, k, v, q_pos, k_pos, m, l, o)
+
+
+def _shard_update_bwd(causal, block_q, block_k, interpret, res, g):
+    # exact gradients by recomputing through the canonical XLA update (the
+    # same trade the main kernel made before its dedicated backward): the
+    # composed ring+pallas path stays trainable
+    import numpy as np
+
+    q, k, v, q_pos, k_pos, m, l, o = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, m_, l_, o_: shard_update_reference(
+            q_, k_, v_, q_pos, k_pos, causal, m_, l_, o_
+        ),
+        q, k, v, m, l, o,
+    )
+    dq, dk, dv, dm, dl, do = vjp(g)
+    zq = np.zeros(q_pos.shape, dtype=jax.dtypes.float0)  # int positions
+    zk = np.zeros(k_pos.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, zq, zk, dm, dl, do
+
+
+_flash_shard_update_vjp.defvjp(_shard_update_fwd, _shard_update_bwd)
+
+
+def flash_shard_update(q, k, v, q_pos, k_pos, m, l, o, causal: bool = True,
+                       block_q: int = 128, block_k: int = 128,
+                       interpret: bool = False):
+    """Differentiable pallas shard update (see _flash_shard_update_impl for
+    the kernel): forward in VMEM blocks, backward by exact recompute through
+    :func:`shard_update_reference`."""
+    return _flash_shard_update_vjp(q, k, v, q_pos, k_pos, m, l, o, causal,
+                                   block_q, block_k, interpret)
 
 
 def _on_tpu() -> bool:

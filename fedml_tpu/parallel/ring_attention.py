@@ -35,32 +35,29 @@ except ImportError:  # pragma: no cover
         )
 
 
-def _block_attend(q, k, v, q_pos, k_pos, causal, m, l, o):
-    """One K/V block's contribution under online softmax.
+# one canonical definition of the per-shard online-softmax math, shared
+# with the pallas kernel's backward (ops/flash_attention.py)
+from ..ops.flash_attention import shard_update_reference
 
-    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; q_pos/k_pos: [Lq]/[Lk] global
-    positions; (m, l, o): running (max [B,H,Lq], denom [B,H,Lq],
-    out [B,Lq,H,D]) accumulators, all float32.
-    """
-    d = q.shape[-1]
-    scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32)
-    scores = scores / jnp.sqrt(jnp.float32(d))
-    if causal:
-        mask = q_pos[:, None] >= k_pos[None, :]  # [Lq, Lk]
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    block_max = jnp.max(scores, axis=-1)  # [B, H, Lq]
-    new_m = jnp.maximum(m, block_max)
-    # guard: rows with every position masked keep -inf max; exp(-inf - -inf)
-    # would be nan, so shift by a finite max in that case
-    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
-    p = jnp.exp(scores - safe_m[..., None])  # [B, H, Lq, Lk]
-    p = jnp.where(jnp.isfinite(scores), p, 0.0)
-    correction = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
-    correction = jnp.where(jnp.isfinite(m), correction, 0.0)  # first block: no history
-    new_l = l * correction + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhlm,bmhd->blhd", p, v.astype(jnp.float32))
-    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
-    return new_m, new_l, new_o
+
+def _block_attend(q, k, v, q_pos, k_pos, causal, m, l, o):
+    """One K/V block's contribution under online softmax (the fused-XLA
+    default block_fn; see :func:`shard_update_reference`)."""
+    return shard_update_reference(q, k, v, q_pos, k_pos, causal, m, l, o)
+
+
+def pallas_block_attend(q, k, v, q_pos, k_pos, causal, m, l, o,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: bool = False):
+    """Drop-in for :func:`_block_attend` that folds the K/V shard through
+    the pallas block-update kernel (ops/flash_attention.flash_shard_update):
+    the ring moves shards over ICI via ppermute, the kernel does the
+    per-chip block math in VMEM — the composed ring+flash design."""
+    from ..ops.flash_attention import flash_shard_update
+
+    return flash_shard_update(q, k, v, q_pos, k_pos, m, l, o, causal=causal,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret)
 
 
 def ring_attention_inner(
@@ -69,9 +66,14 @@ def ring_attention_inner(
     v: jnp.ndarray,
     axis_name: str = "sp",
     causal: bool = True,
+    block_fn=None,
 ) -> jnp.ndarray:
     """Exact attention where q/k/v are the LOCAL sequence shards [B, Ls, H, D]
-    of a ring over ``axis_name``.  Must run inside shard_map."""
+    of a ring over ``axis_name``.  Must run inside shard_map.  ``block_fn``
+    selects the per-shard update: the fused-XLA :func:`_block_attend`
+    (default) or :func:`pallas_block_attend` (the flash kernel per chip)."""
+    if block_fn is None:
+        block_fn = _block_attend
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     B, Ls, H, D = q.shape
@@ -85,7 +87,7 @@ def ring_attention_inner(
     for r in range(n):
         src = (my - r) % n  # ring shift r: the block originated on device my-r
         k_pos = src * Ls + jnp.arange(cur_k.shape[1])
-        m, l, o = _block_attend(q, cur_k, cur_v, q_pos, k_pos, causal, m, l, o)
+        m, l, o = block_fn(q, cur_k, cur_v, q_pos, k_pos, causal, m, l, o)
         if r < n - 1:
             # one collective for both operands (pytree ppermute)
             cur_k, cur_v = jax.lax.ppermute((cur_k, cur_v), axis_name, perm)
@@ -100,14 +102,19 @@ def ring_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     causal: bool = True,
+    block_fn=None,
 ) -> jnp.ndarray:
     """Standalone ring attention: q/k/v are FULL [B, L, H, D] arrays; the
     sequence axis is sharded over ``axis_name`` and the result gathered."""
     spec = P(None, axis_name, None, None)
     fn = shard_map(
-        partial(ring_attention_inner, axis_name=axis_name, causal=causal),
+        partial(ring_attention_inner, axis_name=axis_name, causal=causal,
+                block_fn=block_fn),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # only the pallas block_fn needs the relaxation (pallas_call outputs
+        # can't declare vma); the default XLA path keeps strict checking
+        check_vma=block_fn is None,
     )
     return fn(q, k, v)
