@@ -1,0 +1,16 @@
+"""Cross-silo entry — run one server and N clients as separate processes:
+    python main.py --cf fedml_config.yaml --role server --rank 0
+    python main.py --cf fedml_config.yaml --role client --rank 1
+"""
+import sys
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    role = "client"
+    if "--role" in sys.argv:
+        role = sys.argv[sys.argv.index("--role") + 1]
+    if role == "server":
+        fedml_tpu.run_cross_silo_server()
+    else:
+        fedml_tpu.run_cross_silo_client()

@@ -56,6 +56,7 @@ _CONFIG_SECTIONS = (
     # ignored (the value would quietly fall back to the in-code default)
     "ta_args",
     "vfl_args",
+    "fault_args",
 )
 
 
