@@ -132,3 +132,56 @@ def test_all_silos_alive_is_unchanged():
     for t in threads:
         t.join(timeout=30)
         assert not t.is_alive()
+
+
+def test_round_survives_silent_silo_over_mqtt(tmp_path):
+    """Straggler tolerance is transport-independent: the same silent-silo
+    scenario over the MQTT broker backend (whose last-will liveness plane
+    coexists with the round timer) completes with the live cohort."""
+    from fedml_tpu.core.distributed.communication.mqtt_s3.broker import LocalBroker
+
+    broker = LocalBroker().start()
+    try:
+        n = 3
+        extra = dict(round_timeout_s=3.0, round_timeout_min_clients=2,
+                     mqtt_host="127.0.0.1", mqtt_port=broker.port,
+                     s3_blob_root=str(tmp_path / "blobs"))
+
+        def mqtt_args(rank, role):
+            # comm_args flattens LAST, so backend must be set after _args
+            a = _args("ft-mqtt", n, **extra)
+            a.backend = "MQTT_S3"
+            a.role, a.rank = role, rank
+            return fedml_tpu.init(a, should_init_logs=False)
+
+        args_s = mqtt_args(0, "server")
+        ds, out_dim = fedml_tpu.data.load(args_s)
+        from fedml_tpu.cross_silo.client.client import Client
+        from fedml_tpu.cross_silo.server.server import Server
+
+        server = Server(args_s, None, ds, fedml_tpu.models.create(args_s, out_dim))
+        live = []
+        for r in (1, 2):
+            a = mqtt_args(r, "client")
+            ds_c, od_c = fedml_tpu.data.load(a)
+            live.append(Client(a, None, ds_c, fedml_tpu.models.create(a, od_c)))
+
+        class _SilentMqtt(_SilentClient):
+            def __init__(self, args, rank, size):
+                FedMLCommManager.__init__(self, args, None, rank, size,
+                                          backend="MQTT_S3")
+
+        silent = _SilentMqtt(mqtt_args(3, "client"), rank=3, size=n + 1)
+        threads = [threading.Thread(target=c.run, daemon=True) for c in live]
+        threads.append(threading.Thread(target=silent.run, daemon=True))
+        for t in threads:
+            t.start()
+        t0 = time.time()
+        history = server.run()
+        assert len(history) == 2
+        assert time.time() - t0 < 40
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+    finally:
+        broker.stop()
