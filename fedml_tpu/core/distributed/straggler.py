@@ -48,6 +48,9 @@ class RoundTimeoutMixin:
         self._handshake_timer: Optional[threading.Timer] = None
         self._gen = 0  # phase generation: stale timer callbacks no-op
         self._finished = False
+        # set on the first timeout-close: only from then on can a stale
+        # upload exist (every earlier round closed with its full cohort)
+        self._had_timeout_close = False
 
     # -- sends ---------------------------------------------------------------
     def _send_safe(self, m) -> None:
@@ -68,9 +71,31 @@ class RoundTimeoutMixin:
         """(lock held) True when an upload's round tag does not match the
         current round — a straggler upload for an already-closed round: the
         client will pick up the current sync next (the reference has no tag
-        and would silently fold it into the wrong round).  Untagged uploads
-        (older clients) are accepted for compatibility."""
-        if msg_round is None or int(msg_round) == int(self.args.round_idx):
+        and would silently fold it into the wrong round).
+
+        Untagged uploads (``msg_round`` None): accepted until the FIRST
+        timeout-close — while every round still closes with its full
+        cohort, no upload can be stale, so legacy untagged clients keep
+        working (dropping them outright would livelock an untagged fleet:
+        rounds would never reach the min-client floor).  From the first
+        timeout-close on, a round-less late arrival is exactly the
+        wrong-round corruption the tag exists to prevent (in cross-silo
+        the is_delta path would rebase a stale delta onto the new global),
+        so untagged uploads are then dropped loudly.  All in-repo clients
+        tag."""
+        if msg_round is None:
+            if self.round_timeout_s <= 0 or not self._had_timeout_close:
+                return False
+            logger.warning(
+                "dropping UNTAGGED upload from client %s: a round has "
+                "already closed by timeout (round_timeout_s=%.1f), so an "
+                "upload without a round tag cannot be matched to the "
+                "current round %d — upgrade the client to send "
+                "MSG_ARG_KEY_ROUND_INDEX",
+                sender, self.round_timeout_s, self.args.round_idx,
+            )
+            return True
+        if int(msg_round) == int(self.args.round_idx):
             return False
         logger.warning("dropping stale round-%s upload from client %s "
                        "(current round %d)", msg_round, sender,
@@ -105,7 +130,8 @@ class RoundTimeoutMixin:
             got = self.aggregator.received_indices()
             if len(got) < max(1, self.round_timeout_min_clients):
                 logger.warning(
-                    "round %d timeout with %d/%d uploads (< min %d): waiting on",
+                    "round %d timeout with %d/%d uploads (< min %d): "
+                    "re-arming the timer and waiting for more uploads",
                     self.args.round_idx, len(got),
                     len(self.client_id_list_in_this_round),
                     self.round_timeout_min_clients,
@@ -116,6 +142,7 @@ class RoundTimeoutMixin:
                 "round %d timeout: closing with %d/%d clients (stragglers dropped)",
                 self.args.round_idx, len(got), len(self.client_id_list_in_this_round),
             )
+            self._had_timeout_close = True  # stale arrivals now possible
             self._finalize_safely(self.aggregator.consume_received(got))
 
     # -- round close ----------------------------------------------------------
@@ -160,7 +187,8 @@ class RoundTimeoutMixin:
             online = sum(self.client_online_status.values())
             if online < max(1, self.round_timeout_min_clients):
                 logger.warning(
-                    "handshake timeout with %d/%d online (< min %d): waiting on",
+                    "handshake timeout with %d/%d online (< min %d): "
+                    "re-arming the timer and waiting for more clients",
                     online, self.client_num, self.round_timeout_min_clients,
                 )
                 self._start_phase_timer("_handshake_timer", self._on_handshake_timeout)

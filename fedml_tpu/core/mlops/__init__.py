@@ -18,13 +18,14 @@ from .mlops_profiler_event import MLOpsProfilerEvent
 from .mlops_runtime_log import MLOpsRuntimeLog
 from .mlops_runtime_log_daemon import MLOpsRuntimeLogDaemon
 from .mlops_status import ClientStatus, MLOpsStatus, ServerStatus
-from .sinks import BrokerSink, FanoutSink, InMemorySink, JsonlFileSink
+from .sinks import BrokerSink, FanoutSink, InMemorySink, JsonlFileSink, WandbSink
 from .system_stats import SysStats
 
 __all__ = [
     "MLOpsMetrics", "MLOpsProfilerEvent", "MLOpsRuntimeLog",
     "MLOpsRuntimeLogDaemon", "MLOpsStatus", "ClientStatus", "ServerStatus",
     "SysStats", "FanoutSink", "InMemorySink", "JsonlFileSink", "BrokerSink",
+    "WandbSink",
     "pre_setup", "init", "finish", "event", "log", "log_round_info",
     "log_training_status", "log_aggregation_status", "log_sys_perf",
     "log_aggregated_model_info", "log_client_model_info", "enabled", "sink",
@@ -68,6 +69,20 @@ def init(args: Any, sink_obj: Optional[FanoutSink] = None) -> None:
         port = getattr(args, "mlops_broker_port", None)
         if host and port:
             fan.add(BrokerSink(host, int(port), run_id))
+        if getattr(args, "enable_wandb", False):
+            # never a silent dead flag: either the sink attaches or the
+            # operator is told exactly why their wandb dashboards are empty
+            try:
+                fan.add(WandbSink(args))
+            except Exception as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "enable_wandb is set but the wandb sink could not start "
+                    "(%s): metrics go to the local sinks only — install the "
+                    "'wandb' package (WANDB_MODE=offline works without "
+                    "egress) to activate this leg", e,
+                )
         _ctx.update(
             enabled=True,
             args=args,

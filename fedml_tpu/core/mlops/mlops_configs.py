@@ -46,6 +46,32 @@ class MLOpsConfigs:
     def fetch_s3_config(self) -> Dict[str, Any]:
         return self.fetch_configs(["s3_config"])["s3_config"]
 
+    # -- simulation-run registration (reference core/mlops/__init__.py
+    # create_project :438 / create_run :466 — the RPCs a simulation makes
+    # before streaming metrics so the platform UI has a project/run row)
+    PROJECT_PATH = "/fedmlOpsServer/projects/createSim"
+    RUN_PATH = "/fedmlOpsServer/runs/createSim"
+
+    def create_project(self, project_name: str, api_key: str = "") -> Any:
+        """-> platform project id."""
+        out = self._post(self.PROJECT_PATH, {
+            "name": str(project_name), "userids": api_key,
+            "platform_type": "simulation",
+        })
+        return out["data"]
+
+    def create_run(self, project_id, api_key: str = "",
+                   edge_ids: Optional[List[int]] = None,
+                   run_name: Optional[str] = None) -> Any:
+        """-> platform run id."""
+        payload: Dict[str, Any] = {
+            "userids": api_key, "projectid": str(project_id),
+            "edgeids": list(edge_ids or []),
+        }
+        if run_name is not None:
+            payload["name"] = run_name
+        return self._post(self.RUN_PATH, payload)["data"]
+
 
 def post_log_chunk(log_server_url: str, run_id, rank: int, lines: List[str],
                    timeout_s: float = 10.0) -> None:

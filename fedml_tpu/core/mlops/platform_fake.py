@@ -30,6 +30,8 @@ class MLOpsPlatformFake:
         self._thread: Optional[threading.Thread] = None
         self.log_uploads: List[Dict[str, Any]] = []
         self.config_fetches: List[List[str]] = []
+        self.projects: List[Dict[str, Any]] = []   # createSim registrations
+        self.runs: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         # what the fetch endpoint hands out (reference: MQTT + S3 credentials
         # and the log-server address)
@@ -77,6 +79,19 @@ class MLOpsPlatformFake:
                     with fake._lock:
                         fake.log_uploads.append(req)
                     return self._json(200, {"code": "SUCCESS"})
+                if self.path == "/fedmlOpsServer/projects/createSim":
+                    # simulation project registration (reference
+                    # core/mlops/__init__.py:440): echo back a project id
+                    with fake._lock:
+                        fake.projects.append(req)
+                        pid = len(fake.projects)
+                    return self._json(200, {"code": "SUCCESS", "data": pid})
+                if self.path == "/fedmlOpsServer/runs/createSim":
+                    # simulation run registration (reference :469)
+                    with fake._lock:
+                        fake.runs.append(req)
+                        rid = len(fake.runs)
+                    return self._json(200, {"code": "SUCCESS", "data": rid})
                 return self._json(404, {"code": "FAILED", "message": "unknown path"})
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)

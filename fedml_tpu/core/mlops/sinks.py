@@ -117,6 +117,64 @@ class BrokerSink(Sink):
         self._client.disconnect()
 
 
+class WandbSink(Sink):
+    """wandb-reporting leg (reference ``mlops_profiler_event.py:30``
+    ``log_to_wandb``, ``simulation/sp/fedavg/fedavg_api.py:218-232``
+    ``wandb.log``): numeric metric topics become ``wandb.log`` rows, events
+    become prefixed keys.  Constructing this sink requires the ``wandb``
+    package and raises ImportError otherwise — the mlops ``init`` wiring
+    catches that and downgrades ``enable_wandb`` to a LOUD warning, so the
+    flag is never a silent no-op.  In a zero-egress environment run with
+    ``WANDB_MODE=offline`` (wandb then journals locally)."""
+
+    _METRIC_TOPICS = ("train_metric", "agg_metric", "round_info", "sys_perf")
+
+    def __init__(self, args: Any):
+        import wandb  # optional dep: ImportError -> caller warns loudly
+
+        self._wandb = wandb
+        # adopt a run the USER already opened without closing it at
+        # mlops.finish(); only a run this sink started is ours to finish
+        self._owns_run = wandb.run is None
+        if wandb.run is None:
+            wandb.init(
+                project=str(getattr(args, "wandb_project", "fedml_tpu")),
+                name=str(getattr(args, "run_name", None)
+                         or f"run_{getattr(args, 'run_id', '0')}"),
+                config={k: v for k, v in vars(args).items()
+                        if isinstance(v, (int, float, str, bool))},
+                mode=os.environ.get("WANDB_MODE",
+                                    str(getattr(args, "wandb_mode", "offline"))),
+            )
+
+    def emit(self, topic: str, record: Dict[str, Any]) -> None:
+        if topic in self._METRIC_TOPICS:
+            row = {k: v for k, v in record.items()
+                   if isinstance(v, (int, float)) and k not in ("ts", "edge_id")}
+            if "round_idx" in record:
+                row["round_idx"] = record["round_idx"]
+            if row:
+                self._wandb.log(row)
+        elif topic == "event":
+            name = record.get("event", "event")
+            row = {}
+            if isinstance(record.get("value"), (int, float)):
+                row[f"event/{name}"] = record["value"]
+            if isinstance(record.get("duration_s"), (int, float)):
+                # the reference's log_to_wandb posts span durations
+                # (mlops_profiler_event.py:30)
+                row[f"event/{name}/duration_s"] = record["duration_s"]
+            if row:
+                self._wandb.log(row)
+
+    def close(self) -> None:
+        try:
+            if self._owns_run and self._wandb.run is not None:
+                self._wandb.finish()
+        except Exception:
+            pass
+
+
 class FanoutSink(Sink):
     def __init__(self, sinks: Optional[List[Sink]] = None):
         self.sinks = list(sinks or [])

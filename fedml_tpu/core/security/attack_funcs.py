@@ -96,24 +96,42 @@ def invert_gradient(
     key: jax.Array,
     steps: int = 100,
     lr: float = 0.1,
+    match: str = "l2",
+    tv_weight: float = 0.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Minimize ||grad_fn(x, softmax(y)) - target||^2 over dummy (x, y).
+    """Reconstruct a dummy (x, y) whose gradients match ``target_grads``.
 
     ``grad_fn`` maps (inputs, soft labels) -> parameter-gradient pytree of the
     victim model at the intercepted step.  One fused jitted Adam-free loop
-    (plain GD with cosine-ish decay) — enough to demonstrate leakage, matching
-    the role of reference dlg_attack.py.
+    (plain GD with cosine-ish decay) — enough to demonstrate leakage.
+
+    ``match``: the gradient-match loss — ``"l2"`` (DLG, Zhu et al.) or
+    ``"cosine"`` (Inverting Gradients, Geiping et al.); ``tv_weight`` > 0
+    adds a total-variation image prior on 4-D (NHWC) inputs.  Both analysis
+    attacks delegate here so the GD loop exists once.
     """
     kx, ky = jax.random.split(key)
     x0 = jax.random.normal(kx, x_shape)
     y0 = jax.random.normal(ky, y_logits_shape)
     tvec, _ = ravel_pytree(target_grads)
+    tnorm = jnp.linalg.norm(tvec)
 
     def loss(xy):
         x, y = xy
         g = grad_fn(x, jax.nn.softmax(y, axis=-1))
         gvec, _ = ravel_pytree(g)
-        return jnp.sum((gvec - tvec) ** 2)
+        if match == "cosine":
+            out = 1.0 - jnp.dot(gvec, tvec) / jnp.maximum(
+                jnp.linalg.norm(gvec) * tnorm, 1e-12
+            )
+        else:
+            out = jnp.sum((gvec - tvec) ** 2)
+        if tv_weight > 0 and len(x_shape) == 4:  # NHWC image prior
+            out = out + tv_weight * (
+                jnp.abs(x[:, 1:, :, :] - x[:, :-1, :, :]).mean()
+                + jnp.abs(x[:, :, 1:, :] - x[:, :, :-1, :]).mean()
+            )
+        return out
 
     @jax.jit
     def run(x0, y0):
@@ -127,6 +145,43 @@ def invert_gradient(
     return run(x0, y0)
 
 
+def invert_gradient_attack(
+    module,
+    variables: Pytree,
+    client_update: Pytree,
+    x_shape: Tuple[int, ...],
+    num_classes: int,
+    key: jax.Array,
+    lr_client: float = 0.1,
+    steps: int = 200,
+    lr_attack: float = 0.1,
+    tv_weight: float = 1e-2,
+):
+    """'Inverting Gradients' (Geiping et al.) reconstruction from an
+    intercepted update — reference ``invert_gradient_attack.py``: COSINE
+    gradient matching + a total-variation image prior, vs :func:`dlg_attack`'s
+    plain L2 match.  Returns ``(x_rec, y_soft_logits)``.  Delegates the GD
+    loop to :func:`invert_gradient` (one loop, two match losses)."""
+    import optax
+
+    target_grads = jax.tree_util.tree_map(
+        lambda g, w: (g - w) / lr_client, variables["params"], client_update["params"]
+    )
+
+    def grad_fn(x, y_soft):
+        def loss(params):
+            logits = module.apply(dict(variables, params=params), x, train=False)
+            per = optax.softmax_cross_entropy(logits.astype(jnp.float32), y_soft)
+            return jnp.mean(per)
+
+        return jax.grad(loss)(variables["params"])
+
+    return invert_gradient(
+        grad_fn, target_grads, x_shape, (x_shape[0], num_classes), key,
+        steps=steps, lr=lr_attack, match="cosine", tv_weight=tv_weight,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Revealing labels from gradients (sign heuristic)
 # ---------------------------------------------------------------------------
@@ -134,6 +189,43 @@ def reveal_labels_from_gradients(last_layer_bias_grad: jnp.ndarray) -> jnp.ndarr
     """Classes present in a cross-entropy batch have negative bias-gradient
     entries (iDLG observation) — return indices sorted by most-negative."""
     return jnp.argsort(last_layer_bias_grad)
+
+
+def reveal_labels_from_update(
+    variables: Pytree,
+    client_update: Pytree,
+    num_classes: int,
+    lr_client: float = 0.1,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Label revelation from an intercepted UPDATE (the simulator-facing
+    wrapper over :func:`reveal_labels_from_gradients`): locate the classifier
+    bias, estimate its gradient as ``(w_prev - w_new)/lr``, and return
+    ``(order, present)``: class indices sorted most-likely-present first,
+    and the boolean negative-entry mask (classes the iDLG heuristic says
+    were in the batch).
+
+    Head lookup: among ``(num_classes,)``-shaped leaves, prefer those whose
+    tree path names a bias (a hidden layer of width == num_classes would
+    otherwise shadow the head), then take the LAST such leaf (flax orders
+    the output layer last)."""
+    prev_paths = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+    new_leaves = jax.tree_util.tree_leaves(client_update["params"])
+    candidates = []
+    for (path, p), q in zip(prev_paths, new_leaves):
+        if p.shape != (num_classes,):
+            continue
+        names = "/".join(str(getattr(k, "key", k)) for k in path).lower()
+        candidates.append(("bias" in names, p, q))
+    if not candidates:
+        raise ValueError(
+            f"no ({num_classes},) bias leaf in the params tree — cannot "
+            "locate the classifier head for label revelation"
+        )
+    has_bias = any(is_bias for is_bias, _, _ in candidates)
+    p, q = [(p, q) for is_bias, p, q in candidates
+            if is_bias or not has_bias][-1]
+    bias_grad = (p.astype(jnp.float32) - q.astype(jnp.float32)) / lr_client
+    return reveal_labels_from_gradients(bias_grad), bias_grad < 0
 
 
 # ---------------------------------------------------------------------------

@@ -23,8 +23,10 @@ from .constants import (
     ATTACK_METHOD_BYZANTINE_ATTACK,
     ATTACK_METHOD_DLG,
     ATTACK_METHOD_EDGE_CASE_BACKDOOR,
+    ATTACK_METHOD_INVERT_GRADIENT,
     ATTACK_METHOD_LABEL_FLIPPING,
     ATTACK_METHOD_MODEL_REPLACEMENT,
+    ATTACK_METHOD_REVEALING_LABELS,
 )
 
 logger = logging.getLogger(__name__)
@@ -39,6 +41,13 @@ _DATA_ATTACKS = {
     ATTACK_METHOD_LABEL_FLIPPING,
     ATTACK_METHOD_BACKDOOR,  # trigger-pattern stamping + relabel
     ATTACK_METHOD_EDGE_CASE_BACKDOOR,  # tail-sample relabel
+}
+_ANALYSIS_ATTACKS = {
+    # privacy/analysis primitives: run on ONE intercepted client update
+    # (the round loop pulls a victim row off the update stack)
+    ATTACK_METHOD_DLG,
+    ATTACK_METHOD_INVERT_GRADIENT,
+    ATTACK_METHOD_REVEALING_LABELS,
 }
 
 
@@ -78,6 +87,9 @@ class FedMLAttacker:
 
     def is_data_poisoning_attack(self) -> bool:
         return self.is_enabled and self.attack_type in _DATA_ATTACKS
+
+    def is_analysis_attack(self) -> bool:
+        return self.is_enabled and self.attack_type in _ANALYSIS_ATTACKS
 
     def get_byzantine_idxs(self, num_clients: int) -> List[int]:
         k = int(getattr(self.args, "byzantine_client_num", 1))
@@ -244,3 +256,31 @@ class FedMLAttacker:
             lr_attack=float(getattr(self.args, "dlg_lr", 0.1)),
         )
         return self.last_reconstruction
+
+    def analyze_update(self, module, variables, client_update, x_shape, num_classes):
+        """Unified analysis-attack entry the round loops call on one
+        intercepted update: dlg (L2 gradient matching), invert_gradient
+        (cosine matching + TV prior), revealing_labels (iDLG bias-sign).
+        Results land on the instance (``last_reconstruction`` /
+        ``last_revealed_labels``) for experiment inspection."""
+        if self.attack_type == ATTACK_METHOD_DLG:
+            return self.reconstruct_data(
+                module, variables, client_update, x_shape, num_classes
+            )
+        lr = float(getattr(self.args, "learning_rate", 0.1))
+        if self.attack_type == ATTACK_METHOD_INVERT_GRADIENT:
+            self._key, sub = jax.random.split(self._key)
+            self.last_reconstruction = A.invert_gradient_attack(
+                module, variables, client_update, x_shape, num_classes, sub,
+                lr_client=lr,
+                steps=int(getattr(self.args, "dlg_steps", 200)),
+                lr_attack=float(getattr(self.args, "dlg_lr", 0.1)),
+                tv_weight=float(getattr(self.args, "invert_tv_weight", 1e-2)),
+            )
+            return self.last_reconstruction
+        if self.attack_type == ATTACK_METHOD_REVEALING_LABELS:
+            self.last_revealed_labels = A.reveal_labels_from_update(
+                variables, client_update, num_classes, lr_client=lr
+            )
+            return self.last_revealed_labels
+        return None

@@ -146,28 +146,48 @@ def init_defense_state(defense_type: Optional[str], n: int, d: int) -> State:
 
 
 def build_stacked_defense(args, defense_type: str,
-                          probe_mask: Optional[jnp.ndarray] = None) -> Callable:
+                          probe_mask: Optional[jnp.ndarray] = None,
+                          rows: bool = False) -> Callable:
     """-> ``defend(stack, w, global_vars, key, state) -> (agg_tree, state)``.
 
     ``stack``: update pytree with a leading ``[n]`` client axis (n real
     clients, every ``w > 0``); ``agg_tree`` replaces the round's weighted
     mean (fp32, global-tree structure).  Semantics mirror the list-based
     hooks in :class:`fedml_defender.FedMLDefender` rule for rule.
+
+    ``rows=True`` returns ``defend_rows(stack, w, global_vars, key, state)
+    -> (mat', w', state)`` instead: the defended per-client ROW SPACE —
+    every rule restated as a transform of (rows, weights), with
+    aggregate-replacing rules broadcasting their robust aggregate to all
+    rows (so ``_wmean(mat', w') == agg_tree`` always).  Strategies that
+    aggregate through ``ext`` (FedNova, async — ``aggregates_via_acc``
+    False) recompute their per-client contributions from this defended row
+    space (``InMeshAlgorithm.ext_from_rows``), which matches the sp
+    composition exactly for the before-aggregation defenses (selection /
+    row transforms) and extends aggregate-replacing defenses as "every
+    client reported the robust consensus row".
     """
     a = args
     byz = int(getattr(a, "byzantine_client_num", 1))
     t = defense_type
 
-    def matrix_defense(mat, w, g_vec, key, state):
-        """[n, D] robust aggregation -> (agg_vec, state)."""
+    def matrix_defense(mat, w, g_vec, key, state, rows_mode=False):
+        """[n, D] robust aggregation -> (mat', w', state) row space; the
+        aggregate is always ``_wmean(mat', w')``.  ``rows_mode``: the
+        output feeds an ext-aggregator's per-client recomputation, so
+        returned weights must keep the ORIGINAL sample-count scale (only
+        foolsgold differs: its trust weights are normalized to sum 1, so
+        rows mode broadcasts its aggregate instead — it is an
+        on-aggregation rule, same treatment as median/bulyan)."""
         n = mat.shape[0]
+        bcast = lambda vec: jnp.broadcast_to(vec[None, :], mat.shape)
         if t in (DEFENSE_KRUM, DEFENSE_MULTI_KRUM):
             multi = (t == DEFENSE_MULTI_KRUM) or bool(getattr(a, "multi", False))
             m = max(int(getattr(a, "krum_param_m", 1)), 1) if multi else 1
             scores = F.krum_scores(mat, byz)
             chosen = jnp.argsort(scores)[:m]
             sel = jnp.zeros((n,), jnp.float32).at[chosen].set(1.0)
-            return _wmean(mat, w * sel), state
+            return mat, w * sel, state
         if t == DEFENSE_NORM_DIFF_CLIPPING:
             bound = float(getattr(a, "norm_bound", 5.0))
             diff = mat - g_vec[None, :]
@@ -175,13 +195,13 @@ def build_stacked_defense(args, defense_type: str,
             clipped = g_vec[None, :] + diff * jnp.minimum(
                 1.0, bound / jnp.maximum(nrm, 1e-12)
             )
-            return _wmean(clipped, w), state
+            return clipped, w, state
         if t == DEFENSE_THREE_SIGMA:
             arr = jnp.linalg.norm(mat - g_vec[None, :], axis=1)
             mu, sigma = jnp.mean(arr), jnp.std(arr)
             keep = (jnp.abs(arr - mu) <= 3.0 * sigma + 1e-12).astype(jnp.float32)
             w2 = jnp.where(jnp.sum(keep) > 0, w * keep, w)  # all-outlier fallback
-            return _wmean(mat, w2), state
+            return mat, w2, state
         if t == DEFENSE_WBC:
             strength = float(getattr(a, "wbc_strength", 1.0))
             lr = float(getattr(a, "wbc_lr", 0.1))
@@ -190,7 +210,7 @@ def build_stacked_defense(args, defense_type: str,
             noise = jnp.where(jnp.abs(diff) > jnp.abs(noise), 0.0, noise)
             pert = mat + lr * noise * state["wbc_has"]  # first round: no prev
             new_state = {"wbc_prev": mat, "wbc_has": jnp.ones((), jnp.float32)}
-            return _wmean(pert, w), new_state
+            return pert, w, new_state
         if t in (DEFENSE_GEO_MEDIAN, DEFENSE_RFA):
             max_iter = int(getattr(a, "geo_median_max_iter", 10))
             wn = w / jnp.sum(w)
@@ -201,7 +221,7 @@ def build_stacked_defense(args, defense_type: str,
                 return (inv[:, None] * mat).sum(0) / jnp.sum(inv)
 
             z = jax.lax.fori_loop(0, max_iter, body, wn @ mat)
-            return z, state
+            return bcast(z), w, state
         if t == DEFENSE_CCLIP:
             tau = float(getattr(a, "tau", 10.0))
             n_iter = int(getattr(a, "bucket_iter", 1))
@@ -213,32 +233,34 @@ def build_stacked_defense(args, defense_type: str,
                 s = jnp.minimum(1.0, tau / jnp.maximum(nrm, 1e-12))
                 return v + jnp.sum(wn[:, None] * diff * s, 0)
 
-            return jax.lax.fori_loop(0, n_iter, body, g_vec), state
+            return bcast(jax.lax.fori_loop(0, n_iter, body, g_vec)), w, state
         if t == DEFENSE_SLSGD:
             b = max(0, min(int(getattr(a, "trim_param_b", 1)), (n - 1) // 2))
             alpha = float(getattr(a, "alpha", 0.5))
             srt = jnp.sort(mat, axis=0)
             agg = jnp.mean(srt[b : n - b], axis=0)
-            return (1.0 - alpha) * g_vec + alpha * agg, state
+            return bcast((1.0 - alpha) * g_vec + alpha * agg), w, state
         if t == DEFENSE_FOOLSGOLD:
             hist = state["fg_hist"] + (mat - g_vec[None, :])
             wv = F.foolsgold_weights(hist)
             wv = wv / jnp.maximum(jnp.sum(wv), 1e-12)
-            return wv @ mat, {"fg_hist": hist}
+            if rows_mode:
+                return bcast(wv @ mat), w, {"fg_hist": hist}
+            return mat, wv, {"fg_hist": hist}
         if t == DEFENSE_ROBUST_LEARNING_RATE:
             threshold = int(getattr(a, "robust_threshold", 4))
             deltas = mat - g_vec[None, :]
             wn = w / jnp.sum(w)
             agree = jnp.abs(jnp.sum(jnp.sign(deltas), axis=0))
             lr = jnp.where(agree >= threshold, 1.0, -1.0)
-            return g_vec + lr * (wn @ deltas), state
+            return bcast(g_vec + lr * (wn @ deltas)), w, state
         if t == DEFENSE_COORDINATE_WISE_MEDIAN:
-            return jnp.median(mat, axis=0), state
+            return bcast(jnp.median(mat, axis=0)), w, state
         if t == DEFENSE_COORDINATE_WISE_TRIMMED_MEAN:
             k = int(n * float(getattr(a, "beta", 0.1)))
             k = max(0, min(k, (n - 1) // 2))
             srt = jnp.sort(mat, axis=0)
-            return jnp.mean(srt[k : n - k], axis=0), state
+            return bcast(jnp.mean(srt[k : n - k], axis=0)), w, state
         if t == DEFENSE_BULYAN:
             theta = max(n - 2 * byz, 1)
             scores = F.krum_scores(mat, byz)
@@ -247,14 +269,26 @@ def build_stacked_defense(args, defense_type: str,
             beta = max(theta - 2 * byz, 1)
             med = jnp.median(sel_mat, axis=0)
             order = jnp.argsort(jnp.abs(sel_mat - med[None, :]), axis=0)[:beta]
-            return jnp.mean(jnp.take_along_axis(sel_mat, order, axis=0), 0), state
+            return bcast(jnp.mean(jnp.take_along_axis(sel_mat, order, axis=0), 0)), w, state
         if t == DEFENSE_WEAK_DP:
             agg = _wmean(mat, w)
             stddev = float(getattr(a, "stddev", 0.025))
-            return agg + stddev * jax.random.normal(key, agg.shape), state
+            return bcast(agg + stddev * jax.random.normal(key, agg.shape)), w, state
         raise NotImplementedError(
             f"defense {t!r} has no stacked (XLA-backend) form"
         )
+
+    def _rows(stack, w, global_vars, key, state):
+        if t == DEFENSE_SOTERIA:
+            layer_path = list(getattr(a, "soteria_layer", ("classifier", "kernel")))
+            pct = float(getattr(a, "soteria_percentile", 10.0))
+            pruned = _soteria_stacked(stack, global_vars, layer_path, pct, probe_mask)
+            return stack_to_mat(pruned), w, state
+        g_vec, _ = ravel_pytree(
+            jax.tree_util.tree_map(lambda v: v.astype(jnp.float32), global_vars)
+        )
+        return matrix_defense(stack_to_mat(stack), w, g_vec, key, state,
+                              rows_mode=True)
 
     def defend(stack, w, global_vars, key, state):
         if t == DEFENSE_SOTERIA:
@@ -272,11 +306,10 @@ def build_stacked_defense(args, defense_type: str,
         g_vec, unravel = ravel_pytree(
             jax.tree_util.tree_map(lambda v: v.astype(jnp.float32), global_vars)
         )
-        mat = stack_to_mat(stack)
-        agg_vec, state = matrix_defense(mat, w, g_vec, key, state)
-        return unravel(agg_vec), state
+        mat2, w2, state = matrix_defense(stack_to_mat(stack), w, g_vec, key, state)
+        return unravel(_wmean(mat2, w2)), state
 
-    return defend
+    return _rows if rows else defend
 
 
 def _soteria_stacked(stack: Pytree, global_vars: Pytree, layer_path,

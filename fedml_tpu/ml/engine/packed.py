@@ -176,7 +176,10 @@ def build_packed_device_fn(
         ext0 = algo.zero_contrib(variables)
         out_t = algo.out_template(variables)
         if capture_updates:
-            out_t = {"algo": out_t, "update": variables}
+            # "tau": the engine's per-client step count, captured so the
+            # security tail can recompute ext contributions (FedNova's tau_i)
+            # from the defended stack without re-deriving step semantics
+            out_t = {"algo": out_t, "update": variables, "tau": jnp.zeros(())}
         outs0 = jax.tree_util.tree_map(
             lambda t: jnp.zeros((slots_per_device,) + t.shape, jnp.float32), out_t
         )
@@ -256,7 +259,7 @@ def build_packed_device_fn(
                 )
                 out_i = algo.client_out(variables, result, real, cex_i, server_state)
                 if capture_updates:
-                    out_i = {"algo": out_i, "update": out_vars}
+                    out_i = {"algo": out_i, "update": out_vars, "tau": c_steps}
                 outs = jax.tree_util.tree_map(
                     lambda buf, o: jax.lax.dynamic_update_index_in_dim(
                         buf, o.astype(jnp.float32), s, axis=0

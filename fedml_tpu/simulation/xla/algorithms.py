@@ -90,6 +90,32 @@ class InMeshAlgorithm:
     def server_update(self, acc, wsum, ext, variables, server_state) -> Tuple[Pytree, Pytree]:
         return _weighted_avg(acc, wsum, variables), server_state
 
+    # -- traced: security tail (fed_sim._build_security_fn) ----------------
+    def ext_from_rows(self, mat, w, w_orig, meta, g_vec, unravel) -> Pytree:
+        """Recompute this strategy's psum'd ``ext`` from the security tail's
+        (possibly attacked/defended) per-client row space — the substitute
+        for the in-round ``client_contrib`` accumulation when the round's
+        updates were re-written by a stacked attack or robust aggregation.
+
+        ``mat``: [n, D] defended client rows (``ravel_pytree`` order);
+        ``w``: [n] defended weights (selection defenses zero rows here);
+        ``w_orig``: [n] the round's real sample weights; ``meta``: [n] the
+        strategy's ``security_meta`` vector; ``g_vec``/``unravel``: the
+        ravelled fp32 global.  Only strategies with ``aggregates_via_acc``
+        False need this (acc strategies take the substituted weighted sum).
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} aggregates through ext "
+            "(aggregates_via_acc=False) and must implement ext_from_rows "
+            "to compose with in-mesh attacks/defenses"
+        )
+
+    def security_meta(self, taus, cex, real_sel) -> jnp.ndarray:
+        """[n_real] per-client metadata for ``ext_from_rows``: sliced from
+        the round's captured engine step counts (``taus``, aligned with the
+        schedule slots) and the round's client extras (``cex``)."""
+        return jnp.zeros((len(real_sel),), jnp.float32)
+
     # -- host side ---------------------------------------------------------
     def init_server_state(self, variables: Pytree) -> Pytree:
         return ()
@@ -191,6 +217,21 @@ class FedNovaInMesh(InMeshAlgorithm):
             variables, ext["d"],
         )
         return new, server_state
+
+    def security_meta(self, taus, cex, real_sel):
+        # tau_i = the engine's captured per-client step count, exact by
+        # construction (no host re-derivation of masked-step semantics)
+        return taus[real_sel]
+
+    def ext_from_rows(self, mat, w, w_orig, meta, g_vec, unravel):
+        # client_contrib restated over rows: d = sum_i (w_i/tau_i)(g - m_i),
+        # tau = sum_i w_i tau_i — with the DEFENDED weights, so selection
+        # defenses drop a client from both the direction and tau_eff (the sp
+        # FedNovaAPI.server_update composition: taus follow the surviving
+        # updates through the defense filter)
+        coef = w / jnp.maximum(meta, 1.0)
+        d_vec = jnp.sum(coef) * g_vec - coef @ mat
+        return {"d": unravel(d_vec), "tau": jnp.sum(w * meta)}
 
 
 class ScaffoldInMesh(InMeshAlgorithm):
@@ -395,6 +436,21 @@ class AsyncFedAvgInMesh(InMeshAlgorithm):
             variables, ext["d"],
         )
         return new, server_state
+
+    def security_meta(self, taus, cex, real_sel):
+        # staleness, already gathered per slot by gather_client_extras
+        return cex[real_sel]
+
+    def ext_from_rows(self, mat, w, w_orig, meta, g_vec, unravel):
+        # client_contrib ignores sample weights (each arrival mixes with its
+        # own staleness discount a_i), so the defense's effect enters as the
+        # RELATIVE weight factor r_i = w_i/w_orig_i: 1 for row transforms,
+        # 0/1 for selection defenses (krum/3sigma) — exactly the surviving-
+        # subset semantics of the sp before-aggregation composition
+        r = w / jnp.maximum(w_orig, 1e-9)
+        a_i = r * self.alpha / (1.0 + meta) ** self.beta
+        d_vec = a_i @ mat - jnp.sum(a_i) * g_vec
+        return {"d": unravel(d_vec), "k": jnp.sum(r)}
 
 
 _REGISTRY = {

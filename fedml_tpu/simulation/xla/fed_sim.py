@@ -100,19 +100,21 @@ class XLASimulator:
         dp = FedMLDifferentialPrivacy.get_instance()
         self.defended = defender.is_defense_enabled()
         self.model_attacked = attacker.is_model_attack()
-        self.dlg_attacked = (attacker.is_attack_enabled()
-                             and str(attacker.attack_type) == "dlg")
+        # analysis-primitive attacks (dlg / invert_gradient / revealing
+        # labels) read ONE intercepted per-client update off the round's
+        # sharded stack — reference fedml_attacker.py:28-30 runs the whole
+        # matrix through one simulator path; so does this backend now
+        self.analysis_attacked = attacker.is_analysis_attack()
         if (attacker.is_attack_enabled() and not self.model_attacked
-                and not self.dlg_attacked
+                and not self.analysis_attacked
                 and not attacker.is_data_poisoning_attack()):
             # fail loud rather than report clean-FedAvg metrics as an
-            # attack-experiment result (e.g. the analysis-primitive attack
-            # types invert_gradient / revealing_labels)
+            # attack-experiment result
             raise NotImplementedError(
-                f"attack_type {attacker.attack_type!r} has no XLA-backend "
-                "hook; use backend 'sp'"
+                f"attack_type {attacker.attack_type!r} has no XLA-backend hook"
             )
-        self.needs_stack = self.defended or self.model_attacked or self.dlg_attacked
+        self.needs_stack = (self.defended or self.model_attacked
+                            or self.analysis_attacked)
         # every engine loss family runs in-mesh: the loss key is plumbed
         # into the compiled round and eval goes through the task-aware
         # aggregator.  Tag prediction's int->multi-hot conversion happens
@@ -127,12 +129,6 @@ class XLASimulator:
         sample = jnp.asarray(self.train_global[0][:1])
         self.variables = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
         self.algo = create_inmesh_algorithm(args)
-        if (self.defended or self.model_attacked) and not self.algo.aggregates_via_acc:
-            raise NotImplementedError(
-                "in-mesh attack/defense substitutes the weighted variables "
-                f"aggregate, but {type(self.algo).__name__} aggregates through "
-                "its ext contributions (FedNova/async); use backend 'sp'"
-            )
         self.server_state = self.algo.init_server_state(self.variables)
         self.client_state = self.algo.init_client_state(self.num_clients, self.variables)
         self.packed = bool(getattr(args, "xla_pack", False))
@@ -283,10 +279,13 @@ class XLASimulator:
                 out = algo.client_out(variables, result, real, cex, server_state)
                 if stacked:
                     # per-client update stack for the security program (the
-                    # weights are the host-known sample counts)
+                    # weights are the host-known sample counts); "tau" = the
+                    # engine's step count so the security tail can recompute
+                    # ext contributions (FedNova) from the defended stack
                     out = {"algo": out,
                            "update": jax.tree_util.tree_map(
-                               lambda p: p.astype(jnp.float32), result.variables)}
+                               lambda p: p.astype(jnp.float32), result.variables),
+                           "tau": result.steps}
                 return wv, w, result.loss * w, contrib, out
 
             vclients = jax.vmap(one_client)
@@ -414,6 +413,7 @@ class XLASimulator:
         )
 
         algo = self.algo
+        via_acc = algo.aggregates_via_acc
         attacker = FedMLAttacker.get_instance()
         defender = FedMLDefender.get_instance()
         attack_fn = (build_stacked_attack(self.args, attacker.attack_type)
@@ -429,13 +429,14 @@ class XLASimulator:
                     float(getattr(self.args, "soteria_percentile", 10.0)),
                 )
             defend_fn = build_stacked_defense(
-                self.args, defender.defense_type, probe_mask=probe_mask
+                self.args, defender.defense_type, probe_mask=probe_mask,
+                rows=not via_acc,
             )
         self._defense_type = defender.defense_type if self.defended else None
         self._defense_state = None
         self._defense_n = -1
 
-        def security_round(stack, weights, real_idx, mal_mask, prev_global,
+        def security_round(stack, weights, real_idx, mal_mask, meta, prev_global,
                            server_state, ext, key, dstate):
             sub = jax.tree_util.tree_map(lambda t: t[real_idx], stack)
             w = weights
@@ -443,24 +444,46 @@ class XLASimulator:
             g32 = jax.tree_util.tree_map(
                 lambda v: v.astype(jnp.float32), prev_global
             )
-            if attack_fn is not None:
-                g_vec, unravel = ravel_pytree(g32)
-                mat = attack_fn(stack_to_mat(sub), w, g_vec, mal_mask, ka)
-                sub = jax.vmap(unravel)(mat)
-            if defend_fn is not None:
-                agg, dstate = defend_fn(sub, w, g32, kd, dstate)
-            else:
-                agg = jax.tree_util.tree_map(
-                    lambda s: jnp.tensordot(w, s.astype(jnp.float32), axes=1)
-                    / jnp.maximum(jnp.sum(w), 1e-9),
-                    sub,
+            if via_acc:
+                if attack_fn is not None:
+                    g_vec, unravel = ravel_pytree(g32)
+                    mat = attack_fn(stack_to_mat(sub), w, g_vec, mal_mask, ka)
+                    sub = jax.vmap(unravel)(mat)
+                if defend_fn is not None:
+                    agg, dstate = defend_fn(sub, w, g32, kd, dstate)
+                else:
+                    agg = jax.tree_util.tree_map(
+                        lambda s: jnp.tensordot(w, s.astype(jnp.float32), axes=1)
+                        / jnp.maximum(jnp.sum(w), 1e-9),
+                        sub,
+                    )
+                # hand the robust aggregate to the algorithm's server step as
+                # a weighted sum (every acc strategy divides by wsum)
+                wsum = jnp.sum(w)
+                acc = jax.tree_util.tree_map(lambda t: t * wsum, agg)
+                new_global, new_server_state = algo.server_update(
+                    acc, wsum, ext, prev_global, server_state
                 )
-            # hand the robust aggregate to the algorithm's server step as a
-            # weighted sum (every aggregates_via_acc strategy divides by wsum)
-            wsum = jnp.sum(w)
-            acc = jax.tree_util.tree_map(lambda t: t * wsum, agg)
+                return new_global, new_server_state, dstate
+            # ext-aggregating strategies (FedNova, async): the attacked/
+            # defended row space replaces the round's in-stream contribution
+            # accumulation — ext is recomputed from the defended rows via the
+            # strategy's own per-client math (sp composition: defenses filter
+            # the update list, THEN the aggregator runs on the survivors)
+            g_vec, unravel = ravel_pytree(g32)
+            mat = stack_to_mat(sub)
+            if attack_fn is not None:
+                mat = attack_fn(mat, w, g_vec, mal_mask, ka)
+            w2 = w
+            if defend_fn is not None:
+                sub2 = jax.vmap(unravel)(mat) if attack_fn is not None else sub
+                mat, w2, dstate = defend_fn(sub2, w, g32, kd, dstate)
+            ext2 = algo.ext_from_rows(mat, w2, w, meta, g_vec, unravel)
+            # contract-complete acc (the defended weighted sum); strategies
+            # that only read ext leave it to XLA's dead-code elimination
+            acc = unravel(w2 @ mat)
             new_global, new_server_state = algo.server_update(
-                acc, wsum, ext, prev_global, server_state
+                acc, jnp.sum(w2), ext2, prev_global, server_state
             )
             return new_global, new_server_state, dstate
 
@@ -630,6 +653,7 @@ class XLASimulator:
                 # attacks + robust aggregation + the server step on device
                 mean_loss, outs, ext = self._round_fn(*round_inputs)
                 stack = outs["update"]
+                taus = outs["tau"]
                 outs = outs["algo"]
                 real_sel = np.where(counts > 0)[0]
                 if real_sel.size > 0:
@@ -642,13 +666,19 @@ class XLASimulator:
                             np.float32,
                         )
                     dstate = self._ensure_defense_state(int(real_sel.size))
-                    self._rng, skey = jax.random.split(self._rng)
+                    # derive the security key from the round's sub-key, NOT by
+                    # splitting the main stream: the round-r data/rng layout
+                    # must be identical with and without the security tail
+                    # (one split per round is the replayable invariant)
+                    skey = jax.random.fold_in(sub, 999331)
+                    meta = self.algo.security_meta(taus, cex, jnp.asarray(real_sel))
                     self.variables, self.server_state, self._defense_state = (
                         self._security_fn(
                             stack,
                             jnp.asarray(counts[real_sel], jnp.float32),
                             jnp.asarray(real_sel),
                             jnp.asarray(mal),
+                            meta,
                             self.variables,
                             self.server_state,
                             ext,
@@ -656,19 +686,20 @@ class XLASimulator:
                             dstate,
                         )
                     )
-                    if self.dlg_attacked and round_idx % max(
+                    if self.analysis_attacked and round_idx % max(
                         1, int(getattr(self.args, "dlg_frequency", 1))
                     ) == 0:
-                        # privacy attack: reconstruct a batch from ONE
-                        # intercepted update (a single model-size host pull;
-                        # dlg_frequency gates the ~dlg_steps-GD cost per round)
+                        # privacy/analysis attack (dlg, invert_gradient,
+                        # revealing_labels): run on ONE intercepted update (a
+                        # single model-size host pull; dlg_frequency gates the
+                        # per-round gradient-matching cost)
                         bad = set(attacker.get_byzantine_idxs(self.num_clients))
                         victims = [int(i) for i in real_sel
                                    if int(ids[i]) in bad] or [int(real_sel[0])]
                         row = jax.tree_util.tree_map(
                             lambda t: t[victims[0]], stack
                         )
-                        attacker.reconstruct_data(
+                        attacker.analyze_update(
                             self.module, prev_global, row,
                             (int(getattr(self.args, "dlg_batch_size", 1)),)
                             + tuple(self.x_all.shape[1:]),

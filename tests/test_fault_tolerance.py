@@ -100,10 +100,13 @@ def test_round_survives_silent_silo():
     history = server.run()  # must NOT block forever
     assert len(history) == 2
     assert 0.0 <= history[-1]["test_acc"] <= 1.0
-    # both rounds paid ~one timeout each, not an unbounded wait
-    assert time.time() - t0 < 30
+    # bounded, not fast: under full-suite load the live silos' first XLA
+    # compiles can outlast several 3s timer re-arms (below the min-client
+    # floor the timer re-arms, so correctness never depends on timing);
+    # the bound only proves no reference-style wait-forever wedge
+    assert time.time() - t0 < 120
     for t in threads:
-        t.join(timeout=30)
+        t.join(timeout=60)
         assert not t.is_alive()
 
 
@@ -127,10 +130,10 @@ def test_all_silos_alive_is_unchanged():
         t.start()
     t0 = time.time()
     history = server.run()
-    assert len(history) == 2
     assert time.time() - t0 < 50  # no 60s timeout ever fired
+    assert len(history) == 2
     for t in threads:
-        t.join(timeout=30)
+        t.join(timeout=60)
         assert not t.is_alive()
 
 
@@ -179,9 +182,60 @@ def test_round_survives_silent_silo_over_mqtt(tmp_path):
         t0 = time.time()
         history = server.run()
         assert len(history) == 2
-        assert time.time() - t0 < 40
+        # bounded, not fast (see test_round_survives_silent_silo)
+        assert time.time() - t0 < 120
         for t in threads:
-            t.join(timeout=30)
+            t.join(timeout=60)
             assert not t.is_alive()
     finally:
         broker.stop()
+
+
+class TestStaleUploadPolicy:
+    """The round-tag matrix of RoundTimeoutMixin._is_stale_upload: tagged
+    uploads match by round; untagged uploads are accepted only with
+    straggler tolerance OFF (reference semantics — rounds cannot overlap
+    when the server waits forever) and DROPPED with it on, where a
+    round-less late upload is exactly the wrong-round corruption the tag
+    prevents."""
+
+    def _mixin(self, timeout_s):
+        from fedml_tpu.core.distributed.straggler import RoundTimeoutMixin
+
+        class _M(RoundTimeoutMixin):
+            pass
+
+        m = _M()
+
+        class _A:
+            round_timeout_s = timeout_s
+            round_idx = 4
+
+        m.init_straggler_tolerance(_A())
+        m.args = _A()
+        return m
+
+    def test_matching_tag_accepted(self):
+        assert self._mixin(3.0)._is_stale_upload(4, sender=1) is False
+
+    def test_mismatched_tag_dropped(self):
+        assert self._mixin(3.0)._is_stale_upload(3, sender=1) is True
+
+    def test_untagged_accepted_when_tolerance_off(self):
+        assert self._mixin(0)._is_stale_upload(None, sender=1) is False
+
+    def test_untagged_accepted_before_any_timeout_close(self):
+        # while every round still closes with its full cohort no upload can
+        # be stale — a legacy untagged fleet must keep working (dropping
+        # outright would livelock below the min-client floor)
+        assert self._mixin(3.0)._is_stale_upload(None, sender=1) is False
+
+    def test_untagged_dropped_after_first_timeout_close(self):
+        m = self._mixin(3.0)
+        m._had_timeout_close = True
+        assert m._is_stale_upload(None, sender=1) is True
+
+    def test_mismatched_tag_dropped_even_without_tolerance(self):
+        # a tagged client never regresses: the tag check is independent of
+        # the timer knob
+        assert self._mixin(0)._is_stale_upload(2, sender=1) is True

@@ -62,3 +62,79 @@ class TestLogUpload:
         sink = HttpLogSink("http://127.0.0.1:9/nope", timeout_s=0.2)
         sink.emit("log_chunk", {"run_id": "1", "rank": 0, "lines": ["x"]})
         assert sink.ship_failures == 1
+
+
+class TestSimRegistration:
+    """createSim project/run registration RPCs (reference
+    core/mlops/__init__.py create_project :438 / create_run :466)."""
+
+    def test_create_project_and_run(self, platform):
+        cfg = MLOpsConfigs(platform.url)
+        pid = cfg.create_project("exp-1", api_key="k")
+        assert pid == 1
+        rid = cfg.create_run(pid, api_key="k", edge_ids=[0, 1], run_name="r0")
+        assert rid == 1
+        assert platform.projects[0]["name"] == "exp-1"
+        assert platform.projects[0]["platform_type"] == "simulation"
+        assert platform.runs[0]["projectid"] == "1"
+        assert platform.runs[0]["edgeids"] == [0, 1]
+        assert platform.runs[0]["name"] == "r0"
+
+    def test_second_project_gets_next_id(self, platform):
+        cfg = MLOpsConfigs(platform.url)
+        assert cfg.create_project("a") == 1
+        assert cfg.create_project("b") == 2
+
+
+class TestWandbSink:
+    """enable_wandb must never be a silent dead flag: with wandb importable
+    the sink logs metric rows; without it init() warns loudly and runs on."""
+
+    class _Args:
+        run_id = "w1"
+        rank = 0
+        log_file_dir = None
+        enable_wandb = True
+
+    def test_missing_wandb_warns_not_crashes(self, caplog, monkeypatch):
+        import logging
+        import sys
+
+        from fedml_tpu.core import mlops
+
+        # force the ImportError path even where wandb IS installed
+        monkeypatch.setitem(sys.modules, "wandb", None)
+        with caplog.at_level(logging.WARNING, "fedml_tpu.core.mlops"):
+            mlops.init(self._Args())
+        try:
+            assert mlops.enabled()
+            assert any("enable_wandb" in r.message for r in caplog.records)
+        finally:
+            mlops.finish()
+
+    def test_fake_wandb_receives_metric_rows(self, monkeypatch):
+        import sys
+        import types
+
+        rows = []
+        fake = types.SimpleNamespace(
+            run=None,
+            init=lambda **kw: setattr(fake, "run", object()),
+            log=lambda row: rows.append(row),
+            finish=lambda: setattr(fake, "run", None),
+        )
+        monkeypatch.setitem(sys.modules, "wandb", fake)
+        from fedml_tpu.core import mlops
+
+        mlops.init(self._Args())
+        try:
+            mlops.log({"round": 1, "train_loss": 0.5})
+            mlops.log_round_info(10, 1)
+            mlops.event("train", event_started=False, event_value=1.25)
+            assert {"round": 1, "train_loss": 0.5} in [
+                {k: r[k] for k in ("round", "train_loss") if k in r} for r in rows
+            ]
+            assert any("round_idx" in r for r in rows)
+            assert any("event/train" in r for r in rows)
+        finally:
+            mlops.finish()

@@ -94,6 +94,15 @@ DEFENSE_CASES = [
 ]
 
 
+def test_defense_matrix_is_complete():
+    """Drift gate: every defense the host dispatcher supports MUST have a
+    stacked cross-check case here — adding a defense to one path without
+    the other (or without extending this matrix) fails this test."""
+    from fedml_tpu.core.security.fedml_defender import SUPPORTED_DEFENSES
+
+    assert sorted({name for name, _ in DEFENSE_CASES}) == SUPPORTED_DEFENSES
+
+
 @pytest.mark.parametrize("defense,extra", DEFENSE_CASES)
 def test_stacked_defense_matches_host(defense, extra):
     updates = _make_updates(outlier={2})
@@ -107,6 +116,24 @@ def test_stacked_defense_matches_host(defense, extra):
     agg, _ = fn(stack, w, GLOBAL, jax.random.PRNGKey(0), state)
 
     np.testing.assert_allclose(_flat(agg), _flat(host), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("defense,extra", DEFENSE_CASES)
+def test_rows_mode_aggregate_matches_tree_mode(defense, extra):
+    """The ext-aggregator path (rows=True) must stay consistent with the
+    acc path: the weighted mean of the defended row space equals the
+    tree-mode aggregate, for every rule."""
+    updates = _make_updates(outlier={2})
+    stack, w = _stack(updates)
+    state = S.init_defense_state(defense, int(w.shape[0]), S.flat_dim(GLOBAL))
+    agg, _ = S.build_stacked_defense(_Args(**extra), defense)(
+        stack, w, GLOBAL, jax.random.PRNGKey(0), state
+    )
+    mat2, w2, _ = S.build_stacked_defense(_Args(**extra), defense, rows=True)(
+        stack, w, GLOBAL, jax.random.PRNGKey(0), state
+    )
+    rows_agg = np.asarray((w2 @ mat2) / jnp.maximum(jnp.sum(w2), 1e-9))
+    np.testing.assert_allclose(rows_agg, _flat(agg), rtol=2e-4, atol=2e-5)
 
 
 def test_stacked_foolsgold_state_accumulates():

@@ -254,6 +254,102 @@ class TestXLAZoo:
             w = jax.tree_util.tree_map(lambda g, d: g + d / K, w, delta)
         assert_trees_close(got, w)
 
+    def test_fednova_krum_composition_matches_host(self):
+        """Defense x ext-aggregating algorithm: the in-mesh security tail
+        (ext_from_rows over the defended row space) must equal the sp
+        composition — defend_before_aggregation filters the update list,
+        taus follow the survivors, FedNova aggregates them
+        (sp/fednova/fednova_api.py server_update)."""
+        from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+        FedMLDefender._defender_instance = None
+        d = FedMLDefender.get_instance()
+        try:
+            # hetero partition: distinguishable client updates (a homo
+            # split of the tiny synthetic set yields EXACT krum-score ties,
+            # where host argsort and jnp argsort may break differently)
+            # 8 clients (not the default 4): with n=4 and byz=1 the krum
+            # score degenerates to the single nearest-neighbour distance,
+            # which ties EXACTLY for mutual nearest neighbours — host and
+            # stacked argsort may break the tie differently.  n=8 sums 5
+            # distances per score; ties vanish.
+            rp = Replay(federated_optimizer="FedNova", enable_defense=True,
+                        defense_type="krum", byzantine_client_num=1,
+                        partition_method="hetero", partition_alpha=0.5,
+                        client_num_in_total=8, client_num_per_round=8,
+                        synthetic_train_size=1280)
+            d.init(rp.args)
+            got = rp.run_sim()
+
+            w = rp.w0
+            for r in range(ROUNDS):
+                results = rp.local_results(r, w)
+                updates = [(n, res.variables) for _, n, res in results]
+                tau_by_id = {
+                    id(p): max(float(res.steps), 1.0)
+                    for (_, _, res), (_, p) in zip(results, updates)
+                }
+                survivors = d.defend_before_aggregation(updates, w)
+                taus = [tau_by_id.get(id(p), 1.0) for _, p in survivors]
+                tot = sum(n for n, _ in survivors)
+                ps = [n / tot for n, _ in survivors]
+                tau_eff = sum(p * t for p, t in zip(ps, taus))
+                dsum = jax.tree_util.tree_map(jnp.zeros_like, w)
+                for (n, wi), p, tau in zip(survivors, ps, taus):
+                    dsum = jax.tree_util.tree_map(
+                        lambda acc, g, v: acc + p * (g - v) / tau, dsum, w, wi
+                    )
+                w = jax.tree_util.tree_map(
+                    lambda g, di: g - tau_eff * di, w, dsum
+                )
+            assert_trees_close(got, w)
+        finally:
+            FedMLDefender._defender_instance = None
+
+    def test_async_krum_composition_matches_host(self):
+        """Same composition for the buffered-async strategy: survivors keep
+        their own staleness discounts, k drops to the surviving count."""
+        from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+        FedMLDefender._defender_instance = None
+        d = FedMLDefender.get_instance()
+        try:
+            # 6 sampled per round (krum scores sum 3 distances: no
+            # mutual-NN exact ties; see the FedNova test above)
+            rp = Replay(federated_optimizer="Async_FedAvg",
+                        client_num_in_total=8, client_num_per_round=6,
+                        async_alpha=0.6, async_beta=0.5,
+                        synthetic_train_size=1280,
+                        enable_defense=True, defense_type="krum",
+                        byzantine_client_num=1,
+                        partition_method="hetero", partition_alpha=0.5)
+            d.init(rp.args)
+            got = rp.run_sim()
+
+            w = rp.w0
+            last = {}
+            for r in range(ROUNDS):
+                results = rp.local_results(r, w)
+                updates = [(n, res.variables) for _, n, res in results]
+                cid_by_id = {id(p): cid for (cid, _, _), (_, p)
+                             in zip(results, updates)}
+                survivors = d.defend_before_aggregation(updates, w)
+                K = len(survivors)
+                delta = jax.tree_util.tree_map(jnp.zeros_like, w)
+                for _, wi in survivors:
+                    stale = r - last.get(cid_by_id[id(wi)], r)
+                    a_i = 0.6 / (1.0 + stale) ** 0.5
+                    delta = jax.tree_util.tree_map(
+                        lambda dl, v, wg: dl + a_i * (v - wg), delta, wi, w
+                    )
+                # host_round_end marks EVERY participant (survivor or not)
+                for cid, _, _ in results:
+                    last[cid] = r
+                w = jax.tree_util.tree_map(lambda g, dl: g + dl / K, w, delta)
+            assert_trees_close(got, w)
+        finally:
+            FedMLDefender._defender_instance = None
+
     def test_unsupported_zoo_algorithm_fails_loud(self):
         # XLASimulator owns only the shared FedAvg-family round; every
         # structurally-distinct optimizer (turbo/GAN/NAS/gossip/...) has its
