@@ -1,0 +1,53 @@
+// End-to-end harness: a real Java edge client joining a fedml_tpu
+// cross-device run.  Compile with the SDK sources and run against a
+// LocalBroker + cross-device server started from Python
+// (tests/test_java_sdk.py runs this automatically when a JDK is present):
+//
+//   javac -d build android/sdk/src/main/java/ai/fedml/tpu/*.java \
+//         android/sdk/harness/EdgeHarness.java
+//   java -cp build -Djava.library.path=native/build EdgeHarness \
+//        <host> <port> <runId> <rank> <dataPath> <uploadDir>
+//
+// Prints one line per round and "HARNESS-FINISHED <rounds>" on S2C_FINISH.
+
+import java.io.File;
+import java.util.concurrent.CountDownLatch;
+
+import ai.fedml.tpu.FedEdgeManager;
+import ai.fedml.tpu.OnTrainProgressListener;
+
+public final class EdgeHarness {
+    public static void main(String[] args) throws Exception {
+        String host = args[0];
+        int port = Integer.parseInt(args[1]);
+        String runId = args[2];
+        long rank = Long.parseLong(args[3]);
+        String dataPath = args[4];
+        File uploadDir = new File(args[5]);
+
+        CountDownLatch done = new CountDownLatch(1);
+        FedEdgeManager edge = FedEdgeManager.builder()
+                .broker(host, port)
+                .runId(runId)
+                .rank(rank)
+                .dataPath(dataPath)
+                .uploadDir(uploadDir)
+                .hyperParams(32, 0.1, 1)
+                .listener(new OnTrainProgressListener() {
+                    @Override
+                    public void onRoundCompleted(int roundIdx, double loss, long n) {
+                        System.out.println("round " + roundIdx + " loss=" + loss + " n=" + n);
+                    }
+
+                    @Override
+                    public void onFinished(int roundsTrained) {
+                        System.out.println("HARNESS-FINISHED " + roundsTrained);
+                        done.countDown();
+                    }
+                })
+                .build();
+        edge.start();
+        done.await();
+        System.exit(0);
+    }
+}

@@ -1,0 +1,139 @@
+package ai.fedml.tpu;
+
+import java.io.DataInputStream;
+import java.io.DataOutputStream;
+import java.io.EOFException;
+import java.io.IOException;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * The broker wire: 4-byte big-endian length + UTF-8 JSON dict frames, ops
+ * SUB / UNSUB / PUB / WILL / DISCONNECT, deliveries arriving as MSG frames.
+ *
+ * This is the JSON interop encoding of the fedml_tpu pub/sub broker
+ * (fedml_tpu/core/distributed/communication/mqtt_s3/broker.py — the broker
+ * sniffs each connection's encoding and answers JSON clients in JSON), so a
+ * device JVM joins the same broker the Python silos use.  Reference role:
+ * the paho MqttAndroidClient inside EdgeCommunicator.java.
+ */
+public final class BrokerConnection implements AutoCloseable {
+    /** Topic + decoded payload callback, invoked on the receive thread. */
+    public interface OnMessage {
+        void onMessage(String topic, Object payload);
+    }
+
+    private final Socket socket;
+    private final DataOutputStream out;
+    private final DataInputStream in;
+    private final OnMessage onMessage;
+    private final Thread recvThread;
+    private volatile boolean running = true;
+
+    public BrokerConnection(String host, int port, OnMessage onMessage) throws IOException {
+        this.socket = new Socket(host, port);
+        this.socket.setTcpNoDelay(true);
+        this.out = new DataOutputStream(socket.getOutputStream());
+        this.in = new DataInputStream(socket.getInputStream());
+        this.onMessage = onMessage;
+        this.recvThread = new Thread(this::recvLoop, "broker-recv");
+        this.recvThread.setDaemon(true);
+        this.recvThread.start();
+    }
+
+    public void subscribe(String topic) throws IOException {
+        send(frame("SUB", topic, null));
+    }
+
+    public void unsubscribe(String topic) throws IOException {
+        send(frame("UNSUB", topic, null));
+    }
+
+    public void publish(String topic, Object payload) throws IOException {
+        send(frame("PUB", topic, payload));
+    }
+
+    /** Broker publishes this if the socket dies without DISCONNECT. */
+    public void setLastWill(String topic, Object payload) throws IOException {
+        send(frame("WILL", topic, payload));
+    }
+
+    public void disconnect() {
+        running = false;
+        try {
+            Map<String, Object> f = new LinkedHashMap<>();
+            f.put("op", "DISCONNECT");
+            send(f);
+        } catch (IOException ignored) {
+            // socket already gone: the broker fires the last will instead
+        }
+        try {
+            socket.close();
+        } catch (IOException ignored) {
+        }
+    }
+
+    @Override
+    public void close() {
+        disconnect();
+    }
+
+    private static Map<String, Object> frame(String op, String topic, Object payload) {
+        Map<String, Object> f = new LinkedHashMap<>();
+        f.put("op", op);
+        f.put("topic", topic);
+        if (payload != null) f.put("payload", payload);
+        return f;
+    }
+
+    private synchronized void send(Map<String, Object> frame) throws IOException {
+        byte[] body = Json.encode(frame).getBytes(StandardCharsets.UTF_8);
+        out.writeInt(body.length);
+        out.write(body);
+        out.flush();
+    }
+
+    private void recvLoop() {
+        try {
+            while (running) {
+                int n = in.readInt();
+                if (n < 0) {
+                    throw new IOException("corrupt frame length " + n);
+                }
+                byte[] body = new byte[n];
+                in.readFully(body);
+                try {
+                    Map<String, Object> f =
+                            Json.decodeObject(new String(body, StandardCharsets.UTF_8));
+                    if ("MSG".equals(f.get("op")) && onMessage != null) {
+                        onMessage.onMessage(String.valueOf(f.get("topic")), f.get("payload"));
+                    }
+                } catch (RuntimeException e) {
+                    // an undecodable frame means the stream is desynced: a
+                    // silently-dead receive thread would keep the socket open
+                    // and the broker would never fire our OFFLINE last will —
+                    // tear the connection down instead
+                    System.err.println("fedml broker frame decode failed: " + e);
+                    break;
+                }
+            }
+        } catch (EOFException | java.net.SocketException e) {
+            // broker closed or we disconnected: normal shutdown path
+        } catch (IOException e) {
+            if (running) {
+                System.err.println("fedml broker recv failed: " + e);
+            }
+        } finally {
+            if (running) {
+                // unclean exit: close the socket so the broker notices and
+                // publishes the last will (liveness contract)
+                try {
+                    socket.close();
+                } catch (IOException ignored) {
+                }
+            }
+        }
+    }
+}

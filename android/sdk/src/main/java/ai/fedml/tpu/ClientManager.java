@@ -1,0 +1,105 @@
+package ai.fedml.tpu;
+
+import java.io.File;
+import java.io.IOException;
+
+/**
+ * The FL client scheduler: drives one edge rank through the cross-device
+ * round protocol — the Java twin of the Python device managers
+ * (fedml_tpu/cross_device/fake_device.py handler-for-handler, which is
+ * itself the protocol the server in fedml_tpu/cross_device/
+ * fedml_server_manager.py expects; reference role:
+ * android/fedmlsdk/.../service/ClientManager.java).
+ *
+ * Protocol walked:
+ * <ol>
+ *   <li>connection_ready → C2S_CLIENT_STATUS ONLINE (handshake);</li>
+ *   <li>S2C_CHECK_CLIENT_STATUS → re-announce ONLINE;</li>
+ *   <li>S2C_INIT_CONFIG / S2C_SYNC_MODEL_TO_CLIENT → download the model
+ *       FILE, train natively off-thread, upload the trained file with the
+ *       ROUND TAG (straggler-tolerant servers drop uploads whose tag
+ *       mismatches the open round) and the sample count;</li>
+ *   <li>S2C_FINISH → stop.</li>
+ * </ol>
+ */
+public final class ClientManager implements TrainingExecutor.OnRoundDone {
+    private final EdgeCommunicator comm;
+    private final TrainingExecutor executor;
+    private final long rank;
+    private final File uploadDir;
+    private final OnTrainProgressListener listener;
+    private volatile int roundsTrained = 0;
+
+    public ClientManager(EdgeCommunicator comm, TrainingExecutor executor, long rank,
+                         File uploadDir, OnTrainProgressListener listener) {
+        this.comm = comm;
+        this.executor = executor;
+        this.rank = rank;
+        this.uploadDir = uploadDir;
+        this.listener = listener;
+        comm.register(MessageDefine.MSG_TYPE_CONNECTION_READY, m -> announceOnline());
+        comm.register(MessageDefine.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, m -> announceOnline());
+        comm.register(MessageDefine.MSG_TYPE_S2C_INIT_CONFIG, this::onModel);
+        comm.register(MessageDefine.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, this::onModel);
+        comm.register(MessageDefine.MSG_TYPE_S2C_FINISH, m -> finish());
+    }
+
+    /** Begin participating (raises connection_ready → ONLINE handshake). */
+    public void run() {
+        comm.start();
+    }
+
+    private void announceOnline() {
+        Message m = new Message(MessageDefine.MSG_TYPE_C2S_CLIENT_STATUS, rank, 0);
+        m.add(MessageDefine.MSG_ARG_KEY_CLIENT_STATUS, MessageDefine.CLIENT_STATUS_ONLINE);
+        sendOrWarn(m);
+    }
+
+    private void onModel(Message msg) {
+        String modelFile = msg.getString(MessageDefine.MSG_ARG_KEY_MODEL_PARAMS_FILE);
+        int roundIdx = (int) msg.getLong(MessageDefine.MSG_ARG_KEY_ROUND_INDEX, 0);
+        if (modelFile == null) {
+            System.err.println("fedml round " + roundIdx + ": no model file in sync msg");
+            return;
+        }
+        File out = new File(uploadDir, "model_r" + roundIdx + "_c" + rank + ".ftem");
+        // seed matches the Python fake device: per-(round, rank) determinism
+        executor.submit(roundIdx, modelFile, out.getAbsolutePath(),
+                        roundIdx * 1000L + rank, this);
+    }
+
+    @Override
+    public void onRoundDone(int roundIdx, TrainingExecutor.RoundResult result) {
+        roundsTrained++;
+        Message m = new Message(MessageDefine.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, rank, 0);
+        m.add(MessageDefine.MSG_ARG_KEY_ROUND_INDEX, roundIdx);
+        m.add(MessageDefine.MSG_ARG_KEY_MODEL_PARAMS_FILE, result.modelOutPath);
+        m.add(MessageDefine.MSG_ARG_KEY_NUM_SAMPLES, result.numSamples);
+        sendOrWarn(m);
+        if (listener != null) {
+            listener.onRoundCompleted(roundIdx, result.loss, result.numSamples);
+        }
+    }
+
+    @Override
+    public void onRoundFailed(int roundIdx, String error) {
+        // no upload: a straggler-tolerant server closes the round without us
+        System.err.println("fedml round " + roundIdx + " failed on-device: " + error);
+    }
+
+    private void finish() {
+        executor.shutdown();
+        comm.stop();
+        if (listener != null) {
+            listener.onFinished(roundsTrained);
+        }
+    }
+
+    private void sendOrWarn(Message m) {
+        try {
+            comm.send(m);
+        } catch (IOException e) {
+            System.err.println("fedml send failed (type " + m.getType() + "): " + e);
+        }
+    }
+}

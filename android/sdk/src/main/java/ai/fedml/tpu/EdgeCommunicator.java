@@ -1,0 +1,106 @@
+package ai.fedml.tpu;
+
+import java.io.IOException;
+import java.util.Map;
+import java.util.concurrent.ConcurrentHashMap;
+
+/**
+ * FL message plane for one edge rank — the Java twin of the Python
+ * MqttS3CommManager in MNN mode
+ * (fedml_tpu/core/distributed/communication/mqtt_s3/mqtt_s3_comm_manager.py;
+ * reference role: android/fedmlsdk/.../service/communicator/EdgeCommunicator.java):
+ *
+ * <ul>
+ *   <li>per-pair topics {@code fedml/{runId}/{sender}/{receiver}} — this rank
+ *       subscribes to the run's prefix and filters on receiver;</li>
+ *   <li>status topic {@code fedml/{runId}/status} with an OFFLINE last will
+ *       (server-side liveness detection);</li>
+ *   <li>handlers registered per message type; a local
+ *       {@code connection_ready} fires once the wire is up (same bootstrap
+ *       contract as every Python comm manager).</li>
+ * </ul>
+ */
+public final class EdgeCommunicator implements BrokerConnection.OnMessage {
+    public interface MessageHandler {
+        void onMessage(Message msg);
+    }
+
+    private final String runId;
+    private final long rank;
+    private final BrokerConnection conn;
+    private final Map<String, MessageHandler> handlers = new ConcurrentHashMap<>();
+
+    public EdgeCommunicator(String host, int port, String runId, long rank)
+            throws IOException {
+        this.runId = runId;
+        this.rank = rank;
+        this.conn = new BrokerConnection(host, port, this);
+        Map<String, Object> will = new java.util.LinkedHashMap<>();
+        will.put("rank", rank);
+        will.put("status", MessageDefine.CLIENT_STATUS_OFFLINE);
+        conn.setLastWill(statusTopic(), Json.encode(will));
+        conn.subscribe("fedml/" + runId + "/#");
+    }
+
+    public void register(int msgType, MessageHandler handler) {
+        handlers.put(String.valueOf(msgType), handler);
+    }
+
+    public void register(String msgType, MessageHandler handler) {
+        handlers.put(msgType, handler);
+    }
+
+    /** Call after registering handlers: raises the local connection_ready. */
+    public void start() {
+        MessageHandler h = handlers.get(MessageDefine.MSG_TYPE_CONNECTION_READY);
+        if (h != null) {
+            h.onMessage(new Message(MessageDefine.MSG_TYPE_CONNECTION_READY, rank, rank));
+        }
+    }
+
+    public void send(Message msg) throws IOException {
+        conn.publish(topic(msg.getSenderId(), msg.getReceiverId()), msg.getParams());
+    }
+
+    public void broadcastStatus(String status) throws IOException {
+        Map<String, Object> m = new java.util.LinkedHashMap<>();
+        m.put("rank", rank);
+        m.put("status", status);
+        conn.publish(statusTopic(), Json.encode(m));
+    }
+
+    public void stop() {
+        conn.disconnect();
+    }
+
+    private String topic(long sender, long receiver) {
+        return "fedml/" + runId + "/" + sender + "/" + receiver;
+    }
+
+    private String statusTopic() {
+        return "fedml/" + runId + "/status";
+    }
+
+    @Override
+    @SuppressWarnings("unchecked")
+    public void onMessage(String topic, Object payload) {
+        if (statusTopic().equals(topic)) {
+            return; // liveness plane: observed server-side
+        }
+        // topic = fedml/{runId}/{sender}/{receiver}
+        String[] parts = topic.split("/");
+        if (parts.length != 4) return;
+        long receiver;
+        try {
+            receiver = Long.parseLong(parts[3]);
+        } catch (NumberFormatException e) {
+            return;
+        }
+        if (receiver != rank || !(payload instanceof Map)) return;
+        Message msg = Message.fromParams((Map<String, Object>) payload);
+        MessageHandler h = handlers.get(msg.getType());
+        if (h != null) {
+            h.onMessage(msg);
+        }
+    }
+}

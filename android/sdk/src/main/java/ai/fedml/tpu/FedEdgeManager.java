@@ -1,0 +1,108 @@
+package ai.fedml.tpu;
+
+import java.io.File;
+import java.io.IOException;
+
+/**
+ * Public SDK facade — what an app links (reference role:
+ * android/fedmlsdk/.../FedEdgeManager.java + FedEdgeApi.java):
+ *
+ * <pre>
+ *   FedEdgeManager edge = FedEdgeManager.builder()
+ *       .broker(host, port).runId("mnist-1").rank(1)
+ *       .dataPath("/data/local_data.ftem")
+ *       .uploadDir(context.getCacheDir())
+ *       .hyperParams(32, 0.1, 1)
+ *       .listener(myListener)
+ *       .build();
+ *   edge.start();   // joins the run, trains every round until S2C_FINISH
+ *   ...
+ *   edge.stop();    // leave early (server's straggler tolerance covers us)
+ * </pre>
+ */
+public final class FedEdgeManager {
+    private final EdgeCommunicator comm;
+    private final ClientManager client;
+
+    private FedEdgeManager(EdgeCommunicator comm, ClientManager client) {
+        this.comm = comm;
+        this.client = client;
+    }
+
+    public static Builder builder() {
+        return new Builder();
+    }
+
+    public void start() {
+        client.run();
+    }
+
+    public void stop() {
+        comm.stop();
+    }
+
+    public static final class Builder {
+        private String host = "127.0.0.1";
+        private int port;
+        private String runId = "0";
+        private long rank = 1;
+        private String dataPath;
+        private File uploadDir;
+        private int batchSize = 32;
+        private double lr = 0.1;
+        private int epochs = 1;
+        private OnTrainProgressListener listener;
+
+        public Builder broker(String host, int port) {
+            this.host = host;
+            this.port = port;
+            return this;
+        }
+
+        public Builder runId(String runId) {
+            this.runId = runId;
+            return this;
+        }
+
+        public Builder rank(long rank) {
+            this.rank = rank;
+            return this;
+        }
+
+        /** FTEM file with the device's local (x, y) shard. */
+        public Builder dataPath(String dataPath) {
+            this.dataPath = dataPath;
+            return this;
+        }
+
+        public Builder uploadDir(File uploadDir) {
+            this.uploadDir = uploadDir;
+            return this;
+        }
+
+        public Builder hyperParams(int batchSize, double lr, int epochs) {
+            this.batchSize = batchSize;
+            this.lr = lr;
+            this.epochs = epochs;
+            return this;
+        }
+
+        public Builder listener(OnTrainProgressListener listener) {
+            this.listener = listener;
+            return this;
+        }
+
+        public FedEdgeManager build() throws IOException {
+            if (dataPath == null || uploadDir == null) {
+                throw new IllegalStateException("dataPath and uploadDir are required");
+            }
+            if (!uploadDir.isDirectory() && !uploadDir.mkdirs()) {
+                throw new IOException("cannot create upload dir " + uploadDir);
+            }
+            EdgeCommunicator comm = new EdgeCommunicator(host, port, runId, rank);
+            TrainingExecutor exec = new TrainingExecutor(dataPath, batchSize, lr, epochs);
+            ClientManager client = new ClientManager(comm, exec, rank, uploadDir, listener);
+            return new FedEdgeManager(comm, client);
+        }
+    }
+}

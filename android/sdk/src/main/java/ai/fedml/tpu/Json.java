@@ -1,0 +1,228 @@
+package ai.fedml.tpu;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Minimal dependency-free JSON codec for the broker wire frames — the SDK
+ * runs on bare JVMs/Android without pulling Gson/Jackson (the reference SDK
+ * bundles Gson; this rebuild keeps the edge artifact dependency-free).
+ *
+ * Supports exactly what the control plane needs: objects, arrays, strings,
+ * longs, doubles, booleans, null.  Numbers decode as Long when integral,
+ * Double otherwise.
+ */
+public final class Json {
+    private Json() {}
+
+    // ---- encode -----------------------------------------------------------
+    public static String encode(Object v) {
+        StringBuilder sb = new StringBuilder();
+        write(sb, v);
+        return sb.toString();
+    }
+
+    private static void write(StringBuilder sb, Object v) {
+        if (v == null) {
+            sb.append("null");
+        } else if (v instanceof String) {
+            writeString(sb, (String) v);
+        } else if (v instanceof Boolean) {
+            sb.append(v.toString());
+        } else if (v instanceof Double || v instanceof Float) {
+            double d = ((Number) v).doubleValue();
+            if (Double.isNaN(d) || Double.isInfinite(d)) {
+                throw new IllegalArgumentException("non-finite number in JSON");
+            }
+            sb.append(d);
+        } else if (v instanceof Number) {
+            sb.append(((Number) v).longValue());
+        } else if (v instanceof Map) {
+            sb.append('{');
+            boolean first = true;
+            for (Map.Entry<?, ?> e : ((Map<?, ?>) v).entrySet()) {
+                if (!first) sb.append(',');
+                first = false;
+                writeString(sb, String.valueOf(e.getKey()));
+                sb.append(':');
+                write(sb, e.getValue());
+            }
+            sb.append('}');
+        } else if (v instanceof List) {
+            sb.append('[');
+            boolean first = true;
+            for (Object e : (List<?>) v) {
+                if (!first) sb.append(',');
+                first = false;
+                write(sb, e);
+            }
+            sb.append(']');
+        } else {
+            throw new IllegalArgumentException("unsupported JSON type: " + v.getClass());
+        }
+    }
+
+    private static void writeString(StringBuilder sb, String s) {
+        sb.append('"');
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            switch (c) {
+                case '"': sb.append("\\\""); break;
+                case '\\': sb.append("\\\\"); break;
+                case '\n': sb.append("\\n"); break;
+                case '\r': sb.append("\\r"); break;
+                case '\t': sb.append("\\t"); break;
+                case '\b': sb.append("\\b"); break;
+                case '\f': sb.append("\\f"); break;
+                default:
+                    if (c < 0x20) {
+                        sb.append(String.format("\\u%04x", (int) c));
+                    } else {
+                        sb.append(c);
+                    }
+            }
+        }
+        sb.append('"');
+    }
+
+    // ---- decode -----------------------------------------------------------
+    public static Object decode(String text) {
+        Parser p = new Parser(text);
+        Object v = p.value();
+        p.skipWs();
+        if (!p.done()) throw new IllegalArgumentException("trailing JSON garbage");
+        return v;
+    }
+
+    @SuppressWarnings("unchecked")
+    public static Map<String, Object> decodeObject(String text) {
+        Object v = decode(text);
+        if (!(v instanceof Map)) throw new IllegalArgumentException("not a JSON object");
+        return (Map<String, Object>) v;
+    }
+
+    private static final class Parser {
+        private final String s;
+        private int i = 0;
+
+        Parser(String s) { this.s = s; }
+
+        boolean done() { return i >= s.length(); }
+
+        void skipWs() {
+            while (i < s.length() && Character.isWhitespace(s.charAt(i))) i++;
+        }
+
+        char peek() {
+            if (done()) throw new IllegalArgumentException("unexpected end of JSON");
+            return s.charAt(i);
+        }
+
+        void expect(char c) {
+            if (done() || s.charAt(i) != c) {
+                throw new IllegalArgumentException("expected '" + c + "' at " + i);
+            }
+            i++;
+        }
+
+        Object value() {
+            skipWs();
+            char c = peek();
+            if (c == '{') return object();
+            if (c == '[') return array();
+            if (c == '"') return string();
+            if (c == 't') { literal("true"); return Boolean.TRUE; }
+            if (c == 'f') { literal("false"); return Boolean.FALSE; }
+            if (c == 'n') { literal("null"); return null; }
+            return number();
+        }
+
+        private void literal(String lit) {
+            if (!s.startsWith(lit, i)) throw new IllegalArgumentException("bad literal at " + i);
+            i += lit.length();
+        }
+
+        private Map<String, Object> object() {
+            expect('{');
+            Map<String, Object> out = new LinkedHashMap<>();
+            skipWs();
+            if (peek() == '}') { i++; return out; }
+            while (true) {
+                skipWs();
+                String k = string();
+                skipWs();
+                expect(':');
+                out.put(k, value());
+                skipWs();
+                char c = peek();
+                i++;
+                if (c == '}') return out;
+                if (c != ',') throw new IllegalArgumentException("expected ',' at " + (i - 1));
+            }
+        }
+
+        private List<Object> array() {
+            expect('[');
+            List<Object> out = new ArrayList<>();
+            skipWs();
+            if (peek() == ']') { i++; return out; }
+            while (true) {
+                out.add(value());
+                skipWs();
+                char c = peek();
+                i++;
+                if (c == ']') return out;
+                if (c != ',') throw new IllegalArgumentException("expected ',' at " + (i - 1));
+            }
+        }
+
+        private String string() {
+            expect('"');
+            StringBuilder sb = new StringBuilder();
+            while (true) {
+                char c = s.charAt(i++);
+                if (c == '"') return sb.toString();
+                if (c == '\\') {
+                    char e = s.charAt(i++);
+                    switch (e) {
+                        case '"': sb.append('"'); break;
+                        case '\\': sb.append('\\'); break;
+                        case '/': sb.append('/'); break;
+                        case 'n': sb.append('\n'); break;
+                        case 'r': sb.append('\r'); break;
+                        case 't': sb.append('\t'); break;
+                        case 'b': sb.append('\b'); break;
+                        case 'f': sb.append('\f'); break;
+                        case 'u':
+                            sb.append((char) Integer.parseInt(s.substring(i, i + 4), 16));
+                            i += 4;
+                            break;
+                        default: throw new IllegalArgumentException("bad escape \\" + e);
+                    }
+                } else {
+                    sb.append(c);
+                }
+            }
+        }
+
+        private Object number() {
+            int start = i;
+            if (peek() == '-') i++;
+            boolean isDouble = false;
+            while (!done()) {
+                char c = s.charAt(i);
+                if (c >= '0' && c <= '9') { i++; continue; }
+                if (c == '.' || c == 'e' || c == 'E' || c == '+' || c == '-') {
+                    isDouble = true;
+                    i++;
+                    continue;
+                }
+                break;
+            }
+            String num = s.substring(start, i);
+            return isDouble ? (Object) Double.parseDouble(num) : (Object) Long.parseLong(num);
+        }
+    }
+}

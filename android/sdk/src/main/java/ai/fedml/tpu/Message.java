@@ -1,0 +1,79 @@
+package ai.fedml.tpu;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * One FL control-plane message: a param map with typed accessors — the Java
+ * twin of fedml_tpu/core/distributed/communication/message.py (reference
+ * role: the JSON messages EdgeCommunicator hands to its listeners).
+ */
+public final class Message {
+    private final Map<String, Object> params;
+
+    public Message(String type, long senderId, long receiverId) {
+        params = new LinkedHashMap<>();
+        params.put(MessageDefine.MSG_ARG_KEY_TYPE, type);
+        params.put(MessageDefine.MSG_ARG_KEY_SENDER, senderId);
+        params.put(MessageDefine.MSG_ARG_KEY_RECEIVER, receiverId);
+    }
+
+    public Message(int type, long senderId, long receiverId) {
+        this(String.valueOf(type), senderId, receiverId);
+    }
+
+    private Message(Map<String, Object> params) {
+        this.params = params;
+    }
+
+    /** Rebuild from a received param map (the payload of a broker frame). */
+    public static Message fromParams(Map<String, Object> params) {
+        return new Message(new LinkedHashMap<>(params));
+    }
+
+    public String getType() {
+        return String.valueOf(params.get(MessageDefine.MSG_ARG_KEY_TYPE));
+    }
+
+    public long getSenderId() {
+        return asLong(params.get(MessageDefine.MSG_ARG_KEY_SENDER), 0);
+    }
+
+    public long getReceiverId() {
+        return asLong(params.get(MessageDefine.MSG_ARG_KEY_RECEIVER), 0);
+    }
+
+    public Message add(String key, Object value) {
+        params.put(key, value);
+        return this;
+    }
+
+    public Object get(String key) {
+        return params.get(key);
+    }
+
+    public String getString(String key) {
+        Object v = params.get(key);
+        return v == null ? null : String.valueOf(v);
+    }
+
+    public long getLong(String key, long dflt) {
+        return asLong(params.get(key), dflt);
+    }
+
+    public Map<String, Object> getParams() {
+        return params;
+    }
+
+    private static long asLong(Object v, long dflt) {
+        if (v instanceof Number) return ((Number) v).longValue();
+        if (v instanceof String) {
+            try {
+                return Long.parseLong((String) v);
+            } catch (NumberFormatException ignored) {
+                return dflt;
+            }
+        }
+        return dflt;
+    }
+}

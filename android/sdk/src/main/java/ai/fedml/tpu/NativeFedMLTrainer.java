@@ -1,0 +1,61 @@
+package ai.fedml.tpu;
+
+/**
+ * Binding to the native edge runtime (libfedml_jni.so, built from
+ * native/android/fedml_jni.cpp over the stable C ABI in native/capi.cpp;
+ * reference role: android/fedmlsdk/.../nativemobilenn/NativeFedMLClientManager.java).
+ *
+ * The method list below is the EXACT export surface of fedml_jni.cpp —
+ * tests/test_java_sdk.py cross-checks every native method here against the
+ * {@code Java_ai_fedml_tpu_NativeFedMLTrainer_*} symbols in the C++ file.
+ *
+ * Model/data travel as FTEM files (fedml_tpu/cross_device/edge_model.py):
+ * Java never parses tensors, it hands paths to the native trainer.
+ */
+public final class NativeFedMLTrainer {
+    static {
+        System.loadLibrary("fedml_jni");
+    }
+
+    private NativeFedMLTrainer() {}
+
+    // ---- plain on-device trainer -----------------------------------------
+    public static native long create(String modelPath, String dataPath,
+                                     int batch, double lr, int epochs, long seed);
+
+    /** 0 on success; see {@link #lastError()} otherwise. */
+    public static native int train(long handle);
+
+    public static native int save(long handle, String outPath);
+
+    /** {acc*1e6, loss*1e6}; {-1} on error. */
+    public static native long[] evaluate(long handle);
+
+    /** {epoch, loss*1e6} of the last finished epoch. */
+    public static native long[] epochLoss(long handle);
+
+    public static native long numSamples(long handle);
+
+    /** Cooperative stop: the training loop exits at the next batch. */
+    public static native void stop(long handle);
+
+    public static native void destroy(long handle);
+
+    public static native String lastError();
+
+    // ---- LightSecAgg client (secure aggregation on-device) ----------------
+    public static native long clientCreate(String modelPath, String dataPath,
+                                           int batch, double lr, int epochs, long seed);
+
+    public static native int clientTrain(long handle);
+
+    public static native int clientSaveMasked(long handle, int qBits,
+                                              long maskSeed, String outPath);
+
+    public static native long clientMaskDim(long handle);
+
+    public static native long[] clientEncodeMask(long handle, int n, int t,
+                                                 int u, long maskSeed);
+
+    public static native void clientDestroy(long handle);
+}

@@ -1,0 +1,13 @@
+package ai.fedml.tpu;
+
+/**
+ * App-facing training callbacks (reference role:
+ * android/fedmlsdk/.../OnTrainProgressListener.java + OnTrainingStatusListener).
+ */
+public interface OnTrainProgressListener {
+    /** A round's local training finished; loss scaled back from the native 1e6 fixed point. */
+    void onRoundCompleted(int roundIdx, double loss, long numSamples);
+
+    /** The server ended the run. */
+    void onFinished(int roundsTrained);
+}

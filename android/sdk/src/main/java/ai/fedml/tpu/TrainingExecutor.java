@@ -1,0 +1,86 @@
+package ai.fedml.tpu;
+
+import java.util.concurrent.ExecutorService;
+import java.util.concurrent.Executors;
+
+/**
+ * Single-thread training executor: the round handler returns immediately and
+ * the (seconds-long) native training runs off the communicator's receive
+ * thread — same split as the reference's service/TrainingExecutor.java.
+ */
+public final class TrainingExecutor {
+    /** Result of one local round driven through the native trainer. */
+    public static final class RoundResult {
+        public final String modelOutPath;
+        public final long numSamples;
+        public final double loss;
+
+        RoundResult(String modelOutPath, long numSamples, double loss) {
+            this.modelOutPath = modelOutPath;
+            this.numSamples = numSamples;
+            this.loss = loss;
+        }
+    }
+
+    public interface OnRoundDone {
+        void onRoundDone(int roundIdx, RoundResult result);
+
+        void onRoundFailed(int roundIdx, String error);
+    }
+
+    private final ExecutorService pool = Executors.newSingleThreadExecutor(r -> {
+        Thread t = new Thread(r, "fedml-train");
+        t.setDaemon(true);
+        return t;
+    });
+    private final String dataPath;
+    private final int batchSize;
+    private final double lr;
+    private final int epochs;
+    private volatile long activeHandle = 0;
+
+    public TrainingExecutor(String dataPath, int batchSize, double lr, int epochs) {
+        this.dataPath = dataPath;
+        this.batchSize = batchSize;
+        this.lr = lr;
+        this.epochs = epochs;
+    }
+
+    /** Train the downloaded model file, save to outPath, report via callback. */
+    public void submit(int roundIdx, String modelPath, String outPath, long seed,
+                       OnRoundDone callback) {
+        pool.execute(() -> {
+            long h = NativeFedMLTrainer.create(modelPath, dataPath, batchSize, lr,
+                                               epochs, seed);
+            if (h == 0) {
+                callback.onRoundFailed(roundIdx, NativeFedMLTrainer.lastError());
+                return;
+            }
+            activeHandle = h;
+            try {
+                if (NativeFedMLTrainer.train(h) != 0
+                        || NativeFedMLTrainer.save(h, outPath) != 0) {
+                    callback.onRoundFailed(roundIdx, NativeFedMLTrainer.lastError());
+                    return;
+                }
+                long[] el = NativeFedMLTrainer.epochLoss(h);
+                double loss = el.length == 2 ? el[1] / 1e6 : Double.NaN;
+                callback.onRoundDone(
+                        roundIdx,
+                        new RoundResult(outPath, NativeFedMLTrainer.numSamples(h), loss));
+            } finally {
+                activeHandle = 0;
+                NativeFedMLTrainer.destroy(h);
+            }
+        });
+    }
+
+    /** Cooperative stop of the in-flight round (if any), then drain. */
+    public void shutdown() {
+        long h = activeHandle;
+        if (h != 0) {
+            NativeFedMLTrainer.stop(h);
+        }
+        pool.shutdown();
+    }
+}

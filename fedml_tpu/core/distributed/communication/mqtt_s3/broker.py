@@ -12,13 +12,20 @@ protocol actually uses:
   DISCONNECT (liveness parity with the reference's last-will/active-status
   topics, ``mqtt_s3_multi_clients_comm_manager.py:325-352``).
 
-Wire format: 4-byte big-endian length + pickled dict frames.  The broker is a
-plain threaded TCP server so true multi-process cross-silo runs work on one
-host or across hosts.
+Wire format: 4-byte big-endian length + dict frames, in one of TWO
+encodings sniffed per connection: pickle (Python peers, the default) or
+UTF-8 JSON (first body byte ``{`` — the interop encoding the Java edge SDK
+``android/sdk`` speaks; pickle is not implementable from a phone runtime).
+The broker remembers each connection's encoding from its first frame and
+delivers every frame to a client in that client's own encoding, so Python
+silos and JSON devices share one broker.  The broker is a plain threaded
+TCP server so true multi-process cross-silo runs work on one host or
+across hosts.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import pickle
 import socket
@@ -31,12 +38,28 @@ logger = logging.getLogger(__name__)
 _LEN = struct.Struct(">I")
 
 
-def _send_frame(sock: socket.socket, obj: dict) -> None:
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(data)) + data)
+def _encode_frame(obj: dict, enc: str) -> bytes:
+    if enc == "json":
+        # allow_nan=False: the token 'NaN' is not JSON and would poison a
+        # Java peer's parser mid-stream; non-finite payloads must hit the
+        # caller's drop path instead
+        data = json.dumps(obj, allow_nan=False).encode("utf-8")
+    else:
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return _LEN.pack(len(data)) + data
 
 
-def _recv_frame(sock: socket.socket) -> Optional[dict]:
+def _send_frame(sock: socket.socket, obj: dict, enc: str = "pickle") -> None:
+    sock.sendall(_encode_frame(obj, enc))
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Tuple[dict, str]]:
+    """-> (frame, encoding) — encoding sniffed from the first body byte
+    (every pickle protocol >= 2 starts with 0x80; JSON objects with '{').
+    An undecodable body is treated as connection death (None), NOT raised:
+    an exception here would kill the broker's client thread before its
+    cleanup block, leaving a zombie subscriber whose last will never
+    fires."""
     hdr = _recv_exact(sock, _LEN.size)
     if hdr is None:
         return None
@@ -44,7 +67,13 @@ def _recv_frame(sock: socket.socket) -> Optional[dict]:
     body = _recv_exact(sock, n)
     if body is None:
         return None
-    return pickle.loads(body)
+    try:
+        if body[:1] == b"{":
+            return json.loads(body.decode("utf-8")), "json"
+        return pickle.loads(body), "pickle"
+    except Exception:
+        logger.warning("undecodable %d-byte frame: dropping the connection", n)
+        return None
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -76,6 +105,11 @@ class LocalBroker:
         self._lock = threading.Lock()
         # conn -> (subscriptions, last_will)
         self._clients: Dict[socket.socket, Tuple[List[str], Optional[dict]]] = {}
+        # conn -> wire encoding ("pickle"/"json"), learned from its frames
+        self._enc: Dict[socket.socket, str] = {}
+        # conn -> send lock: concurrent _publish calls (one per publishing
+        # client thread) must not interleave a shared subscriber's frames
+        self._send_locks: Dict[socket.socket, threading.Lock] = {}
         self._running = False
         self._thread: Optional[threading.Thread] = None
 
@@ -116,6 +150,7 @@ class LocalBroker:
                 break
             with self._lock:
                 self._clients[conn] = ([], None)
+                self._send_locks[conn] = threading.Lock()
             threading.Thread(
                 target=self._client_loop, args=(conn,), daemon=True, name="broker-client"
             ).start()
@@ -123,9 +158,11 @@ class LocalBroker:
     def _client_loop(self, conn: socket.socket) -> None:
         clean = False
         while self._running:
-            frame = _recv_frame(conn)
-            if frame is None:
+            got = _recv_frame(conn)
+            if got is None:
                 break
+            frame, enc = got
+            self._enc[conn] = enc
             op = frame.get("op")
             if op == "SUB":
                 with self._lock:
@@ -149,6 +186,8 @@ class LocalBroker:
         # fire last will on unclean death (MQTT parity)
         with self._lock:
             _, will = self._clients.pop(conn, ([], None))
+            self._enc.pop(conn, None)
+            self._send_locks.pop(conn, None)
         try:
             conn.close()
         except OSError:
@@ -159,27 +198,57 @@ class LocalBroker:
     def _publish(self, topic: str, payload) -> None:
         with self._lock:
             targets = [
-                c for c, (subs, _) in self._clients.items()
+                (c, self._enc.get(c, "pickle"), self._send_locks.get(c))
+                for c, (subs, _) in self._clients.items()
                 if any(topic_matches(p, topic) for p in subs)
             ]
-        dead = []
-        for c in targets:
+        # serialize ONCE per encoding (not per subscriber); a payload that
+        # cannot be JSON-encoded (tensors, non-finite floats) is dropped for
+        # JSON subscribers ONLY — control-plane messages are JSON-safe by
+        # design (the MNN flow ships models as FILE references), so this is
+        # a misrouted data-plane frame.  Pickle failures stay loud.
+        frames: Dict[str, Optional[bytes]] = {}
+        for enc in {e for _, e, _ in targets}:
             try:
-                _send_frame(c, {"op": "MSG", "topic": topic, "payload": payload})
+                frames[enc] = _encode_frame(
+                    {"op": "MSG", "topic": topic, "payload": payload}, enc
+                )
+            except (TypeError, ValueError):
+                if enc != "json":
+                    raise
+                logger.warning(
+                    "dropping non-JSON payload on %s for JSON subscribers", topic
+                )
+                frames[enc] = None
+        dead = []
+        for c, enc, slock in targets:
+            data = frames.get(enc)
+            if data is None or slock is None:
+                continue
+            try:
+                with slock:  # frames to one subscriber must never interleave
+                    c.sendall(data)
             except OSError:
                 dead.append(c)
         for c in dead:
             with self._lock:
                 self._clients.pop(c, None)
+                self._enc.pop(c, None)
+                self._send_locks.pop(c, None)
 
 
 class BrokerClient:
-    """Client for :class:`LocalBroker` with paho-like callback semantics."""
+    """Client for :class:`LocalBroker` with paho-like callback semantics.
 
-    def __init__(self, host: str, port: int, on_message: Callable[[str, object], None]):
+    ``encoding="json"`` speaks the interop wire the Java edge SDK uses —
+    handy for driving/validating that protocol from Python tests."""
+
+    def __init__(self, host: str, port: int, on_message: Callable[[str, object], None],
+                 encoding: str = "pickle"):
         self._sock = socket.create_connection((host, port), timeout=30)
         self._sock.settimeout(None)
         self.on_message = on_message
+        self.encoding = encoding
         self._running = True
         self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._recv_loop, daemon=True, name="broker-recv")
@@ -187,34 +256,37 @@ class BrokerClient:
 
     def subscribe(self, topic: str) -> None:
         with self._lock:
-            _send_frame(self._sock, {"op": "SUB", "topic": topic})
+            _send_frame(self._sock, {"op": "SUB", "topic": topic}, self.encoding)
 
     def unsubscribe(self, topic: str) -> None:
         with self._lock:
-            _send_frame(self._sock, {"op": "UNSUB", "topic": topic})
+            _send_frame(self._sock, {"op": "UNSUB", "topic": topic}, self.encoding)
 
     def publish(self, topic: str, payload) -> None:
         with self._lock:
-            _send_frame(self._sock, {"op": "PUB", "topic": topic, "payload": payload})
+            _send_frame(self._sock, {"op": "PUB", "topic": topic, "payload": payload},
+                        self.encoding)
 
     def set_last_will(self, topic: str, payload) -> None:
         with self._lock:
-            _send_frame(self._sock, {"op": "WILL", "topic": topic, "payload": payload})
+            _send_frame(self._sock, {"op": "WILL", "topic": topic, "payload": payload},
+                        self.encoding)
 
     def disconnect(self) -> None:
         self._running = False
         try:
             with self._lock:
-                _send_frame(self._sock, {"op": "DISCONNECT"})
+                _send_frame(self._sock, {"op": "DISCONNECT"}, self.encoding)
             self._sock.close()
         except OSError:
             pass
 
     def _recv_loop(self) -> None:
         while self._running:
-            frame = _recv_frame(self._sock)
-            if frame is None:
+            got = _recv_frame(self._sock)
+            if got is None:
                 break
+            frame, _ = got
             if frame.get("op") == "MSG":
                 try:
                     self.on_message(str(frame["topic"]), frame.get("payload"))

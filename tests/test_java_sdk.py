@@ -1,0 +1,280 @@
+"""Java edge SDK (android/sdk — ai.fedml.tpu): protocol drift gates that run
+everywhere, plus javac/JVM legs that activate when a JDK is present.
+
+The SDK's wire is the broker's JSON interop encoding (broker.py sniffs each
+connection), so a Python client in encoding="json" mode exercises EXACTLY
+the bytes the Java BrokerConnection produces — the 'Java-shaped client'
+below walks the full cross-device round against a real server with it.
+Reference role: android_protocol_test + the ~7k-LoC
+android/fedmlsdk/src/main/java/ai/fedml service layer."""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SDK = os.path.join(REPO, "android", "sdk", "src", "main", "java", "ai", "fedml", "tpu")
+JNI_CPP = os.path.join(REPO, "native", "android", "fedml_jni.cpp")
+
+
+def _java(name: str) -> str:
+    with open(os.path.join(SDK, name)) as f:
+        return f.read()
+
+
+class TestProtocolDriftGates:
+    """Parse the Java sources and pin them to their Python twins — adding or
+    renaming a constant on one side fails here."""
+
+    def test_message_define_matches_python(self):
+        from fedml_tpu.cross_device.message_define import MNNMessage
+
+        src = _java("MessageDefine.java")
+        ints = dict(re.findall(r"int (MSG_TYPE_\w+) = (\d+);", src))
+        strs = dict(re.findall(r'String (\w+) = "([^"]*)";', src))
+        assert ints, "no int constants parsed from MessageDefine.java"
+        for name, val in ints.items():
+            assert getattr(MNNMessage, name) == int(val), name
+        for name, val in strs.items():
+            if name == "MSG_TYPE_CONNECTION_READY":
+                assert val == "connection_ready"
+                continue
+            assert getattr(MNNMessage, name) == val, name
+        # completeness: every Python MSG_TYPE/MSG_ARG the device protocol
+        # uses exists on the Java side
+        for name in dir(MNNMessage):
+            if name.startswith(("MSG_TYPE_", "MSG_ARG_KEY_", "CLIENT_STATUS_")):
+                assert name in ints or name in strs, f"missing in Java: {name}"
+
+    def test_native_binding_matches_jni_exports(self):
+        src = _java("NativeFedMLTrainer.java")
+        java_methods = set(re.findall(r"native [\w\[\]]+ (\w+)\(", src))
+        with open(JNI_CPP) as f:
+            cpp = f.read()
+        cpp_exports = set(re.findall(
+            r"Java_ai_fedml_tpu_NativeFedMLTrainer_(\w+)\(", cpp))
+        assert java_methods == cpp_exports, (
+            java_methods ^ cpp_exports)
+
+    def test_topic_scheme_matches_python(self):
+        src = _java("EdgeCommunicator.java")
+        # fedml/{runId}/{sender}/{receiver} + fedml/{runId}/status + the
+        # run-prefix subscription — the MqttS3CommManager scheme
+        assert '"fedml/" + runId + "/" + sender + "/" + receiver' in src
+        assert '"fedml/" + runId + "/status"' in src
+        assert '"fedml/" + runId + "/#"' in src
+
+
+class TestJsonWireInterop:
+    """The broker side of the Java wire: a JSON-encoding client and a pickle
+    client share topics, payloads, and last-will semantics."""
+
+    def test_json_and_pickle_clients_interoperate(self):
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import (
+            BrokerClient,
+            LocalBroker,
+        )
+
+        broker = LocalBroker().start()
+        try:
+            got_py, got_js = [], []
+            py = BrokerClient("127.0.0.1", broker.port,
+                              lambda t, p: got_py.append((t, p)))
+            js = BrokerClient("127.0.0.1", broker.port,
+                              lambda t, p: got_js.append((t, p)),
+                              encoding="json")
+            py.subscribe("fedml/run/#")
+            js.subscribe("fedml/run/#")
+            time.sleep(0.2)
+            js.publish("fedml/run/1/0", {"msg_type": "5", "sender": 1,
+                                         "receiver": 0, "client_status": "ONLINE"})
+            py.publish("fedml/run/0/1", {"msg_type": "2", "sender": 0,
+                                         "receiver": 1, "round_idx": 3,
+                                         "model_params_file": "/tmp/m.ftem"})
+            deadline = time.time() + 5
+            while (len(got_py) < 2 or len(got_js) < 2) and time.time() < deadline:
+                time.sleep(0.05)
+            py_by_topic = dict(got_py)
+            js_by_topic = dict(got_js)
+            assert py_by_topic["fedml/run/1/0"]["client_status"] == "ONLINE"
+            assert js_by_topic["fedml/run/0/1"]["model_params_file"] == "/tmp/m.ftem"
+            # ints survive the cross-encoding trip
+            assert int(js_by_topic["fedml/run/0/1"]["round_idx"]) == 3
+            py.disconnect()
+            js.disconnect()
+        finally:
+            broker.stop()
+
+    def test_json_client_last_will_reaches_pickle_subscriber(self):
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import (
+            BrokerClient,
+            LocalBroker,
+        )
+
+        broker = LocalBroker().start()
+        try:
+            got = []
+            watcher = BrokerClient("127.0.0.1", broker.port,
+                                   lambda t, p: got.append((t, p)))
+            watcher.subscribe("fedml/run/status")
+            js = BrokerClient("127.0.0.1", broker.port, lambda t, p: None,
+                              encoding="json")
+            js.set_last_will("fedml/run/status", '{"rank": 3, "status": "OFFLINE"}')
+            time.sleep(0.2)
+            # unclean death -> broker fires the will (shutdown, not close:
+            # close() is deferred while the client's recv thread holds the fd)
+            import socket as _socket
+
+            js._sock.shutdown(_socket.SHUT_RDWR)
+            js._sock.close()
+            deadline = time.time() + 5
+            while not got and time.time() < deadline:
+                time.sleep(0.05)
+            assert got and "OFFLINE" in str(got[0][1])
+            watcher.disconnect()
+        finally:
+            broker.stop()
+
+
+def _separable(n, d=12, classes=4, seed=0):
+    centers = np.random.RandomState(1234).randn(classes, d) * 3
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, d) * 0.5
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+class JavaShapedDevice:
+    """A device speaking byte-for-byte what ClientManager.java sends: JSON
+    wire frames, the same topics, the same message fields in the same flow
+    (handshake ONLINE -> train -> tagged upload -> FINISH).  Training runs
+    through the numpy twin of the native trainer the Java SDK drives."""
+
+    def __init__(self, broker_port, run_id, rank, data, upload_dir, lr=0.2, epochs=2):
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import BrokerClient
+
+        self.run_id, self.rank = run_id, rank
+        self.x, self.y = data
+        self.upload_dir = upload_dir
+        self.lr, self.epochs = lr, epochs
+        self.rounds_trained = 0
+        self.finished = threading.Event()
+        self.client = BrokerClient("127.0.0.1", broker_port, self._on_message,
+                                   encoding="json")
+        self.client.set_last_will(
+            f"fedml/{run_id}/status", '{"rank": %d, "status": "OFFLINE"}' % rank)
+        self.client.subscribe(f"fedml/{run_id}/#")
+
+    def _send(self, params):
+        self.client.publish(
+            f"fedml/{self.run_id}/{self.rank}/0", params)
+
+    def _on_message(self, topic, payload):
+        parts = topic.split("/")
+        if len(parts) != 4 or parts[3] != str(self.rank):
+            return
+        msg_type = str(payload.get("msg_type"))
+        if msg_type == "6":  # CHECK_CLIENT_STATUS -> announce ONLINE
+            self._send({"msg_type": "5", "sender": self.rank, "receiver": 0,
+                        "client_status": "ONLINE"})
+        elif msg_type in ("1", "2"):  # INIT / SYNC -> train + tagged upload
+            from fedml_tpu.cross_device.edge_model import (
+                load_edge_model,
+                save_edge_model,
+            )
+            from fedml_tpu.cross_device.fake_device import train_numpy
+
+            round_idx = int(payload["round_idx"])
+            flat = load_edge_model(payload["model_params_file"])
+            trained = train_numpy(flat, self.x, self.y, lr=self.lr,
+                                  epochs=self.epochs, batch_size=16,
+                                  seed=round_idx * 1000 + self.rank)
+            out = os.path.join(self.upload_dir,
+                               f"model_r{round_idx}_c{self.rank}.ftem")
+            save_edge_model(out, trained)
+            self.rounds_trained += 1
+            self._send({"msg_type": "3", "sender": self.rank, "receiver": 0,
+                        "round_idx": round_idx, "model_params_file": out,
+                        "num_samples": int(len(self.y))})
+        elif msg_type == "7":  # FINISH
+            self.finished.set()
+            self.client.disconnect()
+
+
+class TestJavaShapedDeviceE2E:
+    def test_round_with_json_wire_devices(self, tmp_path):
+        """Full cross-device run: Python server over MQTT_S3 (MNN file
+        plane), two devices on the JSON interop wire doing exactly the Java
+        ClientManager flow."""
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import LocalBroker
+        from fedml_tpu.cross_device.fedml_aggregator import FedMLAggregator
+        from fedml_tpu.cross_device.fedml_server_manager import FedMLServerManager
+        from fedml_tpu.models.linear import LogisticRegression
+
+        broker = LocalBroker().start()
+        try:
+            args = Arguments.from_dict({
+                "common_args": {"training_type": "cross_device", "random_seed": 0,
+                                "run_id": "java-e2e"},
+                "data_args": {"dataset": "synthetic"},
+                "model_args": {"model": "lr"},
+                "train_args": {
+                    "federated_optimizer": "FedAvg",
+                    "client_num_in_total": 2, "client_num_per_round": 2,
+                    "comm_round": 3, "epochs": 2, "batch_size": 16,
+                    "learning_rate": 0.2,
+                },
+                "validation_args": {"frequency_of_the_test": 1},
+                "comm_args": {"backend": "MQTT_S3_MNN"},
+            }).validate()
+            args.mqtt_host, args.mqtt_port = "127.0.0.1", broker.port
+            args.s3_blob_root = str(tmp_path / "blobs")
+
+            x_test, y_test = _separable(128, seed=9)
+            aggregator = FedMLAggregator(
+                args, LogisticRegression(output_dim=4), (x_test, y_test),
+                worker_num=2, model_dir=str(tmp_path / "models"))
+            server = FedMLServerManager(args, aggregator, client_rank=0,
+                                        client_num=2, backend="MQTT_S3_MNN")
+            devices = [
+                JavaShapedDevice(broker.port, "java-e2e", rank,
+                                 _separable(96, seed=rank),
+                                 str(tmp_path))
+                for rank in (1, 2)
+            ]
+            t = server.run_async()
+            for d in devices:
+                assert d.finished.wait(timeout=60), "device never saw FINISH"
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert all(d.rounds_trained == 3 for d in devices)
+            assert aggregator.eval_history[-1]["test_acc"] > 0.8
+        finally:
+            broker.stop()
+
+
+HAVE_JAVAC = shutil.which("javac") is not None
+
+
+@pytest.mark.skipif(not HAVE_JAVAC, reason="no JDK in this image")
+class TestJavacCompile:
+    def test_sdk_compiles(self, tmp_path):
+        srcs = [os.path.join(SDK, f) for f in sorted(os.listdir(SDK))
+                if f.endswith(".java")]
+        srcs.append(os.path.join(REPO, "android", "sdk", "harness",
+                                 "EdgeHarness.java"))
+        out = subprocess.run(
+            ["javac", "-Werror", "-d", str(tmp_path)] + srcs,
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert (tmp_path / "ai" / "fedml" / "tpu"
+                / "FedEdgeManager.class").exists()
