@@ -31,6 +31,15 @@ public final class BrokerConnection implements AutoCloseable {
     private final OnMessage onMessage;
     private final Thread recvThread;
     private volatile boolean running = true;
+    private volatile Runnable onConnectionLost;
+
+    /** Invoked once from the receive thread if the wire dies while the
+     *  client did NOT call disconnect() — without it a broker crash would
+     *  leave the app waiting forever with the failure visible only
+     *  server-side (via the last will). */
+    public void setOnConnectionLost(Runnable callback) {
+        this.onConnectionLost = callback;
+    }
 
     public BrokerConnection(String host, int port, OnMessage onMessage) throws IOException {
         this.socket = new Socket(host, port);
@@ -132,6 +141,14 @@ public final class BrokerConnection implements AutoCloseable {
                 try {
                     socket.close();
                 } catch (IOException ignored) {
+                }
+                Runnable cb = onConnectionLost;
+                if (cb != null) {
+                    try {
+                        cb.run();
+                    } catch (RuntimeException e) {
+                        System.err.println("fedml connection-lost callback raised: " + e);
+                    }
                 }
             }
         }

@@ -28,6 +28,8 @@ public final class ClientManager implements TrainingExecutor.OnRoundDone {
     private final long rank;
     private final File uploadDir;
     private final OnTrainProgressListener listener;
+    private final java.util.concurrent.atomic.AtomicBoolean finished =
+            new java.util.concurrent.atomic.AtomicBoolean(false);
     private volatile int roundsTrained = 0;
 
     public ClientManager(EdgeCommunicator comm, TrainingExecutor executor, long rank,
@@ -42,6 +44,11 @@ public final class ClientManager implements TrainingExecutor.OnRoundDone {
         comm.register(MessageDefine.MSG_TYPE_S2C_INIT_CONFIG, this::onModel);
         comm.register(MessageDefine.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, this::onModel);
         comm.register(MessageDefine.MSG_TYPE_S2C_FINISH, m -> finish());
+        // broker death must not strand the app waiting on onFinished
+        comm.setOnConnectionLost(() -> {
+            System.err.println("fedml broker connection lost: leaving the run");
+            finish();
+        });
     }
 
     /** Begin participating (raises connection_ready → ONLINE handshake). */
@@ -87,7 +94,16 @@ public final class ClientManager implements TrainingExecutor.OnRoundDone {
         System.err.println("fedml round " + roundIdx + " failed on-device: " + error);
     }
 
-    private void finish() {
+    /** Leave the run: stop local training, drop the transport, report.
+     *  Idempotent — reachable from S2C_FINISH, connection loss, and the
+     *  app's FedEdgeManager.stop(). */
+    public void finish() {
+        if (!finished.compareAndSet(false, true)) {
+            return;
+        }
+        // shutdown() blocks until the in-flight round resolves, so a final
+        // onRoundCompleted lands BEFORE onFinished and roundsTrained is
+        // complete when reported
         executor.shutdown();
         comm.stop();
         if (listener != null) {

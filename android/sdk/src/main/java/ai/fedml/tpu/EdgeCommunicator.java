@@ -50,6 +50,11 @@ public final class EdgeCommunicator implements BrokerConnection.OnMessage {
         handlers.put(msgType, handler);
     }
 
+    /** Surface transport death to the app layer (see BrokerConnection). */
+    public void setOnConnectionLost(Runnable callback) {
+        conn.setOnConnectionLost(callback);
+    }
+
     /** Call after registering handlers: raises the local connection_ready. */
     public void start() {
         MessageHandler h = handlers.get(MessageDefine.MSG_TYPE_CONNECTION_READY);

@@ -21,11 +21,9 @@ import java.io.IOException;
  * </pre>
  */
 public final class FedEdgeManager {
-    private final EdgeCommunicator comm;
     private final ClientManager client;
 
-    private FedEdgeManager(EdgeCommunicator comm, ClientManager client) {
-        this.comm = comm;
+    private FedEdgeManager(ClientManager client) {
         this.client = client;
     }
 
@@ -37,8 +35,11 @@ public final class FedEdgeManager {
         client.run();
     }
 
+    /** Leave the run early: stops local training (cooperatively, discarding
+     *  queued rounds) AND the transport; the server's straggler tolerance
+     *  covers the missing upload. */
     public void stop() {
-        comm.stop();
+        client.finish();
     }
 
     public static final class Builder {
@@ -102,7 +103,7 @@ public final class FedEdgeManager {
             EdgeCommunicator comm = new EdgeCommunicator(host, port, runId, rank);
             TrainingExecutor exec = new TrainingExecutor(dataPath, batchSize, lr, epochs);
             ClientManager client = new ClientManager(comm, exec, rank, uploadDir, listener);
-            return new FedEdgeManager(comm, client);
+            return new FedEdgeManager(client);
         }
     }
 }

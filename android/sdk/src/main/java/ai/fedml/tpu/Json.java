@@ -178,14 +178,21 @@ public final class Json {
             }
         }
 
+        private char next() {
+            if (done()) {
+                throw new IllegalArgumentException("unexpected end of JSON string");
+            }
+            return s.charAt(i++);
+        }
+
         private String string() {
             expect('"');
             StringBuilder sb = new StringBuilder();
             while (true) {
-                char c = s.charAt(i++);
+                char c = next();
                 if (c == '"') return sb.toString();
                 if (c == '\\') {
-                    char e = s.charAt(i++);
+                    char e = next();
                     switch (e) {
                         case '"': sb.append('"'); break;
                         case '\\': sb.append('\\'); break;
@@ -196,6 +203,10 @@ public final class Json {
                         case 'b': sb.append('\b'); break;
                         case 'f': sb.append('\f'); break;
                         case 'u':
+                            if (i + 4 > s.length()) {
+                                throw new IllegalArgumentException(
+                                        "truncated \\u escape at " + i);
+                            }
                             sb.append((char) Integer.parseInt(s.substring(i, i + 4), 16));
                             i += 4;
                             break;

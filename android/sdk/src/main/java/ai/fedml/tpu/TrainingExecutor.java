@@ -2,6 +2,7 @@ package ai.fedml.tpu;
 
 import java.util.concurrent.ExecutorService;
 import java.util.concurrent.Executors;
+import java.util.concurrent.TimeUnit;
 
 /**
  * Single-thread training executor: the round handler returns immediately and
@@ -38,6 +39,7 @@ public final class TrainingExecutor {
     private final double lr;
     private final int epochs;
     private volatile long activeHandle = 0;
+    private volatile boolean stopping = false;
 
     public TrainingExecutor(String dataPath, int batchSize, double lr, int epochs) {
         this.dataPath = dataPath;
@@ -50,6 +52,9 @@ public final class TrainingExecutor {
     public void submit(int roundIdx, String modelPath, String outPath, long seed,
                        OnRoundDone callback) {
         pool.execute(() -> {
+            if (stopping) {
+                return; // a round queued behind shutdown must not train
+            }
             long h = NativeFedMLTrainer.create(modelPath, dataPath, batchSize, lr,
                                                epochs, seed);
             if (h == 0) {
@@ -57,6 +62,10 @@ public final class TrainingExecutor {
                 return;
             }
             activeHandle = h;
+            if (stopping) {
+                // shutdown raced the create window: stop before training
+                NativeFedMLTrainer.stop(h);
+            }
             try {
                 if (NativeFedMLTrainer.train(h) != 0
                         || NativeFedMLTrainer.save(h, outPath) != 0) {
@@ -75,12 +84,20 @@ public final class TrainingExecutor {
         });
     }
 
-    /** Cooperative stop of the in-flight round (if any), then drain. */
+    /** Cooperative stop of the in-flight round; queued rounds never start.
+     *  Blocks briefly so the in-flight round resolves (its callback fires
+     *  BEFORE the caller reports completion — callback ordering holds). */
     public void shutdown() {
+        stopping = true;
         long h = activeHandle;
         if (h != 0) {
-            NativeFedMLTrainer.stop(h);
+            NativeFedMLTrainer.stop(h); // exits at the next batch boundary
         }
         pool.shutdown();
+        try {
+            pool.awaitTermination(10, TimeUnit.SECONDS);
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
+        }
     }
 }

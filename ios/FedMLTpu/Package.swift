@@ -1,0 +1,22 @@
+// swift-tools-version:5.5
+// FedMLTpu — Swift binding to the fedml_tpu native edge runtime.
+// The C target vendors the canonical C ABI header (native/include/
+// fedml_capi.h — byte-identity asserted by tests/test_ios_package.py);
+// link libfedml_edge built from native/ for the target platform.
+import PackageDescription
+
+let package = Package(
+    name: "FedMLTpu",
+    products: [
+        .library(name: "FedMLTpu", targets: ["FedMLTpu"]),
+    ],
+    targets: [
+        .systemLibrary(name: "CFedML", path: "Sources/CFedML"),
+        .target(
+            name: "FedMLTpu",
+            dependencies: ["CFedML"],
+            path: "Sources/FedMLTpu",
+            linkerSettings: [.linkedLibrary("fedml_edge")]
+        ),
+    ]
+)

@@ -1,0 +1,134 @@
+// FedMLTrainer — Swift wrapper over the native edge runtime's C ABI
+// (CFedML / native/include/fedml_capi.h; runtime built from native/).
+// Role of the reference's ios/ integration surface (the reference ships a
+// README-only placeholder there; this package is a working binding).
+//
+// Model and data travel as FTEM files (fedml_tpu/cross_device/
+// edge_model.py) — Swift hands paths to the native trainer exactly like
+// the Java NativeFedMLTrainer and the Python ctypes binding.
+
+import CFedML
+import Foundation
+
+public enum FedMLError: Error {
+    case native(String)
+
+    static func last() -> FedMLError {
+        .native(String(cString: fedml_last_error()))
+    }
+}
+
+/// One on-device local-training session (reference FedMLBaseTrainer
+/// contract): create from a downloaded global-model FTEM file + the
+/// device's local-data FTEM file, train, save the update for upload.
+public final class FedMLTrainer {
+    private var handle: UnsafeMutableRawPointer?
+
+    public init(modelPath: String, dataPath: String, batchSize: Int32 = 32,
+                learningRate: Double = 0.1, epochs: Int32 = 1,
+                seed: UInt64 = 0) throws {
+        handle = fedml_trainer_create(modelPath, dataPath, batchSize,
+                                      learningRate, epochs, seed)
+        if handle == nil {
+            throw FedMLError.last()
+        }
+    }
+
+    deinit {
+        if let h = handle {
+            fedml_trainer_destroy(h)
+        }
+    }
+
+    public func train() throws {
+        guard fedml_trainer_train(handle) == 0 else {
+            throw FedMLError.last()
+        }
+    }
+
+    public func save(to outPath: String) throws {
+        guard fedml_trainer_save(handle, outPath) == 0 else {
+            throw FedMLError.last()
+        }
+    }
+
+    public func evaluate() throws -> (accuracy: Double, loss: Double) {
+        var acc = 0.0
+        var loss = 0.0
+        guard fedml_trainer_eval(handle, &acc, &loss) == 0 else {
+            throw FedMLError.last()
+        }
+        return (acc, loss)
+    }
+
+    public var lastEpochLoss: (epoch: Int32, loss: Double) {
+        var epoch: Int32 = 0
+        var loss = 0.0
+        fedml_trainer_epoch_loss(handle, &epoch, &loss)
+        return (epoch, loss)
+    }
+
+    public var numSamples: Int64 {
+        fedml_trainer_num_samples(handle)
+    }
+
+    /// Cooperative stop: the native loop exits at the next batch boundary.
+    public func stop() {
+        fedml_trainer_stop(handle)
+    }
+}
+
+/// LightSecAgg on-device client: train + upload a MASKED model so the
+/// server only ever sees the aggregate (mirrors the Java clientCreate/
+/// clientSaveMasked leg and fedml_tpu/cross_silo/lightsecagg).
+public final class FedMLSecureClient {
+    private var handle: UnsafeMutableRawPointer?
+
+    public init(modelPath: String, dataPath: String, batchSize: Int32 = 32,
+                learningRate: Double = 0.1, epochs: Int32 = 1,
+                seed: UInt64 = 0) throws {
+        handle = fedml_client_create(modelPath, dataPath, batchSize,
+                                     learningRate, epochs, seed)
+        if handle == nil {
+            throw FedMLError.last()
+        }
+    }
+
+    deinit {
+        if let h = handle {
+            fedml_client_destroy(h)
+        }
+    }
+
+    public func train() throws {
+        guard fedml_client_train(handle) == 0 else {
+            throw FedMLError.last()
+        }
+    }
+
+    public func saveMaskedModel(qBits: Int32, maskSeed: UInt64,
+                                to outPath: String) throws {
+        guard fedml_client_save_masked_model(handle, qBits, maskSeed,
+                                             outPath) == 0 else {
+            throw FedMLError.last()
+        }
+    }
+
+    public var maskDimension: Int64 {
+        fedml_client_mask_dim(handle)
+    }
+
+    /// LCC-encode this client's mask into n shares ([n * chunk] int64).
+    public func encodeMask(n: Int32, t: Int32, u: Int32,
+                           maskSeed: UInt64) throws -> [Int64] {
+        let chunk = fedml_lsa_chunk(Int32(maskDimension), t, u)
+        var out = [Int64](repeating: 0, count: Int(n) * Int(chunk))
+        let rc = out.withUnsafeMutableBufferPointer {
+            fedml_client_encode_mask(handle, n, t, u, maskSeed, $0.baseAddress)
+        }
+        guard rc == 0 else {
+            throw FedMLError.last()
+        }
+        return out
+    }
+}

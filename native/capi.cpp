@@ -5,6 +5,10 @@
 #include <cstring>
 #include <string>
 
+// the canonical C ABI header: including it here makes any drift between
+// declaration (what bindings see) and definition a compile error
+#include "include/fedml_capi.h"
+
 #include "fedml_edge.hpp"
 
 using fedml::FedMLClientManager;
@@ -81,8 +85,6 @@ void* fedml_trainer_create(const char* model_path, const char* data_path, int ba
     return t;
   });
 }
-
-typedef void (*fedml_progress_cb)(int epoch, double loss);
 
 void fedml_trainer_set_callback(void* h, fedml_progress_cb cb) {
   static_cast<FedMLBaseTrainer*>(h)->set_progress_callback(cb);

@@ -1,0 +1,69 @@
+/* fedml_capi.h — the stable C ABI of the native edge runtime.
+ *
+ * THE integration surface for every host binding: Python (ctypes,
+ * fedml_tpu/native/__init__.py), Android/Java (JNI shim
+ * native/android/fedml_jni.cpp), iOS/Swift (ios/FedMLTpu — its vendored
+ * copy of this header is asserted byte-identical by
+ * tests/test_ios_package.py).  capi.cpp includes this header, so any
+ * signature drift between declaration and definition is a COMPILE error in
+ * the native build.
+ *
+ * Conventions: functions returning int yield 0 on success, -1 on error
+ * with the message in fedml_last_error() (thread-local); create functions
+ * return NULL on error.  C++ exceptions never cross this boundary.
+ */
+#ifndef FEDML_CAPI_H
+#define FEDML_CAPI_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+const char* fedml_last_error(void);
+
+/* -- dataset converters (device-side idx/bin -> FTEM) -------------------- */
+int fedml_mnist_idx_to_ftem(const char* images, const char* labels,
+                            const char* out, int limit);
+int fedml_cifar10_bin_to_ftem(const char* bin_path, const char* out, int limit);
+
+/* -- trainer (reference FedMLBaseTrainer contract) ------------------------ */
+void* fedml_trainer_create(const char* model_path, const char* data_path,
+                           int batch, double lr, int epochs,
+                           unsigned long long seed);
+typedef void (*fedml_progress_cb)(int epoch, double loss);
+void fedml_trainer_set_callback(void* h, fedml_progress_cb cb);
+int fedml_trainer_train(void* h);
+void fedml_trainer_epoch_loss(void* h, int* epoch, double* loss);
+void fedml_trainer_stop(void* h);
+long long fedml_trainer_num_samples(void* h);
+int fedml_trainer_save(void* h, const char* out_path);
+int fedml_trainer_eval(void* h, double* acc, double* loss);
+void fedml_trainer_destroy(void* h);
+
+/* -- LightSecAgg primitives ----------------------------------------------- */
+int fedml_lsa_chunk(int d, int t, int u);
+int fedml_lsa_mask_encoding(int d, int n, int t, int u, const long long* mask,
+                            unsigned long long seed, long long* out);
+int fedml_lsa_aggregate_decode(const long long* rows, const int* ids,
+                               int n_ids, int t, int u, int d, int chunk,
+                               long long* out);
+
+/* -- client manager (trainer + LightSecAgg on-device leg) ----------------- */
+void* fedml_client_create(const char* model_path, const char* data_path,
+                          int batch, double lr, int epochs,
+                          unsigned long long seed);
+int fedml_client_train(void* h);
+int fedml_client_save_model(void* h, const char* out_path);
+int fedml_client_save_masked_model(void* h, int q_bits,
+                                   unsigned long long mask_seed,
+                                   const char* out_path);
+long long fedml_client_mask_dim(void* h);
+int fedml_client_encode_mask(void* h, int n, int t, int u,
+                             unsigned long long mask_seed, long long* out);
+void fedml_client_destroy(void* h);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* FEDML_CAPI_H */

@@ -1,0 +1,247 @@
+"""Golden-fixture parser tests: every real-format parser family in
+fedml_tpu/data/loaders.py run against COMMITTED on-disk bytes
+(tests/fixtures/golden, written by tools/make_golden_fixtures.py with
+stdlib/PIL writers independent of the parsers), asserting the exact
+arrays.  Severs parser correctness from any dataset mount — a format
+regression fails here, not on the first real-data run.
+
+Expected values are re-derived in-test from the fixtures' seeds and the
+documented normalization, NOT by calling the parsers (no self-testing)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data import loaders
+
+GOLD = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures", "golden")
+
+
+def _gold(name: str) -> str:
+    path = os.path.join(GOLD, name)
+    assert os.path.isdir(path), (
+        f"missing fixture dir {path}; run tools/make_golden_fixtures.py")
+    return path
+
+
+class TestMnistIdx:
+    def test_exact_arrays_plain_and_gz(self):
+        r = np.random.RandomState(10)
+        xt = r.randint(0, 256, (10, 28, 28)).astype(np.uint8)
+        yt = r.randint(0, 10, (10,)).astype(np.uint8)
+        xe = r.randint(0, 256, (4, 28, 28)).astype(np.uint8)
+        ye = r.randint(0, 10, (4,)).astype(np.uint8)
+
+        out = loaders.load_mnist_idx(_gold("mnist"))
+        assert out is not None
+        gxt, gyt, gxe, gye = out
+        assert gxt.shape == (10, 28, 28, 1) and gxt.dtype == np.float32
+        np.testing.assert_array_equal(gxt[..., 0], xt.astype(np.float32) / 255.0)
+        np.testing.assert_array_equal(gyt, yt.astype(np.int32))
+        # test split is gzipped on disk: exercises the .gz opener
+        np.testing.assert_array_equal(gxe[..., 0], xe.astype(np.float32) / 255.0)
+        np.testing.assert_array_equal(gye, ye.astype(np.int32))
+
+    def test_partial_cache_falls_back(self, tmp_path):
+        # only images, no labels: must return None (synthetic fallback)
+        import shutil
+
+        shutil.copy(os.path.join(_gold("mnist"), "train-images-idx3-ubyte"),
+                    tmp_path / "train-images-idx3-ubyte")
+        assert loaders.load_mnist_idx(str(tmp_path)) is None
+
+
+class TestCifarPickle:
+    def test_exact_arrays_and_batch_order(self):
+        r = np.random.RandomState(11)
+        raw = {}
+        for name, n in (("data_batch_1", 3), ("data_batch_2", 3), ("test_batch", 2)):
+            raw[name] = (r.randint(0, 256, (n, 3072)).astype(np.uint8),
+                         r.randint(0, 10, (n,)))
+        out = loaders.load_cifar_pickle(_gold("cifar10"))
+        assert out is not None
+        xt, yt, xe, ye = out
+        assert xt.shape == (6, 32, 32, 3) and xe.shape == (2, 32, 32, 3)
+        exp_xt = np.concatenate([
+            raw["data_batch_1"][0], raw["data_batch_2"][0]
+        ]).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+        np.testing.assert_array_equal(xt, exp_xt)
+        np.testing.assert_array_equal(
+            yt, np.concatenate([raw["data_batch_1"][1], raw["data_batch_2"][1]]))
+        np.testing.assert_array_equal(
+            xe, raw["test_batch"][0].reshape(-1, 3, 32, 32)
+                  .transpose(0, 2, 3, 1).astype(np.float32) / 255.0)
+        np.testing.assert_array_equal(ye, raw["test_batch"][1])
+
+
+class TestLeafJson:
+    def test_exact_arrays_and_mnist_reshape(self):
+        r = np.random.RandomState(12)
+        tr_x, tr_y = [], []
+        for u in ("f_00", "f_01"):
+            tr_x.append(np.asarray(r.rand(3, 784).round(6), np.float32))
+            tr_y.append(r.randint(0, 62, (3,)).astype(np.int32))
+        te_x = np.asarray(r.rand(2, 784).round(6), np.float32)
+        te_y = r.randint(0, 62, (2,)).astype(np.int32)
+
+        out = loaders.load_leaf_json(_gold("femnist"))
+        assert out is not None
+        xt, yt, xe, ye = out
+        # 784-wide LEAF x reshapes to NHWC
+        assert xt.shape == (6, 28, 28, 1) and xe.shape == (2, 28, 28, 1)
+        np.testing.assert_allclose(
+            xt.reshape(6, 784), np.concatenate(tr_x), rtol=0, atol=0)
+        np.testing.assert_array_equal(yt, np.concatenate(tr_y))
+        np.testing.assert_allclose(xe.reshape(2, 784), te_x, rtol=0, atol=0)
+        np.testing.assert_array_equal(ye, te_y)
+
+
+class TestImageFolder:
+    def test_cinic_png_exact(self):
+        r = np.random.RandomState(13)
+        imgs = {}
+        for split in ("train", "valid"):
+            for cname in ("airplane", "automobile"):
+                for i in range(2):
+                    imgs[(split, cname, i)] = r.randint(0, 256, (32, 32, 3)).astype(np.uint8)
+        out = loaders.load_image_folder(_gold("cinic10"))
+        assert out is not None
+        xt, yt, xe, ye = out
+        assert xt.shape == (4, 32, 32, 3)
+        # sorted class order: airplane=0, automobile=1; files img0, img1
+        exp = np.stack([
+            imgs[("train", "airplane", 0)], imgs[("train", "airplane", 1)],
+            imgs[("train", "automobile", 0)], imgs[("train", "automobile", 1)],
+        ]).astype(np.float32) / 255.0
+        np.testing.assert_array_equal(xt, exp)  # PNG is lossless
+        np.testing.assert_array_equal(yt, [0, 0, 1, 1])
+        np.testing.assert_array_equal(ye, [0, 0, 1, 1])
+        assert xe.shape == (4, 32, 32, 3)
+
+
+class TestCsvLabeled:
+    def test_exact_arrays_named_label_column(self):
+        r = np.random.RandomState(14)
+        tr = [(r.rand(3).round(4), r.randint(0, 2)) for _ in range(8)]
+        te = [(r.rand(3).round(4), r.randint(0, 2)) for _ in range(3)]
+        out = loaders.load_csv_labeled(_gold("uci"))
+        assert out is not None
+        xt, yt, xe, ye = out
+        np.testing.assert_allclose(xt, np.stack([f for f, _ in tr]).astype(np.float32),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(yt, [y for _, y in tr])
+        np.testing.assert_allclose(xe, np.stack([f for f, _ in te]).astype(np.float32),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(ye, [y for _, y in te])
+
+
+class TestLandmarksCsv:
+    def test_labels_exact_pixels_close(self):
+        # JPEG is lossy: labels/shapes are exact, pixels within jpeg error
+        # (fixtures are smooth gradients, so the bound is tight)
+        raws = []
+        for i in range(4):
+            g = (np.add.outer(np.arange(32) * 4, np.arange(32) * 3) + i * 20) % 256
+            raws.append(np.stack([g, (g + 40) % 256, (g + 90) % 256], -1)
+                        .astype(np.uint8))
+        out = loaders.load_landmarks_csv(_gold("gld23k"))
+        assert out is not None
+        xt, yt, xe, ye = out
+        assert xt.shape == (3, 32, 32, 3) and xe.shape == (1, 32, 32, 3)
+        np.testing.assert_array_equal(yt, [0, 1, 2])
+        np.testing.assert_array_equal(ye, [0])
+        for got, raw in zip(xt, raws[:3]):
+            assert np.abs(got - raw.astype(np.float32) / 255.0).mean() < 0.05
+
+
+class TestNusWide:
+    def test_exact_multihot_and_features(self):
+        r = np.random.RandomState(16)
+        lab = {}
+        for nm in ("sky", "water"):
+            lab[(nm, "Train")] = r.randint(0, 2, (6,))
+            lab[(nm, "Test")] = r.randint(0, 2, (3,))
+        feat_tr = r.rand(6, 4).round(6)
+        feat_te = r.rand(3, 4).round(6)
+        out = loaders.load_nuswide(_gold("nuswide"))
+        assert out is not None
+        xt, yt, xe, ye = out
+        np.testing.assert_allclose(xt, feat_tr.astype(np.float32), atol=1e-6)
+        np.testing.assert_allclose(xe, feat_te.astype(np.float32), atol=1e-6)
+        # names sorted: sky, water
+        np.testing.assert_array_equal(
+            yt, np.stack([lab[("sky", "Train")], lab[("water", "Train")]], 1))
+        np.testing.assert_array_equal(
+            ye, np.stack([lab[("sky", "Test")], lab[("water", "Test")]], 1))
+
+
+class TestFetsNifti:
+    def test_mid_slice_channels_and_seg_mapping(self):
+        r = np.random.RandomState(17)
+        vols = {}
+        for s in ("FeTS21_001", "FeTS21_002"):
+            for mod, dt in (("_t1", np.int16), ("_t1ce", np.int16),
+                            ("_t2", np.int16), ("_flair", np.int16),
+                            ("_seg", np.uint8)):
+                shape = (8, 8, 4)
+                if mod == "_seg":
+                    vols[(s, mod)] = r.choice([0, 1, 2, 4], size=shape).astype(dt)
+                else:
+                    vols[(s, mod)] = r.randint(0, 1000, shape).astype(dt)
+
+        def expect_slice(vol, size=32):
+            sl = vol[:, :, vol.shape[2] // 2].astype(np.float32)
+            iy = np.linspace(0, sl.shape[0] - 1, size).astype(int)
+            ix = np.linspace(0, sl.shape[1] - 1, size).astype(int)
+            return sl[np.ix_(iy, ix)]
+
+        out = loaders.load_fets_nifti(_gold("fets2021"))
+        assert out is not None
+        xt, yt, xe, ye = out
+        # 2 subjects, 80/20 -> 1 train / 1 test, sorted subject order
+        assert xt.shape == (1, 32, 32, 3) and xe.shape == (1, 32, 32, 3)
+        # channel order: t1ce, t1, t2 (flair dropped as 4th)
+        for ci, mod in enumerate(("_t1ce", "_t1", "_t2")):
+            sl = expect_slice(vols[("FeTS21_001", mod)])
+            denom = sl.max() - sl.min()
+            np.testing.assert_allclose(
+                xt[0, :, :, ci], (sl - sl.min()) / (denom if denom > 0 else 1.0),
+                atol=1e-6)
+        exp_mask = expect_slice(vols[("FeTS21_001", "_seg")]).astype(np.int32)
+        np.testing.assert_array_equal(yt[0], np.where(exp_mask >= 2, 2, exp_mask))
+
+
+class TestEdgeCasePool:
+    def test_pools_grouped_by_shape_exact(self):
+        r = np.random.RandomState(18)
+        ardis = r.randint(0, 256, (5, 28, 28, 1)).astype(np.uint8)
+        southwest = r.rand(4, 32, 32, 3).astype(np.float32)
+        pools = loaders.load_edge_case_pool(_gold("edge_case"))
+        assert pools is not None
+        assert set(pools) == {(28, 28, 1), (32, 32, 3)}
+        np.testing.assert_array_equal(pools[(28, 28, 1)],
+                                      ardis.astype(np.float32) / 255.0)
+        np.testing.assert_array_equal(pools[(32, 32, 3)], southwest)
+
+
+class TestTryLoadRealDispatch:
+    @pytest.mark.parametrize("name,fixture", [
+        ("mnist", "mnist"),
+        ("cifar10", "cifar10"),
+        ("femnist", "femnist"),
+        ("cinic10", "cinic10"),
+        ("uci", "uci"),
+        ("gld23k", "gld23k"),
+        ("nuswide", "nuswide"),
+        ("fets2021", "fets2021"),
+    ])
+    def test_dispatch_finds_each_family(self, name, fixture, tmp_path):
+        # mount layout: cache_dir/<dataset>/... exactly as a user would
+        import shutil
+
+        shutil.copytree(_gold(fixture), tmp_path / name)
+        out = loaders.try_load_real(name, str(tmp_path))
+        assert out is not None and len(out) == 4

@@ -1,0 +1,183 @@
+"""Generate the committed golden byte fixtures for tests/test_loaders_golden.py.
+
+Each fixture is a REAL on-disk format instance (idx-ubyte, CIFAR pickle,
+LEAF json, ImageFolder PNGs, landmarks CSVs, NUS-WIDE txt/dat, NIfTI-1,
+edge-case pkl) written with stdlib/PIL primitives — independent of the
+parsers in fedml_tpu/data/loaders.py — holding small DETERMINISTIC arrays
+(seeded numpy).  Run once; the bytes are committed under
+tests/fixtures/golden so parser correctness is severed from any dataset
+mount.  Reference formats: data/MNIST/data_loader.py:16 (LEAF json),
+data/cifar10 pickles, data/Landmarks/data_loader.py:123-150,
+data/NUS_WIDE/nus_wide_dataset.py:8-60, data/FeTS2021, and
+data/edge_case_examples/data_loader.py.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import pickle
+import struct
+
+import numpy as np
+
+ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "tests", "fixtures", "golden")
+
+
+def rng(tag: str) -> np.random.RandomState:
+    return np.random.RandomState(abs(hash(tag)) % (2**31))
+
+
+def write_idx(path: str, arr: np.ndarray, gz: bool = False) -> None:
+    magic = (0x08 << 8) | arr.ndim  # 0x08 = ubyte
+    body = struct.pack(">I", magic)
+    for d in arr.shape:
+        body += struct.pack(">I", d)
+    body += arr.astype(np.uint8).tobytes()
+    op = gzip.open if gz else open
+    with op(path + (".gz" if gz else ""), "wb") as f:
+        f.write(body)
+
+
+def main() -> None:
+    os.makedirs(ROOT, exist_ok=True)
+
+    # -- MNIST idx (train plain, test gzipped: both openers exercised) ------
+    d = os.path.join(ROOT, "mnist")
+    os.makedirs(d, exist_ok=True)
+    r = np.random.RandomState(10)
+    xt = r.randint(0, 256, (10, 28, 28)).astype(np.uint8)
+    yt = r.randint(0, 10, (10,)).astype(np.uint8)
+    xe = r.randint(0, 256, (4, 28, 28)).astype(np.uint8)
+    ye = r.randint(0, 10, (4,)).astype(np.uint8)
+    write_idx(os.path.join(d, "train-images-idx3-ubyte"), xt)
+    write_idx(os.path.join(d, "train-labels-idx1-ubyte"), yt)
+    write_idx(os.path.join(d, "t10k-images-idx3-ubyte"), xe, gz=True)
+    write_idx(os.path.join(d, "t10k-labels-idx1-ubyte"), ye, gz=True)
+
+    # -- CIFAR-10 pickle batches (2 train batches x 3 records + 2 test) -----
+    d = os.path.join(ROOT, "cifar10")
+    os.makedirs(d, exist_ok=True)
+    r = np.random.RandomState(11)
+    for name, n in (("data_batch_1", 3), ("data_batch_2", 3), ("test_batch", 2)):
+        batch = {b"data": r.randint(0, 256, (n, 3072)).astype(np.uint8),
+                 b"labels": r.randint(0, 10, (n,)).tolist()}
+        with open(os.path.join(d, name), "wb") as f:
+            pickle.dump(batch, f)
+
+    # -- LEAF json (femnist layout: 2 users train, 1 user test) -------------
+    d = os.path.join(ROOT, "femnist")
+    r = np.random.RandomState(12)
+    for split, users in (("train", ["f_00", "f_01"]), ("test", ["f_00"])):
+        os.makedirs(os.path.join(d, split), exist_ok=True)
+        blob = {"users": users, "num_samples": [], "user_data": {}}
+        for u in users:
+            n = 3 if split == "train" else 2
+            blob["num_samples"].append(n)
+            blob["user_data"][u] = {
+                "x": r.rand(n, 784).round(6).tolist(),
+                "y": r.randint(0, 62, (n,)).tolist(),
+            }
+        with open(os.path.join(d, split, "all_data_0.json"), "w") as f:
+            json.dump(blob, f)
+
+    # -- CINIC-10 ImageFolder (2 classes x 2 PNGs per split) ----------------
+    from PIL import Image
+
+    d = os.path.join(ROOT, "cinic10")
+    r = np.random.RandomState(13)
+    for split in ("train", "valid"):
+        for cname in ("airplane", "automobile"):
+            cdir = os.path.join(d, split, cname)
+            os.makedirs(cdir, exist_ok=True)
+            for i in range(2):
+                arr = r.randint(0, 256, (32, 32, 3)).astype(np.uint8)
+                Image.fromarray(arr).save(os.path.join(cdir, f"img{i}.png"))
+
+    # -- UCI-style labeled CSV ----------------------------------------------
+    d = os.path.join(ROOT, "uci")
+    os.makedirs(d, exist_ok=True)
+    r = np.random.RandomState(14)
+    for name, n in (("train.csv", 8), ("test.csv", 3)):
+        with open(os.path.join(d, name), "w") as f:
+            f.write("f0,f1,f2,label\n")
+            for _ in range(n):
+                row = r.rand(3).round(4)
+                f.write(",".join(map(str, row)) + f",{r.randint(0, 2)}\n")
+
+    # -- Google Landmarks CSVs + jpgs (smooth gradients: JPEG-friendly so
+    # the golden pixel check has a tight bound — noise is JPEG's worst case)
+    d = os.path.join(ROOT, "gld23k")
+    os.makedirs(os.path.join(d, "images"), exist_ok=True)
+    rows_tr, rows_te = [], []
+    for i in range(4):
+        g = (np.add.outer(np.arange(32) * 4, np.arange(32) * 3) + i * 20) % 256
+        arr = np.stack([g, (g + 40) % 256, (g + 90) % 256], -1).astype(np.uint8)
+        Image.fromarray(arr).save(os.path.join(d, "images", f"im{i}.jpg"),
+                                  quality=95)
+        (rows_tr if i < 3 else rows_te).append((f"u{i % 2}", f"im{i}", i % 3))
+    with open(os.path.join(d, "mini_gld_train_split.csv"), "w") as f:
+        f.write("user_id,image_id,class\n")
+        for u, im, c in rows_tr:
+            f.write(f"{u},{im},{c}\n")
+    with open(os.path.join(d, "mini_gld_test.csv"), "w") as f:
+        f.write("user_id,image_id,class\n")
+        for u, im, c in rows_te:
+            f.write(f"{u},{im},{c}\n")
+
+    # -- NUS-WIDE labels + low-level features -------------------------------
+    d = os.path.join(ROOT, "nuswide")
+    lab = os.path.join(d, "Groundtruth", "TrainTestLabels")
+    feat = os.path.join(d, "Low_Level_Features")
+    os.makedirs(lab, exist_ok=True)
+    os.makedirs(feat, exist_ok=True)
+    r = np.random.RandomState(16)
+    for nm in ("sky", "water"):
+        np.savetxt(os.path.join(lab, f"Labels_{nm}_Train.txt"),
+                   r.randint(0, 2, (6,)), fmt="%d")
+        np.savetxt(os.path.join(lab, f"Labels_{nm}_Test.txt"),
+                   r.randint(0, 2, (3,)), fmt="%d")
+    np.savetxt(os.path.join(feat, "Normalized_CH_Train_x.dat"),
+               r.rand(6, 4).round(6), fmt="%.6f")
+    np.savetxt(os.path.join(feat, "Normalized_CH_Test_x.dat"),
+               r.rand(3, 4).round(6), fmt="%.6f")
+
+    # -- FeTS 2021 NIfTI subjects -------------------------------------------
+    d = os.path.join(ROOT, "fets2021")
+    r = np.random.RandomState(17)
+    for s in ("FeTS21_001", "FeTS21_002"):
+        sdir = os.path.join(d, s)
+        os.makedirs(sdir, exist_ok=True)
+        for mod, dt, code in (("_t1", np.int16, 4), ("_t1ce", np.int16, 4),
+                              ("_t2", np.int16, 4), ("_flair", np.int16, 4),
+                              ("_seg", np.uint8, 2)):
+            shape = (8, 8, 4)
+            if mod == "_seg":
+                vol = r.choice([0, 1, 2, 4], size=shape).astype(dt)
+            else:
+                vol = r.randint(0, 1000, shape).astype(dt)
+            hdr = bytearray(352)
+            struct.pack_into("<i", hdr, 0, 348)               # sizeof_hdr
+            struct.pack_into("<8h", hdr, 40, 3, *shape, 1, 1, 1, 1)  # dim
+            struct.pack_into("<h", hdr, 70, code)             # datatype
+            struct.pack_into("<f", hdr, 108, 352.0)           # vox_offset
+            body = bytes(hdr) + vol.tobytes(order="F")
+            with gzip.open(os.path.join(sdir, f"{s}{mod}.nii.gz"), "wb") as f:
+                f.write(body)
+
+    # -- edge-case example pool (ARDIS-shaped pkl) --------------------------
+    d = os.path.join(ROOT, "edge_case")
+    os.makedirs(d, exist_ok=True)
+    r = np.random.RandomState(18)
+    with open(os.path.join(d, "ardis_7.pkl"), "wb") as f:
+        pickle.dump(r.randint(0, 256, (5, 28, 28, 1)).astype(np.uint8), f)
+    with open(os.path.join(d, "southwest.pkl"), "wb") as f:
+        pickle.dump({"data": r.rand(4, 32, 32, 3).astype(np.float32)}, f)
+
+    print(f"fixtures written under {ROOT}")
+
+
+if __name__ == "__main__":
+    main()
