@@ -37,7 +37,10 @@ public final class FedEdgeManager {
 
     /** Leave the run early: stops local training (cooperatively, discarding
      *  queued rounds) AND the transport; the server's straggler tolerance
-     *  covers the missing upload. */
+     *  covers the missing upload.  BLOCKS until the in-flight round reaches
+     *  its next batch boundary (up to ~10s) so the final callbacks arrive
+     *  in order — call from a background thread, never the Android main
+     *  thread (ANR). */
     public void stop() {
         client.finish();
     }

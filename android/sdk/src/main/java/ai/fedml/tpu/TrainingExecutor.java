@@ -38,7 +38,10 @@ public final class TrainingExecutor {
     private final int batchSize;
     private final double lr;
     private final int epochs;
-    private volatile long activeHandle = 0;
+    // guards the active native handle: stop() from another thread must
+    // never race the worker's destroy() (native use-after-free)
+    private final Object handleLock = new Object();
+    private long activeHandle = 0;
     private volatile boolean stopping = false;
 
     public TrainingExecutor(String dataPath, int batchSize, double lr, int epochs) {
@@ -61,10 +64,12 @@ public final class TrainingExecutor {
                 callback.onRoundFailed(roundIdx, NativeFedMLTrainer.lastError());
                 return;
             }
-            activeHandle = h;
-            if (stopping) {
-                // shutdown raced the create window: stop before training
-                NativeFedMLTrainer.stop(h);
+            synchronized (handleLock) {
+                activeHandle = h;
+                if (stopping) {
+                    // shutdown raced the create window: stop before training
+                    NativeFedMLTrainer.stop(h);
+                }
             }
             try {
                 if (NativeFedMLTrainer.train(h) != 0
@@ -78,20 +83,26 @@ public final class TrainingExecutor {
                         roundIdx,
                         new RoundResult(outPath, NativeFedMLTrainer.numSamples(h), loss));
             } finally {
-                activeHandle = 0;
-                NativeFedMLTrainer.destroy(h);
+                synchronized (handleLock) {
+                    activeHandle = 0;
+                    NativeFedMLTrainer.destroy(h);
+                }
             }
         });
     }
 
     /** Cooperative stop of the in-flight round; queued rounds never start.
-     *  Blocks briefly so the in-flight round resolves (its callback fires
-     *  BEFORE the caller reports completion — callback ordering holds). */
+     *  BLOCKS (up to 10s) so the in-flight round resolves and its callback
+     *  fires BEFORE the caller reports completion — do not call on a UI
+     *  thread (FedEdgeManager.stop documents the same). */
     public void shutdown() {
         stopping = true;
-        long h = activeHandle;
-        if (h != 0) {
-            NativeFedMLTrainer.stop(h); // exits at the next batch boundary
+        synchronized (handleLock) {
+            if (activeHandle != 0) {
+                // exits at the next batch boundary; handle cannot be
+                // destroyed concurrently (worker holds this lock for it)
+                NativeFedMLTrainer.stop(activeHandle);
+            }
         }
         pool.shutdown();
         try {

@@ -1,0 +1,7 @@
+"""One-liner example entry (reference example dirs run the same way):
+    python main.py --cf fedml_config.yaml
+"""
+import fedml_tpu
+
+if __name__ == "__main__":
+    fedml_tpu.run_simulation()

@@ -122,6 +122,12 @@ public final class FedMLSecureClient {
     public func encodeMask(n: Int32, t: Int32, u: Int32,
                            maskSeed: UInt64) throws -> [Int64] {
         let chunk = fedml_lsa_chunk(Int32(maskDimension), t, u)
+        guard chunk > 0, n > 0 else {
+            // fedml_lsa_chunk returns -1 for invalid (t, u): surface it as
+            // the thrown error this API promises, not a negative-count trap
+            throw FedMLError.native("invalid LightSecAgg parameters: need "
+                                    + "t < u <= n (n=\(n), t=\(t), u=\(u))")
+        }
         var out = [Int64](repeating: 0, count: Int(n) * Int(chunk))
         let rc = out.withUnsafeMutableBufferPointer {
             fedml_client_encode_mask(handle, n, t, u, maskSeed, $0.baseAddress)

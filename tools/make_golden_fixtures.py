@@ -26,10 +26,6 @@ ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "tests", "fixtures", "golden")
 
 
-def rng(tag: str) -> np.random.RandomState:
-    return np.random.RandomState(abs(hash(tag)) % (2**31))
-
-
 def write_idx(path: str, arr: np.ndarray, gz: bool = False) -> None:
     magic = (0x08 << 8) | arr.ndim  # 0x08 = ubyte
     body = struct.pack(">I", magic)

@@ -49,6 +49,7 @@ GATES = {
 def _cfg(gate: str, g: dict, data_dir: str, synthetic: bool) -> dict:
     rounds = 2 if synthetic else g["rounds"]
     clients = (8, 4) if synthetic else g["clients"]
+    batch = min(g["batch"], 16) if synthetic else g["batch"]
     return {
         "common_args": {"training_type": "simulation", "random_seed": 0,
                         "run_id": f"parity-run-{gate}"},
@@ -61,7 +62,7 @@ def _cfg(gate: str, g: dict, data_dir: str, synthetic: bool) -> dict:
                        "client_num_in_total": clients[0],
                        "client_num_per_round": clients[1],
                        "comm_round": rounds, "epochs": 1,
-                       "batch_size": g["batch"], "client_optimizer": "sgd",
+                       "batch_size": batch, "client_optimizer": "sgd",
                        "learning_rate": g["lr"]},
         "validation_args": {"frequency_of_the_test": max(rounds // 2, 1)},
         "comm_args": {"backend": "XLA"},
@@ -112,11 +113,30 @@ def main() -> int:
     args = ap.parse_args()
 
     data_dir = os.environ.get("FEDML_DATA_DIR", os.path.join(REPO, "fedml_data"))
+    if args.gate:
+        unknown = [g for g in args.gate if g not in GATES]
+        if unknown:
+            # every requested name must resolve: a silently-dropped typo
+            # would leave a gate unmeasured while PARITY.md looks complete
+            print(f"unknown gate(s) {unknown}; known: {sorted(GATES)}")
+            return 2
     gates = {k: v for k, v in GATES.items()
              if not args.gate or k in args.gate}
-    if args.gate and not gates:
-        print(f"unknown gate(s) {args.gate}; known: {sorted(GATES)}")
-        return 2
+
+    if args.dry_run:
+        # dry-run must work with no TPU/tunnel at all: force CPU before any
+        # jax import (same policy as tests/conftest.py)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    else:
+        # real capture: probe the backend in a SUBPROCESS first (a failed
+        # in-process init is cached by jax) — bench.py's outage-riding loop
+        import bench
+
+        if not bench._wait_for_backend():
+            print("backend unavailable; aborting parity run")
+            return 1
 
     rows, failures = [], 0
     for gate, g in gates.items():
