@@ -187,6 +187,11 @@ def _autotune(args, dataset, model):
     return best[1], (sim if last_overrides == best[1] else None)
 
 
+# module-level so tests can substitute a fast fake probe (the real one pays
+# a full jax import per attempt — minutes under a flaky tunnel, by design)
+_PROBE_CODE = "import jax; print(len(jax.devices()))"
+
+
 def _wait_for_backend() -> bool:
     """Bounded poll for the TPU tunnel before touching jax in-process.
 
@@ -209,7 +214,7 @@ def _wait_for_backend() -> bool:
         attempt += 1
         try:
             r = subprocess.run(
-                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+                [sys.executable, "-c", _PROBE_CODE],
                 capture_output=True, text=True, timeout=300,
             )
             if r.returncode == 0 and r.stdout.strip():

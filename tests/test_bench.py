@@ -64,3 +64,56 @@ def test_transformer_bench_metric_line(monkeypatch):
     assert out["value"] > 0
     assert 0 <= out["mfu"] <= 1
     assert out["n_params"] > 0
+
+
+class TestBackendWait:
+    """The outage-riding probe (round 5): BENCH_r03/r04 were lost because
+    the first jax.devices() throw killed the bench — the probe must ride a
+    bounded window in a SUBPROCESS (a failed in-process init is cached by
+    jax) and give up cleanly when it closes."""
+
+    def test_probe_passes_when_backend_answers(self, monkeypatch):
+        sys.path.insert(0, ".")
+        import bench
+
+        # fast fake probe: the loop logic is under test, not the (minutes-
+        # per-attempt) real jax import
+        monkeypatch.setattr(bench, "_PROBE_CODE", "print(1)")
+        monkeypatch.setenv("BENCH_WAIT_MIN", "0.2")
+        assert bench._wait_for_backend() is True
+
+    def test_probe_rides_window_then_fails(self, monkeypatch):
+        sys.path.insert(0, ".")
+        import time
+
+        import bench
+
+        monkeypatch.setattr(
+            bench, "_PROBE_CODE",
+            "import sys; print('UNAVAILABLE', file=sys.stderr); sys.exit(1)")
+        monkeypatch.setenv("BENCH_WAIT_MIN", "0.03")  # ~2s window
+        monkeypatch.setenv("BENCH_WAIT_POLL_S", "1")
+        t0 = time.time()
+        assert bench._wait_for_backend() is False
+        # it actually polled (>= one retry sleep) and respected the bound
+        assert 1.0 <= time.time() - t0 < 60
+
+    def test_probe_recovers_mid_window(self, monkeypatch, tmp_path):
+        sys.path.insert(0, ".")
+        import bench
+
+        # fails until the marker file exists, then succeeds: the tunnel-
+        # recovery scenario the loop exists for
+        marker = tmp_path / "up"
+        code = ("import os, sys\n"
+                f"if os.path.exists({str(marker)!r}):\n"
+                "    print(1)\n"
+                "else:\n"
+                "    sys.exit(1)\n")
+        monkeypatch.setattr(bench, "_PROBE_CODE", code)
+        monkeypatch.setenv("BENCH_WAIT_MIN", "1")
+        monkeypatch.setenv("BENCH_WAIT_POLL_S", "1")
+        import threading
+
+        threading.Timer(2.0, marker.touch).start()
+        assert bench._wait_for_backend() is True

@@ -74,6 +74,41 @@ def _build_client(run_id: str, rank: int, n_clients: int, **extra):
     return Client(args_c, None, ds, fedml_tpu.models.create(args_c, out_dim))
 
 
+
+
+def _join_all(threads, timeout_s=120):
+    """Join with a bound; on failure dump every thread so a wedge is
+    diagnosable from CI output instead of an opaque hang/timeout."""
+    import faulthandler
+
+    deadline = time.time() + timeout_s
+    for t in threads:
+        t.join(timeout=max(1.0, deadline - time.time()))
+    alive = [t for t in threads if t.is_alive()]
+    if alive:
+        faulthandler.dump_traceback()
+        raise AssertionError(f"threads still alive after {timeout_s}s: {alive}")
+
+
+def _run_server_bounded(server, timeout_s=150):
+    """Run the server with a hard wall-clock bound: a wedged round must FAIL
+    the test (with a thread dump), never hang CI forever."""
+    import faulthandler
+
+    out = {}
+
+    def _target():
+        out["history"] = server.run()
+
+    t = threading.Thread(target=_target, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive():
+        faulthandler.dump_traceback()
+        raise AssertionError(f"server.run() wedged for {timeout_s}s")
+    return out["history"]
+
+
 def test_round_survives_silent_silo():
     """1 server + 2 live silos + 1 silent silo: with round_timeout_s the
     run completes, aggregating the 2 live silos each round."""
@@ -97,7 +132,7 @@ def test_round_survives_silent_silo():
     for t in threads:
         t.start()
     t0 = time.time()
-    history = server.run()  # must NOT block forever
+    history = _run_server_bounded(server)
     assert len(history) == 2
     assert 0.0 <= history[-1]["test_acc"] <= 1.0
     # bounded, not fast: under full-suite load the live silos' first XLA
@@ -105,9 +140,7 @@ def test_round_survives_silent_silo():
     # floor the timer re-arms, so correctness never depends on timing);
     # the bound only proves no reference-style wait-forever wedge
     assert time.time() - t0 < 120
-    for t in threads:
-        t.join(timeout=60)
-        assert not t.is_alive()
+    _join_all(threads)
 
 
 def test_all_silos_alive_is_unchanged():
@@ -129,12 +162,10 @@ def test_all_silos_alive_is_unchanged():
     for t in threads:
         t.start()
     t0 = time.time()
-    history = server.run()
+    history = _run_server_bounded(server)
     assert time.time() - t0 < 50  # no 60s timeout ever fired
     assert len(history) == 2
-    for t in threads:
-        t.join(timeout=60)
-        assert not t.is_alive()
+    _join_all(threads)
 
 
 def test_round_survives_silent_silo_over_mqtt(tmp_path):
@@ -180,13 +211,11 @@ def test_round_survives_silent_silo_over_mqtt(tmp_path):
         for t in threads:
             t.start()
         t0 = time.time()
-        history = server.run()
+        history = _run_server_bounded(server)
         assert len(history) == 2
         # bounded, not fast (see test_round_survives_silent_silo)
         assert time.time() - t0 < 120
-        for t in threads:
-            t.join(timeout=60)
-            assert not t.is_alive()
+        _join_all(threads)
     finally:
         broker.stop()
 
