@@ -70,13 +70,34 @@ public final class BrokerConnection implements AutoCloseable {
     }
 
     public void disconnect() {
+        // graceful close: DISCONNECT, half-close (FIN), drain inbound to
+        // EOF, then close.  An immediate close() with undrained wildcard
+        // deliveries in our receive buffer sends a TCP RST, and an RST
+        // discards our still-unread frames at the broker — it can lose the
+        // tail of our own just-published uploads.
         running = false;
         try {
-            Map<String, Object> f = new LinkedHashMap<>();
-            f.put("op", "DISCONNECT");
-            send(f);
+            // fence the half-close with the sends (same monitor as send()):
+            // a publish slipping between DISCONNECT and FIN would make the
+            // broker break at DISCONNECT with unread data -> RST back at us
+            synchronized (this) {
+                Map<String, Object> f = new LinkedHashMap<>();
+                f.put("op", "DISCONNECT");
+                send(f);
+                socket.shutdownOutput();
+            }
         } catch (IOException ignored) {
             // socket already gone: the broker fires the last will instead
+        }
+        if (Thread.currentThread() == recvThread) {
+            // called from an onMessage handler: the recv loop (this thread)
+            // resumes draining when the handler returns, closing at EOF
+            return;
+        }
+        try {
+            recvThread.join(5000); // recv loop drains until broker EOF
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
         }
         try {
             socket.close();
@@ -106,7 +127,9 @@ public final class BrokerConnection implements AutoCloseable {
 
     private void recvLoop() {
         try {
-            while (running) {
+            // reads to EOF even after disconnect() flips running: draining
+            // the inbound stream keeps the close RST-free (see disconnect)
+            while (true) {
                 int n = in.readInt();
                 if (n < 0) {
                     throw new IOException("corrupt frame length " + n);
@@ -135,13 +158,15 @@ public final class BrokerConnection implements AutoCloseable {
                 System.err.println("fedml broker recv failed: " + e);
             }
         } finally {
-            if (running) {
-                // unclean exit: close the socket so the broker notices and
-                // publishes the last will (liveness contract)
-                try {
-                    socket.close();
-                } catch (IOException ignored) {
-                }
+            boolean unclean = running;
+            // the recv loop owns the final close when disconnect() was
+            // issued from this thread (idempotent otherwise); on unclean
+            // exit the close makes the broker publish our last will
+            try {
+                socket.close();
+            } catch (IOException ignored) {
+            }
+            if (unclean) {
                 Runnable cb = onConnectionLost;
                 if (cb != null) {
                     try {

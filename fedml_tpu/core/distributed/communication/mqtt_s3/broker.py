@@ -220,15 +220,19 @@ class LocalBroker:
                     "dropping non-JSON payload on %s for JSON subscribers", topic
                 )
                 frames[enc] = None
+        logger.debug("PUB %s -> %d subscriber(s)", topic, len(targets))
         dead = []
         for c, enc, slock in targets:
             data = frames.get(enc)
             if data is None or slock is None:
+                logger.debug("PUB %s: skipping fd=%s (no frame/lock)", topic,
+                             c.fileno() if c.fileno() >= 0 else "?")
                 continue
             try:
                 with slock:  # frames to one subscriber must never interleave
                     c.sendall(data)
-            except OSError:
+            except OSError as e:
+                logger.debug("PUB %s: fd=%s dead (%s)", topic, c.fileno(), e)
                 dead.append(c)
         for c in dead:
             with self._lock:
@@ -273,16 +277,37 @@ class BrokerClient:
                         self.encoding)
 
     def disconnect(self) -> None:
+        """Graceful close: DISCONNECT, half-close (FIN), DRAIN inbound to
+        EOF, then close.  An immediate ``close()`` here can send a TCP RST
+        (this side always has undrained wildcard deliveries in its receive
+        buffer), and an RST DISCARDS our still-unread frames at the broker —
+        observed losing the tail of a FINISH fan-out, wedging a client
+        forever.  shutdown(SHUT_WR) sends FIN instead; the recv thread keeps
+        draining until the broker processes our DISCONNECT and closes."""
         self._running = False
         try:
             with self._lock:
+                # the half-close must be fenced with the sends: a publish
+                # slipping between DISCONNECT and FIN would make the broker
+                # break at DISCONNECT with unread data -> RST right back
                 _send_frame(self._sock, {"op": "DISCONNECT"}, self.encoding)
+                self._sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        if threading.current_thread() is self._thread:
+            # called from on_message: the recv loop (this thread) resumes
+            # draining when the handler returns and closes the socket at EOF
+            return
+        self._thread.join(timeout=5)
+        try:
             self._sock.close()
         except OSError:
             pass
 
     def _recv_loop(self) -> None:
-        while self._running:
+        # reads to EOF even after disconnect() flips _running: draining the
+        # inbound stream is what keeps the close RST-free (see disconnect)
+        while True:
             got = _recv_frame(self._sock)
             if got is None:
                 break
@@ -292,3 +317,9 @@ class BrokerClient:
                     self.on_message(str(frame["topic"]), frame.get("payload"))
                 except Exception:
                     logger.exception("broker client on_message raised")
+        # EOF: close here too — the owner of the close when disconnect()
+        # was issued from this thread (idempotent otherwise)
+        try:
+            self._sock.close()
+        except OSError:
+            pass

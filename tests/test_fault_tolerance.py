@@ -98,7 +98,10 @@ def _run_server_bounded(server, timeout_s=150):
     out = {}
 
     def _target():
-        out["history"] = server.run()
+        try:
+            out["history"] = server.run()
+        except BaseException as e:  # surfaced below, not via excepthook
+            out["exc"] = e
 
     t = threading.Thread(target=_target, daemon=True)
     t.start()
@@ -106,6 +109,8 @@ def _run_server_bounded(server, timeout_s=150):
     if t.is_alive():
         faulthandler.dump_traceback()
         raise AssertionError(f"server.run() wedged for {timeout_s}s")
+    if "exc" in out:
+        raise out["exc"]
     return out["history"]
 
 

@@ -278,3 +278,42 @@ class TestJavacCompile:
         assert out.returncode == 0, out.stderr
         assert (tmp_path / "ai" / "fedml" / "tpu"
                 / "FedEdgeManager.class").exists()
+
+
+class TestGracefulClose:
+    """The publish-then-disconnect contract: every frame published before a
+    clean DISCONNECT must reach subscribers.  An abrupt close() used to RST
+    the connection (the closer always holds undrained wildcard deliveries),
+    and the RST discarded the still-unqueued tail at the broker — observed
+    losing the last FINISH of a fan-out, wedging a client forever."""
+
+    def test_publish_burst_then_disconnect_loses_nothing(self):
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import (
+            BrokerClient,
+            LocalBroker,
+        )
+
+        broker = LocalBroker().start()
+        try:
+            got = []
+            sub = BrokerClient("127.0.0.1", broker.port,
+                               lambda t, p: got.append(p))
+            sub.subscribe("run/#")
+            # the publisher also subscribes (cross-silo peers all hold the
+            # run wildcard), so it always has undrained inbound — the RST
+            # precondition
+            pub = BrokerClient("127.0.0.1", broker.port, lambda t, p: None)
+            pub.subscribe("run/#")
+            time.sleep(0.2)
+            n = 200
+            for i in range(n):
+                pub.publish("run/x", {"i": i})
+            pub.disconnect()  # immediately after the burst
+            deadline = time.time() + 20
+            while len(got) < n and time.time() < deadline:
+                time.sleep(0.05)
+            assert len(got) == n, f"lost {n - len(got)} frames to the close"
+            assert [p["i"] for p in got] == list(range(n))
+            sub.disconnect()
+        finally:
+            broker.stop()
