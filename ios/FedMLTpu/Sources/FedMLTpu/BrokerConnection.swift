@@ -1,0 +1,212 @@
+// The broker wire: 4-byte big-endian length + UTF-8 JSON dict frames, ops
+// SUB / UNSUB / PUB / WILL / DISCONNECT, deliveries arriving as MSG frames —
+// the Swift twin of ai.fedml.tpu.BrokerConnection (Java) over the same
+// JSON interop encoding the fedml_tpu broker sniffs per connection
+// (fedml_tpu/core/distributed/communication/mqtt_s3/broker.py).
+//
+// Close semantics mirror the Java/Python clients: DISCONNECT + half-close
+// (FIN) + drain inbound to EOF + close.  An abrupt close with undrained
+// wildcard deliveries in our receive buffer sends a TCP RST, and an RST
+// discards our still-unread frames at the broker — it can lose the tail of
+// our own just-published uploads.
+//
+// Raw-fd lifecycle: the RECEIVE THREAD is the sole owner of close(fd) (it
+// closes exactly once at loop exit and invalidates fd to -1 under the
+// state lock) — no other thread ever closes, so a recycled fd number can
+// never be written to or closed by a stale reference.  disconnect() only
+// sends DISCONNECT + shutdown(SHUT_WR) and waits for the drain.
+
+import Foundation
+
+public final class BrokerConnection {
+    public typealias OnMessage = (_ topic: String, _ payload: Any?) -> Void
+
+    /// Frames larger than this are a desynced stream, not data (the control
+    /// plane ships file PATHS; models never ride it).
+    private static let maxFrame = 64 * 1024 * 1024
+
+    // state lock: serializes writes AND guards fd/running
+    private let lock = NSLock()
+    private var fd: Int32  // -1 once the recv thread has closed it
+    private var running = true
+
+    private let onMessage: OnMessage
+    private var recvThread: Thread?
+    /// Invoked once from the receive thread if the wire dies while we did
+    /// NOT call disconnect() — without it the app would wait forever with
+    /// the failure visible only server-side (via the last will).
+    public var onConnectionLost: (() -> Void)?
+
+    public init(host: String, port: Int32, onMessage: @escaping OnMessage) throws {
+        self.onMessage = onMessage
+        fd = socket(AF_INET, Int32(SOCK_STREAM.rawValue), 0)
+        guard fd >= 0 else {
+            throw FedMLError.native("socket() failed: errno \(errno)")
+        }
+        var flag: Int32 = 1
+        setsockopt(fd, Int32(IPPROTO_TCP), TCP_NODELAY, &flag,
+                   socklen_t(MemoryLayout<Int32>.size))
+        var addr = sockaddr_in()
+        addr.sin_family = sa_family_t(AF_INET)
+        addr.sin_port = in_port_t(UInt16(port).bigEndian)
+        guard inet_pton(AF_INET, host, &addr.sin_addr) == 1 else {
+            close(fd)
+            throw FedMLError.native("bad broker host \(host)")
+        }
+        let rc = withUnsafePointer(to: &addr) {
+            $0.withMemoryRebound(to: sockaddr.self, capacity: 1) {
+                connect(fd, $0, socklen_t(MemoryLayout<sockaddr_in>.size))
+            }
+        }
+        guard rc == 0 else {
+            close(fd)
+            throw FedMLError.native("connect to \(host):\(port) failed: errno \(errno)")
+        }
+        let t = Thread { [weak self] in self?.recvLoop() }
+        t.name = "broker-recv"
+        t.start()
+        recvThread = t
+    }
+
+    public func subscribe(_ topic: String) throws {
+        try send(frame("SUB", topic: topic, payload: nil))
+    }
+
+    public func unsubscribe(_ topic: String) throws {
+        try send(frame("UNSUB", topic: topic, payload: nil))
+    }
+
+    public func publish(_ topic: String, _ payload: Any) throws {
+        try send(frame("PUB", topic: topic, payload: payload))
+    }
+
+    /// Broker publishes this if the socket dies without a clean DISCONNECT.
+    public func setLastWill(_ topic: String, _ payload: Any) throws {
+        try send(frame("WILL", topic: topic, payload: payload))
+    }
+
+    /// Idempotent, callable from any thread including the receive thread
+    /// (from inside an onMessage handler the loop resumes draining when the
+    /// handler returns and performs the close at EOF).
+    public func disconnect() {
+        lock.lock()
+        let wasRunning = running
+        running = false
+        if wasRunning, fd >= 0 {
+            // fence DISCONNECT + FIN with the sends: a publish slipping in
+            // between would make the broker break at DISCONNECT with unread
+            // data -> RST right back at us
+            if let data = try? Self.encodeFrame(["op": "DISCONNECT"]) {
+                _ = writeAllLocked(data)
+            }
+            shutdown(fd, Int32(SHUT_WR))
+        }
+        lock.unlock()
+        if let t = recvThread, Thread.current !== t {
+            // the recv loop drains to broker EOF, then closes the fd (it is
+            // the close's sole owner; a stuck drain leaks the fd rather than
+            // risk closing under a blocked read)
+            let deadline = Date().addingTimeInterval(5)
+            while !t.isFinished && Date() < deadline {
+                usleep(20_000)
+            }
+        }
+    }
+
+    // MARK: - framing
+
+    private func frame(_ op: String, topic: String, payload: Any?) -> [String: Any] {
+        var f: [String: Any] = ["op": op, "topic": topic]
+        if let payload = payload { f["payload"] = payload }
+        return f
+    }
+
+    private static func encodeFrame(_ obj: [String: Any]) throws -> Data {
+        let body = try JSONSerialization.data(withJSONObject: obj)
+        var n = UInt32(body.count).bigEndian
+        var out = Data(bytes: &n, count: 4)
+        out.append(body)
+        return out
+    }
+
+    private func send(_ obj: [String: Any]) throws {
+        let data = try Self.encodeFrame(obj)
+        lock.lock()
+        defer { lock.unlock() }
+        guard running, fd >= 0 else {
+            throw FedMLError.native("broker connection is closed")
+        }
+        guard writeAllLocked(data) else {
+            throw FedMLError.native("broker send failed: errno \(errno)")
+        }
+    }
+
+    /// (lock held) write the whole buffer, retrying on EINTR.
+    private func writeAllLocked(_ data: Data) -> Bool {
+        var sent = 0
+        return data.withUnsafeBytes { (raw: UnsafeRawBufferPointer) in
+            while sent < data.count {
+                let n = write(fd, raw.baseAddress!.advanced(by: sent), data.count - sent)
+                if n < 0 && errno == EINTR { continue }
+                guard n > 0 else { return false }
+                sent += n
+            }
+            return true
+        }
+    }
+
+    private func readExact(_ sock: Int32, _ count: Int) -> Data? {
+        var buf = Data(capacity: count)
+        var chunk = [UInt8](repeating: 0, count: 64 * 1024)
+        while buf.count < count {
+            let want = min(chunk.count, count - buf.count)
+            let n = read(sock, &chunk, want)
+            if n < 0 && errno == EINTR { continue }  // signal, not death
+            guard n > 0 else { return nil }
+            buf.append(contentsOf: chunk[0..<n])
+        }
+        return buf
+    }
+
+    private func recvLoop() {
+        // the recv thread reads its own fd without the lock: it is the only
+        // thread that ever invalidates it, so the value it sees is live
+        let sock = fd
+        // reads to EOF even after disconnect() flips running: draining the
+        // inbound stream keeps the close RST-free (see disconnect)
+        while true {
+            guard let hdr = readExact(sock, 4) else { break }
+            let n = Int(UInt32(bigEndian: hdr.withUnsafeBytes { $0.load(as: UInt32.self) }))
+            guard n <= Self.maxFrame, let body = readExact(sock, n) else {
+                // oversized length = desynced stream: tear down so the
+                // broker notices and publishes our last will
+                break
+            }
+            guard
+                let obj = try? JSONSerialization.jsonObject(with: body) as? [String: Any],
+                obj["op"] as? String == "MSG",
+                let topic = obj["topic"] as? String
+            else {
+                if (try? JSONSerialization.jsonObject(with: body)) == nil {
+                    break  // undecodable frame: desynced, tear down
+                }
+                continue  // decodable non-MSG frame: ignore
+            }
+            onMessage(topic, obj["payload"])
+        }
+        // single close owner: invalidate fd first so no sender can touch a
+        // recycled descriptor number, then close the real one
+        lock.lock()
+        let unclean = running
+        running = false
+        let sockToClose = fd
+        fd = -1
+        lock.unlock()
+        if sockToClose >= 0 {
+            close(sockToClose)
+        }
+        if unclean {
+            onConnectionLost?()
+        }
+    }
+}

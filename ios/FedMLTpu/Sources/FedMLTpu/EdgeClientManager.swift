@@ -1,0 +1,194 @@
+// The FL client scheduler for iOS — the Swift twin of
+// ai.fedml.tpu.ClientManager (Java) and the Python fake device
+// (fedml_tpu/cross_device/fake_device.py), walking the cross-device round
+// protocol over the broker wire:
+//
+// 1. connection ready -> C2S_CLIENT_STATUS ONLINE (handshake);
+// 2. S2C_CHECK_CLIENT_STATUS -> re-announce ONLINE;
+// 3. S2C_INIT_CONFIG / S2C_SYNC_MODEL_TO_CLIENT -> train the downloaded
+//    model FILE with the native runtime, upload the trained file with the
+//    ROUND TAG and the sample count;
+// 4. S2C_FINISH -> stop.
+
+import Foundation
+
+public final class EdgeClientManager {
+    public typealias OnRoundCompleted = (_ roundIdx: Int, _ loss: Double,
+                                         _ numSamples: Int64) -> Void
+    public typealias OnFinished = (_ roundsTrained: Int) -> Void
+
+    /// Late-bound message handler: the connection needs a callback at
+    /// construction, the callback needs self — the box breaks the cycle.
+    private final class HandlerBox {
+        var fn: (String, Any?) -> Void = { _, _ in }
+    }
+
+    private let conn: BrokerConnection
+    private let handlerBox = HandlerBox()
+    private let runId: String
+    private let rank: Int
+    private let dataPath: String
+    private let uploadDir: URL
+    private let batchSize: Int32
+    private let learningRate: Double
+    private let epochs: Int32
+    private let queue = DispatchQueue(label: "fedml-train")
+    private let finishLock = NSLock()  // NOT the train queue: finish() must
+    private var roundsTrained = 0      // be safe from its own callbacks
+    private var finished = false
+    public var onRoundCompleted: OnRoundCompleted?
+    public var onFinished: OnFinished?
+
+    public init(host: String, port: Int32, runId: String, rank: Int,
+                dataPath: String, uploadDir: URL, batchSize: Int32 = 32,
+                learningRate: Double = 0.1, epochs: Int32 = 1) throws {
+        self.runId = runId
+        self.rank = rank
+        self.dataPath = dataPath
+        self.uploadDir = uploadDir
+        self.batchSize = batchSize
+        self.learningRate = learningRate
+        self.epochs = epochs
+        try FileManager.default.createDirectory(at: uploadDir,
+                                                withIntermediateDirectories: true)
+        let box = handlerBox
+        conn = try BrokerConnection(host: host, port: port) { topic, payload in
+            box.fn(topic, payload)
+        }
+        box.fn = { [weak self] topic, payload in
+            self?.dispatch(topic: topic, payload: payload)
+        }
+        conn.onConnectionLost = { [weak self] in
+            FileHandle.standardError.write(
+                Data("fedml broker connection lost: leaving the run\n".utf8))
+            self?.finish()
+        }
+        let will: [String: Any] = ["rank": rank,
+                                   "status": MessageDefine.CLIENT_STATUS_OFFLINE]
+        try conn.setLastWill(statusTopic(), jsonString(will))
+        try conn.subscribe("fedml/\(runId)/#")
+    }
+
+    /// Join the run (announces ONLINE; the same bootstrap contract every
+    /// comm manager follows on connection_ready).
+    public func start() {
+        announceOnline()
+    }
+
+    /// Leave early; the server's straggler tolerance covers the missing
+    /// upload.  Safe to call from any thread.
+    public func stop() {
+        finish()
+    }
+
+    // MARK: - protocol
+
+    private func topic(toServer: Bool) -> String {
+        toServer ? "fedml/\(runId)/\(rank)/0" : "fedml/\(runId)/0/\(rank)"
+    }
+
+    private func statusTopic() -> String {
+        "fedml/\(runId)/status"
+    }
+
+    private func dispatch(topic: String, payload: Any?) {
+        let parts = topic.split(separator: "/").map(String.init)
+        guard parts.count == 4, parts[3] == String(rank),
+              let msg = payload as? [String: Any] else { return }
+        let type = String(describing: msg[MessageDefine.MSG_ARG_KEY_TYPE] ?? "")
+        switch type {
+        case String(MessageDefine.MSG_TYPE_S2C_CHECK_CLIENT_STATUS):
+            announceOnline()
+        case String(MessageDefine.MSG_TYPE_S2C_INIT_CONFIG),
+             String(MessageDefine.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT):
+            onModel(msg)
+        case String(MessageDefine.MSG_TYPE_S2C_FINISH):
+            finish()
+        default:
+            break
+        }
+    }
+
+    private func announceOnline() {
+        sendOrWarn([
+            MessageDefine.MSG_ARG_KEY_TYPE:
+                String(MessageDefine.MSG_TYPE_C2S_CLIENT_STATUS),
+            MessageDefine.MSG_ARG_KEY_SENDER: rank,
+            MessageDefine.MSG_ARG_KEY_RECEIVER: 0,
+            MessageDefine.MSG_ARG_KEY_CLIENT_STATUS:
+                MessageDefine.CLIENT_STATUS_ONLINE,
+        ])
+    }
+
+    private func onModel(_ msg: [String: Any]) {
+        guard let modelFile = msg[MessageDefine.MSG_ARG_KEY_MODEL_PARAMS_FILE]
+                as? String else { return }
+        let roundIdx = (msg[MessageDefine.MSG_ARG_KEY_ROUND_INDEX] as? Int) ?? 0
+        // train off the receive thread (rounds take seconds on-device)
+        queue.async { [weak self] in
+            guard let self = self, !self.isFinished() else { return }
+            let out = self.uploadDir
+                .appendingPathComponent("model_r\(roundIdx)_c\(self.rank).ftem").path
+            do {
+                // seed matches the Java/Python devices: (round, rank)
+                let trainer = try FedMLTrainer(
+                    modelPath: modelFile, dataPath: self.dataPath,
+                    batchSize: self.batchSize, learningRate: self.learningRate,
+                    epochs: self.epochs,
+                    seed: UInt64(roundIdx * 1000 + self.rank))
+                try trainer.train()
+                try trainer.save(to: out)
+                self.roundsTrained += 1
+                self.sendOrWarn([
+                    MessageDefine.MSG_ARG_KEY_TYPE:
+                        String(MessageDefine.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER),
+                    MessageDefine.MSG_ARG_KEY_SENDER: self.rank,
+                    MessageDefine.MSG_ARG_KEY_RECEIVER: 0,
+                    MessageDefine.MSG_ARG_KEY_ROUND_INDEX: roundIdx,
+                    MessageDefine.MSG_ARG_KEY_MODEL_PARAMS_FILE: out,
+                    MessageDefine.MSG_ARG_KEY_NUM_SAMPLES: Int(trainer.numSamples),
+                ])
+                self.onRoundCompleted?(roundIdx, trainer.lastEpochLoss.loss,
+                                       trainer.numSamples)
+            } catch {
+                // no upload: a straggler-tolerant server closes without us
+                FileHandle.standardError.write(
+                    Data("fedml round \(roundIdx) failed on-device: \(error)\n".utf8))
+            }
+        }
+    }
+
+    private func isFinished() -> Bool {
+        finishLock.lock()
+        defer { finishLock.unlock() }
+        return finished
+    }
+
+    private func finish() {
+        // idempotent: reachable from S2C_FINISH, connection loss, stop(),
+        // and the app's own callbacks (a queue.sync guard would deadlock a
+        // stop() issued from onRoundCompleted, which runs on the train queue)
+        finishLock.lock()
+        let first = !finished
+        finished = true
+        finishLock.unlock()
+        guard first else { return }
+        conn.disconnect()
+        onFinished?(roundsTrained)
+    }
+
+    private func sendOrWarn(_ params: [String: Any]) {
+        do {
+            try conn.publish(topic(toServer: true), params)
+        } catch {
+            FileHandle.standardError.write(
+                Data("fedml send failed: \(error)\n".utf8))
+        }
+    }
+
+    private func jsonString(_ obj: [String: Any]) -> String {
+        guard let d = try? JSONSerialization.data(withJSONObject: obj),
+              let s = String(data: d, encoding: .utf8) else { return "{}" }
+        return s
+    }
+}

@@ -1,0 +1,41 @@
+// The FL round message vocabulary — field-for-field mirror of the Python
+// contract (fedml_tpu/cross_device/message_define.py) and the Java
+// MessageDefine.java.  tests/test_ios_package.py parses this file and
+// asserts every constant equals its Python twin, so the three sides
+// cannot drift silently.
+
+public enum MessageDefine {
+    // server -> client
+    public static let MSG_TYPE_S2C_INIT_CONFIG = 1
+    public static let MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = 2
+    public static let MSG_TYPE_S2C_CHECK_CLIENT_STATUS = 6
+    public static let MSG_TYPE_S2C_FINISH = 7
+
+    // client -> server
+    public static let MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
+    public static let MSG_TYPE_C2S_SEND_STATS_TO_SERVER = 4
+    public static let MSG_TYPE_C2S_CLIENT_STATUS = 5
+
+    public static let MSG_ARG_KEY_TYPE = "msg_type"
+    public static let MSG_ARG_KEY_SENDER = "sender"
+    public static let MSG_ARG_KEY_RECEIVER = "receiver"
+
+    public static let MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    public static let MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    public static let MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+    public static let MSG_ARG_KEY_MODEL_PARAMS_FILE = "model_params_file"
+    public static let MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    public static let MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    public static let MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+
+    public static let MSG_ARG_KEY_TRAIN_CORRECT = "train_correct"
+    public static let MSG_ARG_KEY_TRAIN_ERROR = "train_error"
+    public static let MSG_ARG_KEY_TRAIN_NUM = "train_num_sample"
+
+    public static let CLIENT_STATUS_OFFLINE = "OFFLINE"
+    public static let CLIENT_STATUS_IDLE = "IDLE"
+    public static let CLIENT_STATUS_ONLINE = "ONLINE"
+
+    /// Local pseudo-message raised once the wire is up.
+    public static let MSG_TYPE_CONNECTION_READY = "connection_ready"
+}

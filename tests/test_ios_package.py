@@ -87,3 +87,48 @@ class TestSwiftBuild:
             cwd=os.path.join(REPO, "ios", "FedMLTpu"),
             capture_output=True, text=True, timeout=600)
         assert out.returncode == 0, out.stderr
+
+
+class TestSwiftProtocolDriftGates:
+    """The Swift protocol layer (MessageDefine/BrokerConnection/
+    EdgeClientManager) mirrors the Java SDK and the Python wire — same
+    parsing gates as tests/test_java_sdk.py, Swift flavored."""
+
+    SWIFT_DIR = os.path.join(REPO, "ios", "FedMLTpu", "Sources", "FedMLTpu")
+
+    def _swift(self, name):
+        with open(os.path.join(self.SWIFT_DIR, name)) as f:
+            return f.read()
+
+    def test_message_define_matches_python(self):
+        from fedml_tpu.cross_device.message_define import MNNMessage
+
+        src = self._swift("MessageDefine.swift")
+        ints = dict(re.findall(r"let (MSG_TYPE_\w+) = (\d+)", src))
+        strs = dict(re.findall(r'let (\w+) = "([^"]*)"', src))
+        assert ints, "no int constants parsed from MessageDefine.swift"
+        for name, val in ints.items():
+            assert getattr(MNNMessage, name) == int(val), name
+        for name, val in strs.items():
+            if name == "MSG_TYPE_CONNECTION_READY":
+                assert val == "connection_ready"
+                continue
+            assert getattr(MNNMessage, name) == val, name
+        for name in dir(MNNMessage):
+            if name.startswith(("MSG_TYPE_", "MSG_ARG_KEY_", "CLIENT_STATUS_")):
+                assert name in ints or name in strs, f"missing in Swift: {name}"
+
+    def test_broker_frame_ops_match(self):
+        src = self._swift("BrokerConnection.swift")
+        for op in ("SUB", "UNSUB", "PUB", "WILL", "DISCONNECT", "MSG"):
+            assert f'"{op}"' in src, f"missing broker op {op}"
+        # the RST-safe close contract (shared with Java/Python clients)
+        assert "SHUT_WR" in src
+        assert "onConnectionLost" in src
+
+    def test_client_topic_scheme_matches(self):
+        src = self._swift("EdgeClientManager.swift")
+        assert 'fedml/\\(runId)/\\(rank)/0' in src
+        assert 'fedml/\\(runId)/0/\\(rank)' in src
+        assert 'fedml/\\(runId)/status' in src
+        assert 'fedml/\\(runId)/#' in src
