@@ -22,6 +22,31 @@ The canonical YAML shape is unchanged::
     device_args:   { using_gpu, device_type, ... }
     comm_args:     { backend, ... }
     tracking_args: { enable_wandb, log_file_dir, ... }
+    fault_args:    { fault_plan, ... }
+
+Transport-reliability knobs (``train_args`` or ``comm_args``; consumed by
+``core/distributed/comm_manager.py``):
+
+* ``comm_reliability`` (default True) — stamp every outbound message with a
+  monotonic ``msg_id``, ack stamped inbound messages, and drop re-deliveries
+  (idempotent receive).  Turning it off restores the raw reference wire.
+* ``comm_max_retries`` (default 0) — send-side retry budget.  0 keeps the
+  reference's synchronous-raise semantics; > 0 retries failed sends with
+  exponential backoff + jitter AND runs a background retransmitter that
+  re-sends unacked messages until acked or the budget is spent.
+* ``comm_backoff_base_s`` (default 0.2) / ``comm_backoff_max_s`` (default
+  2.0) / ``comm_backoff_jitter`` (default 0.25) — backoff schedule:
+  ``min(base * 2^attempt, max) * (1 + jitter * U[0,1))``.
+* ``comm_dedup_window`` (default 8192) — LRU size of the receive-side
+  message-id dedup window.
+* ``fault_plan`` (default None; ``fault_args`` section) — a deterministic
+  chaos plan injected at the transport seam; schema in
+  ``core/distributed/faults.py``.
+
+Backend-specific resilience knobs: ``trpc_connect_retries`` /
+``trpc_retry_interval_s`` (TCP), ``grpc_send_retries`` /
+``grpc_send_backoff_base_s`` (gRPC), ``mqtt_reconnect_retries`` /
+``mqtt_reconnect_base_s`` (broker client auto-reconnect).
 """
 
 from __future__ import annotations
@@ -163,6 +188,13 @@ class Arguments:
                 from .constants import FEDPROX_DEFAULT_MU
 
                 self.proximal_mu = FEDPROX_DEFAULT_MU
+        # a malformed chaos plan should fail at config time, not mid-run when
+        # the backend factory first tries to wrap the transport
+        plan = getattr(self, "fault_plan", None)
+        if plan:
+            from .core.distributed.faults import FaultPlan
+
+            FaultPlan.from_dict(plan)
         return self
 
 

@@ -1,4 +1,4 @@
-"""Node runtime: handler registry + backend factory.
+"""Node runtime: handler registry + backend factory + reliability layer.
 
 Parity with reference ``core/distributed/fedml_comm_manager.py:10-135``
 (``FedMLCommManager``): every server/client manager subclasses this, registers
@@ -8,12 +8,33 @@ rebuild's backends are LOOPBACK (in-process), GRPC (DCN message plane) and an
 MQTT+S3 emulation (file-blob data plane) — NCCL/MPI collective traffic has no
 backend here because on TPU it is in-program XLA collectives
 (see fedml_tpu/simulation/xla/).
+
+Beyond-reference: a transport-agnostic **reliability layer** sits between the
+application managers and the backend.  Outbound messages are stamped with a
+monotonic ``msg_id`` (``rank:nonce:seq``; the nonce is fresh per incarnation
+so a rejoined silo never collides with its dead predecessor's ids).  Receivers
+ack every stamped message *before* dispatching it and drop re-deliveries by an
+LRU dedup window, so retries and duplicate faults are idempotent end to end.
+With ``args.comm_max_retries > 0`` a background retransmitter re-sends
+unacked messages with exponential backoff + jitter and synchronous send
+errors (connection resets) are retried instead of raised; at the default 0
+the legacy synchronous-raise semantics are preserved exactly and no
+retransmit thread runs (acks from legacy peers are simply ignored).
+Peers that don't stamp ``msg_id`` (the Java/Swift JSON wire) are never acked
+or deduped — the layer is wire-compatible in both directions.
+
+When ``args.fault_plan`` is set the backend is wrapped in the
+:mod:`~fedml_tpu.core.distributed.faults` injection seam, so chaos runs
+differ from clean runs only in config.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
+import uuid
+from collections import OrderedDict
 from typing import Callable, Dict, Optional
 
 from ...constants import (
@@ -25,8 +46,178 @@ from ...constants import (
 )
 from .communication.base_com_manager import BaseCommunicationManager, Observer
 from .communication.message import Message
+from .faults import CommStats
 
 logger = logging.getLogger(__name__)
+
+# transport-level ack; lives below the application vocabulary (MyMessage) so
+# it needs no handler registration and is invisible to the Java/Swift gates
+COMM_ACK_TYPE = "comm_ack"
+
+# backend-synthesized local pseudo-messages bypass the reliability layer
+_LOCAL_TYPES = ("connection_ready",)
+
+
+class _Pending:
+    __slots__ = ("msg", "attempts", "due")
+
+    def __init__(self, msg: Message, due: float):
+        self.msg = msg
+        self.attempts = 0
+        self.due = due
+
+
+class _ReliableLink:
+    """Per-endpoint stamping + ack + dedup + (optional) retransmission.
+
+    The link never raises into the receive loop: ack sends are best-effort
+    (a failed ack just means the peer retransmits) and retransmission gives
+    up after ``max_retries`` with a counted ``delivery_failures`` instead of
+    an exception on a daemon thread.
+    """
+
+    def __init__(self, rank: int, stats: CommStats, *, max_retries: int = 0,
+                 backoff_base_s: float = 0.2, backoff_max_s: float = 2.0,
+                 jitter: float = 0.25, dedup_window: int = 8192):
+        self.rank = int(rank)
+        self.stats = stats
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.jitter = float(jitter)
+        self.dedup_window = int(dedup_window)
+        self._nonce = uuid.uuid4().hex[:8]
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._seen: "OrderedDict[str, None]" = OrderedDict()
+        self._seen_lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._pending: Dict[str, _Pending] = {}
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._send_raw: Optional[Callable[[Message], None]] = None
+        # jitter draws are deterministic per (rank, nonce) but the nonce is
+        # fresh per incarnation — good enough: jitter only de-synchronizes
+        # retransmit storms, correctness never depends on it
+        import random
+
+        self._rng = random.Random(f"{self.rank}:{self._nonce}")
+
+    # -- wiring --------------------------------------------------------------
+    def bind(self, send_raw: Callable[[Message], None]) -> None:
+        self._send_raw = send_raw
+        if self.max_retries > 0 and self._thread is None:
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._retransmit_loop, daemon=True,
+                name=f"comm-retx-rank{self.rank}")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._pending.clear()
+            self._cond.notify_all()
+
+    # -- send side -----------------------------------------------------------
+    def stamp(self, msg: Message) -> str:
+        with self._seq_lock:
+            self._seq += 1
+            msg_id = f"{self.rank}:{self._nonce}:{self._seq}"
+        msg.add_params(Message.MSG_ARG_KEY_MSG_ID, msg_id)
+        return msg_id
+
+    def track(self, msg_id: str, msg: Message) -> None:
+        if self.max_retries <= 0:
+            return
+        with self._cond:
+            if not self._running:
+                return
+            self._pending[msg_id] = _Pending(msg, time.monotonic() + self._backoff(0))
+            self._cond.notify_all()
+
+    def _backoff(self, attempt: int) -> float:
+        base = min(self.backoff_base_s * (2 ** attempt), self.backoff_max_s)
+        return base * (1.0 + self.jitter * self._rng.random())
+
+    def _retransmit_loop(self) -> None:
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+                now = time.monotonic()
+                due = [(mid, p) for mid, p in self._pending.items() if p.due <= now]
+                if not due:
+                    next_due = min((p.due for p in self._pending.values()),
+                                   default=now + 1.0)
+                    self._cond.wait(timeout=max(0.01, next_due - now))
+                    continue
+                for mid, p in due:
+                    p.attempts += 1
+                    if p.attempts > self.max_retries:
+                        del self._pending[mid]
+                    else:
+                        p.due = now + self._backoff(p.attempts)
+            for mid, p in due:
+                if p.attempts > self.max_retries:
+                    self.stats.inc("delivery_failures")
+                    logger.warning(
+                        "rank %s: giving up on %s (%s) after %d retransmits",
+                        self.rank, mid, p.msg.get_type(), self.max_retries)
+                    continue
+                self.stats.inc("retransmits")
+                logger.info("rank %s: retransmit #%d of %s (%s)",
+                            self.rank, p.attempts, mid, p.msg.get_type())
+                try:
+                    assert self._send_raw is not None
+                    self._send_raw(p.msg)
+                except Exception as e:
+                    logger.info("rank %s: retransmit of %s failed (%s); "
+                                "will retry", self.rank, mid, e)
+
+    # -- receive side --------------------------------------------------------
+    def on_receive(self, msg: Message) -> bool:
+        """Return True iff ``msg`` should be dispatched to handlers.
+
+        Consumes acks, acks every stamped message (dup or not — the ack may
+        have been the frame that was lost), and drops re-deliveries.
+        """
+        if msg.get_type() == COMM_ACK_TYPE:
+            acked = msg.get(Message.MSG_ARG_KEY_MSG_ID)
+            self.stats.inc("acks_received")
+            if acked is not None:
+                with self._cond:
+                    self._pending.pop(str(acked), None)
+            return False
+        if msg.get_type() in _LOCAL_TYPES:
+            return True
+        msg_id = msg.get(Message.MSG_ARG_KEY_MSG_ID)
+        if msg_id is None:
+            return True  # legacy peer: no dedup, no ack
+        self._send_ack(msg)
+        with self._seen_lock:
+            if msg_id in self._seen:
+                self.stats.inc("dup_dropped")
+                logger.info("rank %s: dropping duplicate %s (%s)",
+                            self.rank, msg_id, msg.get_type())
+                return False
+            self._seen[msg_id] = None
+            while len(self._seen) > self.dedup_window:
+                self._seen.popitem(last=False)
+        return True
+
+    def _send_ack(self, msg: Message) -> None:
+        ack = Message(COMM_ACK_TYPE, self.rank, msg.get_sender_id())
+        ack.add_params(Message.MSG_ARG_KEY_MSG_ID,
+                       msg.get(Message.MSG_ARG_KEY_MSG_ID))
+        try:
+            assert self._send_raw is not None
+            self._send_raw(ack)
+            self.stats.inc("acks_sent")
+        except Exception as e:
+            # best-effort: a lost ack just means the peer retransmits into
+            # the dedup window
+            logger.info("rank %s: ack send failed (%s)", self.rank, e)
 
 
 class FedMLCommManager(Observer):
@@ -38,7 +229,25 @@ class FedMLCommManager(Observer):
         self.comm = comm
         self.com_manager: Optional[BaseCommunicationManager] = None
         self.message_handler_dict: Dict[str, Callable[[Message], None]] = {}
+        self._comm_stats = CommStats()
+        self._link = self._init_link()
         self._init_manager()
+        if self._link is not None:
+            self._link.bind(self._raw_send)
+
+    def _init_link(self) -> Optional[_ReliableLink]:
+        a = self.args
+        if a is not None and not getattr(a, "comm_reliability", True):
+            return None
+        g = (lambda k, d: getattr(a, k, d) if a is not None else d)
+        return _ReliableLink(
+            self.rank, self._comm_stats,
+            max_retries=int(g("comm_max_retries", 0)),
+            backoff_base_s=float(g("comm_backoff_base_s", 0.2)),
+            backoff_max_s=float(g("comm_backoff_max_s", 2.0)),
+            jitter=float(g("comm_backoff_jitter", 0.25)),
+            dedup_window=int(g("comm_dedup_window", 8192)),
+        )
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -57,16 +266,67 @@ class FedMLCommManager(Observer):
 
     def finish(self) -> None:
         """Stop the transport (reference ``fedml_comm_manager.py:61-76``)."""
+        if self._link is not None:
+            self._link.stop()
+        self._report_comm_stats()
         if self.com_manager is not None:
             self.com_manager.stop_receive_message()
+
+    def _report_comm_stats(self) -> None:
+        try:
+            from ..mlops import log_comm_stats
+
+            log_comm_stats(self.comm_stats_snapshot(), rank=self.rank)
+        except Exception:  # observability must never take the run down
+            logger.debug("comm stats report failed", exc_info=True)
+
+    def comm_stats_snapshot(self) -> Dict[str, int]:
+        """Reliability + fault + backend-reconnect counters for this node."""
+        snap = self._comm_stats.snapshot()
+        snap["reconnects"] += int(getattr(self.com_manager, "reconnect_count", 0) or 0)
+        return snap
 
     # -- messaging ----------------------------------------------------------
     def get_sender_id(self) -> int:
         return self.rank
 
-    def send_message(self, message: Message) -> None:
+    def _raw_send(self, message: Message) -> None:
         assert self.com_manager is not None
         self.com_manager.send_message(message)
+
+    def send_message(self, message: Message) -> None:
+        assert self.com_manager is not None
+        link = self._link
+        if link is None or message.get_type() in _LOCAL_TYPES:
+            self._raw_send(message)
+            return
+        msg_id = link.stamp(message)
+        attempt = 0
+        while True:
+            try:
+                self._raw_send(message)
+                self._comm_stats.inc("messages_sent")
+                break
+            except Exception as e:
+                if attempt >= link.max_retries:
+                    if link.max_retries > 0:
+                        # the retransmitter owns delivery now; surfacing the
+                        # exception would kill round threads the layer exists
+                        # to protect
+                        logger.warning(
+                            "rank %s: send of %s failed %d times (%s); "
+                            "deferring to retransmitter",
+                            self.rank, message.get_type(), attempt + 1, e)
+                        break
+                    raise
+                attempt += 1
+                self._comm_stats.inc("retries")
+                delay = link._backoff(attempt - 1)
+                logger.info("rank %s: send of %s failed (%s); retry %d/%d in %.2fs",
+                            self.rank, message.get_type(), e, attempt,
+                            link.max_retries, delay)
+                time.sleep(delay)
+        link.track(msg_id, message)
 
     def register_message_receive_handler(
         self, msg_type: str, handler_callback_func: Callable[[Message], None]
@@ -78,6 +338,8 @@ class FedMLCommManager(Observer):
 
     # Observer
     def receive_message(self, msg_type: str, msg_params: Message) -> None:
+        if self._link is not None and not self._link.on_receive(msg_params):
+            return
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
             logger.debug("rank %s: no handler for msg_type=%s", self.rank, msg_type)
@@ -109,6 +371,8 @@ class FedMLCommManager(Observer):
                 client_id=self.rank,
                 client_num=self.size,
                 base_port=base_port,
+                send_retries=int(getattr(self.args, "grpc_send_retries", 30)),
+                send_backoff_base_s=float(getattr(self.args, "grpc_send_backoff_base_s", 0.2)),
             )
         elif backend in (FEDML_BACKEND_MQTT_S3, FEDML_BACKEND_MQTT_S3_MNN):
             try:
@@ -135,7 +399,17 @@ class FedMLCommManager(Observer):
                 size=self.size,
                 ip_table=getattr(self.args, "trpc_ip_table", None),
                 bind_host=getattr(self.args, "trpc_bind_host", "0.0.0.0"),
+                connect_retries=int(getattr(self.args, "trpc_connect_retries", 20)),
+                retry_interval_s=float(getattr(self.args, "trpc_retry_interval_s", 0.5)),
             )
         else:
             raise ValueError(f"unsupported comm backend: {self.backend!r}")
+        fault_spec = getattr(self.args, "fault_plan", None) if self.args is not None else None
+        if fault_spec:
+            from .faults import FaultPlan, FaultyCommManager
+
+            plan = FaultPlan.from_dict(fault_spec)
+            self.com_manager = FaultyCommManager(
+                self.com_manager, plan.injector(self.rank), self._comm_stats
+            )
         self.com_manager.add_observer(self)

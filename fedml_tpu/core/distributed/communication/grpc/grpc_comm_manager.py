@@ -92,12 +92,20 @@ class GRPCCommManager(BaseCommunicationManager):
         client_id: int = 0,
         client_num: int = 0,
         base_port: int = 8890,
+        send_retries: int = 30,
+        send_backoff_base_s: float = 0.2,
+        send_backoff_max_s: float = 0.5,
     ):
         self.host = host
         self.port = int(port)
         self.client_id = int(client_id)
         self.client_num = int(client_num)
         self.base_port = int(base_port)
+        self.send_retries = int(send_retries)
+        self.send_backoff_base_s = float(send_backoff_base_s)
+        self.send_backoff_max_s = float(send_backoff_max_s)
+        self.reconnect_count = 0  # channels dropped + redialed after RpcError
+        self._rng = __import__("random").Random(f"grpc-backoff:{int(client_id)}")
         if ip_config is None:
             self.ip_table: Dict[int, str] = {}
         elif isinstance(ip_config, dict):
@@ -137,20 +145,35 @@ class GRPCCommManager(BaseCommunicationManager):
                 self._channels[addr] = ch
             return ch
 
+    def _drop_channel(self, addr: str) -> None:
+        """A failed RPC may mean a dead cached channel (peer restarted):
+        close and forget it so the next attempt dials fresh."""
+        with self._lock:
+            ch = self._channels.pop(addr, None)
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:
+                pass
+            self.reconnect_count += 1
+
     # -- BaseCommunicationManager -------------------------------------------
     def send_message(self, msg: Message) -> None:
         payload = pickle.dumps(device_get_tree(msg.get_params()), protocol=pickle.HIGHEST_PROTOCOL)
         addr = self._addr_of(msg.get_receiver_id())
-        stub = self._channel(addr).unary_unary(_FULL_METHOD)
         t0 = time.time()
-        for attempt in range(30):
+        for attempt in range(self.send_retries):
+            stub = self._channel(addr).unary_unary(_FULL_METHOD)
             try:
                 stub(payload, timeout=60.0)
                 break
-            except grpc.RpcError as e:  # receiver may not be up yet
-                if attempt == 29:
+            except grpc.RpcError:  # receiver not up yet, or stale channel
+                self._drop_channel(addr)
+                if attempt == self.send_retries - 1:
                     raise
-                time.sleep(0.2)
+                backoff = min(self.send_backoff_base_s * (2 ** attempt),
+                              self.send_backoff_max_s)
+                time.sleep(backoff * (1.0 + 0.25 * self._rng.random()))
         logger.debug(
             "grpc rank %s -> %s (%s) %.1f KB in %.3fs",
             self.client_id, msg.get_receiver_id(), msg.get_type(),

@@ -49,6 +49,14 @@ class LoopbackHub:
                 for key in [k for k in cls._queues if k[0] == str(channel)]:
                     del cls._queues[key]
 
+    @classmethod
+    def sever(cls, channel: str, rank: int) -> None:
+        """Kill one rank's mailbox: in-flight frames are lost and a rejoined
+        incarnation gets a fresh queue (no stale ``_STOP`` sentinel from the
+        dead one) — the loopback analog of a silo process crash."""
+        with cls._lock:
+            cls._queues.pop((str(channel), int(rank)), None)
+
 
 class LoopbackCommManager(BaseCommunicationManager):
     """Queue-backed transport for rank ``rank`` of ``size`` nodes on ``channel``."""

@@ -18,6 +18,11 @@ class Message:
     MSG_ARG_KEY_TYPE = "msg_type"
     MSG_ARG_KEY_SENDER = "sender"
     MSG_ARG_KEY_RECEIVER = "receiver"
+    # reliability header (additive wire change): a per-incarnation monotonic
+    # id "rank:nonce:seq" stamped by the node runtime; receivers ack by id and
+    # drop re-deliveries, making retries and duplicate faults idempotent.
+    # Clients that omit it (legacy Java/Swift wire) are never acked or deduped.
+    MSG_ARG_KEY_MSG_ID = "msg_id"
 
     MSG_OPERATION_SEND = "send"
     MSG_OPERATION_RECEIVE = "receive"

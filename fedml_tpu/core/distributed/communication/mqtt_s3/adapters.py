@@ -177,7 +177,9 @@ class S3BlobStore:
 def create_broker_client(host: str, port: int,
                          on_message: Callable[[str, object], None],
                          transport: Optional[str] = None,
-                         client_id: str = ""):
+                         client_id: str = "",
+                         reconnect_retries: Optional[int] = None,
+                         reconnect_base_s: Optional[float] = None):
     """One constructor for both transports.
 
     ``transport``: ``"paho"`` speaks real MQTT via paho-mqtt (raises if the
@@ -185,10 +187,18 @@ def create_broker_client(host: str, port: int,
     in-repo broker client.  Selection is EXPLICIT config, never import
     availability: the host:port in a config points at a specific kind of
     broker, and silently switching wire protocols because paho-mqtt appeared
-    in the environment would hang both sides against a LocalBroker."""
+    in the environment would hang both sides against a LocalBroker.
+
+    ``reconnect_retries``/``reconnect_base_s`` tune the in-repo client's
+    auto-reconnect (paho manages its own reconnect in its network loop)."""
     if (transport or "").lower() == "paho":
         return PahoBrokerClient(host, port, on_message, client_id=client_id)
-    return BrokerClient(host, port, on_message)
+    kw = {}
+    if reconnect_retries is not None:
+        kw["reconnect_retries"] = int(reconnect_retries)
+    if reconnect_base_s is not None:
+        kw["reconnect_base_s"] = float(reconnect_base_s)
+    return BrokerClient(host, port, on_message, **kw)
 
 
 def create_blob_store(root: Optional[str] = None):

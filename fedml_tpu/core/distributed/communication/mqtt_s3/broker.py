@@ -31,11 +31,32 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">I")
+
+try:
+    import numpy as _np
+except ImportError:  # broker is usable without the array stack
+    _np = None
+
+
+def _np_json_default(o):
+    """Coerce numpy scalars for JSON subscribers: a Python silo that computes
+    a status field as ``np.int64``/``np.float32`` must not silently lose the
+    whole frame for a Java-wire peer.  Non-finite floats still fail via
+    ``allow_nan=False`` after coercion; everything else stays unserializable."""
+    if _np is not None:
+        if isinstance(o, _np.bool_):
+            return bool(o)
+        if isinstance(o, _np.integer):
+            return int(o)
+        if isinstance(o, _np.floating):
+            return float(o)
+    raise TypeError(f"Object of type {type(o).__name__} is not JSON serializable")
 
 
 def _encode_frame(obj: dict, enc: str) -> bytes:
@@ -43,7 +64,7 @@ def _encode_frame(obj: dict, enc: str) -> bytes:
         # allow_nan=False: the token 'NaN' is not JSON and would poison a
         # Java peer's parser mid-stream; non-finite payloads must hit the
         # caller's drop path instead
-        data = json.dumps(obj, allow_nan=False).encode("utf-8")
+        data = json.dumps(obj, allow_nan=False, default=_np_json_default).encode("utf-8")
     else:
         data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     return _LEN.pack(len(data)) + data
@@ -157,43 +178,58 @@ class LocalBroker:
 
     def _client_loop(self, conn: socket.socket) -> None:
         clean = False
-        while self._running:
-            got = _recv_frame(conn)
-            if got is None:
-                break
-            frame, enc = got
-            self._enc[conn] = enc
-            op = frame.get("op")
-            if op == "SUB":
-                with self._lock:
-                    subs, will = self._clients.get(conn, ([], None))
-                    subs.append(str(frame["topic"]))
-                    self._clients[conn] = (subs, will)
-            elif op == "UNSUB":
-                with self._lock:
-                    subs, will = self._clients.get(conn, ([], None))
-                    subs = [s for s in subs if s != str(frame["topic"])]
-                    self._clients[conn] = (subs, will)
-            elif op == "PUB":
-                self._publish(str(frame["topic"]), frame.get("payload"))
-            elif op == "WILL":
-                with self._lock:
-                    subs, _ = self._clients.get(conn, ([], None))
-                    self._clients[conn] = (subs, {"topic": str(frame["topic"]), "payload": frame.get("payload")})
-            elif op == "DISCONNECT":
-                clean = True
-                break
-        # fire last will on unclean death (MQTT parity)
-        with self._lock:
-            _, will = self._clients.pop(conn, ([], None))
-            self._enc.pop(conn, None)
-            self._send_locks.pop(conn, None)
+        # try/finally: a publish-path exception (broken peer socket racing
+        # removal, bad payload) must not kill this thread BEFORE the cleanup
+        # block — that would leave a zombie registration holding the dead
+        # socket in every future fan-out and a last will that never fires
         try:
-            conn.close()
-        except OSError:
-            pass
-        if not clean and will is not None and self._running:
-            self._publish(will["topic"], will["payload"])
+            while self._running:
+                got = _recv_frame(conn)
+                if got is None:
+                    break
+                frame, enc = got
+                self._enc[conn] = enc
+                op = frame.get("op")
+                if op == "SUB":
+                    with self._lock:
+                        subs, will = self._clients.get(conn, ([], None))
+                        subs.append(str(frame["topic"]))
+                        self._clients[conn] = (subs, will)
+                elif op == "UNSUB":
+                    with self._lock:
+                        subs, will = self._clients.get(conn, ([], None))
+                        subs = [s for s in subs if s != str(frame["topic"])]
+                        self._clients[conn] = (subs, will)
+                elif op == "PUB":
+                    self._publish(str(frame["topic"]), frame.get("payload"))
+                elif op == "WILL":
+                    with self._lock:
+                        subs, _ = self._clients.get(conn, ([], None))
+                        self._clients[conn] = (subs, {"topic": str(frame["topic"]), "payload": frame.get("payload")})
+                elif op == "DISCONNECT":
+                    clean = True
+                    break
+        except Exception:
+            # protocol error (malformed frame, publish-path failure): drop
+            # THIS connection, loudly but locally — the finally below still
+            # unregisters it and fires its last will
+            logger.warning("broker client loop error: dropping connection",
+                           exc_info=True)
+        finally:
+            # fire last will on unclean death (MQTT parity)
+            with self._lock:
+                _, will = self._clients.pop(conn, ([], None))
+                self._enc.pop(conn, None)
+                self._send_locks.pop(conn, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if not clean and will is not None and self._running:
+                try:
+                    self._publish(will["topic"], will["payload"])
+                except Exception:
+                    logger.exception("last-will publish for dead client failed")
 
     def _publish(self, topic: str, payload) -> None:
         with self._lock:
@@ -245,14 +281,34 @@ class BrokerClient:
     """Client for :class:`LocalBroker` with paho-like callback semantics.
 
     ``encoding="json"`` speaks the interop wire the Java edge SDK uses —
-    handy for driving/validating that protocol from Python tests."""
+    handy for driving/validating that protocol from Python tests.
+
+    Auto-reconnect (paho parity the first cut lacked): when the broker drops
+    the connection mid-run — broker restart, transient network path — the
+    recv thread redials with exponential backoff and replays the session
+    state (last will, then every subscription), so QoS0 delivery resumes
+    without the owner noticing beyond a gap.  Frames published by others
+    while disconnected are lost (QoS0 semantics); the node runtime's
+    ack/retransmit layer is what papers over that gap end to end.
+    ``reconnects`` counts successful redials for the mlops comm-stats sink.
+    """
 
     def __init__(self, host: str, port: int, on_message: Callable[[str, object], None],
-                 encoding: str = "pickle"):
+                 encoding: str = "pickle",
+                 reconnect_retries: int = 20, reconnect_base_s: float = 0.1,
+                 reconnect_max_s: float = 2.0):
+        self.host = host
+        self.port = int(port)
         self._sock = socket.create_connection((host, port), timeout=30)
         self._sock.settimeout(None)
         self.on_message = on_message
         self.encoding = encoding
+        self.reconnect_retries = int(reconnect_retries)
+        self.reconnect_base_s = float(reconnect_base_s)
+        self.reconnect_max_s = float(reconnect_max_s)
+        self.reconnects = 0
+        self._subs: List[str] = []
+        self._will: Optional[Tuple[str, object]] = None
         self._running = True
         self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._recv_loop, daemon=True, name="broker-recv")
@@ -260,10 +316,13 @@ class BrokerClient:
 
     def subscribe(self, topic: str) -> None:
         with self._lock:
+            if topic not in self._subs:
+                self._subs.append(topic)
             _send_frame(self._sock, {"op": "SUB", "topic": topic}, self.encoding)
 
     def unsubscribe(self, topic: str) -> None:
         with self._lock:
+            self._subs = [s for s in self._subs if s != topic]
             _send_frame(self._sock, {"op": "UNSUB", "topic": topic}, self.encoding)
 
     def publish(self, topic: str, payload) -> None:
@@ -273,8 +332,45 @@ class BrokerClient:
 
     def set_last_will(self, topic: str, payload) -> None:
         with self._lock:
+            self._will = (topic, payload)
             _send_frame(self._sock, {"op": "WILL", "topic": topic, "payload": payload},
                         self.encoding)
+
+    def _reconnect(self) -> bool:
+        """Redial and replay session state. Returns False when retries are
+        exhausted or the client was stopped meanwhile."""
+        for attempt in range(self.reconnect_retries):
+            if not self._running:
+                return False
+            try:
+                sock = socket.create_connection((self.host, self.port), timeout=30)
+                sock.settimeout(None)
+            except OSError:
+                delay = min(self.reconnect_base_s * (2 ** attempt), self.reconnect_max_s)
+                time.sleep(delay)
+                continue
+            with self._lock:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = sock
+                try:
+                    # will FIRST so a death mid-replay still announces itself
+                    if self._will is not None:
+                        _send_frame(sock, {"op": "WILL", "topic": self._will[0],
+                                           "payload": self._will[1]}, self.encoding)
+                    for topic in self._subs:
+                        _send_frame(sock, {"op": "SUB", "topic": topic}, self.encoding)
+                except OSError:
+                    continue  # broker died again mid-replay; keep trying
+            self.reconnects += 1
+            logger.info("broker client reconnected to %s:%s (attempt %d)",
+                        self.host, self.port, attempt + 1)
+            return True
+        logger.warning("broker client gave up reconnecting to %s:%s after %d attempts",
+                       self.host, self.port, self.reconnect_retries)
+        return False
 
     def disconnect(self) -> None:
         """Graceful close: DISCONNECT, half-close (FIN), DRAIN inbound to
@@ -310,6 +406,10 @@ class BrokerClient:
         while True:
             got = _recv_frame(self._sock)
             if got is None:
+                # EOF with the client still live = the broker went away, not
+                # us: redial and resume instead of going deaf
+                if self._running and self._reconnect():
+                    continue
                 break
             frame, _ = got
             if frame.get("op") == "MSG":

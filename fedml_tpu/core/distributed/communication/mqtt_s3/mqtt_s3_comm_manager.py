@@ -65,6 +65,8 @@ class MqttS3CommManager(BaseCommunicationManager):
             host, port, self._on_broker_message,
             transport=getattr(args, "mqtt_transport", None),
             client_id=f"fedml_{self.run_id}_r{self.rank}",
+            reconnect_retries=getattr(args, "mqtt_reconnect_retries", None),
+            reconnect_base_s=getattr(args, "mqtt_reconnect_base_s", None),
         )
         # liveness parity: last-will marks this rank offline if the socket dies
         self._client.set_last_will(
@@ -87,6 +89,12 @@ class MqttS3CommManager(BaseCommunicationManager):
 
     def _status_topic(self) -> str:
         return f"fedml/{self.run_id}/status"
+
+    @property
+    def reconnect_count(self) -> int:
+        """Broker redials since start (in-repo client; paho reconnects inside
+        its own network loop and reports none here)."""
+        return int(getattr(self._client, "reconnects", 0) or 0)
 
     # -- BaseCommunicationManager -------------------------------------------
     def send_message(self, msg: Message) -> None:

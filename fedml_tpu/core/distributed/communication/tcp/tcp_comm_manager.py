@@ -48,25 +48,51 @@ class TCPCommManager(BaseCommunicationManager):
         self.ip_table = {int(k): str(v) for k, v in (ip_table or {}).items()}
         self.connect_retries = int(connect_retries)
         self.retry_interval_s = float(retry_interval_s)
+        self.bind_host = bind_host
+        self.reconnect_count = 0  # connect retries + listener rebinds
         self._observers: List[Observer] = []
         self._inbox: "queue.Queue" = queue.Queue()
         self._running = False
+        self._closed = False
 
-        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._server.bind((bind_host, self.base_port + self.rank))
-        self._server.listen(16)
+        self._server = self._bind_listener()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True,
                                                name=f"tcp-accept-{self.rank}")
         self._accept_thread.start()
 
     # -- transport ----------------------------------------------------------
+    def _bind_listener(self) -> socket.socket:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.bind_host, self.base_port + self.rank))
+        s.listen(16)
+        return s
+
     def _accept_loop(self) -> None:
         while True:
             try:
                 conn, _ = self._server.accept()
             except OSError:
-                return  # socket closed
+                if self._closed:
+                    return  # deliberate shutdown
+                # the listener died under us (not a stop): rebind with a
+                # bounded retry so one socket hiccup doesn't deafen the rank
+                for attempt in range(self.connect_retries):
+                    try:
+                        self._server = self._bind_listener()
+                        self.reconnect_count += 1
+                        logger.warning("tcp rank %s: listener died; rebound "
+                                       "after %d attempts", self.rank, attempt + 1)
+                        break
+                    except OSError:
+                        if self._closed:
+                            return
+                        time.sleep(self.retry_interval_s)
+                else:
+                    logger.error("tcp rank %s: could not rebind listener; "
+                                 "receive path is dead", self.rank)
+                    return
+                continue
             threading.Thread(target=self._recv_one, args=(conn,), daemon=True).start()
 
     def _recv_one(self, conn: socket.socket) -> None:
@@ -113,9 +139,13 @@ class TCPCommManager(BaseCommunicationManager):
             try:
                 with socket.create_connection(addr, timeout=30) as s:
                     s.sendall(struct.pack("<Q", len(payload)) + payload)
+                if attempt > 0:
+                    self.reconnect_count += 1
                 return
             except (ConnectionRefusedError, socket.timeout, OSError) as e:
-                # peer process may not have bound its port yet (startup race)
+                # peer process may not have bound its port yet (startup race),
+                # or died and is rejoining — fresh-connection-per-send means
+                # every retry IS a reconnect
                 last_err = e
                 time.sleep(self.retry_interval_s)
         raise ConnectionError(f"tcp rank {self.rank}: cannot reach rank {receiver} at {addr}") from last_err
@@ -136,6 +166,7 @@ class TCPCommManager(BaseCommunicationManager):
             if item is _STOP:
                 break
             self._notify(item)
+        self._closed = True
         try:
             self._server.close()
         except OSError:
@@ -143,6 +174,7 @@ class TCPCommManager(BaseCommunicationManager):
 
     def stop_receive_message(self) -> None:
         self._running = False
+        self._closed = True
         self._inbox.put(_STOP)
 
     def _notify(self, msg: Message) -> None:

@@ -1,0 +1,300 @@
+"""Deterministic, seedable transport fault injection.
+
+The reference framework has no fault story at all: FedML's server blocks a
+round forever on a dead client and its MQTT/gRPC clients have no reconnect
+path (SURVEY.md §5).  This module makes transport faults a *tested,
+first-class input* — the way Parrot treats client heterogeneity as a
+scheduling input (arXiv:2303.01778) and Prime CCL treats link failure as a
+normal collective event to retry around (arXiv:2505.14065).
+
+One seam, every backend: :class:`FaultyCommManager` wraps any
+:class:`~.communication.base_com_manager.BaseCommunicationManager`
+(LOOPBACK / TCP / GRPC / MQTT_S3) and consults a :class:`FaultPlan` on each
+send and each delivery.  The node runtime
+(:mod:`~fedml_tpu.core.distributed.comm_manager`) installs the wrapper when
+``args.fault_plan`` is set, so the four transports are exercised by the
+*same* scripted plan — chaos runs differ from clean runs only in config.
+
+Fault-plan schema (dict / YAML ``fault_args`` section)::
+
+    fault_plan:
+      seed: 0                      # seeds per-rule probability draws
+      rules:
+        - kind: drop               # drop|delay|duplicate|reset|partition
+          direction: send          # send (default) or recv
+          sender: 1                # int or list; omit = any
+          receiver: 0              # int or list; omit = any
+          msg_type: 3              # compared as str; int or list; omit = any
+          round: 1                 # int or [lo, hi]; omit = any (untagged
+                                   #   messages only match when omitted)
+          after: 0                 # skip the first N scope-matching messages
+          times: 1                 # then affect the next N (null = forever;
+                                   #   partition defaults to forever)
+          p: 1.0                   # probability, seeded & per-rule
+          delay_s: 0.05            # kind=delay only
+
+Kinds:
+
+* ``drop`` — the message silently vanishes (in-flight loss).
+* ``delay`` — delivery is deferred ``delay_s`` on a timer thread (messages
+  may reorder, exactly like a congested network path).
+* ``duplicate`` — the message goes through twice (the receive-side dedup
+  must make this invisible).
+* ``reset`` — a send raises :class:`ConnectionError` (peer RST); on the
+  recv direction it degrades to a drop (the frame died with the socket).
+* ``partition`` — a standing one-way ``drop`` (A can talk to B while B's
+  frames to A vanish) — scope it with sender/receiver/round.
+
+Determinism: rules match by *occurrence count within their scope*
+(``after``/``times``), not wall-clock, so the same plan injects the same
+faults on every backend and every run; ``p`` draws come from
+``random.Random(f"{seed}:{rank}:{rule_index}")`` so even probabilistic
+plans replay exactly.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from .communication.base_com_manager import BaseCommunicationManager, Observer
+from .communication.message import Message
+
+logger = logging.getLogger(__name__)
+
+FAULT_KINDS = ("drop", "delay", "duplicate", "reset", "partition")
+
+# local pseudo-messages a backend synthesizes for itself are never faulted
+_EXEMPT_TYPES = ("connection_ready",)
+
+
+class CommStats:
+    """Thread-safe counter bag shared by the reliability layer and the fault
+    injector; ``snapshot()`` is what the mlops ``comm_stats`` record carries."""
+
+    _KEYS = (
+        "messages_sent", "retries", "retransmits", "delivery_failures",
+        "acks_sent", "acks_received", "dup_dropped",
+        "faults_dropped", "faults_delayed", "faults_duplicated",
+        "faults_reset", "reconnects", "rejoins",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {k: 0 for k in self._KEYS}
+
+    def inc(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+def _as_set(v: Any) -> Optional[set]:
+    if v is None:
+        return None
+    if isinstance(v, (list, tuple, set)):
+        return {str(x) for x in v}
+    return {str(v)}
+
+
+class FaultRule:
+    def __init__(self, spec: Dict[str, Any], index: int):
+        kind = str(spec.get("kind", "")).lower()
+        if kind not in FAULT_KINDS:
+            raise ValueError(f"fault rule {index}: unknown kind {kind!r} "
+                             f"(one of {FAULT_KINDS})")
+        self.kind = kind
+        self.index = index
+        self.direction = str(spec.get("direction", "send")).lower()
+        if self.direction not in ("send", "recv"):
+            raise ValueError(f"fault rule {index}: direction must be "
+                             f"send|recv, got {self.direction!r}")
+        self.sender = _as_set(spec.get("sender"))
+        self.receiver = _as_set(spec.get("receiver"))
+        self.msg_type = _as_set(spec.get("msg_type"))
+        rnd = spec.get("round")
+        if rnd is None:
+            self.round: Optional[Sequence[int]] = None
+        elif isinstance(rnd, (list, tuple)):
+            self.round = (int(rnd[0]), int(rnd[1]))
+        else:
+            self.round = (int(rnd), int(rnd))
+        self.after = int(spec.get("after", 0))
+        times = spec.get("times", None if kind == "partition" else 1)
+        self.times = None if times is None else int(times)
+        self.p = float(spec.get("p", 1.0))
+        self.delay_s = float(spec.get("delay_s", 0.05))
+
+    def matches_scope(self, direction: str, msg: Message) -> bool:
+        if direction != self.direction:
+            return False
+        if self.sender is not None and str(msg.get_sender_id()) not in self.sender:
+            return False
+        if self.receiver is not None and str(msg.get_receiver_id()) not in self.receiver:
+            return False
+        if self.msg_type is not None and msg.get_type() not in self.msg_type:
+            return False
+        if self.round is not None:
+            tag = msg.get("round_idx")
+            if tag is None:
+                return False
+            lo, hi = self.round
+            if not (lo <= int(tag) <= hi):
+                return False
+        return True
+
+
+class FaultPlan:
+    """Parsed plan; hand each endpoint its own :class:`FaultInjector` (fresh
+    occurrence counters + seeded RNG) via :meth:`injector`."""
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.rules = rules
+        self.seed = int(seed)
+
+    @classmethod
+    def from_dict(cls, spec: Dict[str, Any]) -> "FaultPlan":
+        if isinstance(spec, FaultPlan):
+            return spec
+        rules = [FaultRule(r, i) for i, r in enumerate(spec.get("rules", []))]
+        return cls(rules, seed=int(spec.get("seed", 0)))
+
+    def injector(self, rank: int) -> "FaultInjector":
+        return FaultInjector(self, int(rank))
+
+
+class FaultInjector:
+    def __init__(self, plan: FaultPlan, rank: int):
+        self.plan = plan
+        self.rank = rank
+        self._lock = threading.Lock()
+        self._match_counts: Dict[int, int] = {r.index: 0 for r in plan.rules}
+        self._rngs: Dict[int, random.Random] = {
+            r.index: random.Random(f"{plan.seed}:{rank}:{r.index}") for r in plan.rules
+        }
+
+    def decide(self, direction: str, msg: Message) -> Optional[FaultRule]:
+        """First rule whose scope + occurrence window + probability hit."""
+        if msg.get_type() in _EXEMPT_TYPES:
+            return None
+        for rule in self.plan.rules:
+            if not rule.matches_scope(direction, msg):
+                continue
+            with self._lock:
+                n = self._match_counts[rule.index]
+                self._match_counts[rule.index] = n + 1
+                if n < rule.after:
+                    continue
+                if rule.times is not None and n >= rule.after + rule.times:
+                    continue
+                if rule.p < 1.0 and self._rngs[rule.index].random() >= rule.p:
+                    continue
+            return rule
+        return None
+
+
+class FaultyCommManager(BaseCommunicationManager, Observer):
+    """The injection seam: sits between the node runtime and any backend.
+
+    Sends pass :meth:`send_message`; deliveries pass :meth:`receive_message`
+    (this wrapper registers itself as the backend's sole observer and
+    re-notifies its own observers), so one plan covers both directions of
+    all four transports.
+    """
+
+    def __init__(self, inner: BaseCommunicationManager, injector: FaultInjector,
+                 stats: Optional[CommStats] = None):
+        self._inner = inner
+        self._injector = injector
+        self._stats = stats if stats is not None else CommStats()
+        self._observers: List[Observer] = []
+        inner.add_observer(self)
+
+    # delegate everything the contract doesn't cover (broadcast,
+    # broadcast_status, reconnect counters, ...) to the wrapped backend
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    # -- send path -----------------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        rule = self._injector.decide("send", msg)
+        if rule is None:
+            self._inner.send_message(msg)
+            return
+        self._apply(rule, msg, self._inner.send_message, "send")
+
+    # -- receive path --------------------------------------------------------
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        rule = self._injector.decide("recv", msg)
+        if rule is None:
+            self._notify(msg)
+            return
+        self._apply(rule, msg, self._notify, "recv")
+
+    def _apply(self, rule: FaultRule, msg: Message, forward, direction: str) -> None:
+        kind = rule.kind
+        if kind in ("drop", "partition") or (kind == "reset" and direction == "recv"):
+            self._stats.inc("faults_dropped")
+            logger.info("FAULT %s: dropping %s %s->%s", kind, msg.get_type(),
+                        msg.get_sender_id(), msg.get_receiver_id())
+            return
+        if kind == "reset":
+            self._stats.inc("faults_reset")
+            logger.info("FAULT reset: %s %s->%s", msg.get_type(),
+                        msg.get_sender_id(), msg.get_receiver_id())
+            raise ConnectionError(
+                f"fault-injected connection reset (rule {rule.index})"
+            )
+        if kind == "duplicate":
+            self._stats.inc("faults_duplicated")
+            logger.info("FAULT duplicate: %s %s->%s", msg.get_type(),
+                        msg.get_sender_id(), msg.get_receiver_id())
+            forward(msg)
+            forward(msg)
+            return
+        if kind == "delay":
+            self._stats.inc("faults_delayed")
+            logger.info("FAULT delay %.3fs: %s %s->%s", rule.delay_s,
+                        msg.get_type(), msg.get_sender_id(), msg.get_receiver_id())
+
+            def _later():
+                try:
+                    forward(msg)
+                except Exception:
+                    logger.exception("delayed %s forward failed", direction)
+
+            t = threading.Timer(rule.delay_s, _later)
+            t.daemon = True
+            t.start()
+            return
+        raise AssertionError(f"unhandled fault kind {kind!r}")  # pragma: no cover
+
+    # -- BaseCommunicationManager --------------------------------------------
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        self._inner.stop_receive_message()
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            try:
+                obs.receive_message(msg.get_type(), msg)
+            except Exception:
+                logger.exception("fault seam: observer for %r raised", msg.get_type())

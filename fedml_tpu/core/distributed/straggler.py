@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -51,6 +51,41 @@ class RoundTimeoutMixin:
         # set on the first timeout-close: only from then on can a stale
         # upload exist (every earlier round closed with its full cohort)
         self._had_timeout_close = False
+        # client_id -> incarnation epoch from its last ONLINE (None until a
+        # client reports one); an epoch CHANGE after init = mid-run rejoin
+        self._client_epochs: Dict[int, str] = {}
+        self.rejoin_count = 0
+
+    # -- rejoin ---------------------------------------------------------------
+    def _note_client_online(self, sender: int, epoch) -> bool:
+        """(lock held) Record an ONLINE report; return True when it is a
+        mid-run REJOIN that the host manager must answer with a resync of the
+        current round (``_resync_rejoined_client``).
+
+        A rejoin is: the run is already initialized AND the client reports an
+        incarnation epoch that is new (its pre-crash ONLINE may have predated
+        the server, so an unknown epoch after init also counts) or different
+        from the one we knew.  The same incarnation re-reporting ONLINE (the
+        handshake's double-send, a late CHECK reply) is NOT a rejoin.  Legacy
+        epoch-less clients never trigger a resync — the reference wire keeps
+        its reference semantics."""
+        prev = self._client_epochs.get(int(sender))
+        if epoch is not None:
+            self._client_epochs[int(sender)] = str(epoch)
+        self.client_online_status[int(sender)] = True
+        if not self.is_initialized or epoch is None:
+            return False
+        if prev is not None and str(epoch) == prev:
+            return False
+        self.rejoin_count += 1
+        stats = getattr(self, "_comm_stats", None)
+        if stats is not None:
+            stats.inc("rejoins")
+        logger.warning(
+            "client %s REJOINED mid-run (epoch %s -> %s): resyncing round %d",
+            sender, prev, epoch, self.args.round_idx,
+        )
+        return True
 
     # -- sends ---------------------------------------------------------------
     def _send_safe(self, m) -> None:

@@ -28,7 +28,8 @@ __all__ = [
     "WandbSink",
     "pre_setup", "init", "finish", "event", "log", "log_round_info",
     "log_training_status", "log_aggregation_status", "log_sys_perf",
-    "log_aggregated_model_info", "log_client_model_info", "enabled", "sink",
+    "log_aggregated_model_info", "log_client_model_info", "log_comm_stats",
+    "enabled", "sink",
 ]
 
 _lock = threading.Lock()
@@ -154,6 +155,15 @@ def log_aggregation_status(status: str) -> None:
     if not enabled():
         return
     _ctx["metrics"].report_server_training_status(status)
+
+
+def log_comm_stats(stats: Dict[str, Any], rank: Optional[int] = None) -> None:
+    """Transport reliability counters (retries, retransmits, dup_dropped,
+    reconnects, rejoins, fault injections) — emitted by every node runtime's
+    ``finish()`` so chaos runs are observable, not just green."""
+    if not enabled():
+        return
+    _ctx["metrics"].report_comm_stats(stats, rank=rank)
 
 
 def log_sys_perf(stats: Optional[Dict[str, Any]] = None) -> None:

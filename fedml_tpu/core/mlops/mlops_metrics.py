@@ -49,6 +49,14 @@ class MLOpsMetrics:
     def report_client_model_info(self, round_idx: int, model_url: str) -> None:
         self._emit("client_model", {"round_idx": round_idx, "model_url": model_url})
 
+    # -- transport reliability ---------------------------------------------
+    def report_comm_stats(self, stats: Dict[str, Any], rank: Optional[int] = None) -> None:
+        """Retry/retransmit/dedup/reconnect/rejoin counters from the node
+        runtime's reliability layer — what makes a chaos run observable
+        rather than just green."""
+        self._emit("comm_stats", {"rank": self.edge_id if rank is None else int(rank),
+                                  **dict(stats)})
+
     # -- system ------------------------------------------------------------
     def report_sys_perf(self, stats: Optional[Dict[str, Any]] = None) -> None:
         if stats is None:

@@ -196,6 +196,7 @@ def reveal_labels_from_update(
     client_update: Pytree,
     num_classes: int,
     lr_client: float = 0.1,
+    head_path=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Label revelation from an intercepted UPDATE (the simulator-facing
     wrapper over :func:`reveal_labels_from_gradients`): locate the classifier
@@ -204,26 +205,50 @@ def reveal_labels_from_update(
     and the boolean negative-entry mask (classes the iDLG heuristic says
     were in the batch).
 
-    Head lookup: among ``(num_classes,)``-shaped leaves, prefer those whose
-    tree path names a bias (a hidden layer of width == num_classes would
-    otherwise shadow the head), then take the LAST such leaf (flax orders
-    the output layer last)."""
-    prev_paths = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
-    new_leaves = jax.tree_util.tree_leaves(client_update["params"])
-    candidates = []
-    for (path, p), q in zip(prev_paths, new_leaves):
+    ``head_path`` names the classifier-head bias explicitly (mirroring the
+    defender-side ``soteria_layer`` knob): a key tuple like
+    ``("Dense_2", "bias")`` or the ``"/"``-joined string ``"Dense_2/bias"``.
+    PASS IT for models of ten or more layers: the fallback heuristic walks
+    leaves in pytree flatten order, which sorts keys LEXICOGRAPHICALLY —
+    ``Dense_10`` < ``Dense_2`` — so "last bias" stops being the output layer
+    once double-digit layer names appear.
+
+    Heuristic fallback (``head_path=None``): among ``(num_classes,)``-shaped
+    leaves, prefer those whose tree path names a bias (a hidden layer of
+    width == num_classes would otherwise shadow the head), then take the
+    LAST such leaf (flax orders the output layer last — for models under ten
+    layers, where sorted order and definition order agree)."""
+    if head_path is not None:
+        keys = tuple(head_path.split("/")) if isinstance(head_path, str) else tuple(head_path)
+        p, q = variables["params"], client_update["params"]
+        try:
+            for k in keys:
+                p, q = p[k], q[k]
+        except (KeyError, IndexError, TypeError):
+            raise ValueError(f"head_path {head_path!r} not found in the params tree")
+        p, q = jnp.asarray(p), jnp.asarray(q)
         if p.shape != (num_classes,):
-            continue
-        names = "/".join(str(getattr(k, "key", k)) for k in path).lower()
-        candidates.append(("bias" in names, p, q))
-    if not candidates:
-        raise ValueError(
-            f"no ({num_classes},) bias leaf in the params tree — cannot "
-            "locate the classifier head for label revelation"
-        )
-    has_bias = any(is_bias for is_bias, _, _ in candidates)
-    p, q = [(p, q) for is_bias, p, q in candidates
-            if is_bias or not has_bias][-1]
+            raise ValueError(
+                f"head_path {head_path!r} leaf has shape {p.shape}, expected "
+                f"({num_classes},) — it must name the classifier-head BIAS"
+            )
+    else:
+        prev_paths = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+        new_leaves = jax.tree_util.tree_leaves(client_update["params"])
+        candidates = []
+        for (path, pl), ql in zip(prev_paths, new_leaves):
+            if pl.shape != (num_classes,):
+                continue
+            names = "/".join(str(getattr(k, "key", k)) for k in path).lower()
+            candidates.append(("bias" in names, pl, ql))
+        if not candidates:
+            raise ValueError(
+                f"no ({num_classes},) bias leaf in the params tree — cannot "
+                "locate the classifier head for label revelation"
+            )
+        has_bias = any(is_bias for is_bias, _, _ in candidates)
+        p, q = [(pl, ql) for is_bias, pl, ql in candidates
+                if is_bias or not has_bias][-1]
     bias_grad = (p.astype(jnp.float32) - q.astype(jnp.float32)) / lr_client
     return reveal_labels_from_gradients(bias_grad), bias_grad < 0
 

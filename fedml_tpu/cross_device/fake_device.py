@@ -118,7 +118,12 @@ class FakeDeviceManager(FedMLCommManager):
                  client_num: int, backend: str = "LOOPBACK", upload_dir: Optional[str] = None,
                  use_native: bool = False):
         super().__init__(args, None, rank, client_num + 1, backend)
+        import uuid
+
         self.x, self.y = train_data
+        # per-incarnation epoch: lets the server tell a rejoined device from
+        # a duplicate ONLINE and resync it with the current round's model
+        self.client_epoch = uuid.uuid4().hex[:8]
         self.upload_dir = upload_dir or tempfile.mkdtemp(prefix=f"fedml_tpu_dev{rank}_")
         os.makedirs(self.upload_dir, exist_ok=True)
         self.rounds_trained = 0
@@ -140,6 +145,10 @@ class FakeDeviceManager(FedMLCommManager):
                 save_edge_model(self._data_path_4d, {"x": x, "y": y32})
 
     def register_message_receive_handlers(self) -> None:
+        # announce ONLINE on our own connect too (not only when probed): a
+        # device that rejoins mid-run gets no fresh CHECK from the server —
+        # its self-announcement with a new epoch is what triggers the resync
+        self.register_message_receive_handler("connection_ready", self._on_check_status)
         self.register_message_receive_handler(
             MNNMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self._on_check_status
         )
@@ -156,6 +165,7 @@ class FakeDeviceManager(FedMLCommManager):
     def _on_check_status(self, msg: Message) -> None:
         m = Message(MNNMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
         m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_STATUS, MNNMessage.CLIENT_STATUS_ONLINE)
+        m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_EPOCH, self.client_epoch)
         self.send_message(m)
 
     def _on_model(self, msg: Message) -> None:

@@ -60,8 +60,28 @@ class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
     def _on_client_status(self, msg: Message) -> None:
         with self._round_lock:
             if msg.get(MNNMessage.MSG_ARG_KEY_CLIENT_STATUS) == MNNMessage.CLIENT_STATUS_ONLINE:
-                self.client_online_status[int(msg.get_sender_id())] = True
+                sender = int(msg.get_sender_id())
+                if self._note_client_online(sender, msg.get(MNNMessage.MSG_ARG_KEY_CLIENT_EPOCH)):
+                    self._resync_rejoined_client(sender)
             self._handshake_check()
+
+    def _resync_rejoined_client(self, client_id: int) -> None:
+        """(lock held) A device that dropped and came back gets the current
+        round's model file immediately — on a phone fleet, churn is the norm
+        and waiting for the run to end would waste every rejoining device."""
+        if self._finished:
+            self._send_safe(Message(MNNMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
+            return
+        if client_id not in self.client_id_list_in_this_round:
+            return
+        if self.client_id_list_in_this_round.index(client_id) in self.aggregator.received_indices():
+            return
+        model_file = self.aggregator.get_global_model_params_file(self.args.round_idx)
+        m = Message(MNNMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
+        m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, model_file)
+        m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_INDEX, client_id - 1)
+        m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+        self._send_safe(m)
 
     def send_init_msg(self) -> None:
         self._send_round(MNNMessage.MSG_TYPE_S2C_INIT_CONFIG)

@@ -12,6 +12,7 @@ host-plane ProcessGroup (see trainer_dist_adapter.py).
 from __future__ import annotations
 
 import logging
+import uuid
 
 from ...core.distributed.comm_manager import FedMLCommManager
 from ...core.distributed.communication.message import Message
@@ -28,6 +29,10 @@ class ClientMasterManager(FedMLCommManager):
         self.round_idx = 0
         self.rank = int(rank)
         self.has_sent_online_msg = False
+        # incarnation epoch: fresh per manager instance, carried in every
+        # ONLINE status — the server detects a mid-run crash-and-rejoin by
+        # the epoch CHANGE and resyncs this silo with the current round
+        self.client_epoch = uuid.uuid4().hex[:8]
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler("connection_ready", self.handle_message_connection_ready)
@@ -88,6 +93,7 @@ class ClientMasterManager(FedMLCommManager):
     def send_client_status(self, receive_id: int, status: str) -> None:
         m = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, receive_id)
         m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
+        m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_EPOCH, self.client_epoch)
         self.send_message(m)
 
     def send_model_to_server(self, receive_id: int, weights, local_sample_num) -> None:

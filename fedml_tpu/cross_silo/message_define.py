@@ -28,6 +28,12 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_CLIENT_STATUS = "client_status"
     MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+    # reliability protocol (beyond-reference, additive): transport-level
+    # message id for ack/dedup, and the client's per-incarnation epoch nonce
+    # carried in ONLINE status — an epoch change after init marks a mid-run
+    # rejoin that the server answers with a current-round model resync
+    MSG_ARG_KEY_MSG_ID = "msg_id"
+    MSG_ARG_KEY_CLIENT_EPOCH = "client_epoch"
 
     MSG_ARG_KEY_TRAIN_CORRECT = "train_correct"
     MSG_ARG_KEY_TRAIN_ERROR = "train_error"

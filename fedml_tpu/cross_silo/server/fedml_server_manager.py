@@ -68,12 +68,34 @@ class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
     def handle_message_client_status_update(self, msg: Message) -> None:
         status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
         sender = int(msg.get_sender_id())
+        epoch = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_EPOCH)
         with self._round_lock:
             if status == MyMessage.CLIENT_STATUS_ONLINE:
-                self.client_online_status[sender] = True
+                if self._note_client_online(sender, epoch):
+                    self._resync_rejoined_client(sender)
             logger.info("client %s status=%s (%d/%d online)", sender, status,
                         sum(self.client_online_status.values()), self.client_num)
             self._handshake_check()
+
+    def _resync_rejoined_client(self, client_id: int) -> None:
+        """(lock held) A silo died and came back mid-run: hand it the current
+        round's model so it rejoins THIS round instead of being ignored until
+        the run ends (the reference behavior this layer replaces)."""
+        if self._finished:
+            # run is over — release the rejoined silo instead of leaving it
+            # waiting for a FINISH that already went to its dead predecessor
+            self._send_safe(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
+            return
+        if client_id not in self.client_id_list_in_this_round:
+            return  # sitting this round out; selection may pick it up later
+        pos = self.client_id_list_in_this_round.index(client_id)
+        if pos in self.aggregator.received_indices():
+            return  # its upload already landed; the round-close sync suffices
+        m = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
+        m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.aggregator.get_global_model_params())
+        m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
+        m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+        self._send_safe(m)
 
     def send_init_msg(self) -> None:
         """Round-0 kick-off (reference send_message_init_config :182)."""

@@ -28,6 +28,12 @@ public enum MessageDefine {
     public static let MSG_ARG_KEY_CLIENT_STATUS = "client_status"
     public static let MSG_ARG_KEY_ROUND_INDEX = "round_idx"
 
+    // reliability headers (additive wire change): per-incarnation message id
+    // ("rank:nonce:seq") for ack/dedup, and the client incarnation epoch the
+    // server uses to recognise a mid-run rejoin and resync the model
+    public static let MSG_ARG_KEY_MSG_ID = "msg_id"
+    public static let MSG_ARG_KEY_CLIENT_EPOCH = "client_epoch"
+
     public static let MSG_ARG_KEY_TRAIN_CORRECT = "train_correct"
     public static let MSG_ARG_KEY_TRAIN_ERROR = "train_error"
     public static let MSG_ARG_KEY_TRAIN_NUM = "train_num_sample"

@@ -3,13 +3,20 @@ the reference server blocks a round forever on a dead client — SURVEY.md §5
 'failure detection').  With ``round_timeout_s`` set, a silo that goes
 silent after its ONLINE handshake must not wedge training: the server
 closes each round with the cohort that responded and drops stale uploads
-by round tag."""
+by round tag.
+
+Plus the chaos suite for the self-healing transport layer: scripted,
+seeded fault plans (drop / delay / duplicate / reset / crash-and-rejoin)
+injected at the transport seam, after which every backend must complete
+all rounds and converge to the BIT-IDENTICAL final model of a fault-free
+run — faults may cost retries, never correctness."""
 
 from __future__ import annotations
 
 import threading
 import time
 
+import numpy as np
 import pytest
 
 import fedml_tpu
@@ -273,3 +280,460 @@ class TestStaleUploadPolicy:
         # a tagged client never regresses: the tag check is independent of
         # the timer knob
         assert self._mixin(0)._is_stale_upload(2, sender=1) is True
+
+
+# ---------------------------------------------------------------------------
+# Chaos suite: the self-healing transport layer under scripted fault plans
+# ---------------------------------------------------------------------------
+
+# knobs every chaos run uses: retries ON (the layer under test), small
+# backoffs so recovery fits a unit-test budget
+_CHAOS_KNOBS = dict(
+    comm_max_retries=5,
+    comm_backoff_base_s=0.05,
+    comm_backoff_max_s=0.3,
+)
+
+
+def _full_chaos_plan():
+    """One plan exercising every fault kind (crash-and-rejoin is scripted by
+    the harness, not the plan): msg_type 2 = SYNC_MODEL, 3 = model upload."""
+    return {
+        "seed": 7,
+        "rules": [
+            # in-flight loss of a model sync: healed by ack/retransmit
+            {"kind": "drop", "direction": "send", "sender": 0, "receiver": 3,
+             "msg_type": 2, "round": 1, "times": 1},
+            # peer RST on an upload: healed by the synchronous send retry
+            {"kind": "reset", "direction": "send", "sender": 2, "msg_type": 3,
+             "round": 0, "times": 1},
+            # duplicated upload: receive-side dedup must make it invisible
+            {"kind": "duplicate", "direction": "send", "sender": 3,
+             "msg_type": 3, "round": 0, "times": 1},
+            # congested path: a late sync must not corrupt the round
+            {"kind": "delay", "direction": "send", "sender": 0, "receiver": 2,
+             "msg_type": 2, "round": 1, "times": 1, "delay_s": 0.05},
+        ],
+    }
+
+
+def _trees_bit_identical(a, b) -> bool:
+    import jax
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def _run_chaos_topology(run_id, backend="LOOPBACK", n=3, fault_plan=None,
+                        crash_rank=None, comm_extra=None, knobs=None):
+    """1 server + ``n`` silos over ``backend``; optionally a scripted hard
+    crash of silo ``crash_rank`` right after its round-0 upload, followed by
+    a fresh incarnation that must rejoin mid-run.  Returns
+    ``(history, final_model_params, {rank: comm_stats})``."""
+    extra = dict(knobs if knobs is not None else _CHAOS_KNOBS)
+    if fault_plan is not None:
+        extra["fault_plan"] = fault_plan
+    comm_extra = comm_extra or {}
+
+    def mk_args(rank, role):
+        a = _args(run_id, n, **extra)
+        for k, v in comm_extra.items():
+            setattr(a, k, v)
+        a.backend = backend
+        a.role, a.rank = role, rank
+        return fedml_tpu.init(a, should_init_logs=False)
+
+    from fedml_tpu.cross_silo.client.client import Client
+    from fedml_tpu.cross_silo.server.server import Server
+
+    args_s = mk_args(0, "server")
+    ds, out_dim = fedml_tpu.data.load(args_s)
+    server = Server(args_s, None, ds, fedml_tpu.models.create(args_s, out_dim))
+
+    def build_client(rank):
+        a = mk_args(rank, "client")
+        ds_c, od = fedml_tpu.data.load(a)
+        return Client(a, None, ds_c, fedml_tpu.models.create(a, od))
+
+    clients = {r: build_client(r) for r in range(1, n + 1)}
+
+    if crash_rank is not None:
+        mgr = clients[crash_rank].manager
+        orig_send = mgr.send_model_to_server
+
+        def crash_send(receive_id, weights, n_samples, _mgr=mgr, _orig=orig_send):
+            _orig(receive_id, weights, n_samples)
+            if _mgr.round_idx == 0:
+                _mgr.finish()  # hard death: transport torn down, no FINISH
+
+        mgr.send_model_to_server = crash_send
+
+    threads = {r: threading.Thread(target=c.run, daemon=True)
+               for r, c in clients.items()}
+    for t in threads.values():
+        t.start()
+
+    rejoin_err = []
+
+    def rejoin():
+        try:
+            threads[crash_rank].join(timeout=90)
+            assert not threads[crash_rank].is_alive(), \
+                "crash incarnation did not exit"
+            if backend == "LOOPBACK":
+                # the crash analog for the queue transport: in-flight frames
+                # die and the rejoined incarnation gets a fresh mailbox
+                LoopbackHub.sever(run_id, crash_rank)
+            c2 = None
+            for _ in range(40):  # dead incarnation's port may still be freeing
+                try:
+                    c2 = build_client(crash_rank)
+                    break
+                except OSError:
+                    time.sleep(0.25)
+            assert c2 is not None, "rejoined incarnation could not rebind"
+            clients[crash_rank] = c2
+            threads[crash_rank] = threading.Thread(target=c2.run, daemon=True)
+            threads[crash_rank].start()
+        except BaseException as e:  # surfaced by the main thread below
+            rejoin_err.append(e)
+
+    rejoin_thread = None
+    if crash_rank is not None:
+        rejoin_thread = threading.Thread(target=rejoin, daemon=True)
+        rejoin_thread.start()
+
+    try:
+        history = _run_server_bounded(server)
+    finally:
+        if rejoin_err:
+            raise rejoin_err[0]
+    if rejoin_thread is not None:
+        rejoin_thread.join(timeout=120)
+        if rejoin_err:
+            raise rejoin_err[0]
+    _join_all(list(threads.values()))
+
+    final = server.server_manager.aggregator.get_global_model_params()
+    stats = {0: server.server_manager.comm_stats_snapshot()}
+    for r, c in clients.items():
+        stats[r] = c.manager.comm_stats_snapshot()
+    return history, final, stats
+
+
+@pytest.fixture(scope="module")
+def fault_free_final_model():
+    """The fault-free reference run every chaos run must bit-match (shared
+    across the matrix: the final model is a pure function of config, not of
+    transport weather — that is the claim under test)."""
+    LoopbackHub.reset()
+    history, final, _ = _run_chaos_topology("chaos-base", knobs={})
+    assert len(history) == 2
+    return final
+
+
+def test_chaos_full_plan_converges_bit_identical(fault_free_final_model):
+    """The acceptance run: one LOOPBACK topology absorbing >=1 drop, >=1
+    duplicate, >=1 reset, >=1 delay AND a crash-and-rejoin, finishing all
+    rounds with the bit-identical final model of the fault-free run, with
+    every recovery visible in the exported counters."""
+    from fedml_tpu.core import mlops
+    from fedml_tpu.core.mlops import FanoutSink, InMemorySink
+
+    mem = InMemorySink()
+
+    class _A:
+        run_id, rank = "chaos-full", 0
+
+    mlops.init(_A(), FanoutSink([mem]))
+    try:
+        history, final, stats = _run_chaos_topology(
+            "chaos-full", fault_plan=_full_chaos_plan(), crash_rank=1)
+        assert len(history) == 2
+        assert _trees_bit_identical(final, fault_free_final_model), \
+            "chaos run diverged from the fault-free model"
+        srv = stats[0]
+        assert srv["rejoins"] >= 1          # crash-and-rejoin detected
+        assert srv["faults_dropped"] >= 1   # drop rule fired...
+        assert srv["retransmits"] >= 1      # ...and was healed by retransmit
+        assert srv["faults_delayed"] >= 1
+        assert srv["dup_dropped"] >= 1      # duplicate was deduped
+        assert srv["acks_sent"] > 0 and srv["acks_received"] > 0
+        assert stats[2]["faults_reset"] >= 1
+        assert stats[2]["retries"] >= 1     # reset healed by sync send retry
+        assert stats[3]["faults_duplicated"] >= 1
+        # the counters are exported through the mlops sink at finish()
+        recs = mem.by_topic("comm_stats")
+        assert any(r.get("rank") == 0 and r.get("rejoins", 0) >= 1 for r in recs)
+        assert any(r.get("rank") == 2 and r.get("retries", 0) >= 1 for r in recs)
+    finally:
+        mlops.finish()
+
+
+_MATRIX_PLANS = {
+    "drop": {"seed": 3, "rules": [
+        {"kind": "drop", "direction": "send", "sender": 0, "receiver": 2,
+         "msg_type": 2, "round": 1, "times": 1}]},
+    "duplicate": {"seed": 3, "rules": [
+        {"kind": "duplicate", "direction": "send", "sender": 1,
+         "msg_type": 3, "round": 0, "times": 1}]},
+    "delay": {"seed": 3, "rules": [
+        {"kind": "delay", "direction": "send", "sender": 0, "receiver": 1,
+         "msg_type": 2, "round": 1, "times": 1, "delay_s": 0.05}]},
+    "reset": {"seed": 3, "rules": [
+        {"kind": "reset", "direction": "send", "sender": 2, "msg_type": 3,
+         "round": 0, "times": 1}]},
+}
+
+_MATRIX_COUNTER = {  # (rank whose stats carry it, counter, injected-counter)
+    "drop": (0, "retransmits", "faults_dropped"),
+    "duplicate": (0, "dup_dropped", None),
+    "delay": (0, "faults_delayed", None),
+    "reset": (2, "retries", "faults_reset"),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(_MATRIX_PLANS))
+def test_chaos_matrix_loopback(kind, fault_free_final_model):
+    """Single-fault matrix over the in-process transport (the fast tier-1
+    slice of the cross-backend matrix below)."""
+    history, final, stats = _run_chaos_topology(
+        f"chaos-m-{kind}", fault_plan=_MATRIX_PLANS[kind])
+    assert len(history) == 2
+    assert _trees_bit_identical(final, fault_free_final_model)
+    rank, counter, injected = _MATRIX_COUNTER[kind]
+    assert stats[rank][counter] >= 1, (kind, stats[rank])
+    if injected is not None:
+        # dup/delay are observed on the injecting sender's own stats instead
+        src = 0 if kind == "drop" else rank
+        assert stats[src][injected] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["TRPC", "GRPC", "MQTT_S3"])
+def test_chaos_full_plan_all_backends(backend, fault_free_final_model, tmp_path):
+    """The same scripted plan + crash-and-rejoin over every socketed
+    backend: recovery must be transport-independent AND the final model must
+    bit-match the (loopback) fault-free run — transports may reorder and
+    retry, never alter, the round."""
+    comm_extra = {}
+    broker = None
+    if backend == "TRPC":
+        comm_extra = {"trpc_base_port": 29310, "trpc_connect_retries": 3,
+                      "trpc_retry_interval_s": 0.1}
+    elif backend == "GRPC":
+        comm_extra = {"grpc_base_port": 29410, "grpc_send_retries": 3,
+                      "grpc_send_backoff_base_s": 0.05}
+    else:
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import LocalBroker
+
+        broker = LocalBroker().start()
+        comm_extra = {"mqtt_host": "127.0.0.1", "mqtt_port": broker.port,
+                      "s3_blob_root": str(tmp_path / "blobs"),
+                      "mqtt_reconnect_retries": 10,
+                      "mqtt_reconnect_base_s": 0.05}
+    try:
+        history, final, stats = _run_chaos_topology(
+            f"chaos-{backend.lower()}", backend=backend,
+            fault_plan=_full_chaos_plan(), crash_rank=1, comm_extra=comm_extra)
+        assert len(history) == 2
+        assert _trees_bit_identical(final, fault_free_final_model)
+        assert stats[0]["rejoins"] >= 1
+        assert stats[0]["dup_dropped"] >= 1
+        assert stats[2]["faults_reset"] >= 1
+    finally:
+        if broker is not None:
+            broker.stop()
+
+
+@pytest.mark.slow
+def test_chaos_check_gate():
+    """The anti-flake gate: the fast chaos matrix must hold up over
+    consecutive full-process runs (tools/chaos_check.py is the operator
+    entry point for the same sweep)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/chaos_check.py", "--runs", "2"],
+        capture_output=True, text=True, timeout=1200,
+        cwd=__import__("os").path.dirname(__import__("os").path.dirname(__file__)),
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# Unit layer: the reliability link and the fault seam, no topology needed
+# ---------------------------------------------------------------------------
+
+class TestReliableLink:
+    def _link(self, **kw):
+        from fedml_tpu.core.distributed.comm_manager import _ReliableLink
+        from fedml_tpu.core.distributed.faults import CommStats
+
+        stats = CommStats()
+        link = _ReliableLink(1, stats, **kw)
+        sent = []
+        link.bind(sent.append)
+        return link, stats, sent
+
+    def _msg(self, mtype=3, sender=2, receiver=1, msg_id=None):
+        m = Message(mtype, sender, receiver)
+        if msg_id is not None:
+            m.add_params(Message.MSG_ARG_KEY_MSG_ID, msg_id)
+        return m
+
+    def test_stamp_is_monotonic_and_unique(self):
+        link, _, _ = self._link()
+        ids = [link.stamp(self._msg()) for _ in range(5)]
+        assert len(set(ids)) == 5
+        assert [int(i.rsplit(":", 1)[1]) for i in ids] == [1, 2, 3, 4, 5]
+
+    def test_duplicate_delivery_acked_but_dropped(self):
+        link, stats, sent = self._link()
+        m = self._msg(msg_id="2:abc:1")
+        assert link.on_receive(m) is True
+        assert link.on_receive(m) is False  # re-delivery suppressed
+        assert stats.get("dup_dropped") == 1
+        # BOTH deliveries were acked: the first ack may be the lost frame
+        assert stats.get("acks_sent") == 2
+        from fedml_tpu.core.distributed.comm_manager import COMM_ACK_TYPE
+        assert all(a.get_type() == COMM_ACK_TYPE for a in sent)
+
+    def test_ack_consumes_pending_retransmit(self):
+        link, stats, _ = self._link(max_retries=5, backoff_base_s=5.0)
+        m = self._msg()
+        mid = link.stamp(m)
+        link.track(mid, m)
+        assert mid in link._pending
+        ack = self._msg(mtype="comm_ack", msg_id=mid)
+        assert link.on_receive(ack) is False  # acks never reach handlers
+        assert mid not in link._pending
+        assert stats.get("acks_received") == 1
+        link.stop()
+
+    def test_legacy_unstamped_messages_pass_without_ack(self):
+        link, stats, sent = self._link()
+        assert link.on_receive(self._msg()) is True
+        assert link.on_receive(self._msg()) is True  # no dedup either
+        assert stats.get("acks_sent") == 0 and sent == []
+
+    def test_unacked_message_is_retransmitted_then_given_up(self):
+        link, stats, sent = self._link(
+            max_retries=2, backoff_base_s=0.01, backoff_max_s=0.02)
+        m = self._msg()
+        link.track(link.stamp(m), m)
+        deadline = time.time() + 5
+        while time.time() < deadline and stats.get("delivery_failures") == 0:
+            time.sleep(0.01)
+        assert stats.get("retransmits") == 2
+        assert stats.get("delivery_failures") == 1
+        assert len(sent) == 2 and not link._pending
+        link.stop()
+
+
+class _StubBackend:
+    """Minimal BaseCommunicationManager double for the fault seam."""
+
+    def __init__(self):
+        self.sent = []
+        self.observers = []
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+    def add_observer(self, o):
+        self.observers.append(o)
+
+    def remove_observer(self, o):
+        self.observers.remove(o)
+
+    def handle_receive_message(self):
+        pass
+
+    def stop_receive_message(self):
+        pass
+
+
+class _CaptureObserver:
+    def __init__(self):
+        self.got = []
+
+    def receive_message(self, msg_type, msg):
+        self.got.append(msg)
+
+
+class TestFaultSeam:
+    def _seam(self, rules, seed=0):
+        from fedml_tpu.core.distributed.faults import (
+            CommStats, FaultPlan, FaultyCommManager)
+
+        inner = _StubBackend()
+        stats = CommStats()
+        plan = FaultPlan.from_dict({"seed": seed, "rules": rules})
+        seam = FaultyCommManager(inner, plan.injector(1), stats)
+        cap = _CaptureObserver()
+        seam.add_observer(cap)
+        return seam, inner, cap, stats
+
+    def test_occurrence_window_after_and_times(self):
+        seam, inner, _, stats = self._seam(
+            [{"kind": "drop", "msg_type": 3, "after": 1, "times": 2}])
+        for _ in range(5):
+            seam.send_message(Message(3, 1, 0))
+        # 1st passes (after=1), 2nd+3rd dropped (times=2), rest pass
+        assert len(inner.sent) == 3
+        assert stats.get("faults_dropped") == 2
+
+    def test_round_scoped_rule_ignores_untagged(self):
+        seam, inner, _, _ = self._seam(
+            [{"kind": "drop", "round": 1, "times": None}])
+        m = Message(3, 1, 0)
+        seam.send_message(m)  # no round tag -> rule cannot match
+        tagged = Message(3, 1, 0)
+        tagged.add_params("round_idx", 1)
+        seam.send_message(tagged)
+        assert inner.sent == [m]
+
+    def test_partition_defaults_to_forever(self):
+        seam, inner, _, stats = self._seam(
+            [{"kind": "partition", "receiver": 0}])
+        for _ in range(4):
+            seam.send_message(Message(3, 1, 0))
+        seam.send_message(Message(3, 1, 2))  # other receiver unaffected
+        assert len(inner.sent) == 1
+        assert stats.get("faults_dropped") == 4
+
+    def test_send_reset_raises_recv_reset_degrades_to_drop(self):
+        seam, inner, cap, stats = self._seam(
+            [{"kind": "reset", "direction": "send", "msg_type": 3},
+             {"kind": "reset", "direction": "recv", "msg_type": 2}])
+        with pytest.raises(ConnectionError):
+            seam.send_message(Message(3, 1, 0))
+        assert stats.get("faults_reset") == 1
+        seam.receive_message("2", Message(2, 0, 1))  # dies with the socket
+        assert cap.got == [] and stats.get("faults_dropped") == 1
+        seam.receive_message("2", Message(2, 0, 1))  # rule spent
+        assert len(cap.got) == 1
+
+    def test_connection_ready_is_exempt(self):
+        seam, _, cap, _ = self._seam([{"kind": "drop", "times": None}])
+        ready = Message("connection_ready", 1, 1)
+        seam.receive_message("connection_ready", ready)
+        assert cap.got == [ready]
+
+    def test_seeded_probability_replays_exactly(self):
+        from fedml_tpu.core.distributed.faults import FaultPlan
+
+        plan = FaultPlan.from_dict({"seed": 11, "rules": [
+            {"kind": "drop", "p": 0.5, "times": None}]})
+
+        def trace():
+            inj = plan.injector(3)
+            return [inj.decide("send", Message(3, 1, 0)) is not None
+                    for _ in range(32)]
+
+        a, b = trace(), trace()
+        assert a == b and any(a) and not all(a)

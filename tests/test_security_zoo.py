@@ -296,3 +296,65 @@ class TestSoteriaMask:
             np.asarray(out["params"]["fc1"]["kernel"])[0], [1.0, 0.0, 1.0]
         )
         np.testing.assert_allclose(np.asarray(out["params"]["classifier"]["kernel"]), 2.0)
+
+
+class TestRevealLabelsHeadPath:
+    """reveal_labels_from_update's explicit head_path (mirroring the
+    defender-side soteria_layer knob): at >= 10 layers the lexicographic
+    flatten order puts Dense_10 before Dense_2, so the 'last bias' heuristic
+    stops pointing at the output layer — the attack needs the head named."""
+
+    NUM_CLASSES = 10
+    LR = 0.1
+
+    def _eleven_layer_update(self):
+        """Params for Dense_0..Dense_10 where BOTH Dense_5 and Dense_10 have
+        (10,)-shaped biases, and a client update whose head-bias gradient is
+        negative exactly for classes {2, 7}; the decoy Dense_5 bias moves
+        negative for classes {0, 1} instead."""
+        rng = np.random.RandomState(0)
+        widths = [32, 28, 24, 20, 16, self.NUM_CLASSES, 18, 14, 12, 16,
+                  self.NUM_CLASSES]  # Dense_5 is the decoy, Dense_10 the head
+        params, update = {}, {}
+        in_dim = 8
+        for i, w in enumerate(widths):
+            name = f"Dense_{i}"
+            kernel = rng.randn(in_dim, w).astype(np.float32)
+            bias = rng.randn(w).astype(np.float32)
+            k_grad = 0.01 * rng.randn(in_dim, w).astype(np.float32)
+            b_grad = np.abs(rng.randn(w)).astype(np.float32) * 0.1 + 0.01
+            if i == 10:  # head: present classes have NEGATIVE bias grad
+                b_grad[[2, 7]] = -0.5
+            if i == 5:  # decoy points the heuristic at the wrong classes
+                b_grad[[0, 1]] = -0.5
+            params[name] = {"kernel": kernel, "bias": bias}
+            update[name] = {"kernel": kernel - self.LR * k_grad,
+                            "bias": bias - self.LR * b_grad}
+            in_dim = w
+        return {"params": params}, {"params": update}
+
+    def test_explicit_head_path_recovers_labels(self):
+        variables, update = self._eleven_layer_update()
+        for head in (("Dense_10", "bias"), "Dense_10/bias"):  # tuple or "/"-joined
+            order, present = A.reveal_labels_from_update(
+                variables, update, self.NUM_CLASSES, lr_client=self.LR,
+                head_path=head)
+            assert sorted(np.asarray(order)[:2].tolist()) == [2, 7]
+            assert np.asarray(present).nonzero()[0].tolist() == [2, 7]
+
+    def test_heuristic_is_fooled_at_eleven_layers(self):
+        """Documents WHY the knob exists: on the same model the fallback
+        heuristic lands on the decoy layer and names the wrong classes."""
+        variables, update = self._eleven_layer_update()
+        _, present = A.reveal_labels_from_update(
+            variables, update, self.NUM_CLASSES, lr_client=self.LR)
+        assert np.asarray(present).nonzero()[0].tolist() == [0, 1]
+
+    def test_bad_head_path_raises(self):
+        variables, update = self._eleven_layer_update()
+        with pytest.raises(ValueError, match="not found"):
+            A.reveal_labels_from_update(variables, update, self.NUM_CLASSES,
+                                        head_path=("Dense_99", "bias"))
+        with pytest.raises(ValueError, match="BIAS"):
+            A.reveal_labels_from_update(variables, update, self.NUM_CLASSES,
+                                        head_path=("Dense_10", "kernel"))

@@ -175,3 +175,158 @@ class TestS3Adapter:
         assert isinstance(adapters.create_blob_store(None), BlobStore)
         with pytest.raises(ImportError):
             adapters.create_blob_store("s3://bucket/prefix")  # no boto3 here
+
+
+# ---------------------------------------------------------------------------
+# LocalBroker robustness: the Java-wire (JSON) interop path and the client
+# thread's cleanup guarantees
+# ---------------------------------------------------------------------------
+
+import json
+import socket
+import struct
+import threading
+import time
+
+from fedml_tpu.core.distributed.communication.mqtt_s3.broker import LocalBroker
+
+_LEN = struct.Struct(">I")
+
+
+class _JavaWireSubscriber:
+    """A strict JSON peer speaking the broker frame protocol over a raw
+    socket — the shape of the Android SDK's wire, with no pickle fallback:
+    any frame that is not valid JSON is a test failure, not a warning."""
+
+    def __init__(self, port: int):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+
+    def send(self, obj: dict) -> None:
+        body = json.dumps(obj).encode("utf-8")
+        self.sock.sendall(_LEN.pack(len(body)) + body)
+
+    def recv(self, timeout: float = 5.0):
+        """One decoded frame, or None on timeout (socket stays usable)."""
+        self.sock.settimeout(timeout)
+        try:
+            hdr = self._exact(_LEN.size)
+            (n,) = _LEN.unpack(hdr)
+            return json.loads(self._exact(n).decode("utf-8"))
+        except socket.timeout:
+            return None
+        finally:
+            self.sock.settimeout(None)
+
+    def _exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("broker closed the connection")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TestLocalBrokerJsonInterop:
+    def test_numpy_scalar_payload_reaches_json_subscriber(self):
+        """Regression: a Python silo publishing np.int64/np.float32/np.bool_
+        status fields silently lost the WHOLE frame for JSON peers (json.dumps
+        TypeError -> drop path).  The encoder now coerces numpy scalars."""
+        broker = LocalBroker().start()
+        sub = _JavaWireSubscriber(broker.port)
+        pub = None
+        try:
+            sub.send({"op": "SUB", "topic": "fedml/status/#"})
+            time.sleep(0.1)  # SUB must land before the publish fans out
+
+            got = []
+            pub = BrokerClient("127.0.0.1", broker.port,
+                               lambda t, p: got.append((t, p)))
+            pub.publish("fedml/status/1", {
+                "round_idx": np.int64(3),
+                "train_acc": np.float32(0.75),
+                "uploaded": np.bool_(True),
+            })
+            frame = sub.recv()
+            assert frame is not None, "numpy-scalar payload was dropped for the JSON peer"
+            assert frame["op"] == "MSG" and frame["topic"] == "fedml/status/1"
+            payload = frame["payload"]
+            assert payload["round_idx"] == 3
+            assert abs(payload["train_acc"] - 0.75) < 1e-6
+            assert payload["uploaded"] is True
+        finally:
+            if pub is not None:
+                pub.disconnect()
+            sub.close()
+            broker.stop()
+
+    def test_non_finite_floats_still_dropped_for_json_peers_only(self):
+        """Coercion must not smuggle NaN past allow_nan=False: a non-finite
+        numpy float is still dropped for JSON subscribers while pickle
+        subscribers receive the frame untouched."""
+        broker = LocalBroker().start()
+        sub = _JavaWireSubscriber(broker.port)
+        got = []
+        pickle_sub = None
+        pub = None
+        try:
+            sub.send({"op": "SUB", "topic": "t/#"})
+            pickle_sub = BrokerClient("127.0.0.1", broker.port,
+                                      lambda t, p: got.append(p))
+            pickle_sub.subscribe("t/#")
+            time.sleep(0.1)
+            pub = BrokerClient("127.0.0.1", broker.port, lambda t, p: None)
+            pub.publish("t/1", {"loss": np.float64("nan")})
+            pub.publish("t/2", {"loss": np.float64(0.5)})
+            frame = sub.recv()
+            assert frame is not None and frame["topic"] == "t/2", \
+                "JSON peer should see only the finite payload"
+            deadline = time.time() + 5
+            while time.time() < deadline and len(got) < 2:
+                time.sleep(0.02)
+            assert len(got) == 2  # pickle peer got both, NaN included
+        finally:
+            for c in (pub, pickle_sub):
+                if c is not None:
+                    c.disconnect()
+            sub.close()
+            broker.stop()
+
+
+class TestBrokerClientLoopCleanup:
+    def test_malformed_frame_fires_last_will_and_unregisters(self):
+        """Regression: an exception inside the broker's client loop (here a
+        PUB frame with no topic) used to kill the thread BEFORE cleanup —
+        a zombie registration held the dead socket in every future fan-out
+        and the last will never fired.  The loop body is now try/finally."""
+        broker = LocalBroker().start()
+        watcher = _JavaWireSubscriber(broker.port)
+        dying = _JavaWireSubscriber(broker.port)
+        try:
+            watcher.send({"op": "SUB", "topic": "liveness/#"})
+            time.sleep(0.1)
+            dying.send({"op": "WILL", "topic": "liveness/edge7",
+                        "payload": {"status": "OFFLINE"}})
+            time.sleep(0.1)
+            assert len(broker._clients) == 2
+            dying.send({"op": "PUB"})  # no topic: raises in the client loop
+
+            will = watcher.recv()
+            assert will is not None, "last will never fired for the dead client"
+            assert will["topic"] == "liveness/edge7"
+            assert will["payload"] == {"status": "OFFLINE"}
+            deadline = time.time() + 5
+            while time.time() < deadline and len(broker._clients) > 1:
+                time.sleep(0.02)
+            assert len(broker._clients) == 1, "dead client left a zombie registration"
+            assert len(broker._send_locks) == 1 and len(broker._enc) == 1
+        finally:
+            watcher.close()
+            dying.close()
+            broker.stop()

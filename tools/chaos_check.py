@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Anti-flake gate for the chaos suite.
+
+Runs the fast chaos matrix (``tests/test_fault_tolerance.py -k chaos``) N
+consecutive times in fresh interpreter processes and fails on the FIRST
+non-green run.  A fault-injection suite that only mostly passes is worse
+than none — operators stop believing red — so new fault kinds / backends
+must hold up under this before they land unmarked.
+
+Usage::
+
+    python tools/chaos_check.py --runs 5
+    python tools/chaos_check.py --runs 3 -k "chaos_matrix"
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--runs", "-n", type=int, default=3,
+                    help="consecutive green runs required (default 3)")
+    ap.add_argument("-k", dest="keyword", default="chaos",
+                    help="pytest -k selector (default: chaos)")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-run wall-clock bound in seconds")
+    args = ap.parse_args(argv)
+
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    cmd = [sys.executable, "-m", "pytest", "tests/test_fault_tolerance.py",
+           "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
+    for i in range(1, args.runs + 1):
+        t0 = time.time()
+        try:
+            proc = subprocess.run(cmd, cwd=REPO_ROOT, env=env,
+                                  timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            print(f"chaos_check: run {i}/{args.runs} TIMED OUT "
+                  f"after {args.timeout:.0f}s", flush=True)
+            return 2
+        if proc.returncode != 0:
+            print(f"chaos_check: FLAKE — run {i}/{args.runs} exited "
+                  f"{proc.returncode} after {time.time() - t0:.1f}s", flush=True)
+            return 1
+        print(f"chaos_check: run {i}/{args.runs} green "
+              f"({time.time() - t0:.1f}s)", flush=True)
+    print(f"chaos_check: {args.runs} consecutive green runs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
