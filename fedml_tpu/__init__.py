@@ -114,7 +114,10 @@ def init(args: Arguments | None = None, should_init_logs: bool = True) -> Argume
 
     seed = int(getattr(args, "random_seed", 0))
     _random.seed(seed)
-    _np.random.seed(seed)
+    # run-entry global seeding is the ONE approved global-RNG seam (the
+    # reference does the same in fedml.init); library code must use local
+    # generators — tools/lint_rng.py enforces this
+    _np.random.seed(seed)  # lint_rng: allow
 
     from .core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
     from .core.security.fedml_attacker import FedMLAttacker

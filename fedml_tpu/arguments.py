@@ -47,6 +47,27 @@ Backend-specific resilience knobs: ``trpc_connect_retries`` /
 ``trpc_retry_interval_s`` (TCP), ``grpc_send_retries`` /
 ``grpc_send_backoff_base_s`` (gRPC), ``mqtt_reconnect_retries`` /
 ``mqtt_reconnect_base_s`` (broker client auto-reconnect).
+
+Population / pacing knobs (``train_args`` or ``population_args``; consumed
+by ``core/population``, semantics in ``docs/POPULATION.md``):
+
+* ``selection_policy`` (default ``uniform``) — per-round cohort policy:
+  ``uniform`` (bit-identical to the legacy schedules) | ``stratified``
+  (speed strata) | ``importance`` (sample-count/staleness weighted).
+* ``pacing_overcommit`` (float >= 1.0, default 1.0) — invite
+  ``ceil(K * overcommit)`` clients per round.
+* ``pacing_quorum`` (int >= 0, default 0 = the target ``K``) — reports
+  needed to close the round when pacing is on; the deadline is the
+  existing ``round_timeout_s`` timer.
+* ``population_blocklist`` (list of client ids, default none) — never
+  selected; must leave >= ``client_num_per_round`` clients eligible.
+* ``population_strata`` (int >= 1, default 4) — stratified policy's
+  stratum count.
+* ``importance_alpha`` / ``importance_staleness`` (floats) — importance
+  policy weights.
+* ``population_stacked`` (bool, default False) — XLA simulator only:
+  draw the whole run's cohorts in one vectorized call (a different,
+  single-seed schedule — NOT parity with the per-round draw).
 """
 
 from __future__ import annotations
@@ -82,6 +103,7 @@ _CONFIG_SECTIONS = (
     "ta_args",
     "vfl_args",
     "fault_args",
+    "population_args",
 )
 
 
@@ -179,6 +201,15 @@ class Arguments:
                     "client_num_per_round must be <= client_num_in_total "
                     f"({self.client_num_per_round} > {self.client_num_in_total})"
                 )
+            bl = getattr(self, "population_blocklist", None)
+            if bl:
+                eligible = int(self.client_num_in_total) - len(set(int(c) for c in bl))
+                if eligible < int(self.client_num_per_round):
+                    raise ValueError(
+                        "population_blocklist leaves only "
+                        f"{eligible} eligible clients (< client_num_per_round="
+                        f"{self.client_num_per_round})"
+                    )
             # selecting FedProx without a mu means "use the default", on
             # EVERY backend — the engine's proximal hook only installs when
             # mu > 0, so injecting here (the one chokepoint all backends
@@ -188,6 +219,23 @@ class Arguments:
                 from .constants import FEDPROX_DEFAULT_MU
 
                 self.proximal_mu = FEDPROX_DEFAULT_MU
+        # population / pacing knobs fail at config time, not as a traceback
+        # mid-run when the first round opens (core/population semantics)
+        oc = getattr(self, "pacing_overcommit", None)
+        if oc is not None and float(oc) < 1.0:
+            raise ValueError(f"pacing_overcommit must be >= 1.0 (got {oc})")
+        q = getattr(self, "pacing_quorum", None)
+        if q is not None and int(q) < 0:
+            raise ValueError(f"pacing_quorum must be >= 0 (got {q})")
+        pol = str(getattr(self, "selection_policy", "uniform") or "uniform").lower()
+        if pol not in ("uniform", "stratified", "importance"):
+            raise ValueError(
+                f"unknown selection_policy {pol!r} "
+                "(expected uniform|stratified|importance)"
+            )
+        strata = getattr(self, "population_strata", None)
+        if strata is not None and int(strata) < 1:
+            raise ValueError(f"population_strata must be >= 1 (got {strata})")
         # a malformed chaos plan should fail at config time, not mid-run when
         # the backend factory first tries to wrap the transport
         plan = getattr(self, "fault_plan", None)

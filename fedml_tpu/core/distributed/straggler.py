@@ -81,6 +81,7 @@ class RoundTimeoutMixin:
         stats = getattr(self, "_comm_stats", None)
         if stats is not None:
             stats.inc("rejoins")
+        self._note_population_rejoin(sender)
         logger.warning(
             "client %s REJOINED mid-run (epoch %s -> %s): resyncing round %d",
             sender, prev, epoch, self.args.round_idx,
@@ -129,13 +130,28 @@ class RoundTimeoutMixin:
                 "MSG_ARG_KEY_ROUND_INDEX",
                 sender, self.round_timeout_s, self.args.round_idx,
             )
+            self._note_rejected_late(sender)
             return True
         if int(msg_round) == int(self.args.round_idx):
             return False
         logger.warning("dropping stale round-%s upload from client %s "
                        "(current round %d)", msg_round, sender,
                        self.args.round_idx)
+        self._note_rejected_late(sender)
         return True
+
+    # -- population hooks ------------------------------------------------------
+    # No-op seams the population pacing mixin (core/population/pacing.py)
+    # overrides; kept here so this mixin stays usable without a population.
+    def _note_rejected_late(self, sender) -> None:
+        """(lock held) A late/stale upload was dropped."""
+
+    def _note_population_rejoin(self, sender) -> None:
+        """(lock held) A crashed client rejoined mid-run."""
+
+    def _note_round_closing(self, reason: str, got) -> None:
+        """(lock held) The round is about to finalize (``reason`` is
+        'complete' | 'quorum' | 'deadline'; ``got`` the closing indices)."""
 
     # -- timers --------------------------------------------------------------
     def _start_phase_timer(self, attr: str, callback) -> None:
@@ -178,6 +194,7 @@ class RoundTimeoutMixin:
                 self.args.round_idx, len(got), len(self.client_id_list_in_this_round),
             )
             self._had_timeout_close = True  # stale arrivals now possible
+            self._note_round_closing("deadline", got)
             self._finalize_safely(self.aggregator.consume_received(got))
 
     # -- round close ----------------------------------------------------------

@@ -29,7 +29,7 @@ __all__ = [
     "pre_setup", "init", "finish", "event", "log", "log_round_info",
     "log_training_status", "log_aggregation_status", "log_sys_perf",
     "log_aggregated_model_info", "log_client_model_info", "log_comm_stats",
-    "enabled", "sink",
+    "log_cohort_stats", "enabled", "sink",
 ]
 
 _lock = threading.Lock()
@@ -164,6 +164,15 @@ def log_comm_stats(stats: Dict[str, Any], rank: Optional[int] = None) -> None:
     if not enabled():
         return
     _ctx["metrics"].report_comm_stats(stats, rank=rank)
+
+
+def log_cohort_stats(stats: Dict[str, Any], rank: Optional[int] = None) -> None:
+    """Per-round population counters (invited, reported, rejected-late,
+    strata sizes) — emitted by ``core/population`` at every round close so
+    pacing behavior is observable alongside ``comm_stats``."""
+    if not enabled():
+        return
+    _ctx["metrics"].report_cohort_stats(stats, rank=rank)
 
 
 def log_sys_perf(stats: Optional[Dict[str, Any]] = None) -> None:

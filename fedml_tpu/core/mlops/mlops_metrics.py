@@ -57,6 +57,14 @@ class MLOpsMetrics:
         self._emit("comm_stats", {"rank": self.edge_id if rank is None else int(rank),
                                   **dict(stats)})
 
+    # -- population --------------------------------------------------------
+    def report_cohort_stats(self, stats: Dict[str, Any], rank: Optional[int] = None) -> None:
+        """Per-round cohort counters from ``core/population`` (invited,
+        reported, rejected-late, strata sizes, close reason) — the
+        selection/pacing analogue of ``comm_stats``."""
+        self._emit("cohort_stats", {"rank": self.edge_id if rank is None else int(rank),
+                                    **dict(stats)})
+
     # -- system ------------------------------------------------------------
     def report_sys_perf(self, stats: Optional[Dict[str, Any]] = None) -> None:
         if stats is None:

@@ -1,0 +1,47 @@
+"""Population subsystem: the server-side fleet view and the per-round
+participation decision.
+
+Parts (see ``docs/POPULATION.md``):
+
+* :mod:`.registry` — array-backed per-client metadata (sample counts,
+  observed latencies via ``core/schedule``, reliability counters via PR 1's
+  ``comm_stats``, last-seen round, blocklist);
+* :mod:`.policies` — seed-deterministic selection policies behind one
+  ``SelectionPolicy`` interface (uniform with bit-exact legacy parity,
+  stratified-by-speed, importance);
+* :mod:`.pacer` — over-commit + deadline-quorum arithmetic;
+* :mod:`.pacing` — the mixin wiring the pacer into the message-plane
+  server managers on top of ``RoundTimeoutMixin``;
+* :mod:`.manager` — the facade (``PopulationManager``) that owns the
+  accounting and emits per-round ``cohort_stats`` through ``core/mlops``;
+* :mod:`.stacked` — the vectorized whole-run selection path for
+  10^5-10^6 virtual clients.
+"""
+
+from .manager import PopulationManager
+from .pacer import RoundPacer
+from .pacing import PopulationPacingMixin
+from .policies import (
+    ImportancePolicy,
+    SelectionPolicy,
+    StratifiedBySpeedPolicy,
+    UniformPolicy,
+    make_policy,
+    uniform_id_choice,
+)
+from .registry import ClientRegistry
+from .stacked import stacked_cohorts
+
+__all__ = [
+    "ClientRegistry",
+    "SelectionPolicy",
+    "UniformPolicy",
+    "StratifiedBySpeedPolicy",
+    "ImportancePolicy",
+    "make_policy",
+    "uniform_id_choice",
+    "RoundPacer",
+    "PopulationManager",
+    "PopulationPacingMixin",
+    "stacked_cohorts",
+]

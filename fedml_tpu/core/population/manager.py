@@ -1,0 +1,172 @@
+"""Population manager: the facade tying registry + policy + pacer together.
+
+Two usage surfaces share one accounting core:
+
+* **message-plane servers** (cross_silo / cross_device) drive the
+  incremental API — ``invite`` at round open, ``note_report`` per upload,
+  ``note_rejected_late`` for post-close stragglers, ``close_round`` when
+  the round finalizes;
+* **simulators** (sp / XLA), where a round is synchronous, call
+  ``observe_round`` once with the whole cohort (fully vectorized — no
+  per-client Python loop, so it holds up at Parrot fleet sizes).
+
+Every close emits one ``cohort_stats`` record through ``core/mlops``
+(no-op until ``mlops.init``), mirroring how PR 1's ``comm_stats`` flow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .pacer import RoundPacer
+from .policies import SelectionPolicy, make_policy
+from .registry import ClientRegistry
+
+
+class PopulationManager:
+    def __init__(self, registry: ClientRegistry, policy: SelectionPolicy,
+                 pacer: Optional[RoundPacer] = None, emit=None):
+        self.registry = registry
+        self.policy = policy
+        self.pacer = pacer if pacer is not None else RoundPacer()
+        self._emit = emit  # test override; default is the mlops facade
+        self._round_idx: Optional[int] = None
+        self._target_k = 0
+        self._invited: List[int] = []
+        self._reported: set = set()
+        self._rejected_late = 0
+        self.history: List[Dict[str, Any]] = []
+
+    @classmethod
+    def from_args(cls, args, client_ids: Sequence[int],
+                  num_samples: Optional[Sequence[int]] = None,
+                  rng_style: str = "mt19937", emit=None) -> "PopulationManager":
+        """Build the whole stack from validated config knobs (the knob
+        schema lives in ``arguments.py``; ``docs/POPULATION.md`` documents
+        semantics)."""
+        registry = ClientRegistry(client_ids, num_samples=num_samples)
+        blocklist = getattr(args, "population_blocklist", None)
+        if blocklist:
+            registry.blocklist(list(blocklist))
+        policy = make_policy(
+            getattr(args, "selection_policy", "uniform"),
+            registry,
+            rng_style=rng_style,
+            num_strata=int(getattr(args, "population_strata", 4) or 4),
+            importance_alpha=float(getattr(args, "importance_alpha", 1.0) or 1.0),
+            importance_staleness=float(
+                getattr(args, "importance_staleness", 0.0) or 0.0
+            ),
+        )
+        return cls(registry, policy, pacer=RoundPacer.from_args(args), emit=emit)
+
+    # -- message-plane surface ----------------------------------------------
+    def select(self, round_idx: int, k: int) -> np.ndarray:
+        """Policy draw only — no accounting (the simulator sampling seam)."""
+        return self.policy.select(int(round_idx), int(k))
+
+    def invite(self, round_idx: int, k: int) -> List[int]:
+        """Open a round: select ``invite_count(k)`` clients (over-commit)
+        and mark them invited.  Returns the invite list in policy order."""
+        invited = [int(c) for c in
+                   self.policy.select(int(round_idx), self.pacer.invite_count(int(k)))]
+        self.registry.note_invited(invited, int(round_idx))
+        self._round_idx = int(round_idx)
+        self._target_k = int(k)
+        self._invited = invited
+        self._reported = set()
+        self._rejected_late = 0
+        return invited
+
+    @property
+    def quorum(self) -> int:
+        """Reports needed to close the open round."""
+        return self.pacer.quorum_for(self._target_k, len(self._invited))
+
+    def note_report(self, client_id: int, round_idx: Optional[int] = None,
+                    n_samples: Optional[int] = None,
+                    seconds: Optional[float] = None) -> bool:
+        """One upload landed; idempotent per round (re-deliveries don't
+        double-count).  Returns True when this was a fresh report."""
+        cid = int(client_id)
+        if cid in self._reported:
+            return False
+        self._reported.add(cid)
+        r = self._round_idx if round_idx is None else int(round_idx)
+        self.registry.note_report(cid, 0 if r is None else r,
+                                  n_samples=n_samples, seconds=seconds)
+        return True
+
+    def quorum_reached(self) -> bool:
+        return len(self._reported) >= self.quorum
+
+    def note_rejected_late(self, client_id: int) -> None:
+        self._rejected_late += 1
+        self.registry.note_rejected_late(int(client_id))
+
+    def note_rejoin(self, client_id: int) -> None:
+        self.registry.note_rejoin(int(client_id))
+
+    def close_round(self, reason: str = "complete",
+                    seconds: Optional[float] = None) -> Dict[str, Any]:
+        """Close the open round: invited-but-missing become failures, and
+        one ``cohort_stats`` record is emitted."""
+        r = self._round_idx if self._round_idx is not None else 0
+        missing = [c for c in self._invited if c not in self._reported]
+        if missing:
+            self.registry.note_failures(missing, r)
+        stats = self._stats(r, len(self._invited), len(self._reported),
+                            len(missing), self._rejected_late, reason, seconds)
+        self._round_idx = None
+        return stats
+
+    # -- simulator surface (fully vectorized) -------------------------------
+    def observe_round(self, round_idx: int, invited_ids,
+                      reported_ids=None, seconds: Optional[float] = None,
+                      reason: str = "complete") -> Dict[str, Any]:
+        """Record a whole synchronous round in one shot: everyone in
+        ``invited_ids`` was invited; ``reported_ids`` (default: all of them)
+        reported.  One vectorized registry update per counter."""
+        inv = np.asarray(invited_ids, np.int64).reshape(-1)
+        rep = inv if reported_ids is None else np.asarray(reported_ids, np.int64).reshape(-1)
+        r = int(round_idx)
+        self.registry.note_invited(inv, r)
+        self.registry.note_reports(rep, r, seconds=seconds)
+        missing = np.setdiff1d(inv, rep)
+        if missing.size:
+            self.registry.note_failures(missing, r)
+        self._target_k = int(rep.size)
+        return self._stats(r, int(inv.size), int(rep.size), int(missing.size),
+                           0, reason, seconds)
+
+    # -- stats ---------------------------------------------------------------
+    def _stats(self, round_idx: int, invited: int, reported: int, failed: int,
+               rejected_late: int, reason: str,
+               seconds: Optional[float]) -> Dict[str, Any]:
+        stats: Dict[str, Any] = {
+            "round_idx": int(round_idx),
+            "policy": self.policy.name,
+            "target_k": int(self._target_k),
+            "invited": invited,
+            "reported": reported,
+            "failed": failed,
+            "rejected_late": rejected_late,
+            "quorum": self.pacer.quorum_for(self._target_k, invited or self._target_k),
+            "overcommit": self.pacer.overcommit,
+            "close_reason": str(reason),
+        }
+        if seconds is not None:
+            stats["round_seconds"] = round(float(seconds), 4)
+        if self.policy.last_strata_sizes is not None:
+            stats["strata_sizes"] = list(self.policy.last_strata_sizes)
+        stats.update(self.registry.snapshot())
+        self.history.append(stats)
+        if self._emit is not None:
+            self._emit(stats)
+        else:
+            from ..mlops import log_cohort_stats
+
+            log_cohort_stats(stats)
+        return stats

@@ -1,0 +1,62 @@
+"""Round pacing: over-commit + deadline-quorum arithmetic.
+
+The Smart-NIC FL-server study (arxiv 2307.06561) observation: at fleet
+scale the server cannot afford to wait for the slowest invitee, so it
+invites MORE clients than it needs (``ceil(K * overcommit)``) and closes
+the round as soon as the target ``K`` (the quorum) have reported — the
+rest become stragglers whose late uploads are rejected and counted.
+
+This module is pure arithmetic; the deadline itself is the existing
+``RoundTimeoutMixin`` round timer (``round_timeout_s``), NOT a second
+timer — the pacer only decides how many to invite and when "enough"
+reports have arrived.  Knobs (validated in ``arguments.py``):
+
+* ``pacing_overcommit`` (float >= 1.0, default 1.0) — invite multiplier.
+* ``pacing_quorum`` (int >= 0, default 0) — explicit quorum; 0 means the
+  target cohort size ``K`` (``client_num_per_round``).
+
+Both at their defaults means pacing is OFF and every round keeps the
+reference wait-for-all semantics (bounded only by ``round_timeout_s``
+when that is set).
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class RoundPacer:
+    def __init__(self, overcommit: float = 1.0, quorum: int = 0):
+        self.overcommit = float(overcommit or 1.0)
+        self.quorum = int(quorum or 0)
+        if self.overcommit < 1.0:
+            raise ValueError(
+                f"pacing_overcommit must be >= 1.0 (got {self.overcommit})"
+            )
+        if self.quorum < 0:
+            raise ValueError(f"pacing_quorum must be >= 0 (got {self.quorum})")
+
+    @classmethod
+    def from_args(cls, args) -> "RoundPacer":
+        return cls(
+            overcommit=float(getattr(args, "pacing_overcommit", 1.0) or 1.0),
+            quorum=int(getattr(args, "pacing_quorum", 0) or 0),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.overcommit > 1.0 or self.quorum > 0
+
+    def invite_count(self, k: int) -> int:
+        """``ceil(K * overcommit)`` with a float-noise guard so 1.1 * 10
+        does not ceil to 12."""
+        return int(math.ceil(int(k) * self.overcommit - 1e-9))
+
+    def quorum_for(self, k: int, invited: int) -> int:
+        """Reports needed to close the round: the explicit quorum (or the
+        target ``K``), never more than were actually invited, never < 1."""
+        q = self.quorum if self.quorum > 0 else int(k)
+        return max(1, min(q, int(invited)))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"RoundPacer(overcommit={self.overcommit}, quorum={self.quorum})"

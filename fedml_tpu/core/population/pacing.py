@@ -1,0 +1,93 @@
+"""Pacing mixin for the message-plane server managers.
+
+Layers over-commit + quorum-close semantics ON TOP of
+``core/distributed/straggler.RoundTimeoutMixin`` — the deadline, the
+generation counter, the stale-upload policy, and the lock discipline all
+stay in that one copy; this mixin only (a) swaps the round's participant
+list for a policy-selected invite list and (b) replaces the wait-for-all
+close check with a quorum check when pacing is enabled.
+
+MRO: ``class Manager(PopulationPacingMixin, RoundTimeoutMixin,
+FedMLCommManager)`` — the pacing mixin overrides the no-op hooks
+(``_note_rejected_late``, ``_note_population_rejoin``) the timeout mixin
+calls.
+
+Host manager requirements (on top of the timeout mixin's): call
+``init_population`` from ``__init__`` (after ``init_straggler_tolerance``),
+open each round's list via ``_population_round_list``, record each accepted
+upload via ``_note_population_report``, and replace the
+``check_whether_all_receive`` close dance with ``_close_round_if_complete``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .manager import PopulationManager
+
+
+class PopulationPacingMixin:
+    def init_population(self, args, client_ids: Sequence[int],
+                        num_samples: Optional[Sequence[int]] = None,
+                        rng_style: str = "pcg64") -> None:
+        self.population = PopulationManager.from_args(
+            args, client_ids, num_samples=num_samples, rng_style=rng_style
+        )
+
+    # -- round open ----------------------------------------------------------
+    def _population_round_list(self, round_idx: int, k: int) -> List[int]:
+        """The round's participant list: ``ceil(k * overcommit)`` invitees
+        drawn by the selection policy (== the legacy list when the policy is
+        uniform and pacing is off)."""
+        return self.population.invite(int(round_idx), int(k))
+
+    # -- per-upload ----------------------------------------------------------
+    def _note_population_report(self, sender: int,
+                                n_samples: Optional[float] = None) -> None:
+        """(lock held) An accepted upload for the CURRENT round."""
+        self.population.note_report(
+            int(sender), round_idx=int(self.args.round_idx),
+            n_samples=None if n_samples is None else int(n_samples),
+        )
+
+    # -- RoundTimeoutMixin hook overrides ------------------------------------
+    def _note_rejected_late(self, sender) -> None:
+        """A stale/late upload was dropped by the round-tag policy."""
+        self.population.note_rejected_late(int(sender))
+
+    def _note_population_rejoin(self, sender) -> None:
+        """A crashed client rejoined mid-run (epoch change)."""
+        self.population.note_rejoin(int(sender))
+
+    def _note_round_closing(self, reason: str, got) -> None:
+        """The round is about to finalize: settle population accounting and
+        emit the round's ``cohort_stats`` record."""
+        self.population.close_round(reason=reason)
+
+    # -- round close ---------------------------------------------------------
+    def _close_round_if_complete(self) -> bool:
+        """(lock held, upload already recorded) Close the round if its
+        completion condition holds; returns True when it closed.
+
+        Pacing off: the reference wait-for-all condition, bit-identical
+        round flow.  Pacing on: close at quorum — outstanding invitees
+        become stragglers, and because a straggler's late upload is now
+        possible, untagged arrivals flip to droppable exactly as after a
+        timeout close (``_had_timeout_close``)."""
+        if not self.population.pacer.enabled:
+            if not self.aggregator.check_whether_all_receive():
+                return False
+            self._cancel_round_timer()
+            self._note_round_closing("complete", None)
+            self._finalize_safely(None)
+            return True
+        got = self.aggregator.received_indices()
+        if len(got) < self.population.quorum:
+            return False
+        if len(got) < len(self.client_id_list_in_this_round):
+            self._had_timeout_close = True
+        self._cancel_round_timer()
+        reason = "quorum" if len(got) < len(self.client_id_list_in_this_round) else "complete"
+        self._note_round_closing(reason, got)
+        self._finalize_safely(self.aggregator.consume_received(got))
+        return True

@@ -1,0 +1,188 @@
+"""Client registry: the server-side view of the fleet.
+
+Array-backed (NOT a dict of objects) so the same registry that tracks three
+cross-silo silos scales to the XLA Parrot simulator's 10^5-10^6 virtual
+clients: every counter is a NumPy column indexed by registry position, and
+the bulk update paths (:meth:`note_reports`, :meth:`note_failures`) are one
+vectorized op per round.  Per-client runtime prediction reuses
+:class:`~fedml_tpu.core.schedule.runtime_estimate.RuntimeEstimator`
+(``uniform_devices=False`` — one linear model per client) fed from observed
+report latencies; fleet-level reliability context comes from PR 1's
+``comm_stats`` snapshot via :meth:`absorb_comm_stats`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..schedule.runtime_estimate import RuntimeEstimator
+
+
+class ClientRegistry:
+    """Per-client metadata columns, keyed by client id.
+
+    ``client_ids`` is the fleet's id space (``arange(N)`` for simulators,
+    ``[1..N]`` for the message-plane servers).  Ids need not be contiguous;
+    lookups go through a position map with an identity fast path.
+    """
+
+    def __init__(self, client_ids: Sequence[int],
+                 num_samples: Optional[Sequence[int]] = None):
+        self.ids = np.asarray(list(client_ids), dtype=np.int64)
+        if self.ids.ndim != 1 or self.ids.size == 0:
+            raise ValueError("client_ids must be a non-empty 1-D sequence")
+        if np.unique(self.ids).size != self.ids.size:
+            raise ValueError("client_ids must be unique")
+        n = self.ids.size
+        self._identity = bool(np.array_equal(self.ids, np.arange(n)))
+        self._pos: Optional[Dict[int, int]] = (
+            None if self._identity else {int(c): i for i, c in enumerate(self.ids)}
+        )
+        self.num_samples = (
+            np.zeros(n, np.int64) if num_samples is None
+            else np.asarray(list(num_samples), np.int64)
+        )
+        if self.num_samples.shape != (n,):
+            raise ValueError("num_samples must align with client_ids")
+        self.invites = np.zeros(n, np.int64)
+        self.reports = np.zeros(n, np.int64)
+        self.failures = np.zeros(n, np.int64)       # invited, never reported
+        self.rejected_late = np.zeros(n, np.int64)  # reported after round close
+        self.rejoins = np.zeros(n, np.int64)        # mid-run crash-and-rejoin
+        self.last_seen_round = np.full(n, -1, np.int64)
+        # EMA of observed round-trip seconds (0 until first report)
+        self.ema_seconds = np.zeros(n, np.float64)
+        self._has_obs = np.zeros(n, bool)
+        self._blocked = np.zeros(n, bool)
+        self._ema_alpha = 0.3
+        # per-client linear runtime model t ~ a*n_samples + b (the
+        # core/schedule machinery, one model per client instead of per device)
+        self.estimator = RuntimeEstimator(num_devices=n, uniform_devices=False)
+        self.comm_stats: Dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return int(self.ids.size)
+
+    # -- id <-> position ----------------------------------------------------
+    def positions(self, client_ids) -> np.ndarray:
+        arr = np.asarray(client_ids, np.int64).reshape(-1)
+        if self._identity:
+            return arr
+        assert self._pos is not None
+        return np.fromiter((self._pos[int(c)] for c in arr), np.int64, arr.size)
+
+    # -- eligibility --------------------------------------------------------
+    def blocklist(self, client_ids) -> None:
+        self._blocked[self.positions(client_ids)] = True
+
+    def unblocklist(self, client_ids) -> None:
+        self._blocked[self.positions(client_ids)] = False
+
+    def is_blocklisted(self, client_id: int) -> bool:
+        return bool(self._blocked[self.positions([client_id])[0]])
+
+    def eligible_ids(self) -> np.ndarray:
+        """Ids a policy may draw from (registry order, blocklist excluded)."""
+        return self.ids[~self._blocked]
+
+    def eligible_count(self) -> int:
+        return int((~self._blocked).sum())
+
+    # -- per-round accounting (vectorized) ----------------------------------
+    def note_invited(self, client_ids, round_idx: int) -> None:
+        pos = self.positions(client_ids)
+        self.invites[pos] += 1
+
+    def note_reports(self, client_ids, round_idx: int,
+                     seconds: Optional[float] = None) -> None:
+        """Bulk report mark for a whole cohort (the simulator path)."""
+        pos = self.positions(client_ids)
+        self.reports[pos] += 1
+        self.last_seen_round[pos] = int(round_idx)
+        if seconds is not None:
+            self._observe_seconds(pos, float(seconds))
+
+    def note_report(self, client_id: int, round_idx: int,
+                    n_samples: Optional[int] = None,
+                    seconds: Optional[float] = None) -> None:
+        """Single-client report (the message-plane server path): updates the
+        counters, the latency EMA, and the per-client runtime model."""
+        pos = int(self.positions([client_id])[0])
+        self.reports[pos] += 1
+        self.last_seen_round[pos] = int(round_idx)
+        if n_samples is not None:
+            self.num_samples[pos] = int(n_samples)
+        if seconds is not None:
+            self._observe_seconds(np.asarray([pos]), float(seconds))
+            if n_samples:
+                self.estimator.record(pos, int(n_samples), float(seconds))
+
+    def _observe_seconds(self, pos: np.ndarray, seconds: float) -> None:
+        a = self._ema_alpha
+        fresh = ~self._has_obs[pos]
+        ema = self.ema_seconds[pos]
+        self.ema_seconds[pos] = np.where(fresh, seconds, (1 - a) * ema + a * seconds)
+        self._has_obs[pos] = True
+
+    def note_failures(self, client_ids, round_idx: int) -> None:
+        """Invited-but-missing at round close (vectorized)."""
+        pos = self.positions(client_ids)
+        self.failures[pos] += 1
+
+    def note_rejected_late(self, client_id: int) -> None:
+        self.rejected_late[self.positions([client_id])[0]] += 1
+
+    def note_rejoin(self, client_id: int) -> None:
+        """A crashed client came back (PR 1's epoch-change rejoin): it stays
+        in / re-enters the eligible pool via its registry entry."""
+        self.rejoins[self.positions([client_id])[0]] += 1
+
+    def absorb_comm_stats(self, snapshot: Dict[str, int]) -> None:
+        """Fold a transport-layer ``comm_stats`` snapshot (PR 1) into the
+        registry's fleet-level reliability context."""
+        for k, v in dict(snapshot).items():
+            self.comm_stats[k] = self.comm_stats.get(k, 0) + int(v)
+
+    # -- derived signals -----------------------------------------------------
+    def speed_scores(self) -> np.ndarray:
+        """Per-client observed seconds (lower = faster); clients never seen
+        get the fleet median so they sort into the middle stratum instead of
+        an artificial extreme."""
+        scores = self.ema_seconds.copy()
+        if self._has_obs.any():
+            scores[~self._has_obs] = float(np.median(scores[self._has_obs]))
+        return scores
+
+    def predicted_seconds(self, client_id: int, n_samples: int) -> Optional[float]:
+        pos = int(self.positions([client_id])[0])
+        return self.estimator.predict(pos, int(n_samples))
+
+    def record(self, client_id: int) -> Dict[str, Any]:
+        """One client's row as a plain dict (debug / test surface)."""
+        pos = int(self.positions([client_id])[0])
+        return {
+            "client_id": int(self.ids[pos]),
+            "num_samples": int(self.num_samples[pos]),
+            "invites": int(self.invites[pos]),
+            "reports": int(self.reports[pos]),
+            "failures": int(self.failures[pos]),
+            "rejected_late": int(self.rejected_late[pos]),
+            "rejoins": int(self.rejoins[pos]),
+            "last_seen_round": int(self.last_seen_round[pos]),
+            "ema_seconds": float(self.ema_seconds[pos]),
+            "blocklisted": bool(self._blocked[pos]),
+        }
+
+    def snapshot(self) -> Dict[str, int]:
+        """Fleet-level totals for the ``cohort_stats`` sink record."""
+        return {
+            "fleet": int(self.ids.size),
+            "eligible": self.eligible_count(),
+            "invited_total": int(self.invites.sum()),
+            "reported_total": int(self.reports.sum()),
+            "failures_total": int(self.failures.sum()),
+            "rejected_late_total": int(self.rejected_late.sum()),
+            "rejoins_total": int(self.rejoins.sum()),
+        }

@@ -3,6 +3,19 @@
 Seeded by round index so every simulator backend (sp / XLA / distributed)
 draws the SAME client schedule for a given round — the property the reference
 relies on for reproducibility, kept in one place here.
+
+The draw comes from a LOCAL ``np.random.RandomState(round_idx)``, never by
+seeding the process-global NumPy RNG: the historical
+``np.random.seed(round_idx)`` here silently reset every other consumer of
+the global stream each round.  ``RandomState(s).choice(n, k, replace=False)``
+is bit-identical to the legacy ``np.random.seed(s)`` +
+``np.random.choice(range(n), k, replace=False)`` (same MT19937 seeding, same
+permutation-based draw), so existing schedules are unchanged — the parity
+tests in ``tests/test_population.py`` pin this.  ``tools/lint_rng.py``
+machine-enforces the no-global-RNG rule tree-wide.
+
+This remains the ``uniform`` selection policy's implementation
+(``core/population/policies.py``); richer policies live there.
 """
 
 from __future__ import annotations
@@ -13,5 +26,5 @@ import numpy as np
 def client_sampling(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> np.ndarray:
     if client_num_in_total == client_num_per_round:
         return np.arange(client_num_in_total)
-    np.random.seed(round_idx)
-    return np.random.choice(range(client_num_in_total), client_num_per_round, replace=False)
+    rs = np.random.RandomState(round_idx)
+    return rs.choice(client_num_in_total, client_num_per_round, replace=False)

@@ -21,12 +21,13 @@ from typing import Dict, List, Optional
 from ..core.distributed.comm_manager import FedMLCommManager
 from ..core.distributed.communication.message import Message
 from ..core.distributed.straggler import RoundTimeoutMixin
+from ..core.population import PopulationPacingMixin
 from .message_define import MNNMessage
 
 logger = logging.getLogger(__name__)
 
 
-class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
+class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0,
                  backend: str = "LOOPBACK"):
         super().__init__(args, comm, client_rank, client_num + 1, backend)
@@ -34,12 +35,18 @@ class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
         self.round_num = int(getattr(args, "comm_round", 1))
         self.args.round_idx = 0
         self.client_num = int(client_num)
+        # cohort target per round; the fleet (client_num) may be larger —
+        # devices not selected for a round just idle until the next select
+        self.per_round = int(getattr(args, "client_num_per_round", self.client_num) or self.client_num)
         self.client_online_status: Dict[int, bool] = {}
         self.is_initialized = False
         self.client_id_list_in_this_round: List[int] = list(range(1, self.client_num + 1))
         # straggler tolerance (0 = reference semantics: wait forever) —
         # the shared machinery lives in core/distributed/straggler.py
         self.init_straggler_tolerance(args)
+        # fleet registry + selection policy + pacer (core/population)
+        self.init_population(args, list(range(1, self.client_num + 1)),
+                             rng_style="pcg64")
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler("connection_ready", self._on_connection_ready)
@@ -92,6 +99,11 @@ class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
 
     # -- round loop -----------------------------------------------------------
     def _send_round(self, msg_type) -> None:
+        # per-round cohort via the population policy (full participation when
+        # per_round == fleet and the policy is uniform — the legacy schedule)
+        self.client_id_list_in_this_round = self._population_round_list(
+            self.args.round_idx, self.per_round
+        )
         model_file = self.aggregator.get_global_model_params_file(self.args.round_idx)
         for client_id in self.client_id_list_in_this_round:
             m = Message(msg_type, self.rank, client_id)
@@ -116,10 +128,8 @@ class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
             self.aggregator.add_local_trained_result(
                 self.client_id_list_in_this_round.index(sender), model_file, n
             )
-            if not self.aggregator.check_whether_all_receive():
-                return
-            self._cancel_round_timer()
-            self._finalize_safely(None)
+            self._note_population_report(sender, n)
+            self._close_round_if_complete()
 
     def _finalize_round(self, indices: Optional[List[int]]) -> None:
         """(lock held) Aggregate the cohort, eval, finish-or-sync."""

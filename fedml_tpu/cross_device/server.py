@@ -20,16 +20,23 @@ class ServerDevice:
             _test_local_dict,
             _class_num,
         ] = dataset
-        client_num = int(getattr(args, "client_num_per_round", getattr(args, "client_num_in_total", 1)))
+        per_round = int(getattr(args, "client_num_per_round", getattr(args, "client_num_in_total", 1)))
+        # the manager handshakes with the FLEET (client_num_in_total devices
+        # connect); the population policy picks per_round of them each round,
+        # and the aggregator's slot table covers the over-commit invite list
+        fleet = int(getattr(args, "client_num_in_total", per_round) or per_round)
+        from ..core.population import RoundPacer
+
+        slots = RoundPacer.from_args(args).invite_count(per_round)
         self.aggregator = FedMLAggregator(
-            args, model, test_global, worker_num=client_num,
+            args, model, test_global, worker_num=slots,
             model_dir=getattr(args, "edge_model_dir", None),
         )
         self.server_manager = FedMLServerManager(
             args,
             self.aggregator,
             client_rank=0,
-            client_num=client_num,
+            client_num=fleet,
             backend=str(getattr(args, "backend", "LOOPBACK")),
         )
 

@@ -92,11 +92,14 @@ class FedMLAggregator:
         return rng.choice(data_silo_num_in_total, client_num_in_total, replace=True).tolist()
 
     def client_selection(self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int) -> List[int]:
-        """Sample real edge ids for the round (reference :113-135)."""
-        if client_num_per_round >= len(client_id_list_in_total):
-            return list(client_id_list_in_total)
-        rng = np.random.default_rng(round_idx)
-        return rng.choice(client_id_list_in_total, client_num_per_round, replace=False).tolist()
+        """Sample real edge ids for the round (reference :113-135).  The
+        draw is the population subsystem's pcg64 uniform schedule — the
+        server manager now selects through its ``PopulationManager``, and
+        this method delegates to the same implementation so both surfaces
+        stay bit-identical."""
+        from ...core.population import uniform_id_choice
+
+        return uniform_id_choice(round_idx, client_id_list_in_total, client_num_per_round)
 
     def test_on_server_for_all_clients(self, round_idx: int) -> Dict[str, Any]:
         stats = self.aggregator.test(self.test_global, self.device, self.args)

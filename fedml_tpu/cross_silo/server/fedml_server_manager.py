@@ -23,18 +23,20 @@ from typing import Any, Dict, List, Optional
 from ...core.distributed.comm_manager import FedMLCommManager
 from ...core.distributed.communication.message import Message
 from ...core.distributed.straggler import RoundTimeoutMixin
+from ...core.population import PopulationPacingMixin
 from ..message_define import MyMessage
 
 logger = logging.getLogger(__name__)
 
 
-class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
+class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0, backend: str = "LOOPBACK"):
         super().__init__(args, comm, client_rank, client_num + 1, backend)
         self.aggregator = aggregator
         self.round_num = int(getattr(args, "comm_round", 1))
         self.args.round_idx = 0
         self.client_num = int(client_num)
+        self.per_round = int(getattr(args, "client_num_per_round", self.client_num) or self.client_num)
         self.client_online_status: Dict[int, bool] = {}
         self.is_initialized = False
         self.client_id_list_in_this_round: List[int] = []
@@ -43,6 +45,10 @@ class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
         # straggler tolerance (0 = reference semantics: wait forever) —
         # the shared machinery lives in core/distributed/straggler.py
         self.init_straggler_tolerance(args)
+        # fleet registry + selection policy + pacer (core/population); the
+        # uniform policy reproduces client_selection's legacy pcg64 schedule
+        self.init_population(args, list(range(1, self.client_num + 1)),
+                             rng_style="pcg64")
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -99,9 +105,8 @@ class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
 
     def send_init_msg(self) -> None:
         """Round-0 kick-off (reference send_message_init_config :182)."""
-        self.client_id_list_in_this_round = self.aggregator.client_selection(
-            self.args.round_idx, list(range(1, self.client_num + 1)),
-            int(getattr(self.args, "client_num_per_round", self.client_num)),
+        self.client_id_list_in_this_round = self._population_round_list(
+            self.args.round_idx, self.per_round
         )
         self.data_silo_index_of_client = dict(zip(
             self.client_id_list_in_this_round,
@@ -150,10 +155,8 @@ class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
                 self.client_id_list_in_this_round.index(sender), model_params,
                 local_sample_number,
             )
-            if not self.aggregator.check_whether_all_receive():
-                return
-            self._cancel_round_timer()
-            self._finalize_safely(None)
+            self._note_population_report(sender, local_sample_number)
+            self._close_round_if_complete()
 
     def _finalize_round(self, indices: Optional[List[int]]) -> None:
         """Close the current round (caller holds the lock): aggregate the
@@ -174,10 +177,11 @@ class FedMLServerManager(RoundTimeoutMixin, FedMLCommManager):
             self.finish()
             return
 
-        # next round participants + model sync (reference :202)
-        self.client_id_list_in_this_round = self.aggregator.client_selection(
-            self.args.round_idx, list(range(1, self.client_num + 1)),
-            int(getattr(self.args, "client_num_per_round", self.client_num)),
+        # next round participants + model sync (reference :202) — the
+        # population policy replaces direct client_selection (over-commit
+        # inflates the invite list when pacing is on)
+        self.client_id_list_in_this_round = self._population_round_list(
+            self.args.round_idx, self.per_round
         )
         self.data_silo_index_of_client = dict(zip(
             self.client_id_list_in_this_round,

@@ -32,8 +32,14 @@ class Server:
                 init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
             )
         worker_num = int(getattr(args, "client_num_per_round", getattr(args, "client_num_in_total", 1)))
+        # with over-commit the manager invites ceil(K * overcommit) silos per
+        # round — the aggregator's slot table must cover the whole invite
+        # list or uploads past slot K would be invisible to received_indices
+        from ...core.population import RoundPacer
+
+        slots = RoundPacer.from_args(args).invite_count(worker_num)
         aggregator = FedMLAggregator(
-            test_data_global, train_data_global, train_data_num, worker_num,
+            test_data_global, train_data_global, train_data_num, slots,
             device, args, server_aggregator,
         )
         backend = str(getattr(args, "backend", "LOOPBACK"))

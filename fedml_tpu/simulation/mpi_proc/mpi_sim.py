@@ -112,6 +112,10 @@ class MPIProcessSimulator:
         )
         last: Dict[str, Any] = {}
         for round_idx in range(comm_round):
+            # stays on the uniform client_sampling seam (NOT a per-rank
+            # PopulationManager): every rank must derive the identical
+            # schedule from round_idx alone, and a state-driven policy's
+            # rank-local registry would diverge across ranks
             sampled = client_sampling(round_idx, n_total, cpr)
             mine = [int(c) for c in sampled[self.rank :: self.world]]
             acc_tree = None

@@ -89,6 +89,19 @@ class FedAvgAPI:
         self.metrics = MetricsLogger(args)
         self.round_times: List[float] = []
         self.samples_per_round: List[int] = []
+        # population subsystem: registry + selection policy (uniform is
+        # bit-identical to the legacy client_sampling schedule)
+        from ....core.population import PopulationManager
+
+        n_total = int(self.args.client_num_in_total)
+        try:
+            samples = [int(self.train_data_local_num_dict[i]) for i in range(n_total)]
+        except (KeyError, IndexError, TypeError):
+            samples = None
+        self.population = PopulationManager.from_args(
+            self.args, np.arange(n_total), num_samples=samples,
+            rng_style="mt19937",
+        )
 
     def _setup_clients(self):
         for client_idx in range(int(self.args.client_num_per_round)):
@@ -103,11 +116,9 @@ class FedAvgAPI:
             self.client_list.append(c)
 
     def _client_sampling(self, round_idx: int) -> List[int]:
-        from ....core.sampling import client_sampling
-
-        return client_sampling(
-            round_idx, int(self.args.client_num_in_total), int(self.args.client_num_per_round)
-        ).tolist()
+        return [int(c) for c in self.population.select(
+            round_idx, int(self.args.client_num_per_round)
+        )]
 
     def train(self) -> Dict[str, Any]:
         from ....core.checkpoint import checkpoint_frequency, maybe_checkpointer
@@ -157,6 +168,8 @@ class FedAvgAPI:
             dt = time.time() - t0
             self.round_times.append(dt)
             self.metrics.log({"round": round_idx, "round_time_s": round(dt, 4)})
+            # population accounting (synchronous round: invited == reported)
+            self.population.observe_round(round_idx, client_indexes, seconds=dt)
             if ckpt is not None and (
                 round_idx % checkpoint_frequency(self.args) == 0 or round_idx == comm_round - 1
             ):

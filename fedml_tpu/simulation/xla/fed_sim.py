@@ -141,6 +141,29 @@ class XLASimulator:
 
         self.runtime_estimator = RuntimeEstimator(self.n_dev, uniform_devices=True)
         self.scheduler = SeqTrainScheduler(self.n_dev, estimator=self.runtime_estimator)
+        # population subsystem: fleet registry + selection policy; the
+        # uniform policy is bit-identical to the legacy client_sampling
+        # schedule (mt19937), so default configs are unchanged
+        from ...core.population import PopulationManager, stacked_cohorts
+
+        try:
+            samples = [int(self.local_num_dict[i]) for i in range(self.num_clients)]
+        except (KeyError, IndexError, TypeError):
+            samples = None
+        self.population = PopulationManager.from_args(
+            self.args, np.arange(self.num_clients), num_samples=samples,
+            rng_style="mt19937",
+        )
+        # opt-in Parrot-scale path: the whole run's cohorts in ONE vectorized
+        # draw (10^5-10^6 virtual clients with no per-round host choice) —
+        # a different schedule from the per-round seeded draw, hence gated
+        self._stacked_schedule = None
+        if bool(getattr(args, "population_stacked", False)):
+            self._stacked_schedule = stacked_cohorts(
+                self.num_clients, self.clients_per_round,
+                int(getattr(args, "comm_round", 1)),
+                seed=int(getattr(args, "random_seed", 0)),
+            )
         from ...ml.aggregator.aggregator_creator import create_server_aggregator
 
         self.aggregator = create_server_aggregator(model, args)
@@ -566,9 +589,11 @@ class XLASimulator:
         return ids2d.reshape(-1), mask2d.reshape(-1)
 
     def _client_sampling(self, round_idx: int) -> np.ndarray:
-        from ...core.sampling import client_sampling
-
-        return client_sampling(round_idx, self.num_clients, self.clients_per_round)
+        if self._stacked_schedule is not None:
+            return self._stacked_schedule[round_idx % len(self._stacked_schedule)]
+        return np.asarray(
+            self.population.select(round_idx, self.clients_per_round), np.int64
+        )
 
     def train(self) -> Dict[str, Any]:
         from ...core.checkpoint import checkpoint_frequency, maybe_checkpointer
@@ -748,6 +773,9 @@ class XLASimulator:
             from ...core import mlops
 
             mlops.log_round_info(comm_round, round_idx)
+            # population accounting for the synchronous round: everyone
+            # sampled was invited and reported; emits cohort_stats
+            self.population.observe_round(round_idx, sampled, seconds=dt)
             if ckpt is not None and (
                 round_idx % checkpoint_frequency(self.args) == 0 or round_idx == comm_round - 1
             ):

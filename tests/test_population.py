@@ -1,0 +1,468 @@
+"""Population subsystem (core/population/): registry accounting, selection
+policies (with bit-exact legacy-schedule parity on BOTH historical RNG
+styles), the over-commit pacer's arithmetic, vectorized stacked selection
+at Parrot fleet sizes, knob validation, and the cohort_stats sink record.
+
+The parity tests are the PR's contract: with policy=uniform and no pacing
+knobs, every backend's cohort schedule is bit-identical to the pre-population
+code — and the draw no longer stomps the process-global NumPy RNG."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.population import (
+    ClientRegistry,
+    ImportancePolicy,
+    PopulationManager,
+    RoundPacer,
+    StratifiedBySpeedPolicy,
+    UniformPolicy,
+    make_policy,
+    stacked_cohorts,
+    uniform_id_choice,
+)
+from fedml_tpu.core.sampling import client_sampling
+
+
+def _sim_args(**over):
+    base = {
+        "common_args": {"training_type": "simulation", "random_seed": 0, "run_id": "pop"},
+        "data_args": {"dataset": "mnist", "data_cache_dir": "",
+                      "partition_method": "hetero", "partition_alpha": 0.5,
+                      "synthetic_train_size": 320},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg", "client_num_in_total": 16,
+                       "client_num_per_round": 4, "comm_round": 3, "epochs": 1,
+                       "batch_size": 32, "client_optimizer": "sgd",
+                       "learning_rate": 0.1},
+        "validation_args": {"frequency_of_the_test": 2},
+        "comm_args": {"backend": "sp"},
+    }
+    args = Arguments.from_dict(base)
+    for k, v in over.items():
+        setattr(args, k, v)
+    return args
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+class TestClientRegistry:
+    def test_counters_and_snapshot(self):
+        reg = ClientRegistry(np.arange(10), num_samples=np.arange(10) * 10)
+        reg.note_invited([1, 2, 3], round_idx=0)
+        reg.note_reports([1, 2], round_idx=0, seconds=2.0)
+        reg.note_failures([3], round_idx=0)
+        reg.note_rejected_late(3)
+        reg.note_rejoin(3)
+        snap = reg.snapshot()
+        assert snap["fleet"] == 10 and snap["eligible"] == 10
+        assert snap["invited_total"] == 3 and snap["reported_total"] == 2
+        assert snap["failures_total"] == 1
+        assert snap["rejected_late_total"] == 1 and snap["rejoins_total"] == 1
+        rec = reg.record(3)
+        assert rec["invites"] == 1 and rec["failures"] == 1
+        assert rec["rejected_late"] == 1 and rec["rejoins"] == 1
+
+    def test_ema_latency_and_speed_scores(self):
+        reg = ClientRegistry(np.arange(4))
+        # first observation seeds the EMA; later ones blend with alpha=0.3
+        reg.note_report(0, 0, seconds=10.0)
+        reg.note_report(0, 1, seconds=20.0)
+        assert reg.record(0)["ema_seconds"] == pytest.approx(13.0)
+        reg.note_report(1, 0, seconds=1.0)
+        scores = reg.speed_scores()
+        # unseen clients (2, 3) sit at the fleet median of observed EMAs
+        assert scores[0] == pytest.approx(13.0) and scores[1] == pytest.approx(1.0)
+        assert scores[2] == scores[3] == pytest.approx(np.median([13.0, 1.0]))
+
+    def test_runtime_estimator_feed(self):
+        reg = ClientRegistry(np.arange(3))
+        for n, s in ((10, 1.0), (20, 2.0), (30, 3.0)):
+            reg.note_report(1, 0, n_samples=n, seconds=s)
+        pred = reg.predicted_seconds(1, 40)
+        assert pred == pytest.approx(4.0, rel=0.2)
+
+    def test_blocklist_round_trip(self):
+        reg = ClientRegistry(np.arange(6))
+        reg.blocklist([0, 5])
+        assert reg.is_blocklisted(0) and not reg.is_blocklisted(1)
+        assert reg.eligible_count() == 4
+        assert set(map(int, reg.eligible_ids())) == {1, 2, 3, 4}
+        reg.unblocklist([0])
+        assert reg.eligible_count() == 5
+
+    def test_non_contiguous_ids(self):
+        # message-plane fleets are 1-based (and could be sparse): the
+        # id->position map must round-trip counters correctly
+        reg = ClientRegistry([7, 11, 42])
+        reg.note_invited([42], round_idx=0)
+        reg.note_report(42, 0, seconds=1.0)
+        assert reg.record(42)["reports"] == 1 and reg.record(7)["reports"] == 0
+
+    def test_absorb_comm_stats(self):
+        reg = ClientRegistry(np.arange(2))
+        reg.absorb_comm_stats({"retries": 3, "rejoins": 1})
+        assert reg.comm_stats.get("retries") == 3
+        reg.absorb_comm_stats({"retries": 2})
+        assert reg.comm_stats.get("retries") == 5
+
+
+# ---------------------------------------------------------------------------
+# Uniform policy: bit-exact legacy parity, no global-RNG stomp
+# ---------------------------------------------------------------------------
+
+class TestUniformParity:
+    def test_client_sampling_matches_legacy_mt19937_schedule(self):
+        """The fixed seam reproduces the historical global-seeded draw."""
+        for r in range(6):
+            np.random.seed(r)  # the schedule the old code produced
+            legacy = np.random.choice(range(20), 5, replace=False)
+            assert np.array_equal(client_sampling(r, 20, 5), legacy)
+
+    def test_client_sampling_no_longer_stomps_global_rng(self):
+        """The historical bug: sampling reseeded np.random, so every other
+        consumer of the global stream became a function of round_idx."""
+        np.random.seed(1234)
+        expect = np.random.rand(4)
+        np.random.seed(1234)
+        client_sampling(0, 20, 5)  # must not touch the global stream
+        assert np.array_equal(np.random.rand(4), expect)
+
+    def test_uniform_policy_mt19937_matches_client_sampling(self):
+        reg = ClientRegistry(np.arange(20))
+        pol = UniformPolicy(reg, rng_style="mt19937")
+        for r in range(4):
+            assert np.array_equal(pol.select(r, 5), client_sampling(r, 20, 5))
+
+    def test_uniform_policy_pcg64_matches_legacy_message_plane_draw(self):
+        """Cross-silo/cross-device historically drew with
+        default_rng(round_idx) over the literal id list."""
+        ids = list(range(1, 13))
+        reg = ClientRegistry(ids)
+        pol = UniformPolicy(reg, rng_style="pcg64")
+        for r in range(4):
+            legacy = np.random.default_rng(r).choice(ids, 5, replace=False).tolist()
+            assert list(map(int, pol.select(r, 5))) == legacy
+            assert uniform_id_choice(r, ids, 5) == legacy
+
+    def test_full_cohort_when_k_covers_pool(self):
+        reg = ClientRegistry([1, 2, 3])
+        assert list(UniformPolicy(reg, "pcg64").select(0, 3)) == [1, 2, 3]
+        assert list(UniformPolicy(reg, "mt19937").select(0, 7)) == [1, 2, 3]
+
+    def test_blocklist_respected(self):
+        reg = ClientRegistry(np.arange(30))
+        reg.blocklist([0, 1, 2])
+        for r in range(5):
+            cohort = UniformPolicy(reg, "mt19937").select(r, 10)
+            assert not set(map(int, cohort)) & {0, 1, 2}
+
+
+# ---------------------------------------------------------------------------
+# Stratified / importance policies
+# ---------------------------------------------------------------------------
+
+class TestStatefulPolicies:
+    def _seeded_registry(self, n=40):
+        reg = ClientRegistry(np.arange(n), num_samples=(np.arange(n) + 1) * 5)
+        # observed speeds: client i takes i+1 seconds
+        reg.note_reports(np.arange(n), 0, seconds=None)
+        for i in range(n):
+            reg.note_report(i, 0, seconds=float(i + 1))
+        return reg
+
+    def test_stratified_deterministic_and_spans_speed_spectrum(self):
+        reg = self._seeded_registry()
+        pol = StratifiedBySpeedPolicy(reg, num_strata=4)
+        a, b = pol.select(3, 8), pol.select(3, 8)
+        assert np.array_equal(a, b)  # deterministic in round_idx
+        assert not np.array_equal(pol.select(4, 8), a)
+        assert len(set(map(int, a))) == 8
+        # largest-remainder quota: 2 clients from each decile of the
+        # speed-sorted pool (speeds here are client_id + 1 seconds)
+        assert pol.last_strata_sizes == [10, 10, 10, 10]  # stratum pool sizes
+        for lo in (0, 10, 20, 30):
+            assert sum(lo <= int(c) < lo + 10 for c in a) == 2
+
+    def test_stratified_blocklist(self):
+        reg = self._seeded_registry()
+        reg.blocklist(list(range(10)))
+        cohort = StratifiedBySpeedPolicy(reg, num_strata=3).select(0, 9)
+        assert all(int(c) >= 10 for c in cohort)
+
+    def test_importance_weights_toward_large_clients(self):
+        reg = self._seeded_registry(n=50)
+        pol = ImportancePolicy(reg, alpha=2.0)
+        picks = np.concatenate([pol.select(r, 10) for r in range(30)])
+        assert len(set(map(int, pol.select(0, 10)))) == 10
+        assert np.array_equal(pol.select(5, 10), pol.select(5, 10))
+        # (num_samples+1)^2 weighting: the big half must dominate the draws
+        big = np.count_nonzero(picks >= 25)
+        assert big > 0.6 * picks.size
+
+    def test_importance_staleness_boost(self):
+        reg = ClientRegistry(np.arange(20), num_samples=np.full(20, 10))
+        # everyone equal except client 7, unseen since round 0
+        reg.note_reports(np.delete(np.arange(20), 7), 99, seconds=1.0)
+        pol = ImportancePolicy(reg, alpha=0.0, staleness_weight=50.0)
+        hits = sum(7 in set(map(int, pol.select(r, 5))) for r in range(100, 140))
+        base = sum(3 in set(map(int, pol.select(r, 5))) for r in range(100, 140))
+        assert hits > base
+
+    def test_make_policy_dispatch_and_unknown_name(self):
+        reg = ClientRegistry(np.arange(4))
+        assert make_policy("uniform", reg, rng_style="pcg64").name == "uniform"
+        assert make_policy("stratified", reg, rng_style="mt19937",
+                           num_strata=2).name == "stratified"
+        assert make_policy("importance", reg, rng_style="mt19937",
+                           importance_alpha=1.0).name == "importance"
+        with pytest.raises(ValueError):
+            make_policy("bogus", reg, rng_style="mt19937")
+
+
+# ---------------------------------------------------------------------------
+# Pacer arithmetic
+# ---------------------------------------------------------------------------
+
+class TestRoundPacer:
+    def test_invite_count_ceil_with_float_guard(self):
+        p = RoundPacer(overcommit=1.1)
+        # 10 * 1.1 is 11.000000000000002 in floats: must not ceil to 12
+        assert p.invite_count(10) == 11
+        assert RoundPacer(overcommit=1.5).invite_count(2) == 3
+        assert RoundPacer(overcommit=1.0).invite_count(7) == 7
+
+    def test_quorum_for(self):
+        assert RoundPacer().quorum_for(4, 4) == 4          # default: target K
+        assert RoundPacer(quorum=2).quorum_for(4, 6) == 2  # explicit quorum
+        assert RoundPacer(quorum=9).quorum_for(4, 3) == 3  # clamped to invited
+        assert RoundPacer().quorum_for(0, 0) == 1          # never zero
+
+    def test_enabled_flag(self):
+        assert not RoundPacer().enabled
+        assert RoundPacer(overcommit=1.2).enabled
+        assert RoundPacer(quorum=3).enabled
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RoundPacer(overcommit=0.9)
+        with pytest.raises(ValueError):
+            RoundPacer(quorum=-1)
+
+    def test_from_args(self):
+        args = _sim_args(pacing_overcommit=1.5, pacing_quorum=3).validate()
+        p = RoundPacer.from_args(args)
+        assert p.overcommit == 1.5 and p.quorum == 3
+
+
+# ---------------------------------------------------------------------------
+# Stacked (vectorized whole-run) selection
+# ---------------------------------------------------------------------------
+
+class TestStackedCohorts:
+    def test_draws_cohort_from_100k_fleet_in_one_call(self):
+        """The acceptance bar: a Parrot-scale fleet (>= 1e5 virtual clients)
+        scheduled in ONE vectorized call — no Python loop over clients."""
+        n, k, rounds = 120_000, 64, 8
+        sched = stacked_cohorts(n, k, rounds, seed=3)
+        assert sched.shape == (rounds, k) and sched.dtype == np.int64
+        for row in sched:
+            assert len(set(map(int, row))) == k  # no replacement
+        assert sched.min() >= 0 and sched.max() < n
+        # rounds differ (astronomically unlikely to collide)
+        assert not np.array_equal(sched[0], sched[1])
+
+    def test_deterministic_in_seed(self):
+        a = stacked_cohorts(1000, 10, 5, seed=11)
+        b = stacked_cohorts(1000, 10, 5, seed=11)
+        c = stacked_cohorts(1000, 10, 5, seed=12)
+        assert np.array_equal(a, b) and not np.array_equal(a, c)
+
+    def test_blocked_never_drawn(self):
+        blocked = np.arange(50)
+        sched = stacked_cohorts(200, 40, 20, seed=0, blocked=blocked)
+        assert sched.min() >= 50
+
+    def test_weighted_draw_biases_heavy_clients(self):
+        w = np.ones(1000)
+        w[:100] = 200.0
+        sched = stacked_cohorts(1000, 50, 40, seed=5, weights=w)
+        heavy = np.count_nonzero(sched < 100)
+        assert heavy > 0.5 * sched.size
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            stacked_cohorts(10, 0, 5)
+        with pytest.raises(ValueError):
+            stacked_cohorts(10, 11, 5)
+        with pytest.raises(ValueError):
+            stacked_cohorts(10, 5, 0)
+        with pytest.raises(ValueError):
+            stacked_cohorts(10, 8, 2, blocked=np.arange(5))  # leaves 5 < k=8
+
+
+# ---------------------------------------------------------------------------
+# Knob validation (fail at config time, not mid-run)
+# ---------------------------------------------------------------------------
+
+class TestArgumentValidation:
+    def test_per_round_must_fit_fleet(self):
+        with pytest.raises(ValueError, match="client_num_per_round"):
+            _sim_args(client_num_per_round=32).validate()
+
+    def test_overcommit_floor(self):
+        with pytest.raises(ValueError, match="pacing_overcommit"):
+            _sim_args(pacing_overcommit=0.5).validate()
+
+    def test_quorum_floor(self):
+        with pytest.raises(ValueError, match="pacing_quorum"):
+            _sim_args(pacing_quorum=-2).validate()
+
+    def test_policy_enum(self):
+        with pytest.raises(ValueError, match="selection_policy"):
+            _sim_args(selection_policy="fastest_first").validate()
+
+    def test_strata_floor(self):
+        with pytest.raises(ValueError, match="population_strata"):
+            _sim_args(population_strata=0).validate()
+
+    def test_blocklist_must_leave_a_cohort(self):
+        with pytest.raises(ValueError, match="population_blocklist"):
+            _sim_args(population_blocklist=list(range(14))).validate()
+
+    def test_valid_knobs_pass(self):
+        args = _sim_args(selection_policy="stratified", pacing_overcommit=1.25,
+                         pacing_quorum=2, population_strata=3,
+                         population_blocklist=[0, 1]).validate()
+        assert args.pacing_overcommit == 1.25
+
+
+# ---------------------------------------------------------------------------
+# Manager + cohort_stats observability
+# ---------------------------------------------------------------------------
+
+class TestPopulationManager:
+    def test_invite_report_close_cycle(self):
+        args = _sim_args(pacing_overcommit=1.5).validate()
+        emitted = []
+        mgr = PopulationManager.from_args(args, list(range(1, 9)),
+                                          rng_style="pcg64", emit=emitted.append)
+        invited = mgr.invite(0, 4)
+        assert len(invited) == 6  # ceil(4 * 1.5)
+        assert mgr.quorum == 4
+        for cid in invited[:3]:
+            assert mgr.note_report(cid, round_idx=0, seconds=1.0)
+        assert not mgr.note_report(invited[0], round_idx=0)  # idempotent
+        assert not mgr.quorum_reached()
+        assert mgr.note_report(invited[3], round_idx=0)
+        assert mgr.quorum_reached()
+        mgr.note_rejected_late(invited[5])
+        stats = mgr.close_round(reason="quorum", seconds=2.5)
+        assert stats is emitted[-1]
+        assert stats["invited"] == 6 and stats["reported"] == 4
+        assert stats["failed"] == 2 and stats["rejected_late"] == 1
+        assert stats["close_reason"] == "quorum" and stats["target_k"] == 4
+        assert stats["round_seconds"] == pytest.approx(2.5)
+        assert stats["rejected_late_total"] == 1
+
+    def test_observe_round_vectorized_surface(self):
+        args = _sim_args().validate()
+        emitted = []
+        mgr = PopulationManager.from_args(args, np.arange(1000),
+                                          emit=emitted.append)
+        inv = np.arange(100)
+        stats = mgr.observe_round(0, inv, reported_ids=inv[:90], seconds=1.0)
+        assert stats["invited"] == 100 and stats["reported"] == 90
+        assert stats["failed"] == 10
+        assert mgr.registry.snapshot()["failures_total"] == 10
+        assert emitted == [stats] and mgr.history == [stats]
+
+    def test_cohort_stats_lands_in_inmemory_sink(self):
+        """The default emit path goes through the core/mlops bus: one
+        cohort_stats record per round close, visible to any attached sink."""
+        from fedml_tpu.core import mlops
+        from fedml_tpu.core.mlops import FanoutSink, InMemorySink
+
+        args = _sim_args().validate()
+        mem = InMemorySink()
+        mlops.init(args, FanoutSink([mem]))
+        try:
+            mgr = PopulationManager.from_args(args, list(range(1, 7)),
+                                              rng_style="pcg64")
+            mgr.invite(0, 4)
+            for cid in mgr._invited:
+                mgr.note_report(cid, round_idx=0)
+            mgr.close_round(reason="complete")
+            records = mem.by_topic("cohort_stats")
+            assert len(records) == 1
+            rec = records[0]
+            assert rec["round_idx"] == 0 and rec["policy"] == "uniform"
+            assert rec["close_reason"] == "complete"
+            assert rec["invited"] == rec["reported"] == 4
+        finally:
+            mlops.finish()
+
+    def test_from_args_applies_blocklist(self):
+        args = _sim_args(population_blocklist=[1, 2]).validate()
+        mgr = PopulationManager.from_args(args, list(range(16)))
+        assert mgr.registry.eligible_count() == 14
+        for r in range(4):
+            assert not set(map(int, mgr.select(r, 6))) & {1, 2}
+
+
+# ---------------------------------------------------------------------------
+# Cross-backend determinism: one seed, one policy -> one schedule
+# ---------------------------------------------------------------------------
+
+class TestCrossBackendDeterminism:
+    def _build(self, backend):
+        import fedml_tpu
+
+        args = fedml_tpu.init(_sim_args(backend=backend).validate(),
+                              should_init_logs=False)
+        dataset, out_dim = fedml_tpu.data.load(args)
+        model = fedml_tpu.models.create(args, out_dim)
+        return args, dataset, model
+
+    def test_sp_and_xla_share_the_legacy_schedule(self):
+        """Same seed + uniform policy -> bit-identical cohorts on the sp and
+        XLA simulators, both equal to the historical global-seeded draw."""
+        from fedml_tpu.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+        from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+        args, dataset, model = self._build("sp")
+        sp = FedAvgAPI(args, None, dataset, model)
+        args_x, dataset_x, model_x = self._build("XLA")
+        xla = XLASimulator(args_x, dataset_x, model_x)
+        for r in range(3):
+            legacy = client_sampling(r, 16, 4)
+            assert list(map(int, sp._client_sampling(r))) == list(map(int, legacy))
+            assert np.array_equal(np.asarray(xla._client_sampling(r)), legacy)
+
+    def test_message_plane_managers_share_the_pcg64_schedule(self):
+        """The cross-silo aggregator seam and a pcg64 PopulationManager draw
+        the identical legacy default_rng(round_idx) cohort."""
+        from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+
+        ids = list(range(1, 9))
+        args = _sim_args().validate()
+        mgr = PopulationManager.from_args(args, ids, rng_style="pcg64")
+        for r in range(4):
+            legacy = np.random.default_rng(r).choice(ids, 3, replace=False).tolist()
+            assert FedMLAggregator.client_selection(None, r, ids, 3) == legacy
+            assert [int(c) for c in mgr.select(r, 3)] == legacy
+
+    def test_stacked_schedule_is_pure_function_of_config(self):
+        from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+        args, dataset, model = self._build("XLA")
+        args.population_stacked = True
+        sim = XLASimulator(args, dataset, model)
+        expect = stacked_cohorts(16, 4, 3, seed=0)
+        for r in range(3):
+            assert np.array_equal(sim._client_sampling(r), expect[r])

@@ -1,0 +1,372 @@
+"""Over-commit + deadline-quorum pacing (core/population/pacing.py) layered
+on the round-timeout machinery (core/distributed/straggler.py).
+
+Two levels:
+
+* mixin-level, with a stub aggregator — the quorum close condition, the
+  reject-late accounting on stale uploads, the re-arm-below-floor path and
+  generation safety, all deterministic (no wall clock);
+* end-to-end over LOOPBACK with a scripted ``faults.py`` delay plan — one
+  silo's upload is held in flight, the round must close at quorum, and the
+  straggler's late upload must be rejected AND counted in ``cohort_stats``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core import mlops
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+from fedml_tpu.core.distributed.straggler import RoundTimeoutMixin
+from fedml_tpu.core.mlops import FanoutSink, InMemorySink
+from fedml_tpu.core.population import PopulationPacingMixin
+
+
+# ---------------------------------------------------------------------------
+# Mixin level (stub aggregator, no transport, no wall clock)
+# ---------------------------------------------------------------------------
+
+class _StubAggregator:
+    """The three calls the close path makes, over a plain set of ids."""
+
+    def __init__(self, expected):
+        self.expected = int(expected)
+        self.flags = []
+        self.consumed = None
+
+    def note(self, cid):
+        self.flags.append(int(cid))
+
+    def received_indices(self):
+        return list(self.flags)
+
+    def check_whether_all_receive(self):
+        return len(self.flags) >= self.expected
+
+    def consume_received(self, got):
+        self.consumed = list(got)
+        return list(got)
+
+
+def _manager(n=3, per_round=2, overcommit=1.0, quorum=0, timeout_s=30.0,
+             min_clients=1):
+    class _M(PopulationPacingMixin, RoundTimeoutMixin):
+        pass
+
+    class _A:
+        pass
+
+    a = _A()
+    a.round_timeout_s = timeout_s
+    a.round_timeout_min_clients = min_clients
+    a.round_idx = 0
+    a.pacing_overcommit = overcommit
+    a.pacing_quorum = quorum
+    a.selection_policy = "uniform"
+
+    m = _M()
+    m.args = a
+    m.init_straggler_tolerance(a)
+    m.init_population(a, list(range(1, n + 1)), rng_style="pcg64")
+    m.client_id_list_in_this_round = m._population_round_list(0, per_round)
+    m.aggregator = _StubAggregator(expected=len(m.client_id_list_in_this_round))
+    m.finalized = []
+    m._finalize_round = m.finalized.append
+    return m
+
+
+class TestPacingMixin:
+    def test_overcommit_invite_list(self):
+        m = _manager(n=6, per_round=4, overcommit=1.5)
+        try:
+            assert len(m.client_id_list_in_this_round) == 6  # ceil(4 * 1.5)
+            assert m.population.quorum == 4  # quorum defaults to target K
+        finally:
+            m._cancel_round_timer()
+
+    def test_pacing_off_is_wait_for_all(self):
+        m = _manager(n=3, per_round=3)  # overcommit 1.0, quorum 0: inert
+        assert not m.population.pacer.enabled
+        for cid in m.client_id_list_in_this_round[:-1]:
+            m.aggregator.note(cid)
+            m._note_population_report(cid)
+            assert m._close_round_if_complete() is False
+        last = m.client_id_list_in_this_round[-1]
+        m.aggregator.note(last)
+        m._note_population_report(last)
+        assert m._close_round_if_complete() is True
+        assert m.finalized == [None]  # reference full-cohort close path
+        assert m._had_timeout_close is False
+        assert m.population.history[-1]["close_reason"] == "complete"
+
+    def test_quorum_close_then_late_upload_rejected_and_counted(self):
+        """The pacing contract end to end at the mixin seam: close at quorum
+        with the straggler outstanding, then its late upload (old round tag)
+        is dropped by the stale-upload policy and lands in the registry's
+        rejected_late accounting."""
+        m = _manager(n=3, per_round=2, overcommit=1.5)  # invite all 3, K=2
+        invited = m.client_id_list_in_this_round
+        assert len(invited) == 3 and m.population.quorum == 2
+
+        m.aggregator.note(invited[0])
+        m._note_population_report(invited[0])
+        assert m._close_round_if_complete() is False  # 1 < quorum
+
+        m.aggregator.note(invited[1])
+        m._note_population_report(invited[1])
+        assert m._close_round_if_complete() is True
+        assert m.aggregator.consumed == [invited[0], invited[1]]
+        assert m.finalized == [[invited[0], invited[1]]]
+        # a straggler is outstanding: untagged late arrivals are now
+        # droppable, exactly as after a deadline close
+        assert m._had_timeout_close is True
+        stats = m.population.history[-1]
+        assert stats["close_reason"] == "quorum"
+        assert stats["invited"] == 3 and stats["reported"] == 2
+        assert stats["failed"] == 1
+
+        # the server moves on; the straggler's round-0 upload arrives late
+        m.args.round_idx = 1
+        assert m._is_stale_upload(0, sender=invited[2]) is True
+        assert m.population.registry.record(invited[2])["rejected_late"] == 1
+        assert m.population.registry.snapshot()["rejected_late_total"] == 1
+
+    def test_full_house_close_is_complete_even_with_pacing_on(self):
+        m = _manager(n=3, per_round=3, overcommit=1.0, quorum=2)
+        assert m.population.pacer.enabled  # quorum knob alone enables pacing
+        for cid in m.client_id_list_in_this_round[:2]:
+            m.aggregator.note(cid)
+            m._note_population_report(cid)
+        # feed the third BEFORE the close check runs (burst arrival): the
+        # close must report 'complete', not 'quorum'
+        third = m.client_id_list_in_this_round[2]
+        m.aggregator.note(third)
+        m._note_population_report(third)
+        assert m._close_round_if_complete() is True
+        assert m._had_timeout_close is False
+        assert m.population.history[-1]["close_reason"] == "complete"
+
+    def test_deadline_close_emits_cohort_stats(self):
+        m = _manager(n=3, per_round=2, overcommit=1.5, min_clients=1)
+        try:
+            invited = m.client_id_list_in_this_round
+            m.aggregator.note(invited[0])
+            m._note_population_report(invited[0])
+            m._on_round_timeout(m._gen)  # the deadline fires below quorum
+            assert m.finalized == [[invited[0]]]
+            assert m._had_timeout_close is True
+            stats = m.population.history[-1]
+            assert stats["close_reason"] == "deadline"
+            assert stats["reported"] == 1 and stats["failed"] == 2
+        finally:
+            m._cancel_round_timer()
+
+    def test_timeout_below_floor_rearms_instead_of_closing(self):
+        m = _manager(n=3, per_round=2, overcommit=1.5, min_clients=2)
+        try:
+            invited = m.client_id_list_in_this_round
+            m.aggregator.note(invited[0])
+            m._note_population_report(invited[0])
+            m._on_round_timeout(m._gen)  # 1 < min_clients floor
+            assert m.finalized == []  # no close
+            assert m.population.history == []  # no cohort_stats emitted
+            assert m._round_timer is not None  # timer re-armed
+        finally:
+            m._cancel_round_timer()
+
+    def test_stale_generation_timeout_is_a_noop(self):
+        m = _manager(n=3, per_round=2, overcommit=1.5)
+        try:
+            for cid in m.client_id_list_in_this_round:
+                m.aggregator.note(cid)
+                m._note_population_report(cid)
+            stale_gen = m._gen
+            m._gen += 1  # the phase closed; the in-flight callback lost
+            m._on_round_timeout(stale_gen)
+            assert m.finalized == [] and m.population.history == []
+        finally:
+            m._cancel_round_timer()
+
+    def test_rejoin_hook_reaches_registry(self):
+        m = _manager(n=3, per_round=2)
+        m.client_online_status = {}
+        m.is_initialized = True
+        m._note_client_online(2, epoch="aaa")  # first sight after init
+        assert m.population.registry.record(2)["rejoins"] == 1
+
+
+# ---------------------------------------------------------------------------
+# End to end: chaos-style delay plan over LOOPBACK
+# ---------------------------------------------------------------------------
+
+def _e2e_args(run_id: str, n: int, **extra):
+    cfg = {
+        "common_args": {"training_type": "cross_silo", "random_seed": 0,
+                        "run_id": run_id},
+        "data_args": {"dataset": "synthetic", "data_cache_dir": "",
+                      "partition_method": "homo", "synthetic_train_size": 240},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": n,
+            "client_num_per_round": n,
+            "comm_round": 2,
+            "epochs": 1,
+            "batch_size": 16,
+            "client_optimizer": "sgd",
+            "learning_rate": 0.1,
+            **extra,
+        },
+        "validation_args": {"frequency_of_the_test": 1},
+        "comm_args": {"backend": "LOOPBACK"},
+    }
+    return Arguments.from_dict(cfg).validate()
+
+
+def _run_server_bounded(server, timeout_s=150):
+    import faulthandler
+
+    out = {}
+
+    def _target():
+        try:
+            out["history"] = server.run()
+        except BaseException as e:
+            out["exc"] = e
+
+    t = threading.Thread(target=_target, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive():
+        faulthandler.dump_traceback()
+        raise AssertionError(f"server.run() wedged for {timeout_s}s")
+    if "exc" in out:
+        raise out["exc"]
+    return out["history"]
+
+
+def test_quorum_close_with_late_upload_rejected_e2e():
+    """3 silos, target K=2, overcommit 1.5 (invite all 3), with a faults.py
+    delay holding silo 3's round-0 upload in flight: round 0 must close at
+    quorum with 2 uploads, and the held upload must arrive during round 1,
+    be dropped by its stale round tag, and show up in the cohort_stats
+    stream (per-round ``rejected_late`` and fleet ``rejected_late_total``)."""
+    LoopbackHub.reset()
+    n = 3
+    extra = dict(
+        client_num_per_round=2,
+        pacing_overcommit=1.5,
+        round_timeout_s=30.0,
+        fault_plan={
+            "seed": 7,
+            "rules": [
+                # hold silo 3's round-0 upload (msg_type 3) in flight long
+                # enough that the quorum close beats it...
+                {"kind": "delay", "direction": "send", "sender": 3,
+                 "msg_type": 3, "round": 0, "times": 1, "delay_s": 1.0},
+                # ...and hold silos 1+2's round-1 sync (msg_type 2) even
+                # longer, so the late upload lands while round 1 is open
+                {"kind": "delay", "direction": "send", "sender": 0,
+                 "receiver": [1, 2], "msg_type": 2, "round": 1, "times": 2,
+                 "delay_s": 3.0},
+            ],
+        },
+    )
+
+    def mk_args(rank, role):
+        a = _e2e_args("pop-pace-1", n, **extra)
+        a.role, a.rank = role, rank
+        return fedml_tpu.init(a, should_init_logs=False)
+
+    from fedml_tpu.cross_silo.client.client import Client
+    from fedml_tpu.cross_silo.server.server import Server
+
+    args_s = mk_args(0, "server")
+    mem = InMemorySink()
+    mlops.init(args_s, FanoutSink([mem]))
+    try:
+        ds, out_dim = fedml_tpu.data.load(args_s)
+        server = Server(args_s, None, ds, fedml_tpu.models.create(args_s, out_dim))
+
+        clients = []
+        for r in range(1, n + 1):
+            a = mk_args(r, "client")
+            ds_c, od = fedml_tpu.data.load(a)
+            clients.append(Client(a, None, ds_c, fedml_tpu.models.create(a, od)))
+
+        threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+        for t in threads:
+            t.start()
+        history = _run_server_bounded(server)
+        assert len(history) == 2  # both rounds completed despite the holds
+
+        deadline = time.time() + 120
+        for t in threads:
+            t.join(timeout=max(1.0, deadline - time.time()))
+        assert not any(t.is_alive() for t in threads)
+
+        records = mem.by_topic("cohort_stats")
+        assert len(records) == 2  # one per round close
+        r0 = next(rec for rec in records if rec["round_idx"] == 0)
+        assert r0["close_reason"] == "quorum"
+        assert r0["invited"] == 3 and r0["reported"] == 2 and r0["failed"] == 1
+        assert r0["target_k"] == 2 and r0["overcommit"] == 1.5
+        # the held round-0 upload was rejected while a later round was open
+        assert records[-1]["rejected_late_total"] >= 1
+        assert any(rec["rejected_late"] >= 1 for rec in records)
+        # the registry agrees with the sink stream
+        pop = server.server_manager.population
+        assert pop.registry.snapshot()["rejected_late_total"] >= 1
+    finally:
+        mlops.finish()
+
+
+def test_pacing_off_cross_silo_round_flow_unchanged():
+    """Parity guard at the E2E seam: with the pacing knobs at their defaults
+    the cross-silo run closes every round 'complete' with the full cohort —
+    the pre-population round flow, now with cohort_stats observability."""
+    LoopbackHub.reset()
+    n = 2
+
+    def mk_args(rank, role):
+        a = _e2e_args("pop-pace-2", n)
+        a.role, a.rank = role, rank
+        return fedml_tpu.init(a, should_init_logs=False)
+
+    from fedml_tpu.cross_silo.client.client import Client
+    from fedml_tpu.cross_silo.server.server import Server
+
+    args_s = mk_args(0, "server")
+    mem = InMemorySink()
+    mlops.init(args_s, FanoutSink([mem]))
+    try:
+        ds, out_dim = fedml_tpu.data.load(args_s)
+        server = Server(args_s, None, ds, fedml_tpu.models.create(args_s, out_dim))
+        clients = []
+        for r in range(1, n + 1):
+            a = mk_args(r, "client")
+            ds_c, od = fedml_tpu.data.load(a)
+            clients.append(Client(a, None, ds_c, fedml_tpu.models.create(a, od)))
+        threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+        for t in threads:
+            t.start()
+        history = _run_server_bounded(server)
+        assert len(history) == 2
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+
+        records = mem.by_topic("cohort_stats")
+        assert len(records) == 2
+        for rec in records:
+            assert rec["close_reason"] == "complete"
+            assert rec["invited"] == rec["reported"] == n
+            assert rec["failed"] == 0 and rec["rejected_late"] == 0
+    finally:
+        mlops.finish()
