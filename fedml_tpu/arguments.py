@@ -68,6 +68,26 @@ by ``core/population``, semantics in ``docs/POPULATION.md``):
 * ``population_stacked`` (bool, default False) — XLA simulator only:
   draw the whole run's cohorts in one vectorized call (a different,
   single-seed schedule — NOT parity with the per-round draw).
+
+Checkpoint / crash-recovery knobs (``train_args``; consumed by
+``core/checkpoint.py``, recovery semantics in ``docs/FAULT_TOLERANCE.md``):
+
+* ``checkpoint_dir`` (default unset = disabled) — simulator round
+  checkpoint/resume directory (``sp`` / ``xla``).
+* ``checkpoint_keep`` (int >= 1, default 3) — keep-last-N retention for
+  both simulator checkpoints and server state snapshots.
+* ``checkpoint_frequency`` (int >= 1, default 1) — simulator rounds
+  between saves.  The message-plane server snapshots every round open
+  regardless: journal replay is only correct against that round's
+  snapshot.
+* ``server_checkpoint_dir`` (default unset = disabled) — enables
+  message-plane server crash recovery: a per-round state snapshot plus an
+  update journal of accepted uploads; a restarted server resumes the
+  in-flight round instead of restarting the run.
+* ``server_journal_fsync`` (``always`` | ``never``, default ``always``) —
+  whether each journal append fsyncs before the upload is acked.
+  ``never`` trades the power-loss guarantee for upload-path latency
+  (process crashes are still covered by the OS page cache).
 """
 
 from __future__ import annotations
@@ -236,6 +256,33 @@ class Arguments:
         strata = getattr(self, "population_strata", None)
         if strata is not None and int(strata) < 1:
             raise ValueError(f"population_strata must be >= 1 (got {strata})")
+        # checkpoint / server-recovery knobs (core/checkpoint.py) — a typo'd
+        # value must fail here, not be silently ignored by the bare getattr
+        # defaults at the use sites
+        for knob in ("checkpoint_dir", "server_checkpoint_dir"):
+            d = getattr(self, knob, None)
+            if d is not None and not isinstance(d, (str, os.PathLike)):
+                raise ValueError(
+                    f"{knob} must be a path string (got {type(d).__name__}); "
+                    "empty/unset disables checkpointing")
+        for knob, floor in (("checkpoint_keep", 1), ("checkpoint_frequency", 1)):
+            v = getattr(self, knob, None)
+            if v is None:
+                continue
+            try:
+                iv = int(v)
+            except (TypeError, ValueError):
+                raise ValueError(f"{knob} must be an integer >= {floor} (got {v!r})")
+            if iv < floor:
+                raise ValueError(f"{knob} must be >= {floor} (got {iv})")
+        fsync = getattr(self, "server_journal_fsync", None)
+        if fsync is not None:
+            from .core.checkpoint import JOURNAL_FSYNC_POLICIES
+
+            if str(fsync).lower() not in JOURNAL_FSYNC_POLICIES:
+                raise ValueError(
+                    "server_journal_fsync must be one of "
+                    f"{JOURNAL_FSYNC_POLICIES} (got {fsync!r})")
         # a malformed chaos plan should fail at config time, not mid-run when
         # the backend factory first tries to wrap the transport
         plan = getattr(self, "fault_plan", None)

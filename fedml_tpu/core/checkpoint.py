@@ -21,7 +21,9 @@ import json
 import logging
 import os
 import re
+import struct
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -31,6 +33,36 @@ from flax import serialization
 logger = logging.getLogger(__name__)
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+_JOURNAL_RE = re.compile(r"^journal_r(\d+)\.bin$")
+
+JOURNAL_FSYNC_POLICIES = ("always", "never")
+
+
+def _fsync_dir(directory: str) -> None:
+    """fsync a directory so a rename into it survives power loss (POSIX
+    requires the directory entry itself to be synced, not just the file)."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:  # pragma: no cover - non-POSIX dir-open semantics
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + rename + dir-fsync: the file at ``path`` is either the
+    old complete version or the new complete version, never empty/partial."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
 
 
 def _to_host(tree: Any) -> Any:
@@ -74,36 +106,54 @@ class CheckpointManager:
 
     # -- save/restore --------------------------------------------------------
     def save(self, step: int, state: Any, metadata: Optional[Dict[str, Any]] = None) -> str:
-        """Atomically write ``state`` for ``step``; prunes old checkpoints."""
+        """Atomically + durably write ``state`` for ``step``; prunes old
+        checkpoints.  The payload is fsynced before the rename and the
+        directory after it, so a power cut can never leave an empty "latest"
+        file shadowing a good older one."""
         payload = serialization.msgpack_serialize(_to_host(state))
         path = self._path(step)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, path)
+        _atomic_write(path, payload)
         meta = {"step": int(step), "time": time.time()}
         if metadata:
             meta.update(metadata)
-        meta_tmp = path + ".json.tmp"
-        with open(meta_tmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(meta_tmp, path + ".json")
+        _atomic_write(path + ".json", json.dumps(meta).encode("utf-8"))
         self._prune()
         logger.info("checkpoint saved: %s", path)
         return path
 
     def restore(self, step: Optional[int] = None) -> Tuple[int, Any]:
-        """Restore ``(step, state)``; latest step when ``step`` is None.
+        """Restore ``(step, state)``; latest *readable* step when ``step`` is
+        None — a truncated/corrupt latest file is logged, pruned, and the
+        walk falls back to the previous retained step instead of failing the
+        resume.  An explicitly requested ``step`` still raises on corruption.
 
-        Raises ``FileNotFoundError`` when the directory holds no checkpoint.
+        Raises ``FileNotFoundError`` when the directory holds no (readable)
+        checkpoint.
         """
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        if step is not None:
+            return int(step), self._load(step)
+        for cand in reversed(self.all_steps()):
+            try:
+                return int(cand), self._load(cand)
+            except FileNotFoundError:
+                raise
+            except Exception as e:
+                logger.warning(
+                    "checkpoint ckpt_%d.msgpack is unreadable (%s): pruning it "
+                    "and falling back to the previous retained step", cand, e)
+                for suffix in ("", ".json"):
+                    try:
+                        os.remove(self._path(cand) + suffix)
+                    except FileNotFoundError:
+                        pass
+        raise FileNotFoundError(f"no checkpoint in {self.directory}")
+
+    def _load(self, step: int) -> Any:
         with open(self._path(step), "rb") as f:
-            state = serialization.msgpack_restore(f.read())
-        return int(step), state
+            payload = f.read()
+        if not payload:
+            raise ValueError("empty checkpoint file")
+        return serialization.msgpack_restore(payload)
 
     def metadata(self, step: int) -> Dict[str, Any]:
         try:
@@ -136,3 +186,280 @@ def maybe_checkpointer(args: Any) -> Optional[CheckpointManager]:
 
 def checkpoint_frequency(args: Any) -> int:
     return max(int(getattr(args, "checkpoint_frequency", 1)), 1)
+
+
+# ---------------------------------------------------------------------------
+# Message-plane server recovery: update journal + state snapshot + mixin.
+#
+# The simulators above checkpoint a closed-form state between rounds; the
+# message-plane servers additionally hold *mid-round* state — the aggregator
+# slot table filling up with client uploads.  Recovery therefore needs two
+# artifacts with different write cadences:
+#
+#   * a per-round **snapshot** (CheckpointManager) written once at round open:
+#     (global params, round_idx, participant list, registry columns,
+#     incarnation epoch, eval history);
+#   * a per-round **update journal** appended once per accepted upload,
+#     *before* the upload is acked — a restarted server replays the journal
+#     into the slot table, so an acked upload is never lost and a retransmit
+#     of a journaled upload is discarded instead of double-counted.
+# ---------------------------------------------------------------------------
+
+_FRAME_HEADER = struct.Struct("!II")  # (payload length, crc32)
+
+
+class UpdateJournal:
+    """Append-only per-round journal of accepted client uploads.
+
+    One ``journal_r<round>.bin`` per round; each record is a length+crc32
+    framed msgpack blob appended with O_APPEND semantics and (policy
+    permitting) fsynced before the caller acks the upload.  ``replay()``
+    tolerates a truncated or corrupt tail — exactly what a crash mid-append
+    leaves behind — by returning every complete record before it.
+    """
+
+    def __init__(self, directory: str, fsync: str = "always"):
+        fsync = str(fsync).lower()
+        if fsync not in JOURNAL_FSYNC_POLICIES:
+            raise ValueError(
+                f"journal fsync policy must be one of {JOURNAL_FSYNC_POLICIES}, "
+                f"got {fsync!r}")
+        self.directory = directory
+        self.fsync = fsync
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, round_idx: int) -> str:
+        return os.path.join(self.directory, f"journal_r{int(round_idx)}.bin")
+
+    def rounds(self) -> List[int]:
+        found = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _JOURNAL_RE.match(name)
+            if m:
+                found.append(int(m.group(1)))
+        return sorted(found)
+
+    def append(self, round_idx: int, record: Dict[str, Any]) -> None:
+        """Durably append one record; returns only once it is on disk (under
+        the default ``always`` policy), so callers may ack afterwards."""
+        payload = serialization.msgpack_serialize(_to_host(record))
+        frame = _FRAME_HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+        with open(self._path(round_idx), "ab") as f:
+            f.write(frame + payload)
+            f.flush()
+            if self.fsync == "always":
+                os.fsync(f.fileno())
+
+    def replay(self, round_idx: int) -> Tuple[List[Dict[str, Any]], int]:
+        """Read back ``(records, bad_tail)`` for a round.  ``bad_tail`` is 1
+        when a truncated/corrupt trailing frame was discarded (a crash hit
+        mid-append; that upload was never acked, so the client re-sends)."""
+        path = self._path(round_idx)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return [], 0
+        records: List[Dict[str, Any]] = []
+        offset = 0
+        while offset + _FRAME_HEADER.size <= len(blob):
+            length, crc = _FRAME_HEADER.unpack_from(blob, offset)
+            start = offset + _FRAME_HEADER.size
+            payload = blob[start:start + length]
+            if len(payload) < length or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                logger.warning(
+                    "journal %s: discarding corrupt/truncated tail frame at "
+                    "byte %d", path, offset)
+                return records, 1
+            records.append(serialization.msgpack_restore(payload))
+            offset = start + length
+        if offset != len(blob):
+            logger.warning("journal %s: discarding truncated tail header at "
+                           "byte %d", path, offset)
+            return records, 1
+        return records, 0
+
+    def reset_round(self, round_idx: int) -> None:
+        """Start a round's journal from scratch (a *fresh* round open after a
+        crash that predated its snapshot leaves stale same-round entries)."""
+        try:
+            os.remove(self._path(round_idx))
+        except FileNotFoundError:
+            pass
+
+    def prune_before(self, round_idx: int) -> None:
+        for old in self.rounds():
+            if old < int(round_idx):
+                try:
+                    os.remove(self._path(old))
+                except FileNotFoundError:
+                    pass
+
+
+class ServerStateStore:
+    """Snapshot + journal pair backing one message-plane server run.
+
+    Layout under ``directory``: ``state/ckpt_<round>.msgpack`` (snapshot at
+    round open, keep-last-N) and ``journal/journal_r<round>.bin`` (one accepted
+    upload per frame).  The snapshot is authoritative for which round is in
+    flight; journals for finished rounds are pruned at the next round open.
+    """
+
+    def __init__(self, directory: str, keep: int = 3, fsync: str = "always"):
+        self.directory = directory
+        self.snapshots = CheckpointManager(os.path.join(directory, "state"), keep=keep)
+        self.journal = UpdateJournal(os.path.join(directory, "journal"), fsync=fsync)
+
+    def save_round_start(self, round_idx: int, state: Any,
+                         metadata: Optional[Dict[str, Any]] = None) -> str:
+        path = self.snapshots.save(int(round_idx), state, metadata)
+        self.journal.prune_before(round_idx)
+        self.journal.reset_round(round_idx)
+        return path
+
+    def load_latest(self) -> Optional[Tuple[int, Any]]:
+        try:
+            return self.snapshots.restore()
+        except FileNotFoundError:
+            return None
+
+
+def maybe_server_store(args: Any) -> Optional[ServerStateStore]:
+    """Build a ServerStateStore from config, or None when disabled.
+
+    Config keys: ``server_checkpoint_dir`` (enables), ``checkpoint_keep``
+    (snapshot retention, default 3), ``server_journal_fsync``
+    (``always`` | ``never``, default ``always``)."""
+    directory = getattr(args, "server_checkpoint_dir", None)
+    if not directory:
+        return None
+    return ServerStateStore(
+        str(directory),
+        keep=int(getattr(args, "checkpoint_keep", 3)),
+        fsync=str(getattr(args, "server_journal_fsync", "always")),
+    )
+
+
+class ServerRecoveryMixin:
+    """Crash-resumable rounds for the message-plane server managers.
+
+    Mixed into ``cross_silo.server.FedMLServerManager`` and
+    ``cross_device.FedMLServerManager``; the host provides four hooks —
+    ``_capture_global_params`` / ``_restore_global_params`` (model tree in/out
+    of the aggregator), ``_round_start_extras`` / ``_restore_round_extras``
+    (stack-specific state: silo index map, eval history) — plus
+    ``_replay_upload(record)`` to push one journaled upload back into its
+    slot table.  Lifecycle:
+
+    * ``init_server_recovery(args)`` at the end of ``__init__``: loads the
+      latest snapshot (if any), bumps the incarnation epoch, replays the
+      open round's journal, and marks the manager initialized so the
+      ONLINE/epoch rejoin machinery (``straggler.RoundTimeoutMixin``)
+      re-syncs every client into the restored round — the inverse of the
+      client rejoin flow, reusing the same resync path.
+    * ``_save_round_start()`` at every round open (after the participant
+      list is fixed, before any sync/init send).
+    * ``_journal_upload(sender, ...)`` in the upload handler, before the
+      slot-table insert; returns False for a duplicate (already journaled
+      this round), which the handler drops un-counted.
+    """
+
+    def init_server_recovery(self, args: Any) -> None:
+        self._store = maybe_server_store(args)
+        self.server_epoch = 0
+        self._uploads_this_round: set = set()
+        self._recovered_pending_close = False
+        if self._store is None:
+            return
+        loaded = self._store.load_latest()
+        if loaded is None:
+            return
+        round_idx, state = loaded
+        logger.warning("server restore: resuming round %d from %s",
+                       round_idx, self._store.directory)
+        self.server_epoch = int(state.get("server_epoch", 0)) + 1
+        self.args.round_idx = int(round_idx)
+        self.client_id_list_in_this_round = [int(c) for c in state["participants"]]
+        self._had_timeout_close = bool(state.get("had_timeout_close", False))
+        self._restore_global_params(state["global_params"])
+        self._restore_round_extras(state)
+        pop = getattr(self, "population", None)
+        if pop is not None:
+            pop.restore_registry(state["registry"])
+            pop.resume_round(round_idx, self.per_round,
+                             self.client_id_list_in_this_round)
+        records, bad_tail = self._store.journal.replay(round_idx)
+        replayed = 0
+        for rec in records:
+            sender = int(rec["sender"])
+            if sender in self._uploads_this_round:
+                self._comm_stats.inc("dup_uploads_discarded")
+                continue
+            if self._replay_upload(rec):
+                self._uploads_this_round.add(sender)
+                replayed += 1
+        # already-initialized: the ONLINE handshake must NOT restart round 0.
+        # _client_epochs is deliberately NOT restored — every client's next
+        # ONLINE therefore reads as a rejoin and flows through the existing
+        # _resync_rejoined_client path into the restored round.
+        self.is_initialized = True
+        self._comm_stats.inc("server_restores")
+        self._comm_stats.inc("epoch_bumps")
+        self._comm_stats.inc("journal_replays", replayed)
+        self._recovered_pending_close = True
+        logger.warning(
+            "server restore: epoch=%d round=%d participants=%s replayed=%d "
+            "bad_tail=%d", self.server_epoch, round_idx,
+            self.client_id_list_in_this_round, replayed, bad_tail)
+
+    def _save_round_start(self) -> None:
+        """Persist the round-open snapshot; also resets the per-round upload
+        dedup set (kept even with persistence off — a same-round re-upload
+        must never double-count)."""
+        self._uploads_this_round = set()
+        if self._store is None:
+            return
+        state = {
+            "server_epoch": int(self.server_epoch),
+            "participants": np.asarray(
+                [int(c) for c in self.client_id_list_in_this_round], np.int64),
+            "had_timeout_close": bool(getattr(self, "_had_timeout_close", False)),
+            "global_params": self._capture_global_params(),
+        }
+        pop = getattr(self, "population", None)
+        if pop is not None:
+            state["registry"] = pop.export_registry()
+        state.update(self._round_start_extras())
+        self._store.save_round_start(int(self.args.round_idx), state)
+
+    def _journal_upload(self, sender: int, **payload: Any) -> bool:
+        """Record one accepted upload; False = duplicate for this round (the
+        caller must drop it without touching the slot table).  The append is
+        durable before return, and the transport ack happens only after the
+        handler returns (ack-after-dispatch), so ack implies journaled."""
+        sender = int(sender)
+        if sender in self._uploads_this_round:
+            self._comm_stats.inc("dup_uploads_discarded")
+            logger.info("duplicate upload from %d for round %d discarded",
+                        sender, self.args.round_idx)
+            return False
+        if self._store is not None:
+            record = {"round_idx": int(self.args.round_idx), "sender": sender}
+            record.update(payload)
+            self._store.journal.append(self.args.round_idx, record)
+        self._uploads_this_round.add(sender)
+        return True
+
+    def _maybe_close_recovered_round(self) -> None:
+        """One-shot, called from the status handler once transport is live:
+        if the crash happened *after* the cohort's last upload was journaled
+        but *before* the round closed, re-close it now (aggregation is
+        deterministic in (params, uploads), so the result is bit-identical)."""
+        if not self._recovered_pending_close:
+            return
+        self._recovered_pending_close = False
+        self._close_round_if_complete()

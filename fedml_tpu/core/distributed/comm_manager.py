@@ -13,8 +13,11 @@ Beyond-reference: a transport-agnostic **reliability layer** sits between the
 application managers and the backend.  Outbound messages are stamped with a
 monotonic ``msg_id`` (``rank:nonce:seq``; the nonce is fresh per incarnation
 so a rejoined silo never collides with its dead predecessor's ids).  Receivers
-ack every stamped message *before* dispatching it and drop re-deliveries by an
-LRU dedup window, so retries and duplicate faults are idempotent end to end.
+dispatch every fresh stamped message and only then ack it (so an ack implies
+the handler's durable effects — e.g. the server's update journal — are on
+disk), and drop re-deliveries by an LRU dedup window (re-acking them, since
+the first ack may have been the lost frame), so retries and duplicate faults
+are idempotent end to end.
 With ``args.comm_max_retries > 0`` a background retransmitter re-sends
 unacked messages with exponential backoff + jitter and synchronous send
 errors (connection resets) are retried instead of raised; at the default 0
@@ -176,11 +179,18 @@ class _ReliableLink:
                                 "will retry", self.rank, mid, e)
 
     # -- receive side --------------------------------------------------------
-    def on_receive(self, msg: Message) -> bool:
-        """Return True iff ``msg`` should be dispatched to handlers.
+    def on_receive(self, msg: Message,
+                   dispatch: Optional[Callable[[Message], None]] = None) -> bool:
+        """Return True iff ``msg`` is (or should be) dispatched to handlers.
 
         Consumes acks, acks every stamped message (dup or not — the ack may
-        have been the frame that was lost), and drops re-deliveries.
+        have been the frame that was lost), and drops re-deliveries.  When
+        ``dispatch`` is given, a fresh message is dispatched *before* its ack
+        goes out, so receiver-side durable effects (the server's update
+        journal) reach disk before the sender is released from retransmit
+        duty — ack implies processed.  A dispatch that raises withholds the
+        ack and forgets the msg_id, so the sender's retransmit retries the
+        delivery instead of losing it.
         """
         if msg.get_type() == COMM_ACK_TYPE:
             acked = msg.get(Message.MSG_ARG_KEY_MSG_ID)
@@ -189,21 +199,32 @@ class _ReliableLink:
                 with self._cond:
                     self._pending.pop(str(acked), None)
             return False
-        if msg.get_type() in _LOCAL_TYPES:
+        if msg.get_type() in _LOCAL_TYPES or msg.get(Message.MSG_ARG_KEY_MSG_ID) is None:
+            # local pseudo-message or legacy peer: no dedup, no ack
+            if dispatch is not None:
+                dispatch(msg)
             return True
         msg_id = msg.get(Message.MSG_ARG_KEY_MSG_ID)
-        if msg_id is None:
-            return True  # legacy peer: no dedup, no ack
-        self._send_ack(msg)
         with self._seen_lock:
-            if msg_id in self._seen:
-                self.stats.inc("dup_dropped")
-                logger.info("rank %s: dropping duplicate %s (%s)",
-                            self.rank, msg_id, msg.get_type())
-                return False
-            self._seen[msg_id] = None
-            while len(self._seen) > self.dedup_window:
-                self._seen.popitem(last=False)
+            dup = msg_id in self._seen
+            if not dup:
+                self._seen[msg_id] = None
+                while len(self._seen) > self.dedup_window:
+                    self._seen.popitem(last=False)
+        if dup:
+            self.stats.inc("dup_dropped")
+            logger.info("rank %s: dropping duplicate %s (%s)",
+                        self.rank, msg_id, msg.get_type())
+            self._send_ack(msg)  # re-ack: the first ack may have been lost
+            return False
+        if dispatch is not None:
+            try:
+                dispatch(msg)
+            except BaseException:
+                with self._seen_lock:
+                    self._seen.pop(msg_id, None)
+                raise
+        self._send_ack(msg)
         return True
 
     def _send_ack(self, msg: Message) -> None:
@@ -338,11 +359,18 @@ class FedMLCommManager(Observer):
 
     # Observer
     def receive_message(self, msg_type: str, msg_params: Message) -> None:
-        if self._link is not None and not self._link.on_receive(msg_params):
+        if self._link is None:
+            self._dispatch(msg_params)
             return
-        handler = self.message_handler_dict.get(str(msg_type))
+        # the link calls _dispatch for fresh messages BEFORE acking them, so
+        # handler-side durable effects (update journal) precede the ack
+        self._link.on_receive(msg_params, self._dispatch)
+
+    def _dispatch(self, msg_params: Message) -> None:
+        handler = self.message_handler_dict.get(str(msg_params.get_type()))
         if handler is None:
-            logger.debug("rank %s: no handler for msg_type=%s", self.rank, msg_type)
+            logger.debug("rank %s: no handler for msg_type=%s",
+                         self.rank, msg_params.get_type())
             return
         handler(msg_params)
 

@@ -20,7 +20,8 @@ Fault-plan schema (dict / YAML ``fault_args`` section)::
     fault_plan:
       seed: 0                      # seeds per-rule probability draws
       rules:
-        - kind: drop               # drop|delay|duplicate|reset|partition
+        - kind: drop               # drop|delay|duplicate|reset|partition|
+                                   #   server_kill
           direction: send          # send (default) or recv
           sender: 1                # int or list; omit = any
           receiver: 0              # int or list; omit = any
@@ -44,6 +45,13 @@ Kinds:
   recv direction it degrades to a drop (the frame died with the socket).
 * ``partition`` — a standing one-way ``drop`` (A can talk to B while B's
   frames to A vanish) — scope it with sender/receiver/round.
+* ``server_kill`` — hard-crashes this node: the triggering message dies
+  undelivered, the inner receive loop is stopped (the blocking ``run()``
+  returns), and every later send/delivery through the seam is silently
+  dropped — the process is "dead" until a supervisor builds a fresh
+  incarnation.  Scope it ``direction: recv, receiver: <server rank>`` to
+  kill the server at an exact point mid-round (e.g. between two uploads);
+  ``kill_event`` lets a test harness observe the crash.
 
 Determinism: rules match by *occurrence count within their scope*
 (``after``/``times``), not wall-clock, so the same plan injects the same
@@ -64,7 +72,7 @@ from .communication.message import Message
 
 logger = logging.getLogger(__name__)
 
-FAULT_KINDS = ("drop", "delay", "duplicate", "reset", "partition")
+FAULT_KINDS = ("drop", "delay", "duplicate", "reset", "partition", "server_kill")
 
 # local pseudo-messages a backend synthesizes for itself are never faulted
 _EXEMPT_TYPES = ("connection_ready",)
@@ -78,7 +86,10 @@ class CommStats:
         "messages_sent", "retries", "retransmits", "delivery_failures",
         "acks_sent", "acks_received", "dup_dropped",
         "faults_dropped", "faults_delayed", "faults_duplicated",
-        "faults_reset", "reconnects", "rejoins",
+        "faults_reset", "faults_killed", "reconnects", "rejoins",
+        # server crash-recovery counters (core/checkpoint.ServerRecoveryMixin)
+        "server_restores", "journal_replays", "epoch_bumps",
+        "dup_uploads_discarded",
     )
 
     def __init__(self):
@@ -217,6 +228,10 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
         self._injector = injector
         self._stats = stats if stats is not None else CommStats()
         self._observers: List[Observer] = []
+        self._killed = False
+        # set when a server_kill rule fires; test supervisors wait on this to
+        # distinguish "crashed mid-round" from "finished the run"
+        self.kill_event = threading.Event()
         inner.add_observer(self)
 
     # delegate everything the contract doesn't cover (broadcast,
@@ -226,6 +241,8 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
 
     # -- send path -----------------------------------------------------------
     def send_message(self, msg: Message) -> None:
+        if self._killed:
+            return  # dead process: outbound frames go nowhere
         rule = self._injector.decide("send", msg)
         if rule is None:
             self._inner.send_message(msg)
@@ -234,6 +251,8 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
 
     # -- receive path --------------------------------------------------------
     def receive_message(self, msg_type: str, msg: Message) -> None:
+        if self._killed:
+            return  # dead process: inbound frames are never observed
         rule = self._injector.decide("recv", msg)
         if rule is None:
             self._notify(msg)
@@ -242,6 +261,20 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
 
     def _apply(self, rule: FaultRule, msg: Message, forward, direction: str) -> None:
         kind = rule.kind
+        if kind == "server_kill":
+            self._stats.inc("faults_killed")
+            logger.warning(
+                "FAULT server_kill: node dies on %s %s->%s (rule %d); the "
+                "triggering message is lost with the process",
+                msg.get_type(), msg.get_sender_id(), msg.get_receiver_id(),
+                rule.index)
+            self._killed = True
+            self.kill_event.set()
+            try:  # unblock the node's receive loop so run() returns
+                self._inner.stop_receive_message()
+            except Exception:
+                logger.exception("server_kill: inner stop raised")
+            return
         if kind in ("drop", "partition") or (kind == "reset" and direction == "recv"):
             self._stats.inc("faults_dropped")
             logger.info("FAULT %s: dropping %s %s->%s", kind, msg.get_type(),

@@ -102,6 +102,28 @@ class PopulationManager:
     def quorum_reached(self) -> bool:
         return len(self._reported) >= self.quorum
 
+    # -- crash-recovery surface (core/checkpoint.ServerRecoveryMixin) --------
+    def export_registry(self) -> Dict[str, Any]:
+        return self.registry.state_columns()
+
+    def restore_registry(self, cols: Dict[str, Any]) -> None:
+        self.registry.load_state_columns(cols)
+
+    def resume_round(self, round_idx: int, k: int,
+                     invited: Sequence[int]) -> None:
+        """Re-open a round from a restored snapshot WITHOUT re-drawing the
+        policy or re-counting invites: the snapshot was taken at round open,
+        *after* :meth:`invite` ran, so the restored registry columns already
+        carry this round's invite marks but none of its reports.  Journal
+        replay then re-fills ``_reported`` through the normal
+        :meth:`note_report` path, which re-counts each report exactly once
+        (the pre-crash counts died with the old incarnation's memory)."""
+        self._round_idx = int(round_idx)
+        self._target_k = int(k)
+        self._invited = [int(c) for c in invited]
+        self._reported = set()
+        self._rejected_late = 0
+
     def note_rejected_late(self, client_id: int) -> None:
         self._rejected_late += 1
         self.registry.note_rejected_late(int(client_id))

@@ -175,6 +175,40 @@ class ClientRegistry:
             "blocklisted": bool(self._blocked[pos]),
         }
 
+    # -- crash-recovery persistence (core/checkpoint.ServerRecoveryMixin) ----
+    # The runtime-prediction model (``estimator``) is deliberately NOT part of
+    # the persisted state: its per-client observation lists are advisory (they
+    # only shape stratified/importance selection) and refit within a few
+    # rounds of fresh observations after a server restart.
+    _STATE_COLUMNS = ("num_samples", "invites", "reports", "failures",
+                      "rejected_late", "rejoins", "last_seen_round",
+                      "ema_seconds")
+
+    def state_columns(self) -> Dict[str, np.ndarray]:
+        """The registry's durable columns as a flat dict of arrays — msgpack-
+        serializable as-is, so it rides inside the server state snapshot."""
+        cols = {k: np.asarray(getattr(self, k)).copy() for k in self._STATE_COLUMNS}
+        cols["ids"] = self.ids.copy()
+        cols["has_obs"] = self._has_obs.copy()
+        cols["blocked"] = self._blocked.copy()
+        return cols
+
+    def load_state_columns(self, cols: Dict[str, Any]) -> None:
+        """Inverse of :meth:`state_columns`; the id space must be unchanged
+        (a restarted server serves the same fleet it crashed in)."""
+        ids = np.asarray(cols["ids"], np.int64).reshape(-1)
+        if not np.array_equal(ids, self.ids):
+            raise ValueError(
+                "registry snapshot id space does not match this fleet "
+                f"(snapshot has {ids.size} ids, registry has {self.ids.size})")
+        # np.array (not asarray): deserialized columns may be read-only
+        # frombuffer views and the registry mutates these in place
+        for k in self._STATE_COLUMNS:
+            current = getattr(self, k)
+            setattr(self, k, np.array(cols[k], current.dtype).reshape(current.shape))
+        self._has_obs = np.array(cols["has_obs"], bool).reshape(self._has_obs.shape)
+        self._blocked = np.array(cols["blocked"], bool).reshape(self._blocked.shape)
+
     def snapshot(self) -> Dict[str, int]:
         """Fleet-level totals for the ``cohort_stats`` sink record."""
         return {

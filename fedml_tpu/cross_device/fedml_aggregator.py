@@ -63,6 +63,16 @@ class FedMLAggregator:
     def set_global_model_params_from_file(self, path: str) -> None:
         self.variables = unflatten_params(load_edge_model(path))
 
+    # -- crash-recovery persistence (core/checkpoint.ServerRecoveryMixin) ----
+    def export_state(self) -> Dict[str, np.ndarray]:
+        """The global model as a flat name->array dict (msgpack-ready)."""
+        return flatten_params(self.variables)
+
+    def restore_state(self, flat: Dict[str, Any]) -> None:
+        self.variables = unflatten_params(
+            {str(k): np.asarray(v) for k, v in flat.items()}
+        )
+
     # -- collection (reference :44-58) ---------------------------------------
     def add_local_trained_result(self, index: int, model_file: str, sample_num: float) -> None:
         self.model_file_dict[index] = str(model_file)

@@ -16,8 +16,10 @@ by round tag.  Default (knob unset) keeps reference wait-forever semantics.
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional
+import os
+from typing import Any, Dict, List, Optional
 
+from ..core.checkpoint import ServerRecoveryMixin
 from ..core.distributed.comm_manager import FedMLCommManager
 from ..core.distributed.communication.message import Message
 from ..core.distributed.straggler import RoundTimeoutMixin
@@ -27,7 +29,8 @@ from .message_define import MNNMessage
 logger = logging.getLogger(__name__)
 
 
-class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommManager):
+class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
+                         RoundTimeoutMixin, FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0,
                  backend: str = "LOOPBACK"):
         super().__init__(args, comm, client_rank, client_num + 1, backend)
@@ -47,6 +50,9 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
         # fleet registry + selection policy + pacer (core/population)
         self.init_population(args, list(range(1, self.client_num + 1)),
                              rng_style="pcg64")
+        # crash recovery last: a restore overwrites round_idx / participant
+        # list / registry columns and replays the open round's journal
+        self.init_server_recovery(args)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler("connection_ready", self._on_connection_ready)
@@ -71,6 +77,9 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
                 if self._note_client_online(sender, msg.get(MNNMessage.MSG_ARG_KEY_CLIENT_EPOCH)):
                     self._resync_rejoined_client(sender)
             self._handshake_check()
+            # restored round whose journal already held the full cohort:
+            # close it now that the transport is live
+            self._maybe_close_recovered_round()
 
     def _resync_rejoined_client(self, client_id: int) -> None:
         """(lock held) A device that dropped and came back gets the current
@@ -105,6 +114,9 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
             self.args.round_idx, self.per_round
         )
         model_file = self.aggregator.get_global_model_params_file(self.args.round_idx)
+        # durable round-open point: cohort is fixed, no upload accepted yet —
+        # a crash from here on resumes this round in a fresh incarnation
+        self._save_round_start()
         for client_id in self.client_id_list_in_this_round:
             m = Message(msg_type, self.rank, client_id)
             m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, model_file)
@@ -125,6 +137,13 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
                 return
             model_file = msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE)
             n = msg.get(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES)
+            # journal-before-slot-table (the ack follows the handler): the
+            # message plane carries only the upload FILE path, so that is
+            # what the journal records — replay skips entries whose file
+            # vanished (the resync path re-invites those devices instead)
+            if not self._journal_upload(sender, model_file=str(model_file),
+                                        n_samples=n):
+                return
             self.aggregator.add_local_trained_result(
                 self.client_id_list_in_this_round.index(sender), model_file, n
             )
@@ -146,3 +165,39 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
             self.finish()
             return
         self._send_round(MNNMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+
+    # -- ServerRecoveryMixin hooks (core/checkpoint.py) ----------------------
+    def _capture_global_params(self):
+        return self.aggregator.export_state()
+
+    def _restore_global_params(self, flat) -> None:
+        self.aggregator.restore_state(flat)
+
+    def _round_start_extras(self) -> Dict[str, Any]:
+        # eval history lives on the cross-device AGGREGATOR (the manager has
+        # none); persist it so ServerDevice.run()'s summary survives a crash
+        return {"eval_history": list(self.aggregator.eval_history)}
+
+    def _restore_round_extras(self, state: Dict[str, Any]) -> None:
+        self.aggregator.eval_history = [
+            dict(r) for r in state.get("eval_history", [])
+        ]
+
+    def _replay_upload(self, record: Dict[str, Any]) -> bool:
+        """Re-insert one journaled upload.  The journal holds the upload's
+        FILE path, not its tensors — if the file is gone (tmpdir wipe), the
+        entry is dropped and the device is re-synced like any straggler."""
+        sender = int(record["sender"])
+        if sender not in self.client_id_list_in_this_round:
+            return False
+        model_file = str(record["model_file"])
+        if not os.path.exists(model_file):
+            logger.warning("journal replay: upload file %s vanished; device "
+                           "%d will be re-synced", model_file, sender)
+            return False
+        self.aggregator.add_local_trained_result(
+            self.client_id_list_in_this_round.index(sender), model_file,
+            record["n_samples"],
+        )
+        self._note_population_report(sender, record["n_samples"])
+        return True

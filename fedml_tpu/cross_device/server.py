@@ -32,6 +32,10 @@ class ServerDevice:
             args, model, test_global, worker_num=slots,
             model_dir=getattr(args, "edge_model_dir", None),
         )
+        # building the manager may RESUME a crashed run: with
+        # args.server_checkpoint_dir set it restores the latest round
+        # snapshot, replays the upload journal, and bumps its incarnation
+        # epoch (core/checkpoint.ServerRecoveryMixin)
         self.server_manager = FedMLServerManager(
             args,
             self.aggregator,
@@ -39,6 +43,12 @@ class ServerDevice:
             client_num=fleet,
             backend=str(getattr(args, "backend", "LOOPBACK")),
         )
+
+    @property
+    def resumed(self) -> bool:
+        """True when this incarnation restored a crashed predecessor's round
+        (supervisors use this to tell resume from cold start)."""
+        return int(getattr(self.server_manager, "server_epoch", 0)) > 0
 
     def run(self):
         self.server_manager.run()

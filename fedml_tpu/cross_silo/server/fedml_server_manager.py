@@ -20,6 +20,9 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
+from ...core.checkpoint import ServerRecoveryMixin
 from ...core.distributed.comm_manager import FedMLCommManager
 from ...core.distributed.communication.message import Message
 from ...core.distributed.straggler import RoundTimeoutMixin
@@ -29,7 +32,8 @@ from ..message_define import MyMessage
 logger = logging.getLogger(__name__)
 
 
-class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommManager):
+class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
+                         RoundTimeoutMixin, FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0, backend: str = "LOOPBACK"):
         super().__init__(args, comm, client_rank, client_num + 1, backend)
         self.aggregator = aggregator
@@ -49,6 +53,9 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
         # uniform policy reproduces client_selection's legacy pcg64 schedule
         self.init_population(args, list(range(1, self.client_num + 1)),
                              rng_style="pcg64")
+        # crash recovery last: a restore overwrites round_idx / participant
+        # list / registry columns and replays the open round's journal
+        self.init_server_recovery(args)
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -82,6 +89,9 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
             logger.info("client %s status=%s (%d/%d online)", sender, status,
                         sum(self.client_online_status.values()), self.client_num)
             self._handshake_check()
+            # restored round whose journal already held the full cohort:
+            # close it now that the transport is live
+            self._maybe_close_recovered_round()
 
     def _resync_rejoined_client(self, client_id: int) -> None:
         """(lock held) A silo died and came back mid-run: hand it the current
@@ -117,6 +127,9 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
             ),
         ))
         global_model = self.aggregator.get_global_model_params()
+        # durable round-open point: participants + silo map are fixed, no
+        # upload has been accepted yet — a crash from here on resumes round 0
+        self._save_round_start()
         for client_id in self.client_id_list_in_this_round:
             m = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
             m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
@@ -151,6 +164,14 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
                     lambda g, d: jnp.asarray(g) + jnp.asarray(d), base, model_params
                 )
             local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+            # durably journal the accepted upload BEFORE it enters the slot
+            # table; the transport ack goes out only after this handler
+            # returns, so an acked upload is always journaled.  False means
+            # this sender already landed this round (retransmit into a new
+            # incarnation) — discard instead of double-count.
+            if not self._journal_upload(sender, model_params=model_params,
+                                        n_samples=local_sample_number):
+                return
             self.aggregator.add_local_trained_result(
                 self.client_id_list_in_this_round.index(sender), model_params,
                 local_sample_number,
@@ -192,6 +213,10 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
             ),
         ))
         global_model = self.aggregator.get_global_model_params()
+        # durable round-open point (see send_init_msg): a crash during or
+        # after the sync sends resumes THIS round, and clients that already
+        # got the sync are re-synced idempotently on their next ONLINE
+        self._save_round_start()
         for client_id in self.client_id_list_in_this_round:
             m = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
             m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
@@ -203,3 +228,41 @@ class FedMLServerManager(PopulationPacingMixin, RoundTimeoutMixin, FedMLCommMana
     def send_finish_msg(self) -> None:
         for client_id in range(1, self.client_num + 1):
             self._send_safe(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
+
+    # -- ServerRecoveryMixin hooks (core/checkpoint.py) ----------------------
+    def _capture_global_params(self):
+        return self.aggregator.get_global_model_params()
+
+    def _restore_global_params(self, tree) -> None:
+        self.aggregator.set_global_model_params(tree)
+
+    def _round_start_extras(self) -> Dict[str, Any]:
+        # dicts with int keys don't survive msgpack: the silo index map rides
+        # as two parallel columns aligned with the participant list
+        return {
+            "silo_clients": np.asarray(
+                list(self.data_silo_index_of_client.keys()), np.int64),
+            "silo_indices": np.asarray(
+                list(self.data_silo_index_of_client.values()), np.int64),
+            "eval_history": list(self.eval_history),
+        }
+
+    def _restore_round_extras(self, state: Dict[str, Any]) -> None:
+        self.data_silo_index_of_client = {
+            int(c): int(i) for c, i in zip(state["silo_clients"],
+                                           state["silo_indices"])
+        }
+        self.eval_history = [dict(r) for r in state.get("eval_history", [])]
+
+    def _replay_upload(self, record: Dict[str, Any]) -> bool:
+        """Push one journaled upload back into the aggregator slot table —
+        the same inserts the live handler performs, minus the transport."""
+        sender = int(record["sender"])
+        if sender not in self.client_id_list_in_this_round:
+            return False
+        self.aggregator.add_local_trained_result(
+            self.client_id_list_in_this_round.index(sender),
+            record["model_params"], record["n_samples"],
+        )
+        self._note_population_report(sender, record["n_samples"])
+        return True

@@ -44,9 +44,19 @@ class Server:
         )
         backend = str(getattr(args, "backend", "LOOPBACK"))
         client_num = int(getattr(args, "client_num_in_total", worker_num))
+        # building the manager may RESUME a crashed run: with
+        # args.server_checkpoint_dir set it restores the latest round
+        # snapshot, replays the upload journal, and bumps its incarnation
+        # epoch (core/checkpoint.ServerRecoveryMixin)
         self.server_manager = FedMLServerManager(
             args, aggregator, client_rank=0, client_num=client_num, backend=backend
         )
+
+    @property
+    def resumed(self) -> bool:
+        """True when this incarnation restored a crashed predecessor's round
+        (supervisors use this to tell resume from cold start)."""
+        return int(getattr(self.server_manager, "server_epoch", 0)) > 0
 
     def run(self):
         self.server_manager.run()

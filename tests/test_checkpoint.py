@@ -38,6 +38,274 @@ class TestCheckpointManager:
         with pytest.raises(FileNotFoundError):
             mgr.restore()
 
+    def test_retention_removes_sidecars_too(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for step in range(5):
+            mgr.save(step, {"x": np.float32(step)}, metadata={"r": step})
+        names = sorted(p.name for p in tmp_path.iterdir())
+        assert names == ["ckpt_3.msgpack", "ckpt_3.msgpack.json",
+                         "ckpt_4.msgpack", "ckpt_4.msgpack.json"]
+
+    def test_metadata_roundtrip_and_default(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(7, {"x": np.float32(1)}, metadata={"run": "m", "acc": 0.5})
+        meta = mgr.metadata(7)
+        assert meta["run"] == "m" and meta["acc"] == 0.5
+        assert meta["step"] == 7 and "time" in meta
+        # a step with no sidecar degrades to the bare step, never raises
+        assert mgr.metadata(99) == {"step": 99}
+
+    def test_no_tmp_file_left_behind(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, {"x": np.float32(1)})
+        assert not [p for p in tmp_path.iterdir() if p.name.endswith(".tmp")]
+
+    def test_corrupt_latest_falls_back_to_previous(self, tmp_path, caplog):
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        mgr.save(1, {"x": np.float32(1.0)})
+        mgr.save(2, {"x": np.float32(2.0)})
+        # a crash mid-write can only corrupt the file via the power-loss
+        # window; simulate the worst case (truncated + garbage)
+        (tmp_path / "ckpt_2.msgpack").write_bytes(b"\x00garbage")
+        step, state = mgr.restore()
+        assert step == 1 and float(state["x"]) == 1.0
+        # the bad step was pruned (file AND sidecar) so the next save/restore
+        # cycle never trips on it again
+        assert mgr.all_steps() == [1]
+        assert not (tmp_path / "ckpt_2.msgpack.json").exists()
+
+    def test_corrupt_empty_latest_falls_back(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, {"x": np.float32(1.0)})
+        mgr.save(2, {"x": np.float32(2.0)})
+        (tmp_path / "ckpt_2.msgpack").write_bytes(b"")
+        step, _ = mgr.restore()
+        assert step == 1
+
+    def test_all_corrupt_raises_file_not_found(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, {"x": np.float32(1.0)})
+        (tmp_path / "ckpt_1.msgpack").write_bytes(b"junk")
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+
+    def test_explicitly_requested_corrupt_step_raises(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, {"x": np.float32(1.0)})
+        mgr.save(2, {"x": np.float32(2.0)})
+        (tmp_path / "ckpt_2.msgpack").write_bytes(b"junk")
+        with pytest.raises(Exception):
+            mgr.restore(2)  # an explicit ask must not silently time-travel
+
+
+class TestUpdateJournal:
+    def _journal(self, tmp_path, **kw):
+        from fedml_tpu.core.checkpoint import UpdateJournal
+
+        return UpdateJournal(str(tmp_path / "j"), **kw)
+
+    def test_append_replay_roundtrip(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.append(0, {"sender": 1, "n_samples": 10,
+                     "model_params": {"w": np.arange(3.0)}})
+        j.append(0, {"sender": 2, "n_samples": 20,
+                     "model_params": {"w": np.arange(3.0) * 2}})
+        records, bad_tail = j.replay(0)
+        assert bad_tail == 0
+        assert [int(r["sender"]) for r in records] == [1, 2]
+        np.testing.assert_array_equal(records[1]["model_params"]["w"],
+                                      np.arange(3.0) * 2)
+
+    def test_replay_missing_round_is_empty(self, tmp_path):
+        assert self._journal(tmp_path).replay(5) == ([], 0)
+
+    def test_truncated_tail_keeps_complete_records(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.append(0, {"sender": 1})
+        j.append(0, {"sender": 2})
+        path = tmp_path / "j" / "journal_r0.bin"
+        blob = path.read_bytes()
+        path.write_bytes(blob[:-3])  # crash mid-append of record 2
+        records, bad_tail = j.replay(0)
+        assert bad_tail == 1
+        assert [int(r["sender"]) for r in records] == [1]
+
+    def test_corrupt_tail_crc_detected(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.append(0, {"sender": 1})
+        j.append(0, {"sender": 2})
+        path = tmp_path / "j" / "journal_r0.bin"
+        blob = bytearray(path.read_bytes())
+        blob[-1] ^= 0xFF  # bit-rot inside the last record's payload
+        path.write_bytes(bytes(blob))
+        records, bad_tail = j.replay(0)
+        assert bad_tail == 1
+        assert [int(r["sender"]) for r in records] == [1]
+
+    def test_reset_and_prune(self, tmp_path):
+        j = self._journal(tmp_path)
+        for r in (0, 1, 2):
+            j.append(r, {"sender": 1})
+        j.prune_before(2)
+        assert j.rounds() == [2]
+        j.reset_round(2)
+        assert j.rounds() == []
+        assert j.replay(2) == ([], 0)
+
+    def test_bad_fsync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="fsync policy"):
+            self._journal(tmp_path, fsync="sometimes")
+
+
+class TestServerStateStore:
+    def test_roundtrip_and_journal_reset_on_round_open(self, tmp_path):
+        from fedml_tpu.core.checkpoint import ServerStateStore
+
+        store = ServerStateStore(str(tmp_path / "srv"), keep=2)
+        assert store.load_latest() is None
+        store.save_round_start(0, {"participants": np.array([1, 2, 3])})
+        store.journal.append(0, {"sender": 1})
+        # next round open: old journal pruned, new round's journal fresh
+        store.save_round_start(1, {"participants": np.array([1, 2, 3])})
+        assert store.journal.rounds() == []
+        round_idx, state = store.load_latest()
+        assert round_idx == 1
+        np.testing.assert_array_equal(state["participants"], [1, 2, 3])
+
+    def test_reopening_same_round_discards_stale_journal(self, tmp_path):
+        """A crash between round open and snapshot write leaves the PREVIOUS
+        snapshot authoritative; reopening that round must not replay uploads
+        accepted by the dead incarnation for its never-persisted round."""
+        from fedml_tpu.core.checkpoint import ServerStateStore
+
+        store = ServerStateStore(str(tmp_path / "srv"))
+        store.save_round_start(3, {"v": 1})
+        store.journal.append(3, {"sender": 9})
+        store.save_round_start(3, {"v": 2})  # restarted incarnation reopens
+        assert store.journal.replay(3) == ([], 0)
+        assert store.load_latest()[1]["v"] == 2
+
+
+class _RecoveryHost:
+    """Minimal ServerRecoveryMixin host: just the hooks, no transport."""
+
+    def __init__(self, ckpt_dir, round_idx=0):
+        import types
+
+        from fedml_tpu.core.checkpoint import ServerRecoveryMixin
+        from fedml_tpu.core.distributed.faults import CommStats
+
+        class _H(ServerRecoveryMixin):
+            def _capture_global_params(self):
+                return {"w": np.arange(3.0)}
+
+            def _restore_global_params(self, tree):
+                self.restored_params = tree
+
+            def _round_start_extras(self):
+                return {}
+
+            def _restore_round_extras(self, state):
+                pass
+
+            def _replay_upload(self, record):
+                self.replayed.append(record)
+                return True
+
+            def _close_round_if_complete(self):
+                self.close_attempts += 1
+
+        h = _H()
+        h.args = types.SimpleNamespace(server_checkpoint_dir=str(ckpt_dir),
+                                       round_idx=round_idx)
+        h._comm_stats = CommStats()
+        h.client_id_list_in_this_round = [1, 2]
+        h.replayed = []
+        h.close_attempts = 0
+        h.init_server_recovery(h.args)
+        self.h = h
+
+
+class TestServerRecoveryMixin:
+    def test_same_round_duplicate_upload_discarded(self, tmp_path):
+        h = _RecoveryHost(tmp_path / "srv").h
+        h._save_round_start()
+        assert h._journal_upload(1, n_samples=10) is True
+        assert h._journal_upload(1, n_samples=10) is False
+        assert h._comm_stats.get("dup_uploads_discarded") == 1
+        assert h._journal_upload(2, n_samples=20) is True
+
+    def test_restore_replays_journal_exactly_once(self, tmp_path):
+        a = _RecoveryHost(tmp_path / "srv").h
+        a._save_round_start()
+        a._journal_upload(1, n_samples=10)
+        # crash here; a fresh incarnation restores and replays
+        b = _RecoveryHost(tmp_path / "srv").h
+        assert b.server_epoch == 1
+        assert b.args.round_idx == 0
+        assert [int(r["sender"]) for r in b.replayed] == [1]
+        assert b._comm_stats.get("server_restores") == 1
+        assert b._comm_stats.get("epoch_bumps") == 1
+        assert b._comm_stats.get("journal_replays") == 1
+        # a retransmit of the replayed upload into the new incarnation is a
+        # duplicate, not a double count
+        assert b._journal_upload(1, n_samples=10) is False
+        assert b._comm_stats.get("dup_uploads_discarded") == 1
+        assert b._journal_upload(2, n_samples=20) is True
+        # the recovered-round close check fires exactly once
+        b._maybe_close_recovered_round()
+        b._maybe_close_recovered_round()
+        assert b.close_attempts == 1
+
+    def test_round_open_clears_dedup_even_without_store(self, tmp_path):
+        h = _RecoveryHost(tmp_path / "srv").h
+        h._store = None  # persistence off: dedup still enforced per round
+        h._save_round_start()
+        assert h._journal_upload(1) is True
+        assert h._journal_upload(1) is False
+        h._save_round_start()
+        assert h._journal_upload(1) is True
+
+
+class TestCheckpointKnobValidation:
+    def _cfg(self, **train_extra):
+        cfg = {
+            "common_args": {"training_type": "cross_silo", "random_seed": 0,
+                            "run_id": "kv"},
+            "data_args": {"dataset": "synthetic", "data_cache_dir": "",
+                          "partition_method": "homo"},
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "federated_optimizer": "FedAvg", "client_num_in_total": 2,
+                "client_num_per_round": 2, "comm_round": 1, "epochs": 1,
+                "batch_size": 16, "client_optimizer": "sgd",
+                "learning_rate": 0.1, **train_extra,
+            },
+            "validation_args": {"frequency_of_the_test": 1},
+            "comm_args": {"backend": "LOOPBACK"},
+        }
+        return Arguments.from_dict(cfg)
+
+    def test_valid_knobs_pass(self, tmp_path):
+        self._cfg(server_checkpoint_dir=str(tmp_path), checkpoint_keep=5,
+                  checkpoint_frequency=2, server_journal_fsync="never").validate()
+
+    def test_non_path_dir_rejected(self):
+        with pytest.raises(ValueError, match="server_checkpoint_dir"):
+            self._cfg(server_checkpoint_dir=123).validate()
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            self._cfg(checkpoint_dir=["a"]).validate()
+
+    def test_bad_keep_and_frequency_rejected(self):
+        with pytest.raises(ValueError, match="checkpoint_keep"):
+            self._cfg(checkpoint_keep=0).validate()
+        with pytest.raises(ValueError, match="checkpoint_frequency"):
+            self._cfg(checkpoint_frequency="soon").validate()
+
+    def test_bad_fsync_policy_rejected(self):
+        with pytest.raises(ValueError, match="server_journal_fsync"):
+            self._cfg(server_journal_fsync="sometimes").validate()
+
 
 def _args(tmp_path, comm_round):
     return Arguments.from_dict(

@@ -565,6 +565,190 @@ def test_chaos_check_gate():
 
 
 # ---------------------------------------------------------------------------
+# Server-kill suite: durable server state + supervisor restart
+# ---------------------------------------------------------------------------
+
+def _server_kill_plan(extra_rules=None):
+    """Kill the server on the SECOND round-0 upload it receives: the first
+    upload is journaled+acked before death, the killed one is lost pre-ack
+    (its sender must be re-synced by the restarted incarnation)."""
+    rules = list(extra_rules or [])
+    rules.append({"kind": "server_kill", "direction": "recv", "receiver": 0,
+                  "msg_type": 3, "round": 0, "after": 1, "times": 1})
+    return {"seed": 7, "rules": rules}
+
+
+def _without_kill(plan):
+    return {"seed": plan["seed"],
+            "rules": [r for r in plan["rules"] if r["kind"] != "server_kill"]}
+
+
+def _run_server_kill_topology(run_id, ckpt_dir, backend="LOOPBACK", n=3,
+                              fault_plan=None, comm_extra=None,
+                              max_restarts=3):
+    """1 server + ``n`` silos; the server is KILLED mid-round by the fault
+    seam and a supervisor loop restarts it from its durable state
+    (``server_checkpoint_dir``).  Only incarnation 0 carries the kill rule —
+    a supervisor restarts the same binary, but a kill that re-fired every
+    incarnation would never let the run end.  Returns
+    ``(history, final, {rank: stats}, restarts, killed_stats, server)``."""
+    plan = fault_plan if fault_plan is not None else _server_kill_plan()
+    client_plan = _without_kill(plan)
+    extra = dict(_CHAOS_KNOBS)
+    extra["server_checkpoint_dir"] = str(ckpt_dir)
+    comm_extra = comm_extra or {}
+
+    def mk_args(rank, role, plan_):
+        kw = dict(extra)
+        if plan_["rules"]:
+            kw["fault_plan"] = plan_
+        a = _args(run_id, n, **kw)
+        for k, v in comm_extra.items():
+            setattr(a, k, v)
+        a.backend = backend
+        a.role, a.rank = role, rank
+        return fedml_tpu.init(a, should_init_logs=False)
+
+    from fedml_tpu.cross_silo.client.client import Client
+    from fedml_tpu.cross_silo.server.server import Server
+
+    def build_server(plan_):
+        a = mk_args(0, "server", plan_)
+        ds, od = fedml_tpu.data.load(a)
+        return Server(a, None, ds, fedml_tpu.models.create(a, od))
+
+    def build_client(rank):
+        a = mk_args(rank, "client", client_plan)
+        ds_c, od = fedml_tpu.data.load(a)
+        return Client(a, None, ds_c, fedml_tpu.models.create(a, od))
+
+    clients = {r: build_client(r) for r in range(1, n + 1)}
+    threads = {r: threading.Thread(target=c.run, daemon=True)
+               for r, c in clients.items()}
+    for t in threads.values():
+        t.start()
+
+    server = build_server(plan)
+    restarts = 0
+    killed_stats = []
+    while True:
+        history = _run_server_bounded(server)
+        mgr = server.server_manager
+        if mgr._finished:
+            break
+        # run() returned without finishing: the only legal cause here is the
+        # scripted kill (anything else is a transport bug)
+        seam = mgr.com_manager
+        assert getattr(seam, "kill_event", None) is not None \
+            and seam.kill_event.is_set(), \
+            "server run() exited unfinished without a scripted kill"
+        killed_stats.append(mgr.comm_stats_snapshot())
+        mgr.finish()  # tear down the dead incarnation's link/transport
+        if backend == "LOOPBACK":
+            # the crash analog for the queue transport: the dead
+            # incarnation's mailbox (and its _STOP sentinel) dies with it
+            LoopbackHub.sever(run_id, 0)
+        restarts += 1
+        assert restarts <= max_restarts, "server restart loop did not converge"
+        server = None
+        for _ in range(40):  # dead incarnation's port may still be freeing
+            try:
+                server = build_server(client_plan)
+                break
+            except OSError:
+                time.sleep(0.25)
+        assert server is not None, "restarted server could not rebind"
+        assert server.resumed, "restart did not restore the durable snapshot"
+
+    _join_all(list(threads.values()))
+    final = server.server_manager.aggregator.get_global_model_params()
+    stats = {0: server.server_manager.comm_stats_snapshot()}
+    for r, c in clients.items():
+        stats[r] = c.manager.comm_stats_snapshot()
+    return history, final, stats, restarts, killed_stats, server
+
+
+def _assert_recovered(history, final, stats, restarts, killed_stats, server,
+                      fault_free_final_model, n=3):
+    assert restarts >= 1
+    assert len(history) == 2
+    assert _trees_bit_identical(final, fault_free_final_model), \
+        "restarted run diverged from the fault-free model"
+    # the kill is visible on the DEAD incarnation's counters...
+    assert sum(s.get("faults_killed", 0) for s in killed_stats) >= 1
+    # ...and the recovery on the surviving incarnation's
+    srv = stats[0]
+    assert srv["server_restores"] >= 1
+    assert srv["epoch_bumps"] >= 1
+    assert srv["journal_replays"] >= 1
+    mgr = server.server_manager
+    assert mgr.server_epoch == restarts
+    # exactly-once accounting: journal replay + re-uploads must not
+    # double-count any report in the fleet registry
+    reg = mgr.population.registry.snapshot()
+    assert reg["reported_total"] == n * 2, reg
+
+
+def test_server_kill_restart_bit_identical(fault_free_final_model, tmp_path):
+    """The acceptance run: a server killed between two round-0 uploads
+    restarts from snapshot + journal, re-syncs the clients whose uploads
+    died with it, and finishes with the bit-identical final model."""
+    LoopbackHub.reset()
+    out = _run_server_kill_topology("kill-loop", tmp_path / "srv")
+    _assert_recovered(*out, fault_free_final_model)
+
+
+def test_server_kill_under_client_chaos_bit_identical(fault_free_final_model,
+                                                      tmp_path):
+    """Combined plan: the server kill rides on top of the full client-side
+    chaos plan (drop + reset + duplicate + delay) — recovery and the
+    self-healing transport must compose, not merely coexist."""
+    LoopbackHub.reset()
+    plan = _server_kill_plan(extra_rules=_full_chaos_plan()["rules"])
+    out = _run_server_kill_topology("kill-chaos", tmp_path / "srv",
+                                    fault_plan=plan)
+    _assert_recovered(*out, fault_free_final_model)
+    _, _, stats, _, killed, _ = out
+    # the client-side chaos actually fired somewhere in the run
+    assert stats[2]["faults_reset"] >= 1
+    assert stats[3]["faults_duplicated"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["TRPC", "GRPC", "MQTT_S3"])
+def test_server_kill_restart_all_backends(backend, fault_free_final_model,
+                                          tmp_path):
+    """Server crash recovery is transport-independent: the same kill +
+    supervisor restart over every socketed backend (the restarted
+    incarnation must rebind the listener / reconnect the broker) converges
+    to the bit-identical final model."""
+    comm_extra = {}
+    broker = None
+    if backend == "TRPC":
+        comm_extra = {"trpc_base_port": 29510, "trpc_connect_retries": 3,
+                      "trpc_retry_interval_s": 0.1}
+    elif backend == "GRPC":
+        comm_extra = {"grpc_base_port": 29610, "grpc_send_retries": 3,
+                      "grpc_send_backoff_base_s": 0.05}
+    else:
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import LocalBroker
+
+        broker = LocalBroker().start()
+        comm_extra = {"mqtt_host": "127.0.0.1", "mqtt_port": broker.port,
+                      "s3_blob_root": str(tmp_path / "blobs"),
+                      "mqtt_reconnect_retries": 10,
+                      "mqtt_reconnect_base_s": 0.05}
+    try:
+        out = _run_server_kill_topology(
+            f"kill-{backend.lower()}", tmp_path / "srv", backend=backend,
+            comm_extra=comm_extra)
+        _assert_recovered(*out, fault_free_final_model)
+    finally:
+        if broker is not None:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
 # Unit layer: the reliability link and the fault seam, no topology needed
 # ---------------------------------------------------------------------------
 
@@ -723,6 +907,22 @@ class TestFaultSeam:
         ready = Message("connection_ready", 1, 1)
         seam.receive_message("connection_ready", ready)
         assert cap.got == [ready]
+
+    def test_server_kill_silences_seam_and_signals_supervisor(self):
+        seam, inner, cap, stats = self._seam(
+            [{"kind": "server_kill", "direction": "recv", "msg_type": 3,
+              "after": 1, "times": 1}])
+        seam.receive_message("3", Message(3, 1, 0))  # after=1: passes
+        assert len(cap.got) == 1
+        seam.receive_message("3", Message(3, 2, 0))  # the kill: msg dies too
+        assert len(cap.got) == 1
+        assert seam.kill_event.is_set()
+        assert stats.get("faults_killed") == 1
+        # a killed process neither sends nor receives — the seam plays dead
+        seam.send_message(Message(2, 0, 1))
+        assert inner.sent == []
+        seam.receive_message("3", Message(3, 3, 0))
+        assert len(cap.got) == 1
 
     def test_seeded_probability_replays_exactly(self):
         from fedml_tpu.core.distributed.faults import FaultPlan

@@ -1,16 +1,18 @@
 #!/usr/bin/env python
 """Anti-flake gate for the chaos suite.
 
-Runs the fast chaos matrix (``tests/test_fault_tolerance.py -k chaos``) N
-consecutive times in fresh interpreter processes and fails on the FIRST
-non-green run.  A fault-injection suite that only mostly passes is worse
-than none — operators stop believing red — so new fault kinds / backends
-must hold up under this before they land unmarked.
+Runs the fast chaos matrix plus the server-kill/restart tests
+(``tests/test_fault_tolerance.py -k "chaos or server_kill"``) N consecutive
+times in fresh interpreter processes and fails on the FIRST non-green run.
+A fault-injection suite that only mostly passes is worse than none —
+operators stop believing red — so new fault kinds / backends must hold up
+under this before they land unmarked.
 
 Usage::
 
     python tools/chaos_check.py --runs 5
     python tools/chaos_check.py --runs 3 -k "chaos_matrix"
+    python tools/chaos_check.py --runs 3 -k "server_kill"
 """
 
 from __future__ import annotations
@@ -28,8 +30,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--runs", "-n", type=int, default=3,
                     help="consecutive green runs required (default 3)")
-    ap.add_argument("-k", dest="keyword", default="chaos",
-                    help="pytest -k selector (default: chaos)")
+    ap.add_argument("-k", dest="keyword", default="chaos or server_kill",
+                    help='pytest -k selector (default: "chaos or server_kill")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     args = ap.parse_args(argv)
