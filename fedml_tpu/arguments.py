@@ -88,6 +88,21 @@ Checkpoint / crash-recovery knobs (``train_args``; consumed by
   whether each journal append fsyncs before the upload is acked.
   ``never`` trades the power-loss guarantee for upload-path latency
   (process crashes are still covered by the OS page cache).
+
+Observability knobs (``tracking_args`` or ``obs_args``; consumed by
+``core/obs``, semantics in ``docs/OBSERVABILITY.md``):
+
+* ``obs_trace`` (bool, default False) — emit the per-round span tree
+  (deterministic ids, cross-process ``traceparent`` propagation) through
+  the mlops sink fan.  Off keeps the wire and the sink stream
+  bit-identical to the pre-obs build.
+* ``obs_metrics_export_interval`` (float seconds >= 0, default 0) —
+  rate limit for periodic MetricsRegistry exports at round close; 0
+  exports only the final snapshot at ``mlops.finish()``.
+* ``obs_slow_round_factor`` (float >= 1.0, default 2.0) — a round slower
+  than ``factor * median(previous rounds)`` gets a ``slow_round`` span
+  event (straggler flagging in ``tools/trace_report.py`` uses the same
+  factor).
 """
 
 from __future__ import annotations
@@ -124,6 +139,7 @@ _CONFIG_SECTIONS = (
     "vfl_args",
     "fault_args",
     "population_args",
+    "obs_args",
 )
 
 
@@ -283,6 +299,30 @@ class Arguments:
                 raise ValueError(
                     "server_journal_fsync must be one of "
                     f"{JOURNAL_FSYNC_POLICIES} (got {fsync!r})")
+        # observability knobs (core/obs) — bad values fail here so a typo'd
+        # interval doesn't silently disable the periodic metrics export
+        interval = getattr(self, "obs_metrics_export_interval", None)
+        if interval is not None:
+            try:
+                fv = float(interval)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_metrics_export_interval must be a number >= 0 "
+                    f"(got {interval!r})")
+            if fv < 0:
+                raise ValueError(
+                    f"obs_metrics_export_interval must be >= 0 (got {fv})")
+        slow = getattr(self, "obs_slow_round_factor", None)
+        if slow is not None:
+            try:
+                sv = float(slow)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_slow_round_factor must be a number >= 1.0 "
+                    f"(got {slow!r})")
+            if sv < 1.0:
+                raise ValueError(
+                    f"obs_slow_round_factor must be >= 1.0 (got {sv})")
         # a malformed chaos plan should fail at config time, not mid-run when
         # the backend factory first tries to wrap the transport
         plan = getattr(self, "fault_plan", None)

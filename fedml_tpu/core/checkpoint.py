@@ -30,6 +30,8 @@ import jax
 import numpy as np
 from flax import serialization
 
+from . import obs
+
 logger = logging.getLogger(__name__)
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
@@ -118,6 +120,9 @@ class CheckpointManager:
             meta.update(metadata)
         _atomic_write(path + ".json", json.dumps(meta).encode("utf-8"))
         self._prune()
+        obs.counter_inc("checkpoint.saves")
+        obs.histogram_observe("checkpoint.bytes", len(payload),
+                              buckets=(2**10, 2**14, 2**18, 2**22, 2**26, 2**30))
         logger.info("checkpoint saved: %s", path)
         return path
 
@@ -253,6 +258,7 @@ class UpdateJournal:
             f.flush()
             if self.fsync == "always":
                 os.fsync(f.fileno())
+        obs.counter_inc("journal.appends")
 
     def replay(self, round_idx: int) -> Tuple[List[Dict[str, Any]], int]:
         """Read back ``(records, bad_tail)`` for a round.  ``bad_tail`` is 1
@@ -274,12 +280,14 @@ class UpdateJournal:
                 logger.warning(
                     "journal %s: discarding corrupt/truncated tail frame at "
                     "byte %d", path, offset)
+                obs.counter_inc("journal.bad_tail")
                 return records, 1
             records.append(serialization.msgpack_restore(payload))
             offset = start + length
         if offset != len(blob):
             logger.warning("journal %s: discarding truncated tail header at "
                            "byte %d", path, offset)
+            obs.counter_inc("journal.bad_tail")
             return records, 1
         return records, 0
 
@@ -410,6 +418,16 @@ class ServerRecoveryMixin:
         self._comm_stats.inc("server_restores")
         self._comm_stats.inc("epoch_bumps")
         self._comm_stats.inc("journal_replays", replayed)
+        obs.counter_inc("journal.replay_records", replayed)
+        # annotate the recovery onto the restored round's root span: the id
+        # is deterministic in (run_id, round_idx), so these land on the tree
+        # the dead incarnation opened
+        node = getattr(self, "rank", 0)
+        obs.span_event("server_restore", round_idx=int(round_idx), node=node,
+                       epoch=self.server_epoch, replayed=replayed,
+                       bad_tail=bad_tail)
+        obs.span_event("epoch_bump", round_idx=int(round_idx), node=node,
+                       epoch=self.server_epoch)
         self._recovered_pending_close = True
         logger.warning(
             "server restore: epoch=%d round=%d participants=%s replayed=%d "

@@ -47,6 +47,7 @@ from ...constants import (
     FEDML_BACKEND_MQTT_S3_MNN,
     FEDML_BACKEND_TRPC,
 )
+from .. import obs
 from .communication.base_com_manager import BaseCommunicationManager, Observer
 from .communication.message import Message
 from .faults import CommStats
@@ -171,10 +172,20 @@ class _ReliableLink:
                 self.stats.inc("retransmits")
                 logger.info("rank %s: retransmit #%d of %s (%s)",
                             self.rank, p.attempts, mid, p.msg.get_type())
+                # each attempt is its own child span under the context the
+                # original send carried, so stragglers caused by lossy links
+                # are visible in the round tree (NULL_SPAN when untraced)
+                tctx = obs.extract(p.msg)
+                retx = obs.unique_span(
+                    "retransmit", tctx, node=self.rank, attempt=p.attempts,
+                    msg_id=mid, msg_type=p.msg.get_type(),
+                ) if tctx is not None else obs.NULL_SPAN
                 try:
                     assert self._send_raw is not None
                     self._send_raw(p.msg)
+                    retx.end()
                 except Exception as e:
+                    retx.end(error=str(e))
                     logger.info("rank %s: retransmit of %s failed (%s); "
                                 "will retry", self.rank, mid, e)
 
@@ -213,6 +224,9 @@ class _ReliableLink:
                     self._seen.popitem(last=False)
         if dup:
             self.stats.inc("dup_dropped")
+            obs.span_event("dup", obs.extract(msg), node=self.rank,
+                           side="dedup", msg_id=msg_id,
+                           msg_type=msg.get_type())
             logger.info("rank %s: dropping duplicate %s (%s)",
                         self.rank, msg_id, msg.get_type())
             self._send_ack(msg)  # re-ack: the first ack may have been lost
@@ -250,7 +264,7 @@ class FedMLCommManager(Observer):
         self.comm = comm
         self.com_manager: Optional[BaseCommunicationManager] = None
         self.message_handler_dict: Dict[str, Callable[[Message], None]] = {}
-        self._comm_stats = CommStats()
+        self._comm_stats = CommStats(node=self.rank)
         self._link = self._init_link()
         self._init_manager()
         if self._link is not None:

@@ -23,6 +23,12 @@ class Message:
     # drop re-deliveries, making retries and duplicate faults idempotent.
     # Clients that omit it (legacy Java/Swift wire) are never acked or deduped.
     MSG_ARG_KEY_MSG_ID = "msg_id"
+    # tracing header (additive, opt-in via obs_trace): a W3C-style
+    # "00-<trace>-<span>-01" string stamped by core.obs.inject; a plain
+    # string survives both the JSON control plane and the pickled binary
+    # transports, so one header propagates span context on all backends.
+    # Peers that omit it simply start parentless spans.
+    MSG_ARG_KEY_TRACEPARENT = "traceparent"
 
     MSG_OPERATION_SEND = "send"
     MSG_OPERATION_RECEIVE = "receive"

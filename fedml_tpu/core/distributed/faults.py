@@ -67,6 +67,7 @@ import random
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
+from .. import obs
 from .communication.base_com_manager import BaseCommunicationManager, Observer
 from .communication.message import Message
 
@@ -80,7 +81,13 @@ _EXEMPT_TYPES = ("connection_ready",)
 
 class CommStats:
     """Thread-safe counter bag shared by the reliability layer and the fault
-    injector; ``snapshot()`` is what the mlops ``comm_stats`` record carries."""
+    injector; ``snapshot()`` is what the mlops ``comm_stats`` record carries.
+
+    Every increment is additionally mirrored into the process-global
+    :class:`~fedml_tpu.core.obs.MetricsRegistry` as ``comm.<key>`` (labeled
+    by ``node`` when the owner identifies itself) — the per-instance
+    snapshot keeps the legacy ``comm_stats`` topic byte-compatible while
+    the registry makes the same counters joinable across subsystems."""
 
     _KEYS = (
         "messages_sent", "retries", "retransmits", "delivery_failures",
@@ -92,13 +99,15 @@ class CommStats:
         "dup_uploads_discarded",
     )
 
-    def __init__(self):
+    def __init__(self, node: Optional[int] = None):
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {k: 0 for k in self._KEYS}
+        self._labels = None if node is None else {"node": int(node)}
 
     def inc(self, key: str, n: int = 1) -> None:
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + n
+        obs.counter_inc(f"comm.{key}", n, self._labels)
 
     def get(self, key: str) -> int:
         with self._lock:
@@ -259,10 +268,26 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
             return
         self._apply(rule, msg, self._notify, "recv")
 
+    def _fault_event(self, name: str, msg: Message, **attrs: Any) -> None:
+        """Annotate the injected fault onto the message's span (or the round
+        root when the message is traced but unstamped) — events are
+        telemetry, they never alter the fault's behavior."""
+        try:
+            rnd = msg.get("round_idx")
+            obs.span_event(
+                name, obs.extract(msg),
+                round_idx=int(rnd) if rnd is not None else None,
+                node=self._injector.rank, msg_type=msg.get_type(),
+                sender=msg.get_sender_id(), receiver=msg.get_receiver_id(),
+                **attrs)
+        except Exception:  # pragma: no cover - observability is non-fatal
+            pass
+
     def _apply(self, rule: FaultRule, msg: Message, forward, direction: str) -> None:
         kind = rule.kind
         if kind == "server_kill":
             self._stats.inc("faults_killed")
+            self._fault_event("server_kill", msg, rule=rule.index)
             logger.warning(
                 "FAULT server_kill: node dies on %s %s->%s (rule %d); the "
                 "triggering message is lost with the process",
@@ -277,11 +302,13 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
             return
         if kind in ("drop", "partition") or (kind == "reset" and direction == "recv"):
             self._stats.inc("faults_dropped")
+            self._fault_event("drop", msg, rule=rule.index, fault_kind=kind)
             logger.info("FAULT %s: dropping %s %s->%s", kind, msg.get_type(),
                         msg.get_sender_id(), msg.get_receiver_id())
             return
         if kind == "reset":
             self._stats.inc("faults_reset")
+            self._fault_event("reset", msg, rule=rule.index)
             logger.info("FAULT reset: %s %s->%s", msg.get_type(),
                         msg.get_sender_id(), msg.get_receiver_id())
             raise ConnectionError(
@@ -289,6 +316,7 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
             )
         if kind == "duplicate":
             self._stats.inc("faults_duplicated")
+            self._fault_event("dup", msg, rule=rule.index, side="injected")
             logger.info("FAULT duplicate: %s %s->%s", msg.get_type(),
                         msg.get_sender_id(), msg.get_receiver_id())
             forward(msg)
@@ -296,6 +324,7 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
             return
         if kind == "delay":
             self._stats.inc("faults_delayed")
+            self._fault_event("delay", msg, rule=rule.index, delay_s=rule.delay_s)
             logger.info("FAULT delay %.3fs: %s %s->%s", rule.delay_s,
                         msg.get_type(), msg.get_sender_id(), msg.get_receiver_id())
 

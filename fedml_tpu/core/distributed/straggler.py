@@ -81,6 +81,11 @@ class RoundTimeoutMixin:
         stats = getattr(self, "_comm_stats", None)
         if stats is not None:
             stats.inc("rejoins")
+        from .. import obs
+
+        obs.span_event("rejoin", round_idx=int(self.args.round_idx),
+                       node=getattr(self, "rank", 0), client=int(sender),
+                       prev_epoch=prev, epoch=str(epoch))
         self._note_population_rejoin(sender)
         logger.warning(
             "client %s REJOINED mid-run (epoch %s -> %s): resyncing round %d",

@@ -94,6 +94,12 @@ def init(args: Any, sink_obj: Optional[FanoutSink] = None) -> None:
             profiler=MLOpsProfilerEvent(run_id, edge_id, fan),
             log_daemon=None,
         )
+    if getattr(args, "obs_trace", False):
+        # the obs layer rides the same sink fan; opt-in so the disabled
+        # wire/flow stays bit-identical to the pre-obs framework
+        from .. import obs
+
+        obs.configure(args, fan.emit)
 
 
 def start_log_daemon(log_path: str) -> Optional[MLOpsRuntimeLogDaemon]:
@@ -107,6 +113,10 @@ def start_log_daemon(log_path: str) -> Optional[MLOpsRuntimeLogDaemon]:
 
 
 def finish() -> None:
+    from .. import obs
+
+    if obs.enabled():
+        obs.shutdown()  # final metrics flush rides the fan before it closes
     with _lock:
         daemon = _ctx.get("log_daemon")
         if daemon is not None:

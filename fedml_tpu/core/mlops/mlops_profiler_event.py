@@ -24,7 +24,10 @@ class MLOpsProfilerEvent:
         self._open: Dict[str, float] = {}
 
     def log_event_started(self, event_name: str, event_value: Any = None) -> None:
-        self._open[event_name] = time.time()
+        # durations come from the monotonic clock — an NTP step mid-event
+        # must not yield negative/garbage spans; wall time stays available
+        # as record metadata (the FanoutSink stamps "ts")
+        self._open[event_name] = time.monotonic()
         self.sink.emit(
             "event",
             {
@@ -46,7 +49,7 @@ class MLOpsProfilerEvent:
                 "event": event_name,
                 "phase": "ended",
                 "value": event_value,
-                "duration_s": round(time.time() - t0, 6) if t0 is not None else None,
+                "duration_s": round(time.monotonic() - t0, 6) if t0 is not None else None,
             },
         )
 

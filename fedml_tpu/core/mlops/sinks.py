@@ -47,16 +47,24 @@ class JsonlFileSink(Sink):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self._fh = open(path, "a")
+        self._closed = False
         self._lock = threading.Lock()
 
     def emit(self, topic: str, record: Dict[str, Any]) -> None:
         line = json.dumps({"topic": topic, **record})
         with self._lock:
+            # late emitters (daemon flush racing mlops.finish) must not
+            # crash on a closed handle — their record is simply dropped
+            if self._closed:
+                return
             self._fh.write(line + "\n")
             self._fh.flush()
 
     def close(self) -> None:
         with self._lock:
+            if self._closed:
+                return
+            self._closed = True
             self._fh.close()
 
 

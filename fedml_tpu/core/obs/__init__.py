@@ -1,0 +1,188 @@
+"""``fedml_tpu.core.obs`` — the round-trace observability layer.
+
+One process-global context (configured by ``core.mlops.init`` when
+``args.obs_trace`` is set, torn down by ``mlops.finish``) exposing:
+
+* a :class:`~.trace.Tracer` whose deterministic span ids and W3C-style
+  ``traceparent`` header turn each federated round into one cross-process
+  span tree (``round → select → invite → client.train → upload →
+  journal.append → aggregate → broadcast``, with fault/recovery events
+  attached — taxonomy in ``docs/OBSERVABILITY.md``);
+* a :class:`~.metrics.MetricsRegistry` every library counter mirrors into
+  (``tools/lint_obs.py`` forbids NEW bare counter bags outside this
+  package and ``core/mlops``);
+* module-level helpers (``span`` / ``span_event`` / ``inject`` /
+  ``extract`` / ``counter_inc`` / ...) that are cheap no-ops until
+  :func:`configure` runs — library code calls them unconditionally, and
+  with ``obs_trace`` off the message flow stays bit-identical (no
+  traceparent param is ever added).
+
+Everything here is telemetry: emission failures are swallowed, ids carry
+no wall-clock, and nothing round-critical may ever depend on a span.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from .metrics import DEFAULT_TIME_BUCKETS, MetricsRegistry
+from .trace import (
+    NULL_SPAN,
+    Span,
+    SpanContext,
+    Tracer,
+    round_root_ctx,
+    span_id_for,
+    trace_id_for,
+)
+
+__all__ = [
+    "MetricsRegistry", "Tracer", "Span", "SpanContext", "NULL_SPAN",
+    "DEFAULT_TIME_BUCKETS", "trace_id_for", "span_id_for", "round_root_ctx",
+    "configure", "shutdown", "enabled", "tracer", "registry", "run_id",
+    "span", "round_span", "unique_span", "span_event",
+    "inject", "extract", "counter_inc", "gauge_set", "histogram_observe",
+    "maybe_export_metrics", "slow_round_factor",
+]
+
+_lock = threading.Lock()
+_ctx: Dict[str, Any] = {"enabled": False}
+
+# the registry outlives configure/shutdown cycles within a process run so
+# counters survive mlops re-init (tests reset it explicitly)
+_registry = MetricsRegistry()
+
+
+def configure(args: Any, emit: Callable[[str, Dict[str, Any]], None]) -> None:
+    """Enable tracing for this process.  ``emit`` is sink-shaped
+    (``(topic, record)``) — ``mlops.init`` passes its fan's emit."""
+    with _lock:
+        _ctx.update(
+            enabled=True,
+            run_id=str(getattr(args, "run_id", "0")),
+            emit=emit,
+            tracer=Tracer(str(getattr(args, "run_id", "0")), emit),
+            export_interval_s=float(
+                getattr(args, "obs_metrics_export_interval", 0) or 0),
+            slow_round_factor=float(
+                getattr(args, "obs_slow_round_factor", 2.0) or 2.0),
+        )
+
+
+def shutdown() -> None:
+    """Final metrics flush + disable (idempotent)."""
+    with _lock:
+        emit = _ctx.get("emit")
+        if emit is not None:
+            _registry.export_to(emit)
+        _ctx.clear()
+        _ctx["enabled"] = False
+
+
+def enabled() -> bool:
+    return bool(_ctx.get("enabled"))
+
+
+def tracer() -> Optional[Tracer]:
+    return _ctx.get("tracer")
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+def run_id() -> str:
+    return str(_ctx.get("run_id", "0"))
+
+
+def slow_round_factor() -> float:
+    return float(_ctx.get("slow_round_factor", 2.0))
+
+
+# -- span helpers (no-ops until configure) ----------------------------------
+
+def round_span(round_idx: int, node: Any = 0, annotate: bool = False,
+               **attrs: Any):
+    t = _ctx.get("tracer")
+    if t is None:
+        return NULL_SPAN
+    return t.round_span(int(round_idx), node=node, annotate=annotate, **attrs)
+
+
+def span(name: str, parent: Optional[SpanContext] = None,
+         round_idx: Optional[int] = None, node: Any = 0, seq: int = 0,
+         annotate: bool = False, **attrs: Any):
+    t = _ctx.get("tracer")
+    if t is None:
+        return NULL_SPAN
+    return t.span(name, parent, round_idx=round_idx, node=node, seq=seq,
+                  annotate=annotate, **attrs)
+
+
+def unique_span(name: str, parent: Optional[SpanContext] = None,
+                round_idx: Optional[int] = None, node: Any = 0,
+                annotate: bool = False, **attrs: Any):
+    t = _ctx.get("tracer")
+    if t is None:
+        return NULL_SPAN
+    return t.unique_span(name, parent, round_idx=round_idx, node=node,
+                         annotate=annotate, **attrs)
+
+
+def span_event(name: str, ctx: Optional[SpanContext] = None,
+               round_idx: Optional[int] = None, node: Any = 0,
+               **attrs: Any) -> None:
+    t = _ctx.get("tracer")
+    if t is not None:
+        t.span_event(name, ctx, round_idx=round_idx, node=node, **attrs)
+
+
+# -- context propagation ----------------------------------------------------
+
+def inject(message: Any, ctx: Optional[SpanContext]) -> None:
+    """Stamp ``ctx`` into a :class:`Message`'s params as a ``traceparent``
+    string (survives every backend: JSON keeps strings, binary transports
+    pickle the whole dict).  No-op when tracing is off or ctx is None, so
+    the disabled wire is byte-identical to the pre-obs wire."""
+    if ctx is None or not enabled():
+        return
+    from ..distributed.communication.message import Message
+
+    message.add_params(Message.MSG_ARG_KEY_TRACEPARENT, ctx.to_traceparent())
+
+
+def extract(message: Any) -> Optional[SpanContext]:
+    """The :class:`SpanContext` a peer injected, or None (legacy peer,
+    tracing off at the sender, malformed header)."""
+    from ..distributed.communication.message import Message
+
+    return SpanContext.from_traceparent(
+        message.get(Message.MSG_ARG_KEY_TRACEPARENT))
+
+
+# -- metrics helpers --------------------------------------------------------
+
+def counter_inc(name: str, n: float = 1,
+                labels: Optional[Dict[str, Any]] = None) -> None:
+    _registry.counter_inc(name, n, labels)
+
+
+def gauge_set(name: str, value: float,
+              labels: Optional[Dict[str, Any]] = None) -> None:
+    _registry.gauge_set(name, value, labels)
+
+
+def histogram_observe(name: str, value: float,
+                      labels: Optional[Dict[str, Any]] = None,
+                      buckets=None) -> None:
+    _registry.histogram_observe(name, value, labels, buckets)
+
+
+def maybe_export_metrics() -> bool:
+    """Rate-limited registry flush to the sink (round-close call sites);
+    obeys ``obs_metrics_export_interval`` (0 = only the shutdown flush)."""
+    emit = _ctx.get("emit")
+    if emit is None:
+        return False
+    return _registry.maybe_export(emit, float(_ctx.get("export_interval_s", 0)))

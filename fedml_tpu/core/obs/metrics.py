@@ -1,0 +1,231 @@
+"""Unified metrics registry: counters / gauges / fixed-bucket histograms.
+
+PRs 1-4 each grew an ad-hoc counter bag (``CommStats`` in faults.py, the
+``cohort_stats`` dict in population/manager.py, the recovery counters in
+checkpoint.py) — correct individually, but unjoinable: no shared naming, no
+labels, no distribution type at all.  This registry is the one sink-side
+shape for all of them, Prometheus-flavored but offline-first:
+
+* **Counter** — monotonic ``inc``; **Gauge** — last-write ``set``;
+  **Histogram** — fixed, instrument-declared bucket upper bounds with
+  ``+Inf`` implicit, plus running sum/count (so mean and quantile bounds
+  are derivable offline).
+* **Labeled series** — each instrument fans out by a small label dict
+  (``node``, ``backend``, ...).  Cardinality is capped per instrument
+  (default 64 series): past the cap, new label sets collapse into a single
+  ``{"overflow": "true"}`` series and a ``dropped_series`` count — a
+  runaway label (client id as a label on a 1e5 fleet) degrades to one
+  series instead of eating the process.
+* **export()** — a flat list of records for the mlops sink (topic
+  ``metrics``); ``maybe_export`` rate-limits by ``export_interval_s`` so
+  per-upload instruments don't flood the JSONL.
+
+The legacy ``comm_stats`` / ``cohort_stats`` topics keep emitting from
+their original call sites — this registry is additive, existing dashboards
+and tests stay valid.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_MAX_SERIES = 64
+
+# seconds-scale latency buckets: fine where rounds live (sub-second to
+# minutes), one decade of headroom either side
+DEFAULT_TIME_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                        30.0, 60.0, 300.0)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+_OVERFLOW_KEY: _LabelKey = (("overflow", "true"),)
+
+
+def _label_key(labels: Optional[Dict[str, Any]]) -> _LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Family:
+    """One named instrument: a dict of label-keyed series.  All access goes
+    through the owning registry's lock."""
+
+    def __init__(self, name: str, kind: str, max_series: int,
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.kind = kind
+        self.max_series = int(max_series)
+        self.buckets: Optional[Tuple[float, ...]] = None
+        if kind == "histogram":
+            b = tuple(sorted(float(x) for x in (buckets or DEFAULT_TIME_BUCKETS)))
+            if not b:
+                raise ValueError(f"histogram {name!r} needs at least one bucket")
+            self.buckets = b
+        self.series: Dict[_LabelKey, Any] = {}
+        self.dropped_series = 0
+
+    def resolve_key(self, key: _LabelKey) -> _LabelKey:
+        """The storage key for ``key``: itself while under the cardinality
+        cap, the shared overflow series once over it."""
+        if key in self.series or len(self.series) < self.max_series:
+            return key
+        self.dropped_series += 1
+        return _OVERFLOW_KEY
+
+    def new_series(self) -> Any:
+        if self.kind == "histogram":
+            assert self.buckets is not None
+            return {"bucket_counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0, "count": 0}
+        return 0 if self.kind == "counter" else 0.0
+
+
+class MetricsRegistry:
+    """Thread-safe instrument registry.  One process-global instance lives
+    behind the ``core.obs`` facade; tests construct their own."""
+
+    def __init__(self, max_series_per_metric: int = DEFAULT_MAX_SERIES):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        self.max_series_per_metric = int(max_series_per_metric)
+        self._last_export = time.monotonic()
+
+    def _family(self, name: str, kind: str,
+                buckets: Optional[Sequence[float]] = None) -> _Family:
+        fam = self._families.get(name)
+        if fam is None:
+            fam = _Family(name, kind, self.max_series_per_metric, buckets)
+            self._families[name] = fam
+        elif fam.kind != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as {fam.kind}, not {kind}")
+        return fam
+
+    # -- instruments ---------------------------------------------------------
+    def counter_inc(self, name: str, n: float = 1,
+                    labels: Optional[Dict[str, Any]] = None) -> None:
+        with self._lock:
+            fam = self._family(name, "counter")
+            k = fam.resolve_key(_label_key(labels))
+            fam.series[k] = fam.series.get(k, 0) + n
+
+    def gauge_set(self, name: str, value: float,
+                  labels: Optional[Dict[str, Any]] = None) -> None:
+        with self._lock:
+            fam = self._family(name, "gauge")
+            k = fam.resolve_key(_label_key(labels))
+            fam.series[k] = float(value)
+
+    def histogram_observe(self, name: str, value: float,
+                          labels: Optional[Dict[str, Any]] = None,
+                          buckets: Optional[Sequence[float]] = None) -> None:
+        v = float(value)
+        with self._lock:
+            fam = self._family(name, "histogram", buckets)
+            k = fam.resolve_key(_label_key(labels))
+            s = fam.series.get(k)
+            if s is None:
+                s = fam.new_series()
+                fam.series[k] = s
+            assert fam.buckets is not None
+            idx = len(fam.buckets)  # +Inf bucket
+            for i, ub in enumerate(fam.buckets):
+                if v <= ub:
+                    idx = i
+                    break
+            s["bucket_counts"][idx] += 1
+            s["sum"] += v
+            s["count"] += 1
+
+    # -- reads ---------------------------------------------------------------
+    def get_counter(self, name: str,
+                    labels: Optional[Dict[str, Any]] = None) -> float:
+        with self._lock:
+            fam = self._families.get(name)
+            return fam.series.get(_label_key(labels), 0) if fam else 0
+
+    def get_gauge(self, name: str,
+                  labels: Optional[Dict[str, Any]] = None) -> float:
+        with self._lock:
+            fam = self._families.get(name)
+            return fam.series.get(_label_key(labels), 0.0) if fam else 0.0
+
+    def get_histogram(self, name: str,
+                      labels: Optional[Dict[str, Any]] = None
+                      ) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam.kind != "histogram":
+                return None
+            s = fam.series.get(_label_key(labels))
+            if s is None:
+                return None
+            return {"buckets": list(fam.buckets or ()),
+                    "bucket_counts": list(s["bucket_counts"]),
+                    "sum": s["sum"], "count": s["count"]}
+
+    def series_count(self, name: str) -> int:
+        with self._lock:
+            fam = self._families.get(name)
+            return len(fam.series) if fam else 0
+
+    def dropped_series(self, name: str) -> int:
+        with self._lock:
+            fam = self._families.get(name)
+            return fam.dropped_series if fam else 0
+
+    # -- export --------------------------------------------------------------
+    def export(self) -> List[Dict[str, Any]]:
+        """Flat snapshot: one record per (metric, label-set)."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for name in sorted(self._families):
+                fam = self._families[name]
+                for key in sorted(fam.series):
+                    rec: Dict[str, Any] = {
+                        "metric": name, "kind": fam.kind,
+                        "labels": {k: v for k, v in key},
+                    }
+                    if fam.kind == "histogram":
+                        s = fam.series[key]
+                        rec.update(buckets=list(fam.buckets or ()),
+                                   bucket_counts=list(s["bucket_counts"]),
+                                   sum=round(s["sum"], 6), count=s["count"])
+                    else:
+                        rec["value"] = fam.series[key]
+                    if fam.dropped_series:
+                        rec["dropped_series"] = fam.dropped_series
+                    out.append(rec)
+        return out
+
+    def export_to(self, emit: Callable[[str, Dict[str, Any]], None]) -> int:
+        """Emit every series as a ``metrics`` topic record; returns count."""
+        records = self.export()
+        for rec in records:
+            try:
+                emit("metrics", rec)
+            except Exception:  # pragma: no cover - sink failure is non-fatal
+                pass
+        return len(records)
+
+    def maybe_export(self, emit: Callable[[str, Dict[str, Any]], None],
+                     interval_s: float) -> bool:
+        """Rate-limited export: flush at most once per ``interval_s``
+        seconds (0 disables periodic export — :meth:`export_to` still runs
+        at shutdown).  Called from round-close paths, so no thread."""
+        if interval_s <= 0:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_export < float(interval_s):
+                return False
+            self._last_export = now
+        self.export_to(emit)
+        return True
+
+    def reset(self) -> None:
+        with self._lock:
+            self._families.clear()
+            self._last_export = time.monotonic()

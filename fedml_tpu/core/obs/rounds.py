@@ -1,0 +1,80 @@
+"""Round-span bookkeeping shared by the message-plane server managers.
+
+The cross-silo and cross-device servers drive structurally identical round
+state machines (open → invite fan-out → collect uploads → aggregate →
+broadcast/close); this mixin holds the one copy of the span bookkeeping so
+each manager's instrumentation stays a handful of ``with`` blocks.
+
+Host requirements: ``self.args`` (with ``round_idx``) and — optionally —
+``self.rank`` for node labeling.  Every helper degrades to
+:data:`~.trace.NULL_SPAN` when tracing is off, so call sites never branch
+on ``obs.enabled()``.
+
+Crash-restart contract: a restored server calls :meth:`_obs_adopt_round`
+instead of :meth:`_obs_open_round` — it holds the restored round's root
+WITHOUT re-emitting ``span_start`` (ids are deterministic in
+``(run_id, round_idx)``, so the adopter's eventual end pairs with the dead
+incarnation's start and chaos runs still report zero unclosed spans).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from . import enabled, run_id, span, tracer
+from .trace import NULL_SPAN, SpanContext, round_root_ctx
+
+
+class RoundObsMixin:
+    # class-level default so managers need no extra __init__ wiring
+    _obs_round = None
+
+    def _obs_node(self) -> int:
+        return int(getattr(self, "rank", 0) or 0)
+
+    def _obs_open_round(self, **attrs: Any) -> None:
+        """Open the root span for ``args.round_idx`` (no-op when off)."""
+        if not enabled():
+            self._obs_round = None
+            return
+        t = tracer()
+        self._obs_round = t.round_span(int(self.args.round_idx),
+                                       node=self._obs_node(), **attrs)
+
+    def _obs_adopt_round(self) -> None:
+        """Hold the restored round's root without re-emitting its start."""
+        t = tracer()
+        if t is None:
+            self._obs_round = None
+            return
+        self._obs_round = t.adopt_round_span(int(self.args.round_idx),
+                                             node=self._obs_node())
+
+    def _obs_round_ctx(self) -> Optional[SpanContext]:
+        """The current round root's context — derived deterministically even
+        when no local Span object is held (a handler racing round open)."""
+        sp = self._obs_round
+        if sp is not None and sp.ctx is not None:
+            return sp.ctx
+        if enabled():
+            return round_root_ctx(run_id(), int(self.args.round_idx))
+        return None
+
+    def _obs_phase(self, name: str, parent: Optional[SpanContext] = None,
+                   round_idx: Optional[int] = None, seq: int = 0,
+                   **attrs: Any):
+        """A child span of the current round root (or of ``parent``)."""
+        if not enabled():
+            return NULL_SPAN
+        return span(
+            name,
+            parent if parent is not None else self._obs_round_ctx(),
+            round_idx=int(self.args.round_idx if round_idx is None
+                          else round_idx),
+            node=self._obs_node(), seq=seq, **attrs)
+
+    def _obs_close_round(self, **attrs: Any) -> None:
+        sp = self._obs_round
+        self._obs_round = None
+        if sp is not None:
+            sp.end(**attrs)

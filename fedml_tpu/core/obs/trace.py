@@ -1,0 +1,285 @@
+"""Deterministic cross-process tracing for the federated round protocol.
+
+The reference framework's only timeline primitive is the wall-clock
+start/end event pair (``core/mlops/mlops_profiler_event.py``) — no ids, no
+parent/child structure, no propagation, so "where did round 17 spend its
+time" is unanswerable once four transports, retransmits, and a server
+restart are in play.  This module is the span layer under
+``fedml_tpu.core.obs``:
+
+* **Deterministic ids** — ``trace_id = H(run_id, round_idx)`` and
+  ``span_id = H(trace_id, name, sender, seq)`` (SHA-256 prefixes, no
+  wall-clock, no process randomness).  Every incarnation of the server
+  derives the SAME id for round ``r``'s root span, which is what lets a
+  crash-restarted server CLOSE the span its dead predecessor opened — the
+  report pairs start/end by id, not by process.
+* **W3C-style propagation** — ``00-<trace_id>-<span_id>-01`` rides as a
+  plain string under ``Message.MSG_ARG_KEY_TRACEPARENT``; JSON transports
+  keep strings and binary transports pickle the whole params dict, so one
+  header covers LOOPBACK / TRPC / GRPC / MQTT_S3 with zero per-backend
+  code.
+* **Sink records, not objects** — a span is two flat records
+  (``span_start`` / ``span_end`` topics) plus zero or more ``span_event``
+  annotations, emitted through the mlops sink fan (JSONL / broker /
+  in-memory).  ``tools/trace_report.py`` reconstructs the trees offline.
+
+Durations are measured with ``time.monotonic()`` (wall time is sink
+metadata only, added by the FanoutSink): the start-side monotonic stamp is
+kept in-process and the end record carries the difference, so an NTP step
+mid-round cannot produce a negative span.  Cross-process pairs (a restart
+closing its predecessor's round span) carry no duration — the report falls
+back to the records' wall timestamps for those.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+TOPIC_SPAN_START = "span_start"
+TOPIC_SPAN_END = "span_end"
+TOPIC_SPAN_EVENT = "span_event"
+
+_TRACE_VERSION = "00"
+
+
+def trace_id_for(run_id: Any, round_idx: int) -> str:
+    """32-hex trace id: one trace per (run, round)."""
+    h = hashlib.sha256(f"fedml-trace:{run_id}:{int(round_idx)}".encode())
+    return h.hexdigest()[:32]
+
+
+def span_id_for(trace_id: str, name: str, sender: Any = 0, seq: int = 0) -> str:
+    """16-hex span id, deterministic in (trace, name, sender, seq)."""
+    h = hashlib.sha256(f"{trace_id}:{name}:{sender}:{int(seq)}".encode())
+    return h.hexdigest()[:16]
+
+
+class SpanContext:
+    """The propagated half of a span: (trace_id, span_id)."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = str(trace_id)
+        self.span_id = str(span_id)
+
+    def to_traceparent(self) -> str:
+        return f"{_TRACE_VERSION}-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_traceparent(cls, header: Any) -> Optional["SpanContext"]:
+        if not isinstance(header, str):
+            return None
+        parts = header.split("-")
+        if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        return cls(parts[1], parts[2])
+
+    def __eq__(self, other: Any) -> bool:
+        return (isinstance(other, SpanContext)
+                and other.trace_id == self.trace_id
+                and other.span_id == self.span_id)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"SpanContext({self.trace_id!r}, {self.span_id!r})"
+
+
+def round_root_ctx(run_id: Any, round_idx: int) -> SpanContext:
+    """The round root span's context, reconstructible by ANY node from
+    (run_id, round_idx) alone — the fallback parent when a message arrived
+    without a traceparent (legacy peer, fault-injected path)."""
+    tid = trace_id_for(run_id, round_idx)
+    return SpanContext(tid, span_id_for(tid, "round", 0, 0))
+
+
+class Span:
+    """One open span; emits ``span_start`` on creation, ``span_end`` on
+    :meth:`end` (idempotent — a crash-recovery double close is harmless)."""
+
+    def __init__(self, tracer: "Tracer", name: str, ctx: SpanContext,
+                 parent_id: Optional[str], round_idx: Optional[int],
+                 node: Any, attrs: Optional[Dict[str, Any]], annotate: bool,
+                 emit_start: bool = True):
+        self.tracer = tracer
+        self.name = str(name)
+        self.ctx = ctx
+        self._t0 = time.monotonic()
+        self._ended = False
+        self._adopted = not emit_start
+        self._ann = None
+        if annotate:
+            # make the protocol phase visible inside XLA/TensorBoard traces
+            try:
+                import jax.profiler
+
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:  # pragma: no cover - profiler unavailable
+                self._ann = None
+        rec: Dict[str, Any] = {
+            "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+            "name": self.name, "node": node,
+        }
+        if parent_id is not None:
+            rec["parent_span_id"] = parent_id
+        if round_idx is not None:
+            rec["round_idx"] = int(round_idx)
+        if attrs:
+            rec.update(attrs)
+        if emit_start:
+            tracer._emit(TOPIC_SPAN_START, rec)
+
+    def event(self, name: str, **attrs: Any) -> None:
+        self.tracer.span_event(name, self.ctx, **attrs)
+
+    def end(self, **attrs: Any) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        rec: Dict[str, Any] = {
+            "trace_id": self.ctx.trace_id, "span_id": self.ctx.span_id,
+            "name": self.name,
+        }
+        if self._adopted:
+            # this process did not open the span (crash-restart adoption):
+            # its monotonic origin is meaningless here, so the end record
+            # carries no duration and the report falls back to wall ts
+            rec["adopted"] = True
+        else:
+            rec["duration_s"] = round(time.monotonic() - self._t0, 6)
+        if attrs:
+            rec.update(attrs)
+        self.tracer._emit(TOPIC_SPAN_END, rec)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+
+class _NullSpan:
+    """The disabled fast path: every operation is a no-op and ``ctx`` is
+    None, so call sites never branch on ``obs.enabled()`` themselves."""
+
+    ctx = None
+    name = ""
+
+    def event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def end(self, **attrs: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Span factory bound to one run and one emit function.
+
+    ``emit`` is ``Sink.emit``-shaped (``(topic, record) -> None``); the obs
+    facade hands it the mlops fan, so span records ride the same JSONL /
+    broker / in-memory sinks as every other telemetry topic.  Emission
+    failures are swallowed: observability must never take the run down.
+    """
+
+    def __init__(self, run_id: Any, emit: Callable[[str, Dict[str, Any]], None]):
+        self.run_id = run_id
+        self._emit_fn = emit
+        self._lock = threading.Lock()
+        self._seq: Dict[str, int] = {}
+
+    def _emit(self, topic: str, rec: Dict[str, Any]) -> None:
+        try:
+            self._emit_fn(topic, rec)
+        except Exception:  # pragma: no cover - sink failure is non-fatal
+            pass
+
+    def _next_seq(self, key: str) -> int:
+        with self._lock:
+            n = self._seq.get(key, 0)
+            self._seq[key] = n + 1
+            return n
+
+    # -- span construction ---------------------------------------------------
+    def round_span(self, round_idx: int, node: Any = 0,
+                   annotate: bool = False, **attrs: Any) -> Span:
+        """Open round ``round_idx``'s root span (the deterministic id every
+        incarnation agrees on)."""
+        ctx = round_root_ctx(self.run_id, round_idx)
+        return Span(self, "round", ctx, None, round_idx, node, attrs, annotate)
+
+    def adopt_round_span(self, round_idx: int, node: Any = 0) -> Span:
+        """A handle on round ``round_idx``'s root WITHOUT re-emitting its
+        start: a crash-restarted server derives the same deterministic id
+        its dead predecessor opened, so the adopter's eventual ``end``
+        pairs with the original ``span_start`` in the report."""
+        ctx = round_root_ctx(self.run_id, round_idx)
+        return Span(self, "round", ctx, None, round_idx, node, None,
+                    annotate=False, emit_start=False)
+
+    def span(self, name: str, parent: Optional[SpanContext],
+             round_idx: Optional[int] = None, node: Any = 0, seq: int = 0,
+             annotate: bool = False, **attrs: Any) -> Span:
+        """Open a child span under ``parent`` (or under the deterministic
+        round root when ``parent`` is None and ``round_idx`` is given)."""
+        if parent is None and round_idx is not None:
+            parent = round_root_ctx(self.run_id, round_idx)
+        if parent is not None:
+            tid = parent.trace_id
+            parent_id = parent.span_id
+        else:
+            tid = trace_id_for(self.run_id, -1)
+            parent_id = None
+        ctx = SpanContext(tid, span_id_for(tid, name, node, seq))
+        return Span(self, name, ctx, parent_id, round_idx, node, attrs, annotate)
+
+    def unique_span(self, name: str, parent: Optional[SpanContext],
+                    round_idx: Optional[int] = None, node: Any = 0,
+                    annotate: bool = False, **attrs: Any) -> Span:
+        """Like :meth:`span` but with a per-tracer occurrence counter mixed
+        into the id — for spans that can legitimately repeat with identical
+        (name, node) coordinates (e.g. retransmit attempts)."""
+        seq = self._next_seq(f"{name}:{node}:{parent.span_id if parent else ''}")
+        return self.span(name, parent, round_idx=round_idx, node=node,
+                         seq=seq, annotate=annotate, **attrs)
+
+    def span_event(self, name: str, ctx: Optional[SpanContext],
+                   round_idx: Optional[int] = None, node: Any = 0,
+                   **attrs: Any) -> None:
+        """Attach a point-in-time event to ``ctx`` (fault injections,
+        rejoins, recovery milestones).  With no ctx, falls back to the round
+        root when ``round_idx`` is known, else drops the event — events are
+        annotations, never load-bearing."""
+        if ctx is None:
+            if round_idx is None:
+                return
+            ctx = round_root_ctx(self.run_id, round_idx)
+        rec: Dict[str, Any] = {
+            "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+            "event": str(name), "node": node,
+        }
+        if round_idx is not None:
+            rec["round_idx"] = int(round_idx)
+        if attrs:
+            rec.update(attrs)
+        self._emit(TOPIC_SPAN_EVENT, rec)
+
+
+@contextlib.contextmanager
+def null_context():
+    yield NULL_SPAN

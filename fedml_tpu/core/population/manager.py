@@ -185,6 +185,18 @@ class PopulationManager:
             stats["strata_sizes"] = list(self.policy.last_strata_sizes)
         stats.update(self.registry.snapshot())
         self.history.append(stats)
+        # registry mirror: the same counters, joinable with comm.* and the
+        # span layer (legacy cohort_stats topic keeps emitting below)
+        from .. import obs
+
+        labels = {"policy": self.policy.name}
+        obs.counter_inc("population.invited", invited, labels)
+        obs.counter_inc("population.reported", reported, labels)
+        obs.counter_inc("population.failed", failed, labels)
+        obs.counter_inc("population.rejected_late", rejected_late, labels)
+        obs.counter_inc(f"population.close.{reason}", 1, labels)
+        if seconds is not None:
+            obs.histogram_observe("population.round_seconds", float(seconds))
         if self._emit is not None:
             self._emit(stats)
         else:

@@ -21,6 +21,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..core import obs
 from ..core.distributed.comm_manager import FedMLCommManager
 from ..core.distributed.communication.message import Message
 from .edge_model import load_edge_model, save_edge_model
@@ -171,7 +172,10 @@ class FakeDeviceManager(FedMLCommManager):
     def _on_model(self, msg: Message) -> None:
         model_file = msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE)
         round_idx = int(msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX) or 0)
+        invite_ctx = obs.extract(msg)  # server invite span (or None)
         out_path = os.path.join(self.upload_dir, f"model_r{round_idx}_c{self.rank}.ftem")
+        train_span = obs.span("client.train", invite_ctx, round_idx=round_idx,
+                              node=self.rank, native=self.use_native)
         if self.use_native:
             from .. import native
 
@@ -202,6 +206,7 @@ class FakeDeviceManager(FedMLCommManager):
                 seed=round_idx * 1000 + self.rank,
             )
             save_edge_model(out_path, trained)
+        train_span.end()
         self.rounds_trained += 1
         m = Message(MNNMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         # round tag: lets a straggler-tolerant server drop uploads that
@@ -209,4 +214,7 @@ class FakeDeviceManager(FedMLCommManager):
         m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, round_idx)
         m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, out_path)
         m.add_params(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES, int(len(self.y)))
-        self.send_message(m)
+        with obs.span("upload", invite_ctx, round_idx=round_idx,
+                      node=self.rank) as up:
+            obs.inject(m, up.ctx)
+            self.send_message(m)

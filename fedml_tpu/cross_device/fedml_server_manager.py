@@ -19,18 +19,21 @@ import logging
 import os
 from typing import Any, Dict, List, Optional
 
+from ..core import obs
 from ..core.checkpoint import ServerRecoveryMixin
 from ..core.distributed.comm_manager import FedMLCommManager
 from ..core.distributed.communication.message import Message
 from ..core.distributed.straggler import RoundTimeoutMixin
+from ..core.obs.rounds import RoundObsMixin
 from ..core.population import PopulationPacingMixin
 from .message_define import MNNMessage
 
 logger = logging.getLogger(__name__)
 
 
-class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
-                         RoundTimeoutMixin, FedMLCommManager):
+class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
+                         PopulationPacingMixin, RoundTimeoutMixin,
+                         FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0,
                  backend: str = "LOOPBACK"):
         super().__init__(args, comm, client_rank, client_num + 1, backend)
@@ -53,6 +56,10 @@ class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
         # crash recovery last: a restore overwrites round_idx / participant
         # list / registry columns and replays the open round's journal
         self.init_server_recovery(args)
+        if self.is_initialized:
+            # restored mid-round: hold the open round's root span without
+            # re-emitting its start (the dead incarnation opened it)
+            self._obs_adopt_round()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler("connection_ready", self._on_connection_ready)
@@ -108,21 +115,26 @@ class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
 
     # -- round loop -----------------------------------------------------------
     def _send_round(self, msg_type) -> None:
+        self._obs_open_round()
         # per-round cohort via the population policy (full participation when
         # per_round == fleet and the policy is uniform — the legacy schedule)
-        self.client_id_list_in_this_round = self._population_round_list(
-            self.args.round_idx, self.per_round
-        )
+        with self._obs_phase("select", k=self.per_round):
+            self.client_id_list_in_this_round = self._population_round_list(
+                self.args.round_idx, self.per_round
+            )
         model_file = self.aggregator.get_global_model_params_file(self.args.round_idx)
         # durable round-open point: cohort is fixed, no upload accepted yet —
         # a crash from here on resumes this round in a fresh incarnation
         self._save_round_start()
-        for client_id in self.client_id_list_in_this_round:
-            m = Message(msg_type, self.rank, client_id)
-            m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, model_file)
-            m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_INDEX, client_id - 1)
-            m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
-            self._send_safe(m)
+        with self._obs_phase(
+                "invite", fanout=len(self.client_id_list_in_this_round)) as inv:
+            for client_id in self.client_id_list_in_this_round:
+                m = Message(msg_type, self.rank, client_id)
+                m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, model_file)
+                m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_INDEX, client_id - 1)
+                m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+                obs.inject(m, inv.ctx)
+                self._send_safe(m)
         self._arm_round_timer()
 
     def _on_model_from_client(self, msg: Message) -> None:
@@ -141,8 +153,13 @@ class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
             # message plane carries only the upload FILE path, so that is
             # what the journal records — replay skips entries whose file
             # vanished (the resync path re-invites those devices instead)
-            if not self._journal_upload(sender, model_file=str(model_file),
-                                        n_samples=n):
+            with self._obs_phase("journal.append", parent=obs.extract(msg),
+                                 seq=sender, sender=sender) as jsp:
+                ok = self._journal_upload(sender, model_file=str(model_file),
+                                          n_samples=n)
+                if not ok:
+                    jsp.event("dup", side="journal", sender=sender)
+            if not ok:
                 return
             self.aggregator.add_local_trained_result(
                 self.client_id_list_in_this_round.index(sender), model_file, n
@@ -153,18 +170,38 @@ class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
     def _finalize_round(self, indices: Optional[List[int]]) -> None:
         """(lock held) Aggregate the cohort, eval, finish-or-sync."""
         self._gen += 1  # this round's phase closes; its timers go stale
-        self.aggregator.aggregate(indices)
-        freq = int(getattr(self.args, "frequency_of_the_test", 1) or 0)
-        if freq and (self.args.round_idx % freq == 0 or self.args.round_idx == self.round_num - 1):
-            self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        closing_idx = int(self.args.round_idx)
+        closing_ctx = self._obs_round_ctx()
+        closing_root = self._obs_round
+        with self._obs_phase(
+                "aggregate",
+                n_uploads=(len(indices) if indices is not None
+                           else len(self.client_id_list_in_this_round))):
+            self.aggregator.aggregate(indices)
+            freq = int(getattr(self.args, "frequency_of_the_test", 1) or 0)
+            if freq and (self.args.round_idx % freq == 0 or self.args.round_idx == self.round_num - 1):
+                self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+        obs.maybe_export_metrics()
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
             self._finished = True
-            self.send_finish_msg()
+            with self._obs_phase("broadcast", parent=closing_ctx,
+                                 round_idx=closing_idx, final=True):
+                self.send_finish_msg()
+            self._obs_close_round(reason="run_complete")
             self.finish()
             return
+        # span handoff: the closing round's root stays open until its
+        # aggregate has been broadcast; _send_round opens the next root and
+        # its invite span while the broadcast span sits under the old root
+        self._obs_round = None
+        bcast = self._obs_phase("broadcast", parent=closing_ctx,
+                                round_idx=closing_idx)
         self._send_round(MNNMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+        bcast.end()
+        if closing_root is not None:
+            closing_root.end(reason="closed")
 
     # -- ServerRecoveryMixin hooks (core/checkpoint.py) ----------------------
     def _capture_global_params(self):

@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 import uuid
 
+from ...core import obs
 from ...core.distributed.comm_manager import FedMLCommManager
 from ...core.distributed.communication.message import Message
 from ..message_define import MyMessage
@@ -62,6 +63,7 @@ class ClientMasterManager(FedMLCommManager):
         global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         self.round_idx = 0
+        self._invite_ctx = obs.extract(msg)  # server invite span (or None)
         self._last_global = global_model_params  # delta base for compression
         self._update_client_index(client_index)
         self.trainer_dist_adapter.set_model_params(global_model_params)
@@ -71,6 +73,7 @@ class ClientMasterManager(FedMLCommManager):
         global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx + 1))
+        self._invite_ctx = obs.extract(msg)
         self._last_global = global_model_params
         self._update_client_index(client_index)
         self.trainer_dist_adapter.set_model_params(global_model_params)
@@ -129,10 +132,19 @@ class ClientMasterManager(FedMLCommManager):
         # round tag: lets a straggler-tolerant server drop uploads that
         # arrive after their round was closed by round_timeout_s
         m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
-        self.send_message(m)
+        with obs.span("upload", getattr(self, "_invite_ctx", None),
+                      round_idx=self.round_idx, node=self.rank) as up:
+            # the upload's own context rides the message: the server's
+            # journal.append and any retransmit attempts parent under it
+            obs.inject(m, up.ctx)
+            self.send_message(m)
 
     def __train(self) -> None:
         logger.info("client rank %d: train round %d (silo idx %d)",
                     self.rank, self.round_idx, self.trainer_dist_adapter.client_index)
-        weights, local_sample_num = self.trainer_dist_adapter.train(self.round_idx)
+        with obs.span("client.train", getattr(self, "_invite_ctx", None),
+                      round_idx=self.round_idx, node=self.rank,
+                      annotate=True,
+                      client_index=int(self.trainer_dist_adapter.client_index)):
+            weights, local_sample_num = self.trainer_dist_adapter.train(self.round_idx)
         self.send_model_to_server(0, weights, local_sample_num)

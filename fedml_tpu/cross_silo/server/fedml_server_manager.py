@@ -22,18 +22,21 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ...core import obs
 from ...core.checkpoint import ServerRecoveryMixin
 from ...core.distributed.comm_manager import FedMLCommManager
 from ...core.distributed.communication.message import Message
 from ...core.distributed.straggler import RoundTimeoutMixin
+from ...core.obs.rounds import RoundObsMixin
 from ...core.population import PopulationPacingMixin
 from ..message_define import MyMessage
 
 logger = logging.getLogger(__name__)
 
 
-class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
-                         RoundTimeoutMixin, FedMLCommManager):
+class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
+                         PopulationPacingMixin, RoundTimeoutMixin,
+                         FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0, backend: str = "LOOPBACK"):
         super().__init__(args, comm, client_rank, client_num + 1, backend)
         self.aggregator = aggregator
@@ -56,6 +59,10 @@ class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
         # crash recovery last: a restore overwrites round_idx / participant
         # list / registry columns and replays the open round's journal
         self.init_server_recovery(args)
+        if self.is_initialized:
+            # restored mid-round: hold the open round's root span without
+            # re-emitting its start (the dead incarnation opened it)
+            self._obs_adopt_round()
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -115,27 +122,33 @@ class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
 
     def send_init_msg(self) -> None:
         """Round-0 kick-off (reference send_message_init_config :182)."""
-        self.client_id_list_in_this_round = self._population_round_list(
-            self.args.round_idx, self.per_round
-        )
-        self.data_silo_index_of_client = dict(zip(
-            self.client_id_list_in_this_round,
-            self.aggregator.data_silo_selection(
-                self.args.round_idx,
-                int(getattr(self.args, "client_num_in_total", self.client_num)),
-                len(self.client_id_list_in_this_round),
-            ),
-        ))
+        self._obs_open_round()
+        with self._obs_phase("select", k=self.per_round):
+            self.client_id_list_in_this_round = self._population_round_list(
+                self.args.round_idx, self.per_round
+            )
+            self.data_silo_index_of_client = dict(zip(
+                self.client_id_list_in_this_round,
+                self.aggregator.data_silo_selection(
+                    self.args.round_idx,
+                    int(getattr(self.args, "client_num_in_total", self.client_num)),
+                    len(self.client_id_list_in_this_round),
+                ),
+            ))
         global_model = self.aggregator.get_global_model_params()
         # durable round-open point: participants + silo map are fixed, no
         # upload has been accepted yet — a crash from here on resumes round 0
         self._save_round_start()
-        for client_id in self.client_id_list_in_this_round:
-            m = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
-            m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
-            m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
-            m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
-            self._send_safe(m)
+        with self._obs_phase(
+                "invite", fanout=len(self.client_id_list_in_this_round)) as inv:
+            for client_id in self.client_id_list_in_this_round:
+                m = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
+                m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
+                m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
+                m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+                # clients parent their train/upload spans under the invite
+                obs.inject(m, inv.ctx)
+                self._send_safe(m)
         self._arm_round_timer()
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
@@ -169,8 +182,13 @@ class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
             # returns, so an acked upload is always journaled.  False means
             # this sender already landed this round (retransmit into a new
             # incarnation) — discard instead of double-count.
-            if not self._journal_upload(sender, model_params=model_params,
-                                        n_samples=local_sample_number):
+            with self._obs_phase("journal.append", parent=obs.extract(msg),
+                                 seq=sender, sender=sender) as jsp:
+                ok = self._journal_upload(sender, model_params=model_params,
+                                          n_samples=local_sample_number)
+                if not ok:
+                    jsp.event("dup", side="journal", sender=sender)
+            if not ok:
                 return
             self.aggregator.add_local_trained_result(
                 self.client_id_list_in_this_round.index(sender), model_params,
@@ -184,45 +202,70 @@ class FedMLServerManager(ServerRecoveryMixin, PopulationPacingMixin,
         ``indices`` cohort (None = every silo), eval, then either finish or
         open the next round."""
         self._gen += 1  # this round's phase closes; its timers go stale
-        self.aggregator.aggregate(indices)
-        freq = int(getattr(self.args, "frequency_of_the_test", 1) or 0)
-        if freq and (self.args.round_idx % freq == 0 or self.args.round_idx == self.round_num - 1):
-            self.eval_history.append(
-                self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
-            )
+        closing_idx = int(self.args.round_idx)
+        closing_ctx = self._obs_round_ctx()
+        closing_root = self._obs_round
+        with self._obs_phase(
+                "aggregate",
+                n_uploads=(len(indices) if indices is not None
+                           else len(self.client_id_list_in_this_round))):
+            self.aggregator.aggregate(indices)
+            freq = int(getattr(self.args, "frequency_of_the_test", 1) or 0)
+            if freq and (self.args.round_idx % freq == 0 or self.args.round_idx == self.round_num - 1):
+                self.eval_history.append(
+                    self.aggregator.test_on_server_for_all_clients(self.args.round_idx)
+                )
+        obs.maybe_export_metrics()
 
         self.args.round_idx += 1
         if self.args.round_idx >= self.round_num:
             self._finished = True
-            self.send_finish_msg()
+            with self._obs_phase("broadcast", parent=closing_ctx,
+                                 round_idx=closing_idx, final=True):
+                self.send_finish_msg()
+            self._obs_close_round(reason="run_complete")
             self.finish()
             return
 
         # next round participants + model sync (reference :202) — the
         # population policy replaces direct client_selection (over-commit
-        # inflates the invite list when pacing is on)
-        self.client_id_list_in_this_round = self._population_round_list(
-            self.args.round_idx, self.per_round
-        )
-        self.data_silo_index_of_client = dict(zip(
-            self.client_id_list_in_this_round,
-            self.aggregator.data_silo_selection(
-                self.args.round_idx,
-                int(getattr(self.args, "client_num_in_total", self.client_num)),
-                len(self.client_id_list_in_this_round),
-            ),
-        ))
+        # inflates the invite list when pacing is on).  Span handoff: the
+        # closing round's root stays open until its aggregate is broadcast;
+        # the broadcast span sits under the OLD root while the invite span
+        # (whose context rides the sync messages) sits under the NEW one.
+        self._obs_round = None
+        self._obs_open_round()
+        with self._obs_phase("select", k=self.per_round):
+            self.client_id_list_in_this_round = self._population_round_list(
+                self.args.round_idx, self.per_round
+            )
+            self.data_silo_index_of_client = dict(zip(
+                self.client_id_list_in_this_round,
+                self.aggregator.data_silo_selection(
+                    self.args.round_idx,
+                    int(getattr(self.args, "client_num_in_total", self.client_num)),
+                    len(self.client_id_list_in_this_round),
+                ),
+            ))
         global_model = self.aggregator.get_global_model_params()
         # durable round-open point (see send_init_msg): a crash during or
         # after the sync sends resumes THIS round, and clients that already
         # got the sync are re-synced idempotently on their next ONLINE
         self._save_round_start()
-        for client_id in self.client_id_list_in_this_round:
-            m = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
-            m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
-            m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
-            m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
-            self._send_safe(m)
+        bcast = self._obs_phase("broadcast", parent=closing_ctx,
+                                round_idx=closing_idx)
+        with self._obs_phase(
+                "invite", fanout=len(self.client_id_list_in_this_round)) as inv:
+            for client_id in self.client_id_list_in_this_round:
+                m = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
+                m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model)
+                m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
+                m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+                obs.inject(m, inv.ctx)
+                self._send_safe(m)
+        bcast.end()
+        if closing_root is not None:
+            closing_root.end(reason="closed")
         self._arm_round_timer()
 
     def send_finish_msg(self) -> None:

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Tuple
 import jax
 import numpy as np
 
+from ....core import obs
 from ....core.aggregate import FedMLAggOperator
 from ....core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
 from ....core.security.fedml_attacker import FedMLAttacker
@@ -136,8 +137,15 @@ class FedAvgAPI:
             logger.info("resumed from checkpoint round %d", step)
         for round_idx in range(start_round, comm_round):
             t0 = time.time()
+            # one span tree per round; in-process simulation means select/
+            # train/aggregate are direct children of the root (no transport,
+            # so no invite/upload legs).  annotate=True nests the round under
+            # a jax.profiler.TraceAnnotation when a device trace is running.
+            rsp = obs.round_span(round_idx, annotate=True, mode="simulation_sp")
             self.trainer.round_idx = round_idx  # deterministic per-round RNG stream
-            client_indexes = self._client_sampling(round_idx)
+            with obs.span("select", rsp.ctx, round_idx=round_idx,
+                          k=int(self.args.client_num_per_round)):
+                client_indexes = self._client_sampling(round_idx)
             logger.info("round %d: clients %s", round_idx, client_indexes)
             w_locals: List[Tuple[float, Any]] = []
             attacker = FedMLAttacker.get_instance()
@@ -156,16 +164,28 @@ class FedAvgAPI:
                     self.test_data_local_dict[idx],
                     self.train_data_local_num_dict[idx],
                 )
-                w = client.train(self.w_global)
+                with obs.span("client.train", rsp.ctx, round_idx=round_idx,
+                              seq=slot, annotate=True, client=int(idx)):
+                    w = client.train(self.w_global)
                 w_locals.append((float(client.local_sample_number), w))
             self.samples_per_round.append(
                 int(sum(n for n, _ in w_locals)) * int(getattr(self.args, "epochs", 1))
             )
 
-            self.w_global = self.server_update(w_locals)
-            self.aggregator.set_model_params(self.w_global)
+            with obs.span("aggregate", rsp.ctx, round_idx=round_idx,
+                          annotate=True, n_uploads=len(w_locals)):
+                self.w_global = self.server_update(w_locals)
+                self.aggregator.set_model_params(self.w_global)
 
             dt = time.time() - t0
+            if obs.enabled() and len(self.round_times) >= 3:
+                med = float(np.median(self.round_times))
+                if dt > obs.slow_round_factor() * med:
+                    obs.span_event("slow_round", rsp.ctx, round_idx=round_idx,
+                                   dt_s=round(dt, 4), median_s=round(med, 4))
+            obs.histogram_observe("round.seconds", float(dt))
+            rsp.end(reason="closed")
+            obs.maybe_export_metrics()
             self.round_times.append(dt)
             self.metrics.log({"round": round_idx, "round_time_s": round(dt, 4)})
             # population accounting (synchronous round: invited == reported)

@@ -55,6 +55,7 @@ except ImportError:  # pragma: no cover - legacy jax uses check_rep instead
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
         )
 
+from ...core import obs
 from ...core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
 from ...core.schedule import RuntimeEstimator, SeqTrainScheduler
 from ...core.security.fedml_attacker import FedMLAttacker
@@ -643,6 +644,10 @@ class XLASimulator:
             logger.info("jax profiler trace -> %s", prof_dir)
         for round_idx in range(start_round, comm_round):
             t0 = time.time()
+            # the whole round is one (or two) compiled XLA programs, so the
+            # round root is the only meaningful span here; annotate=True nests
+            # it inside the device trace when enable_profiler is on
+            rsp = obs.round_span(round_idx, annotate=True, mode="simulation_xla")
             sampled = self._client_sampling(round_idx)
             ids, real = self._schedule(sampled)
             counts = np.where(real > 0, np.asarray(self.client_counts)[ids], 0)
@@ -742,6 +747,14 @@ class XLASimulator:
                 self.variables = dp.add_global_noise(self.variables)
             jax.block_until_ready(self.variables)
             dt = time.time() - t0
+            if obs.enabled() and len(self.round_times) >= 3:
+                med = float(np.median(self.round_times))
+                if dt > obs.slow_round_factor() * med:
+                    obs.span_event("slow_round", rsp.ctx, round_idx=round_idx,
+                                   dt_s=round(dt, 4), median_s=round(med, 4))
+            obs.histogram_observe("round.seconds", float(dt))
+            rsp.end(reason="closed", loss=float(mean_loss))
+            obs.maybe_export_metrics()
             self.round_times.append(dt)
             if round_idx > 0:  # round 0 is dominated by XLA compile
                 # The round's wall time is set by the heaviest mesh slot.

@@ -1,0 +1,91 @@
+"""tools/lint_obs.py wired into tier-1: with the unified metrics registry
+and the obs facade in place, library code must not grow new bare counter
+bags (``defaultdict(int)``) or bypass the mlops seam with direct
+``<sink>.emit(...)`` calls — and the linter itself must actually catch
+violations, because a lint that can't fail is not a gate."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import lint_obs
+
+
+def test_library_tree_is_clean():
+    """The machine-enforced contract: every fedml_tpu/ counter reaches the
+    registry and every record rides the sink fan."""
+    assert lint_obs.main([]) == 0
+
+
+def test_catches_counter_bag_and_direct_emit(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from collections import defaultdict\n"
+        "class Stats:\n"
+        "    def __init__(self, sink):\n"
+        "        self.counts = defaultdict(int)\n"
+        "        self.sink = sink\n"
+        "    def flush(self):\n"
+        "        self.sink.emit('stats', dict(self.counts))\n"
+    )
+    violations = lint_obs.lint_file(str(bad))
+    assert [(lineno, kind) for _, lineno, kind, _ in violations] == [
+        (4, "bare counter bag"),
+        (7, "direct sink emit"),
+    ]
+    assert lint_obs.main(["--root", str(tmp_path)]) == 1
+
+
+def test_fan_alias_is_covered(tmp_path):
+    f = tmp_path / "alias.py"
+    f.write_text(
+        "def ship(fan, mem_sink, record):\n"
+        "    fan.emit('x', record)\n"
+        "    mem_sink.emit('x', record)\n"
+    )
+    assert len(lint_obs.lint_file(str(f))) == 2
+
+
+def test_pragma_allows_approved_seam(tmp_path):
+    f = tmp_path / "seam.py"
+    f.write_text(
+        "from collections import defaultdict\n"
+        "counts = defaultdict(int)  # lint_obs: allow\n"
+    )
+    assert lint_obs.lint_file(str(f)) == []
+    assert lint_obs.main(["--root", str(tmp_path)]) == 0
+
+
+def test_obs_and_mlops_layers_are_exempt(tmp_path):
+    # the two layers that ARE the seam may touch sinks/registries freely
+    for part in (("core", "obs"), ("core", "mlops")):
+        d = tmp_path.joinpath(*part)
+        d.mkdir(parents=True)
+        f = d / "impl.py"
+        f.write_text("def flush(self):\n    self.sink.emit('x', {})\n")
+        assert lint_obs.lint_file(str(f)) == []
+    assert lint_obs.main(["--root", str(tmp_path)]) == 0
+
+
+def test_docstrings_and_comments_do_not_false_positive(tmp_path):
+    f = tmp_path / "prose.py"
+    f.write_text(
+        '"""Never call sink.emit(...) directly; defaultdict(int) is banned."""\n'
+        "# the old code kept a defaultdict(int) and called fan.emit() here\n"
+        "MSG = 'route counters through obs, not sink.emit(topic, rec)'\n"
+    )
+    assert lint_obs.lint_file(str(f)) == []
+
+
+def test_registry_and_facade_calls_are_not_flagged(tmp_path):
+    f = tmp_path / "good.py"
+    f.write_text(
+        "from fedml_tpu.core import obs\n"
+        "def record(n):\n"
+        "    obs.counter_inc('comm.retransmits', n, {'node': 0})\n"
+        "    obs.histogram_observe('round.seconds', 0.5)\n"
+    )
+    assert lint_obs.lint_file(str(f)) == []

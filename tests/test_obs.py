@@ -1,0 +1,556 @@
+"""The round-trace observability layer (``fedml_tpu.core.obs``).
+
+Three strata, mirroring the layer's own contract:
+
+* **Unit** — deterministic trace/span ids, W3C traceparent round-trips,
+  tracer record shapes (incl. crash-adoption ends), the metrics registry's
+  bucket math and cardinality cap, and the no-op guarantees of the
+  disabled facade (with ``obs_trace`` off the wire must stay byte-identical
+  to the pre-obs wire).
+* **Report** — ``tools/trace_report.py`` against golden record sets:
+  critical-path walk, straggler flagging, orphan/unclosed detection and
+  the ``--assert-closed`` exit contract.
+* **Trace integrity under chaos** — the acceptance claim: a topology
+  absorbing drop + duplicate + delay + reset + crash-and-rejoin (and,
+  separately, a server kill + restart) must still reconstruct every
+  completed round as ONE closed span tree, with retransmit attempts
+  visible as child spans and every fault as a span event.  Reuses the
+  chaos harness from ``test_fault_tolerance`` — same plans, same
+  topologies, now traced.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import trace_report
+
+import test_fault_tolerance as _ft
+from fedml_tpu.core import mlops, obs
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+from fedml_tpu.core.distributed.communication.message import Message
+from fedml_tpu.core.mlops import FanoutSink, InMemorySink
+from fedml_tpu.core.mlops.mlops_profiler_event import MLOpsProfilerEvent
+from fedml_tpu.core.mlops.sinks import JsonlFileSink
+from fedml_tpu.core.obs import MetricsRegistry, SpanContext, Tracer
+from fedml_tpu.core.obs.trace import round_root_ctx, span_id_for, trace_id_for
+
+
+@pytest.fixture(autouse=True)
+def _obs_hygiene():
+    """obs state is process-global: every test leaves it disabled and the
+    registry empty so no other module inherits a live tracer."""
+    yield
+    obs.shutdown()
+    obs.registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# Unit: deterministic ids + propagation header
+# ---------------------------------------------------------------------------
+
+class TestDeterministicIds:
+    def test_trace_id_is_pure_function_of_run_and_round(self):
+        a = trace_id_for("run-7", 3)
+        assert a == trace_id_for("run-7", 3)
+        assert len(a) == 32 and int(a, 16) >= 0
+        assert a != trace_id_for("run-7", 4)
+        assert a != trace_id_for("run-8", 3)
+
+    def test_span_id_is_pure_function_of_coordinates(self):
+        tid = trace_id_for("r", 0)
+        a = span_id_for(tid, "upload", 2, 0)
+        assert a == span_id_for(tid, "upload", 2, 0)
+        assert len(a) == 16 and int(a, 16) >= 0
+        assert a != span_id_for(tid, "upload", 3, 0)
+        assert a != span_id_for(tid, "upload", 2, 1)
+        assert a != span_id_for(tid, "invite", 2, 0)
+
+    def test_every_incarnation_agrees_on_the_round_root(self):
+        # the property crash adoption rests on: any process, any time
+        assert round_root_ctx("r", 5) == round_root_ctx("r", 5)
+
+    def test_traceparent_roundtrip(self):
+        ctx = round_root_ctx("run-x", 2)
+        back = SpanContext.from_traceparent(ctx.to_traceparent())
+        assert back == ctx
+
+    @pytest.mark.parametrize("header", [
+        None, "", "00", "00-short-short-01", 12345,
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",   # trace id too short
+        "00-" + "a" * 32 + "-" + "b" * 15 + "-01",   # span id too short
+    ])
+    def test_malformed_traceparent_is_none(self, header):
+        assert SpanContext.from_traceparent(header) is None
+
+
+# ---------------------------------------------------------------------------
+# Unit: tracer record shapes
+# ---------------------------------------------------------------------------
+
+def _collecting_tracer(run_id="t"):
+    out = []
+    return Tracer(run_id, lambda topic, rec: out.append((topic, dict(rec)))), out
+
+
+class TestTracer:
+    def test_round_tree_start_end_parenting(self):
+        tr, out = _collecting_tracer()
+        root = tr.round_span(0, fanout=3)
+        with tr.span("select", root.ctx, round_idx=0) as sel:
+            pass
+        root.end(reason="closed")
+        topics = [t for t, _ in out]
+        assert topics == ["span_start", "span_start", "span_end", "span_end"]
+        root_start, sel_start, sel_end, root_end = [r for _, r in out]
+        assert root_start["name"] == "round" and root_start["fanout"] == 3
+        assert "parent_span_id" not in root_start
+        assert sel_start["parent_span_id"] == root.ctx.span_id
+        assert sel_start["trace_id"] == root.ctx.trace_id
+        assert sel_end["duration_s"] >= 0
+        assert root_end["reason"] == "closed"
+        assert sel.ctx.span_id == span_id_for(root.ctx.trace_id, "select", 0, 0)
+
+    def test_end_is_idempotent(self):
+        tr, out = _collecting_tracer()
+        sp = tr.round_span(0)
+        sp.end()
+        sp.end()
+        assert [t for t, _ in out].count("span_end") == 1
+
+    def test_adopted_end_carries_no_duration(self):
+        # a crash-restarted server never saw the start's monotonic origin
+        tr, out = _collecting_tracer()
+        sp = tr.adopt_round_span(4)
+        sp.end(reason="closed")
+        assert [t for t, _ in out] == ["span_end"]  # no re-emitted start
+        rec = out[0][1]
+        assert rec["adopted"] is True and "duration_s" not in rec
+        assert rec["span_id"] == round_root_ctx("t", 4).span_id
+
+    def test_unique_span_ids_differ_per_attempt(self):
+        tr, out = _collecting_tracer()
+        parent = round_root_ctx("t", 0)
+        a = tr.unique_span("retransmit", parent, node=1)
+        b = tr.unique_span("retransmit", parent, node=1)
+        assert a.ctx.span_id != b.ctx.span_id
+        assert a.ctx.trace_id == b.ctx.trace_id == parent.trace_id
+
+    def test_span_event_falls_back_to_round_root(self):
+        tr, out = _collecting_tracer()
+        tr.span_event("drop", None, round_idx=1, msg_type=2)
+        assert out[0][1]["span_id"] == round_root_ctx("t", 1).span_id
+        # with neither ctx nor round the event is dropped, never mis-filed
+        tr.span_event("drop", None)
+        assert len(out) == 1
+
+    def test_emit_failure_is_swallowed(self):
+        def boom(topic, rec):
+            raise RuntimeError("sink down")
+
+        tr = Tracer("t", boom)
+        sp = tr.round_span(0)
+        sp.event("x")
+        sp.end()  # telemetry must never take the run down
+
+
+# ---------------------------------------------------------------------------
+# Unit: metrics registry
+# ---------------------------------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_histogram_bucket_edges(self):
+        r = MetricsRegistry()
+        buckets = (0.1, 1.0, 10.0)
+        for v in (0.05, 0.1, 0.5, 10.0, 50.0):
+            r.histogram_observe("lat", v, buckets=buckets)
+        h = r.get_histogram("lat")
+        assert h["buckets"] == [0.1, 1.0, 10.0]
+        # v <= upper_bound: 0.05 and 0.1 land in the first bucket, 10.0 in
+        # the last finite one, 50.0 in the implicit +Inf slot
+        assert h["bucket_counts"] == [2, 1, 1, 1]
+        assert h["count"] == 5
+        assert h["sum"] == pytest.approx(60.65)
+
+    def test_counter_and_gauge_semantics(self):
+        r = MetricsRegistry()
+        r.counter_inc("c")
+        r.counter_inc("c", 2, {"node": 1})
+        r.gauge_set("g", 3.0)
+        r.gauge_set("g", 1.5)  # last write wins
+        assert r.get_counter("c") == 1
+        assert r.get_counter("c", {"node": 1}) == 2
+        assert r.get_gauge("g") == 1.5
+
+    def test_kind_conflict_raises(self):
+        r = MetricsRegistry()
+        r.counter_inc("m")
+        with pytest.raises(ValueError):
+            r.gauge_set("m", 1.0)
+
+    def test_cardinality_cap_collapses_to_overflow(self):
+        r = MetricsRegistry(max_series_per_metric=3)
+        for i in range(5):
+            r.counter_inc("c", 1, {"client": i})
+        # 3 real series + the shared overflow series; 2 increments collapsed
+        assert r.series_count("c") == 4
+        assert r.dropped_series("c") == 2
+        assert r.get_counter("c", {"overflow": "true"}) == 2
+        # existing series keep incrementing normally past the cap
+        r.counter_inc("c", 1, {"client": 0})
+        assert r.get_counter("c", {"client": 0}) == 2
+        recs = [x for x in r.export() if x["metric"] == "c"]
+        assert all(x["dropped_series"] == 2 for x in recs)
+
+    def test_export_record_shape(self):
+        r = MetricsRegistry()
+        r.counter_inc("comm.retransmits", 3, {"node": 0})
+        r.histogram_observe("round.seconds", 0.2, buckets=(1.0,))
+        recs = {x["metric"]: x for x in r.export()}
+        c = recs["comm.retransmits"]
+        assert c["kind"] == "counter" and c["value"] == 3
+        assert c["labels"] == {"node": "0"}
+        h = recs["round.seconds"]
+        assert h["kind"] == "histogram"
+        assert h["bucket_counts"] == [1, 0] and h["count"] == 1
+
+    def test_maybe_export_rate_limit(self):
+        r = MetricsRegistry()
+        r.counter_inc("x")
+        emitted = []
+        emit = lambda t, rec: emitted.append((t, rec))
+        assert r.maybe_export(emit, 0) is False      # 0 = shutdown-only
+        time.sleep(0.02)
+        assert r.maybe_export(emit, 0.01) is True
+        assert emitted and emitted[0][0] == "metrics"
+        assert r.maybe_export(emit, 10.0) is False   # inside the window
+
+
+# ---------------------------------------------------------------------------
+# Unit: the facade's disabled guarantees + satellite mlops fixes
+# ---------------------------------------------------------------------------
+
+class _ObsArgs:
+    rank = 0
+
+    def __init__(self, run_id, obs_trace=True):
+        self.run_id = run_id
+        self.obs_trace = obs_trace
+
+
+class TestFacade:
+    def test_disabled_everything_is_noop(self):
+        assert obs.enabled() is False
+        sp = obs.span("upload", round_root_ctx("r", 0))
+        assert sp is obs.NULL_SPAN and sp.ctx is None
+        sp.event("x")
+        sp.end()
+        obs.span_event("drop", round_idx=0)
+        assert obs.round_span(0) is obs.NULL_SPAN
+
+    def test_disabled_inject_leaves_wire_byte_identical(self):
+        m = Message(3, 1, 0)
+        before = dict(m.get_params())
+        obs.inject(m, round_root_ctx("r", 0))
+        assert m.get_params() == before
+        assert m.get(Message.MSG_ARG_KEY_TRACEPARENT) is None
+        assert obs.extract(m) is None
+
+    def test_enabled_inject_extract_roundtrip(self):
+        emitted = []
+        obs.configure(_ObsArgs("rt"), lambda t, rec: emitted.append(t))
+        try:
+            with obs.span("upload", round_root_ctx("rt", 0),
+                          round_idx=0, node=2) as up:
+                m = Message(3, 2, 0)
+                obs.inject(m, up.ctx)
+            assert obs.extract(m) == up.ctx
+            assert emitted == ["span_start", "span_end"]
+        finally:
+            obs.shutdown()
+        assert obs.enabled() is False
+
+    def test_metrics_helpers_live_even_when_tracing_off(self):
+        # counters mirror unconditionally: obs_trace gates spans, not metrics
+        obs.counter_inc("comm.test_metric", 2, {"node": 1})
+        assert obs.registry().get_counter("comm.test_metric",
+                                          {"node": 1}) == 2
+
+
+class TestMlopsSatellites:
+    def test_profiler_durations_survive_wall_clock_step(self, monkeypatch):
+        # an NTP step back mid-event must not yield a negative duration:
+        # the profiler measures with time.monotonic, wall time is metadata
+        mem = InMemorySink()
+        ev = MLOpsProfilerEvent("r", 0, FanoutSink([mem]))
+        walls = iter([1000.0, 500.0, 400.0, 300.0])
+        monkeypatch.setattr(time, "time", lambda: next(walls, 300.0))
+        ev.log_event_started("train")
+        ev.log_event_ended("train")
+        ended = [r for r in mem.by_topic("event") if r["phase"] == "ended"]
+        assert ended and ended[0]["duration_s"] >= 0
+
+    def test_jsonl_sink_close_is_idempotent(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        sink = JsonlFileSink(path)
+        sink.emit("metrics", {"metric": "x", "value": 1})
+        sink.close()
+        sink.close()  # second close: no-op, no raise
+        sink.emit("metrics", {"metric": "y", "value": 2})  # dropped, no raise
+        with open(path) as f:
+            lines = [json.loads(l) for l in f if l.strip()]
+        assert len(lines) == 1 and lines[0]["metric"] == "x"
+
+
+# ---------------------------------------------------------------------------
+# Report: tools/trace_report.py on golden record sets
+# ---------------------------------------------------------------------------
+
+def _golden_round(run_id="golden", slow_node=3):
+    """One closed round: root > invite > 3 client.train legs, one slow."""
+    tid = trace_id_for(run_id, 0)
+    root = span_id_for(tid, "round", 0, 0)
+    inv = span_id_for(tid, "invite", 0, 0)
+    recs = [
+        {"topic": "span_start", "trace_id": tid, "span_id": root,
+         "name": "round", "node": 0, "round_idx": 0, "ts": 10.0},
+        {"topic": "span_start", "trace_id": tid, "span_id": inv,
+         "name": "invite", "node": 0, "parent_span_id": root, "ts": 10.05},
+        {"topic": "span_end", "trace_id": tid, "span_id": inv,
+         "name": "invite", "duration_s": 0.05, "ts": 10.1},
+    ]
+    for node, dur in ((1, 0.2), (2, 0.21), (slow_node, 1.5)):
+        sid = span_id_for(tid, "client.train", node, 0)
+        recs.append({"topic": "span_start", "trace_id": tid, "span_id": sid,
+                     "name": "client.train", "node": node,
+                     "parent_span_id": inv, "ts": 10.1})
+        recs.append({"topic": "span_end", "trace_id": tid, "span_id": sid,
+                     "name": "client.train", "duration_s": dur,
+                     "ts": 10.1 + dur})
+    recs.append({"topic": "span_event", "trace_id": tid,
+                 "span_id": span_id_for(tid, "client.train", slow_node, 0),
+                 "event": "gc_pause", "node": slow_node})
+    recs.append({"topic": "span_end", "trace_id": tid, "span_id": root,
+                 "name": "round", "duration_s": 2.0, "ts": 12.0})
+    return tid, recs
+
+
+class TestTraceReport:
+    def test_golden_round_is_closed_and_critical_path_finds_the_slow_leg(self):
+        tid, recs = _golden_round()
+        tr = trace_report.build_traces(recs)[tid]
+        assert tr.problems() == []
+        path = tr.critical_path()
+        assert [sn.name for sn in path] == ["round", "invite", "client.train"]
+        assert path[-1].node == 3  # the leg the round actually waited on
+
+    def test_straggler_ranking_flags_past_factor_x_median(self):
+        tid, recs = _golden_round()
+        ranked = trace_report.build_traces(recs)[tid].stragglers(2.0)
+        assert [sn.node for sn, _, _ in ranked] == [3, 2, 1]
+        assert [slow for _, _, slow in ranked] == [True, False, False]
+
+    def test_duplicate_records_collapse_first_wins(self):
+        # retransmitted frames can re-deliver span records; deterministic
+        # ids make the copies collapse instead of corrupting the tree
+        tid, recs = _golden_round()
+        tr = trace_report.build_traces(recs + [dict(r) for r in recs])[tid]
+        assert tr.problems() == []
+        assert len([sn for sn in tr.spans.values()
+                    if sn.name == "client.train"]) == 3
+
+    def test_orphan_and_unclosed_and_multiroot_detection(self):
+        tid, recs = _golden_round()
+        recs.append({"topic": "span_start", "trace_id": tid,
+                     "span_id": "feedfeedfeedfeed", "name": "upload",
+                     "node": 9, "parent_span_id": "beefbeefbeefbeef"})
+        problems = trace_report.build_traces(recs)[tid].problems()
+        assert any("orphan" in p for p in problems)
+        assert any("never closed" in p for p in problems)
+        # adopted close pairing: an end with no start is also a violation
+        lone = [{"topic": "span_end", "trace_id": "x" * 32,
+                 "span_id": "c" * 16, "name": "round"}]
+        p2 = trace_report.build_traces(lone)["x" * 32].problems()
+        assert any("ended without starting" in p for p in p2)
+        assert any("root" in p for p in p2)
+
+    def test_assert_closed_exit_codes(self, tmp_path, capsys):
+        _, recs = _golden_round()
+        good = tmp_path / "good.jsonl"
+        good.write_text("\n".join(json.dumps(r) for r in recs) + "\n"
+                        + "{torn json tail\n")  # unparseable tail is skipped
+        assert trace_report.main([str(good), "--assert-closed"]) == 0
+        # drop the root's end: the trace is no longer closed
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("\n".join(
+            json.dumps(r) for r in recs
+            if not (r["topic"] == "span_end" and r["name"] == "round")) + "\n")
+        assert trace_report.main([str(bad)]) == 0  # report-only: informative
+        assert trace_report.main([str(bad), "--assert-closed"]) == 2
+        out = capsys.readouterr().out
+        assert "never closed" in out
+
+
+# ---------------------------------------------------------------------------
+# Trace integrity under chaos (the acceptance layer)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _traced(run_id):
+    """Process-wide tracing through an in-memory sink: obs is configured by
+    ``mlops.init`` (the production seam) and covers every in-process node
+    thread of the topology."""
+    mem = InMemorySink()
+    mlops.init(_ObsArgs(run_id), FanoutSink([mem]))
+    try:
+        yield mem
+    finally:
+        mlops.finish()
+
+
+def _span_records(mem):
+    return [dict(rec, topic=t) for t, rec in list(mem.records)
+            if t in trace_report.SPAN_TOPICS]
+
+
+def _assert_rounds_closed(mem, run_id, n_rounds):
+    """Every completed round reconstructs as exactly one CLOSED span tree —
+    zero orphans, zero unclosed spans — and returns {round_idx: Trace}."""
+    traces = trace_report.build_traces(_span_records(mem))
+    out = {}
+    for r in range(n_rounds):
+        tid = trace_id_for(run_id, r)
+        assert tid in traces, f"round {r}: no trace emitted"
+        tr = traces[tid]
+        assert tr.problems() == [], (r, tr.problems())
+        out[r] = tr
+    return out
+
+
+def _names(tr):
+    return {sn.name for sn in tr.spans.values()}
+
+
+def _events(traces):
+    return {ev["event"] for tr in traces.values()
+            for sn in tr.spans.values() for ev in sn.events}
+
+
+def test_trace_integrity_chaos_loopback():
+    """Full chaos plan (drop + reset + duplicate + delay) + a client
+    crash-and-rejoin: both rounds close as single span trees, the healed
+    drop is visible as a retransmit child span, and every injected fault
+    surfaces as a span event on the round it hit."""
+    LoopbackHub.reset()
+    run_id = "obs-chaos"
+    with _traced(run_id) as mem:
+        history, final, stats = _ft._run_chaos_topology(
+            run_id, fault_plan=_ft._full_chaos_plan(), crash_rank=1)
+        assert len(history) == 2
+    traces = _assert_rounds_closed(mem, run_id, 2)
+    # the round protocol's full phase vocabulary, per round
+    for r, tr in traces.items():
+        assert {"round", "select", "invite", "client.train", "upload",
+                "journal.append", "aggregate", "broadcast"} <= _names(tr), r
+        path = tr.critical_path()
+        assert path and path[0].name == "round" and len(path) >= 2
+    # the dropped round-1 sync was healed by retransmit — as a child span
+    retx = [sn for sn in traces[1].spans.values() if sn.name == "retransmit"]
+    assert retx and all(sn.end is not None for sn in retx)
+    events = _events(traces)
+    assert {"drop", "reset", "dup", "delay", "rejoin"} <= events, events
+    # legacy topic keeps emitting alongside the registry export
+    assert mem.by_topic("comm_stats")
+    metric_names = {r["metric"] for r in mem.by_topic("metrics")}
+    assert "comm.retransmits" in metric_names
+    assert "comm.dup_dropped" in metric_names
+    assert "population.reported" in metric_names
+
+
+def test_trace_integrity_server_kill_loopback(tmp_path):
+    """A server killed mid-round-0 and restarted from durable state ADOPTS
+    the dead incarnation's round span: the restart closes the span its
+    predecessor opened (deterministic ids), so even the killed round reads
+    as one closed tree with the recovery milestones attached."""
+    LoopbackHub.reset()
+    run_id = "obs-kill"
+    with _traced(run_id) as mem:
+        history, final, stats, restarts, killed, server = \
+            _ft._run_server_kill_topology(run_id, tmp_path / "srv")
+        assert restarts >= 1 and len(history) == 2
+    traces = _assert_rounds_closed(mem, run_id, 2)
+    root0 = traces[0].roots()[0]
+    assert root0.end is not None and root0.end.get("adopted") is True
+    events = _events(traces)
+    assert {"server_kill", "server_restore", "epoch_bump"} <= events, events
+    metric_names = {r["metric"] for r in mem.by_topic("metrics")}
+    assert "journal.appends" in metric_names
+    assert "journal.replay_records" in metric_names
+    assert "checkpoint.saves" in metric_names
+
+
+def test_tracing_off_and_on_converge_bit_identical():
+    """The <2%-overhead claim's correctness half: enabling ``obs_trace``
+    must not perturb the round flow — a traced fault-free run produces the
+    BIT-IDENTICAL final model of an untraced one (and the untraced run
+    emits no span records at all)."""
+    LoopbackHub.reset()
+    _, final_off, _ = _ft._run_chaos_topology("obs-off", knobs={})
+    assert obs.enabled() is False
+    with _traced("obs-on") as mem:
+        history, final_on, _ = _ft._run_chaos_topology("obs-on", knobs={})
+        assert len(history) == 2
+    assert _ft._trees_bit_identical(final_off, final_on)
+    # the traced clean run is also fully closed (no chaos required)
+    _assert_rounds_closed(mem, "obs-on", 2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["TRPC", "GRPC", "MQTT_S3"])
+def test_trace_integrity_all_backends(backend, tmp_path):
+    """The cross-backend acceptance sweep: drop + duplicate + delay + reset
+    + server_kill over every socketed transport, and every completed round
+    still reconstructs as one closed tree — the traceparent header survives
+    JSON and pickled transports alike."""
+    run_id = f"obs-{backend.lower()}"
+    comm_extra = {}
+    broker = None
+    if backend == "TRPC":
+        comm_extra = {"trpc_base_port": 29710, "trpc_connect_retries": 3,
+                      "trpc_retry_interval_s": 0.1}
+    elif backend == "GRPC":
+        comm_extra = {"grpc_base_port": 29810, "grpc_send_retries": 3,
+                      "grpc_send_backoff_base_s": 0.05}
+    else:
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import LocalBroker
+
+        broker = LocalBroker().start()
+        comm_extra = {"mqtt_host": "127.0.0.1", "mqtt_port": broker.port,
+                      "s3_blob_root": str(tmp_path / "blobs"),
+                      "mqtt_reconnect_retries": 10,
+                      "mqtt_reconnect_base_s": 0.05}
+    plan = _ft._server_kill_plan(extra_rules=_ft._full_chaos_plan()["rules"])
+    try:
+        with _traced(run_id) as mem:
+            history, final, stats, restarts, killed, server = \
+                _ft._run_server_kill_topology(
+                    run_id, tmp_path / "srv", backend=backend,
+                    fault_plan=plan, comm_extra=comm_extra)
+            assert restarts >= 1 and len(history) == 2
+        traces = _assert_rounds_closed(mem, run_id, 2)
+        root0 = traces[0].roots()[0]
+        assert root0.end is not None and root0.end.get("adopted") is True
+        events = _events(traces)
+        assert "server_kill" in events, events
+    finally:
+        if broker is not None:
+            broker.stop()

@@ -2,7 +2,9 @@
 """Anti-flake gate for the chaos suite.
 
 Runs the fast chaos matrix plus the server-kill/restart tests
-(``tests/test_fault_tolerance.py -k "chaos or server_kill"``) N consecutive
+(``tests/test_fault_tolerance.py``) AND the trace-integrity chaos tests
+(``tests/test_obs.py`` — every completed round must reconstruct as one
+closed span tree even under drop/dup/delay/server_kill) N consecutive
 times in fresh interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
 operators stop believing red — so new fault kinds / backends must hold up
@@ -13,6 +15,7 @@ Usage::
     python tools/chaos_check.py --runs 5
     python tools/chaos_check.py --runs 3 -k "chaos_matrix"
     python tools/chaos_check.py --runs 3 -k "server_kill"
+    python tools/chaos_check.py --runs 3 -k "trace_integrity"
 """
 
 from __future__ import annotations
@@ -30,14 +33,18 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--runs", "-n", type=int, default=3,
                     help="consecutive green runs required (default 3)")
-    ap.add_argument("-k", dest="keyword", default="chaos or server_kill",
-                    help='pytest -k selector (default: "chaos or server_kill")')
+    ap.add_argument(
+        "-k", dest="keyword",
+        default="chaos or server_kill or trace_integrity",
+        help='pytest -k selector '
+             '(default: "chaos or server_kill or trace_integrity")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     args = ap.parse_args(argv)
 
     env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
     cmd = [sys.executable, "-m", "pytest", "tests/test_fault_tolerance.py",
+           "tests/test_obs.py",
            "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
     for i in range(1, args.runs + 1):
         t0 = time.time()

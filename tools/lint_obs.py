@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Observability lint: no bare counter bags, no direct sink emits.
+
+With ``core/obs`` in place there is exactly one metrics surface
+(``obs.counter_inc`` / ``gauge_set`` / ``histogram_observe`` — labeled,
+capped, exportable) and one emission seam (the mlops sink fan).  Library
+code that grows its own ``defaultdict(int)`` counter bag or calls
+``<sink>.emit(...)`` directly bypasses both: those numbers never reach the
+registry export and never ride the sink fan's JSONL/broker legs.
+
+This tool greps ``fedml_tpu/`` for the two patterns with comments/strings
+stripped.  ``core/obs`` and ``core/mlops`` — the two layers that ARE the
+seam — are exempt; anything else needing an exception carries a
+``# lint_obs: allow`` pragma on the flagged line.  Wired into tier-1 via
+``tests/test_lint_obs.py``.
+
+Usage::
+
+    python tools/lint_obs.py            # lint the repo's fedml_tpu/
+    python tools/lint_obs.py --root DIR # lint DIR instead (tests use this)
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import re
+import sys
+import tokenize
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# counter bags: defaultdict(int) is the canonical "private metrics dict"
+# constructor (Counter() would be next, but the stdlib Counter has heavy
+# non-metrics use, so only the unambiguous form is banned)
+_COUNTER_BAG = re.compile(r"(?<![\w.])defaultdict\s*\(\s*int\s*\)")
+# direct sink emission: any attribute/variable whose name contains "sink"
+# (or the mlops fan) calling .emit(...) — metrics and spans go through the
+# obs facade; records go through core/mlops helpers
+_SINK_EMIT = re.compile(r"(?i)\w*(?:sink|fan)\w*\s*\.\s*emit\s*\(")
+_PRAGMA = "lint_obs: allow"
+
+# the two layers that implement the seam may touch sinks/registries freely
+_EXEMPT_PARTS = (
+    os.path.join("core", "obs"),
+    os.path.join("core", "mlops"),
+)
+
+
+def _exempt(path: str) -> bool:
+    norm = os.path.normpath(os.path.abspath(path))
+    return any(os.sep + part + os.sep in norm or
+               norm.endswith(os.sep + part) for part in _EXEMPT_PARTS)
+
+
+def _code_lines(source: str) -> list:
+    """Lines with comments and string literals blanked via ``tokenize`` —
+    only actual code can trip the patterns (same approach as lint_rng)."""
+    lines = source.splitlines()
+    kept = list(lines)
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return kept  # unparseable: lint the raw lines rather than skip
+    for tok in tokens:
+        if tok.type not in (tokenize.COMMENT, tokenize.STRING):
+            continue
+        (srow, scol), (erow, ecol) = tok.start, tok.end
+        for row in range(srow, erow + 1):
+            line = kept[row - 1]
+            lo = scol if row == srow else 0
+            hi = ecol if row == erow else len(line)
+            kept[row - 1] = line[:lo] + " " * (hi - lo) + line[hi:]
+    return kept
+
+
+def lint_file(path: str) -> list:
+    if _exempt(path):
+        return []
+    violations = []
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        source = f.read()
+    raw_lines = source.splitlines()
+    for lineno, code in enumerate(_code_lines(source), 1):
+        raw = raw_lines[lineno - 1]
+        if _PRAGMA in raw:
+            continue
+        if _COUNTER_BAG.search(code):
+            violations.append((path, lineno, "bare counter bag", raw.rstrip()))
+        if _SINK_EMIT.search(code):
+            violations.append((path, lineno, "direct sink emit", raw.rstrip()))
+    return violations
+
+
+def lint_tree(root: str) -> list:
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                violations.extend(lint_file(os.path.join(dirpath, name)))
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=os.path.join(REPO_ROOT, "fedml_tpu"),
+                    help="directory tree to lint (default: the library)")
+    args = ap.parse_args(argv)
+
+    violations = lint_tree(args.root)
+    for path, lineno, kind, line in violations:
+        rel = os.path.relpath(path, args.root)
+        print(f"lint_obs: {rel}:{lineno}: {kind}: {line.strip()}", flush=True)
+    if violations:
+        print(f"lint_obs: {len(violations)} violation(s) — use "
+              "obs.counter_inc/gauge_set/histogram_observe for metrics and "
+              "the core/mlops helpers for records, or mark an approved seam "
+              f"with '# {_PRAGMA}'", flush=True)
+        return 1
+    print("lint_obs: clean", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

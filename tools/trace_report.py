@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Offline round-trace reconstruction and critical-path reporting.
+
+Reads the ``span_start`` / ``span_end`` / ``span_event`` records that
+``fedml_tpu.core.obs`` emits through the mlops JSONL sink and rebuilds one
+span tree per (run, round) trace:
+
+* **Integrity** — every trace must have exactly one root span (the round),
+  no span may reference a parent that never started, and every started
+  span must close.  A crash-restarted server closes its predecessor's
+  round span under the same deterministic id, so a clean recovery still
+  reads as closed here.  ``--assert-closed`` turns violations into exit
+  code 2 (the chaos gate).
+* **Critical path** — walk from the round root to the leaf that closed
+  last; the chain of spans on that walk is where the round's wall time
+  went (the slowest silo's train+upload leg, a retransmit storm, ...).
+* **Straggler ranking** — ``client.train`` spans sorted by duration;
+  anything slower than ``--slow-factor`` x the round's median is flagged
+  (the same factor ``obs_slow_round_factor`` uses online).
+
+Durations prefer the end record's monotonic ``duration_s``; adopted ends
+(crash recovery) carry none and fall back to the sink wall-timestamp delta.
+
+Usage::
+
+    python tools/trace_report.py run.jsonl
+    python tools/trace_report.py run.jsonl --round 3
+    python tools/trace_report.py a.jsonl b.jsonl --assert-closed
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+SPAN_TOPICS = ("span_start", "span_end", "span_event")
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    """The file's span-topic records, in file order (other topics skipped;
+    unparseable lines skipped — a torn tail write is not a trace error)."""
+    out: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("topic") in SPAN_TOPICS:
+                out.append(rec)
+    return out
+
+
+class SpanNode:
+    """One reconstructed span: paired start/end records plus events."""
+
+    __slots__ = ("span_id", "start", "end", "events", "children")
+
+    def __init__(self, span_id: str):
+        self.span_id = span_id
+        self.start: Optional[Dict[str, Any]] = None
+        self.end: Optional[Dict[str, Any]] = None
+        self.events: List[Dict[str, Any]] = []
+        self.children: List["SpanNode"] = []
+
+    @property
+    def name(self) -> str:
+        for rec in (self.start, self.end):
+            if rec is not None and rec.get("name"):
+                return str(rec["name"])
+        return "?"
+
+    @property
+    def node(self) -> Any:
+        return (self.start or {}).get("node", "?")
+
+    @property
+    def parent_span_id(self) -> Optional[str]:
+        return (self.start or {}).get("parent_span_id")
+
+    @property
+    def round_idx(self) -> Optional[int]:
+        for rec in (self.start, self.end):
+            if rec is not None and "round_idx" in rec:
+                return int(rec["round_idx"])
+        return None
+
+    def duration_s(self) -> float:
+        """Monotonic duration when the closer measured one; wall-ts delta
+        for cross-process (adopted) closes; 0 when unclosed."""
+        if self.end is not None and isinstance(
+                self.end.get("duration_s"), (int, float)):
+            return float(self.end["duration_s"])
+        if (self.start is not None and self.end is not None
+                and isinstance(self.start.get("ts"), (int, float))
+                and isinstance(self.end.get("ts"), (int, float))):
+            return max(0.0, float(self.end["ts"]) - float(self.start["ts"]))
+        return 0.0
+
+    def end_ts(self) -> float:
+        if self.end is not None and isinstance(self.end.get("ts"), (int, float)):
+            return float(self.end["ts"])
+        if self.start is not None and isinstance(self.start.get("ts"), (int, float)):
+            return float(self.start["ts"]) + self.duration_s()
+        return 0.0
+
+
+class Trace:
+    """All spans sharing one trace_id (= one round of one run)."""
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.spans: Dict[str, SpanNode] = {}
+
+    def _node(self, span_id: str) -> SpanNode:
+        sn = self.spans.get(span_id)
+        if sn is None:
+            sn = self.spans[span_id] = SpanNode(span_id)
+        return sn
+
+    def add(self, rec: Dict[str, Any]) -> None:
+        topic = rec.get("topic")
+        sn = self._node(str(rec.get("span_id")))
+        if topic == "span_start":
+            # duplicate starts (a re-delivered record) keep the FIRST copy:
+            # ids are deterministic, so first-wins is order-stable
+            if sn.start is None:
+                sn.start = rec
+        elif topic == "span_end":
+            if sn.end is None:
+                sn.end = rec
+        else:
+            sn.events.append(rec)
+
+    def link(self) -> None:
+        for sn in self.spans.values():
+            sn.children = []
+        for sn in self.spans.values():
+            pid = sn.parent_span_id
+            if pid is not None and pid in self.spans:
+                self.spans[pid].children.append(sn)
+
+    def roots(self) -> List[SpanNode]:
+        return [sn for sn in self.spans.values()
+                if sn.start is not None and sn.parent_span_id is None]
+
+    def round_idx(self) -> Optional[int]:
+        for sn in self.spans.values():
+            ri = sn.round_idx
+            if ri is not None:
+                return ri
+        return None
+
+    def problems(self) -> List[str]:
+        """Integrity violations: orphans, unclosed spans, ends that never
+        started, zero-or-many roots."""
+        out: List[str] = []
+        roots = self.roots()
+        if len(roots) != 1:
+            out.append(f"{len(roots)} root spans (expected exactly 1: the round)")
+        elif roots[0].name != "round":
+            out.append(f"root span is {roots[0].name!r} (expected 'round')")
+        for sn in sorted(self.spans.values(), key=lambda s: s.span_id):
+            if sn.start is None and sn.end is not None:
+                out.append(f"span {sn.span_id} ({sn.name}) ended without starting")
+            if sn.start is not None and sn.end is None:
+                out.append(f"span {sn.span_id} ({sn.name}, node={sn.node}) "
+                           "never closed")
+            pid = sn.parent_span_id
+            if pid is not None and pid not in self.spans:
+                out.append(f"span {sn.span_id} ({sn.name}) is an orphan "
+                           f"(parent {pid} unknown)")
+        return out
+
+    def critical_path(self) -> List[SpanNode]:
+        """Root-to-leaf chain following, at each level, the child that
+        closed LAST — the spans the round's wall time actually waited on."""
+        roots = self.roots()
+        if not roots:
+            return []
+        self.link()
+        path = [roots[0]]
+        seen = {roots[0].span_id}
+        while path[-1].children:
+            nxt = max(path[-1].children, key=lambda s: (s.end_ts(), s.span_id))
+            if nxt.span_id in seen:  # defensive: corrupt parent links
+                break
+            seen.add(nxt.span_id)
+            path.append(nxt)
+        return path
+
+    def stragglers(self, slow_factor: float) -> List[Tuple[SpanNode, float, bool]]:
+        """``client.train`` spans ranked slowest-first with their duration
+        and a flag for > slow_factor x median."""
+        trains = [sn for sn in self.spans.values()
+                  if sn.name == "client.train" and sn.start is not None]
+        if not trains:
+            return []
+        durs = sorted(sn.duration_s() for sn in trains)
+        median = durs[len(durs) // 2]
+        ranked = sorted(trains, key=lambda s: -s.duration_s())
+        return [(sn, sn.duration_s(),
+                 median > 0 and sn.duration_s() > slow_factor * median)
+                for sn in ranked]
+
+
+def build_traces(records: Iterable[Dict[str, Any]]) -> Dict[str, Trace]:
+    traces: Dict[str, Trace] = {}
+    for rec in records:
+        tid = str(rec.get("trace_id"))
+        tr = traces.get(tid)
+        if tr is None:
+            tr = traces[tid] = Trace(tid)
+        tr.add(rec)
+    for tr in traces.values():
+        tr.link()
+    return traces
+
+
+def _fmt_path(path: List[SpanNode]) -> str:
+    return " > ".join(
+        f"{sn.name}[node={sn.node}, {sn.duration_s():.3f}s]" for sn in path
+    )
+
+
+def report(traces: Dict[str, Trace], slow_factor: float,
+           round_filter: Optional[int] = None, out=None) -> int:
+    """Print the per-round report; returns the total problem count."""
+    # bind the stream late: a def-time sys.stdout default would dodge any
+    # redirection installed after import (test capture, CLI piping)
+    out = out if out is not None else sys.stdout
+    n_problems = 0
+    ordered = sorted(
+        traces.values(),
+        key=lambda t: (t.round_idx() if t.round_idx() is not None else -1,
+                       t.trace_id),
+    )
+    for tr in ordered:
+        ri = tr.round_idx()
+        if round_filter is not None and ri != round_filter:
+            continue
+        problems = tr.problems()
+        n_problems += len(problems)
+        roots = tr.roots()
+        dur = roots[0].duration_s() if roots else 0.0
+        print(f"trace {tr.trace_id}  round={ri}  spans={len(tr.spans)}  "
+              f"duration={dur:.3f}s", file=out)
+        path = tr.critical_path()
+        if path:
+            print(f"  critical path: {_fmt_path(path)}", file=out)
+        for sn, d, slow in tr.stragglers(slow_factor):
+            flag = "  << STRAGGLER" if slow else ""
+            print(f"  client.train node={sn.node}: {d:.3f}s{flag}", file=out)
+        events = [ev for sn in tr.spans.values() for ev in sn.events]
+        for ev in events:
+            print(f"  event {ev.get('event')}: node={ev.get('node')} "
+                  + " ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                             if k not in ("topic", "trace_id", "span_id",
+                                          "event", "node", "ts")),
+                  file=out)
+        for p in problems:
+            print(f"  PROBLEM: {p}", file=out)
+    return n_problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="mlops JSONL file(s)")
+    ap.add_argument("--round", type=int, default=None,
+                    help="report only this round index")
+    ap.add_argument("--slow-factor", type=float, default=2.0,
+                    help="straggler flag threshold vs round median (default 2.0)")
+    ap.add_argument("--assert-closed", action="store_true",
+                    help="exit 2 if any trace has orphan/unclosed spans")
+    args = ap.parse_args(argv)
+
+    records: List[Dict[str, Any]] = []
+    for path in args.paths:
+        records.extend(load_records(path))
+    if not records:
+        print("trace_report: no span records found", flush=True)
+        return 0
+    traces = build_traces(records)
+    n_problems = report(traces, args.slow_factor, args.round)
+    if n_problems:
+        print(f"trace_report: {n_problems} integrity problem(s)", flush=True)
+        if args.assert_closed:
+            return 2
+    else:
+        print(f"trace_report: {len(traces)} trace(s), all closed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
