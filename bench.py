@@ -235,8 +235,16 @@ def _wait_for_backend() -> bool:
 
 
 def main() -> int | None:
+    degraded_reason = None
     if not _wait_for_backend():
-        return 1
+        if os.environ.get("BENCH_REQUIRE_TPU") == "1":
+            return 1
+        # r03-r05 produced empty BENCH artifacts this way: no backend meant
+        # no JSON line at all, and three rounds of perf work went unmeasured.
+        # Degrade to a CPU run that still reports the RELATIVE keys (agg
+        # step host vs compiled, obs overhead) — trend data, not absolutes.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        degraded_reason = "backend probe failed"
 
     import jax
 
@@ -267,6 +275,14 @@ def main() -> int | None:
             sys.stderr.flush()
             os.execv(sys.executable, [sys.executable] + sys.argv)
         raise
+    if (degraded_reason is None and jax.default_backend() == "cpu"
+            and os.environ.get("BENCH_ALLOW_CPU_FULL") != "1"):
+        # the probe succeeds on a CPU-only box (jax falls back silently);
+        # running ResNet-56/CIFAR there would take hours and measure nothing
+        # comparable — report the relative keys instead
+        degraded_reason = "no accelerator (cpu backend)"
+    if degraded_reason is not None:
+        return _run_degraded(degraded_reason)
     args = fedml_tpu.init(_bench_args(n_chips), should_init_logs=False)
     from fedml_tpu import data
 
@@ -320,6 +336,7 @@ def main() -> int | None:
         # failed (distinct from BENCH_AUTOTUNE=0, where the key is absent)
         out["autotuned"] = tuned
     out.update(obs_overhead)
+    out.update(_measure_agg_step())
     if os.environ.get("BENCH_SP"):
         out["sp_samples_per_sec"] = round(_measure_sp(args, dataset), 2)
     print(json.dumps(out))
@@ -329,6 +346,127 @@ def main() -> int | None:
         # regardless of round structure; this line substantiates "high MFU
         # is reachable on the transformer stack" with a measured number.
         print(json.dumps(_measure_transformer()))
+
+
+def _synthetic_updates(n_clients: int, seed: int = 0):
+    """Seeded synthetic client deltas shaped like a small MLP — enough
+    structure (matrices, vectors, a scalar) to exercise the partition rules
+    without making the CPU-degraded run slow."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    shapes = {
+        "layer1/kernel": (256, 256), "layer1/bias": (256,),
+        "layer2/kernel": (256, 256), "layer2/bias": (256,),
+        "head/kernel": (256, 10), "head/bias": (10,),
+        "scale": (),
+    }
+    rng = np.random.default_rng(seed)
+    updates = []
+    for _ in range(n_clients):
+        tree = {k: jnp.asarray(rng.standard_normal(s), jnp.float32)
+                for k, s in shapes.items()}
+        updates.append((float(rng.integers(16, 256)), tree))
+    return updates
+
+
+def _measure_agg_step() -> dict:
+    """The aggregation-plane relative keys: median host-loop vs compiled
+    reduction time over the same seeded synthetic deltas.  Emitted on BOTH
+    the full-TPU and CPU-degraded metric lines, so the agg-plane trend
+    survives a dark chip window.  Failures degrade to empty keys."""
+    import numpy as np
+
+    try:
+        import jax
+
+        from fedml_tpu.core.aggregate import weighted_mean
+        from fedml_tpu.parallel.agg_plane import CompiledAggPlane
+
+        n = int(os.environ.get("BENCH_AGG_CLIENTS", "32"))
+        reps = int(os.environ.get("BENCH_AGG_REPS", "5"))
+        updates = _synthetic_updates(n)
+
+        def timed(fn):
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+
+        host_s = timed(lambda: weighted_mean(updates))
+        plane = CompiledAggPlane()
+        plane.aggregate(updates)  # pay the compile outside the timing
+        comp_s = timed(lambda: plane.aggregate(updates))
+        return {
+            "agg_step_host_s": round(host_s, 6),
+            "agg_step_compiled_s": round(comp_s, 6),
+            "agg_speedup": round(host_s / max(comp_s, 1e-9), 4),
+            "agg_clients": n,
+        }
+    except Exception as e:
+        print(f"agg step measurement failed: {e}", file=sys.stderr)
+        return {}
+
+
+def _run_degraded(reason: str) -> int:
+    """No-TPU fallback: ONE JSON line with the relative keys (agg step host
+    vs compiled, obs overhead on the agg step) instead of an empty BENCH
+    artifact.  Absolute throughput is meaningless on CPU, so the headline
+    value is the compiled agg step time — trend data for the agg plane."""
+    import numpy as np
+
+    out = {
+        "metric": "agg_step_cpu_degraded",
+        "unit": "s/agg_step",
+        "degraded": True,
+        "degraded_reason": reason,
+    }
+    agg = _measure_agg_step()
+    out.update(agg)
+    out["value"] = agg.get("agg_step_compiled_s", None)
+
+    # obs overhead on the measured path: the same compiled agg step with
+    # tracing configured (spans to an in-memory sink, parented under a
+    # round span) vs. the tracing-off times just measured
+    try:
+        import jax
+
+        from fedml_tpu.core import obs
+        from fedml_tpu.core.aggregate import weighted_mean
+        from fedml_tpu.core.mlops.sinks import InMemorySink
+        from fedml_tpu.parallel.agg_plane import CompiledAggPlane
+
+        class _ObsArgs:
+            run_id = "bench_degraded"
+
+        n = int(agg.get("agg_clients", 8) or 8)
+        reps = int(os.environ.get("BENCH_AGG_REPS", "5"))
+        updates = _synthetic_updates(n)
+        plane = CompiledAggPlane()
+        plane.aggregate(updates)  # compile
+        mem = InMemorySink()
+        obs.configure(_ObsArgs(), mem.emit)
+        try:
+            ts = []
+            for i in range(reps):
+                with obs.round_span(i, mode="bench_degraded"):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(plane.aggregate(updates))
+                    ts.append(time.perf_counter() - t0)
+            on_s = float(np.median(ts))
+        finally:
+            obs.shutdown()
+        off_s = float(agg.get("agg_step_compiled_s", 0.0) or 0.0)
+        if off_s > 0:
+            out["agg_step_obs_on_s"] = round(on_s, 6)
+            out["obs_overhead_frac"] = round(on_s / off_s - 1.0, 4)
+    except Exception as e:
+        print(f"degraded obs overhead measurement failed: {e}", file=sys.stderr)
+
+    print(json.dumps(out))
+    return 0
 
 
 def _measure_obs_overhead(sim) -> dict:

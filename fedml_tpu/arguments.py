@@ -103,6 +103,20 @@ Observability knobs (``tracking_args`` or ``obs_args``; consumed by
   than ``factor * median(previous rounds)`` gets a ``slow_round`` span
   event (straggler flagging in ``tools/trace_report.py`` uses the same
   factor).
+
+Aggregation-plane knobs (``train_args``; consumed by
+``parallel/agg_plane``, semantics in ``docs/AGGREGATION.md``):
+
+* ``agg_plane`` (``host`` | ``compiled``, default ``host``) — where the
+  server reduces client updates.  ``compiled`` runs ONE donated-buffer
+  GSPMD program over the device mesh; in f32 mode it is bit-exact vs.
+  the host path.
+* ``agg_wire_dtype`` (``f32`` | ``bf16``, default ``f32``) — dtype for
+  staging float client deltas onto the mesh.  ``bf16`` halves wire
+  traffic; accumulation stays f32 either way.
+* ``agg_microbatch_clients`` (int >= 0, default 0 = all at once) — fold
+  K clients at a time into the running accumulator so huge cohorts
+  aggregate without materializing the full client stack in HBM.
 """
 
 from __future__ import annotations
@@ -323,6 +337,34 @@ class Arguments:
             if sv < 1.0:
                 raise ValueError(
                     f"obs_slow_round_factor must be >= 1.0 (got {sv})")
+        # aggregation-plane knobs (parallel/agg_plane) — a typo'd plane name
+        # must not silently fall back to the host loop
+        plane = getattr(self, "agg_plane", None)
+        if plane is not None:
+            from .parallel.agg_plane import AGG_PLANES
+
+            if str(plane).lower() not in AGG_PLANES:
+                raise ValueError(
+                    f"agg_plane must be one of {AGG_PLANES} (got {plane!r})")
+        wire = getattr(self, "agg_wire_dtype", None)
+        if wire is not None:
+            from .parallel.agg_plane import AGG_WIRE_DTYPES
+
+            if str(wire).lower() not in AGG_WIRE_DTYPES:
+                raise ValueError(
+                    f"agg_wire_dtype must be one of {AGG_WIRE_DTYPES} "
+                    f"(got {wire!r})")
+        mb = getattr(self, "agg_microbatch_clients", None)
+        if mb is not None:
+            try:
+                mv = int(mb)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"agg_microbatch_clients must be an integer >= 0 "
+                    f"(got {mb!r})")
+            if mv < 0:
+                raise ValueError(
+                    f"agg_microbatch_clients must be >= 0 (got {mv})")
         # a malformed chaos plan should fail at config time, not mid-run when
         # the backend factory first tries to wrap the transport
         plan = getattr(self, "fault_plan", None)

@@ -2,7 +2,7 @@
 
 Replaces the reference's four per-engine, per-layer-dict loops
 (``ml/aggregator/agg_operator.py:18-141``) with ``jax.tree_util`` maps that
-work for ANY parameter pytree (flax/haiku/dict-of-arrays).  Two shapes:
+work for ANY parameter pytree (flax/haiku/dict-of-arrays).  Three shapes:
 
 * list form — host-side aggregation of per-client pytrees (cross-silo server,
   SP simulator): ``weighted_mean(updates)``.
@@ -11,16 +11,85 @@ work for ANY parameter pytree (flax/haiku/dict-of-arrays).  Two shapes:
   This is the TPU translation of ``fedml_nccl_reduce``
   (reference ``simulation/nccl/base_framework/common.py:196``): the weighted
   sum happens on-device and the cross-device combine is a ``lax.psum``.
+* compiled plane — :mod:`fedml_tpu.parallel.agg_plane` runs the same
+  reduction as ONE donated-buffer GSPMD program over a device mesh;
+  :class:`FedMLAggOperator` routes to it when ``args.agg_plane ==
+  "compiled"`` and the result is bit-exact vs. the list form in f32 mode.
+
+Structure validation for multi-client pytrees lives in
+:func:`flatten_checked`: every stacking/aggregation entry point names the
+offending client and leaf instead of failing deep inside ``jnp.stack``.
 """
 
 from __future__ import annotations
 
+import functools
+import time
 from typing import Any, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from . import obs
+
 Pytree = Any
+
+
+# ---------------------------------------------------------------------------
+# structure validation (shared by tree_stack and the compiled plane)
+# ---------------------------------------------------------------------------
+def _key_name(key: Any) -> str:
+    for attr in ("key", "idx", "name"):
+        if hasattr(key, attr):
+            return str(getattr(key, attr))
+    return str(key)
+
+
+@functools.lru_cache(maxsize=256)
+def leaf_paths(treedef) -> Tuple[str, ...]:
+    """``/``-joined path names for every leaf of ``treedef``, in flatten
+    order — what the compiled plane's partition rules match against and
+    what mismatch errors cite.  Cached per treedef (hashable, interned by
+    jax), so the path walk happens once per model structure per process."""
+    dummy = jax.tree_util.tree_unflatten(
+        treedef, list(range(treedef.num_leaves)))
+    names: List[str] = [""] * treedef.num_leaves
+    for path, idx in jax.tree_util.tree_flatten_with_path(dummy)[0]:
+        names[idx] = "/".join(_key_name(k) for k in path) or "<root>"
+    return tuple(names)
+
+
+def flatten_checked(
+        trees: Sequence[Pytree]) -> Tuple[List[List[Any]], Any]:
+    """Flatten a list of per-client pytrees, validating that every client
+    matches client 0 in structure and per-leaf shape.
+
+    Returns ``(leaves_per_client, treedef)``.  On mismatch raises a
+    ``ValueError`` naming the client index and the leaf path — previously
+    this surfaced as an opaque shape error deep inside ``jnp.stack``.
+    The expensive part of validation (leaf path naming) is computed lazily
+    and cached via :func:`leaf_paths`; the per-call cost is one flatten and
+    a shape-tuple comparison per client.
+    """
+    if not trees:
+        raise ValueError("no pytrees to aggregate")
+    leaves0, treedef0 = jax.tree_util.tree_flatten(trees[0])
+    shapes0 = tuple(jnp.shape(l) for l in leaves0)
+    out = [leaves0]
+    for i, tree in enumerate(trees[1:], start=1):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if treedef != treedef0:
+            raise ValueError(
+                f"client {i} pytree structure differs from client 0: "
+                f"{treedef} vs {treedef0}")
+        for j, leaf in enumerate(leaves):
+            shape = jnp.shape(leaf)
+            if shape != shapes0[j]:
+                raise ValueError(
+                    f"client {i} leaf '{leaf_paths(treedef0)[j]}' has shape "
+                    f"{shape} but client 0 has {shapes0[j]}")
+        out.append(leaves)
+    return out, treedef0
 
 
 # ---------------------------------------------------------------------------
@@ -61,8 +130,14 @@ def unweighted_sum(updates: Sequence[Tuple[float, Pytree]]) -> Pytree:
 
 
 def tree_stack(trees: Sequence[Pytree]) -> Pytree:
-    """Stack a list of identically-shaped pytrees on a new leading axis."""
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+    """Stack a list of identically-shaped pytrees on a new leading axis.
+
+    Structure/shape mismatches raise a clear :func:`flatten_checked` error
+    naming the client and leaf.
+    """
+    leaves_list, treedef = flatten_checked(trees)
+    stacked = [jnp.stack(cols, axis=0) for cols in zip(*leaves_list)]
+    return jax.tree_util.tree_unflatten(treedef, stacked)
 
 
 def tree_unstack(tree: Pytree, n: int) -> List[Pytree]:
@@ -87,7 +162,22 @@ def stacked_weighted_sum(stacked: Pytree, weights: jnp.ndarray) -> Pytree:
 
 
 def stacked_weighted_mean(stacked: Pytree, sample_nums: jnp.ndarray) -> Pytree:
-    total = jnp.maximum(jnp.sum(sample_nums), 1e-12)
+    """Sample-weighted average over the stacked leading axis.
+
+    Raises on a non-positive total like :func:`weighted_mean` (the two forms
+    used to disagree: this one silently clamped to 1e-12).  Under jit
+    tracing the total is abstract and cannot be checked — there the
+    defensive clamp remains, documented as traced-path behavior.
+    """
+    sample_nums = jnp.asarray(sample_nums)
+    total = jnp.sum(sample_nums)
+    try:
+        concrete = float(total)
+    except jax.errors.ConcretizationTypeError:
+        return stacked_weighted_sum(
+            stacked, sample_nums / jnp.maximum(total, 1e-12))
+    if concrete <= 0:
+        raise ValueError("total sample count must be positive")
     return stacked_weighted_sum(stacked, sample_nums / total)
 
 
@@ -101,6 +191,16 @@ class FedMLAggOperator:
     @staticmethod
     def agg(args, raw_grad_list: Sequence[Tuple[float, Pytree]]) -> Pytree:
         opt = getattr(args, "federated_optimizer", "FedAvg")
-        if opt in FedMLAggOperator._SUM_MODE:
-            return unweighted_sum(raw_grad_list)
-        return weighted_mean(raw_grad_list)
+        mode = "sum" if opt in FedMLAggOperator._SUM_MODE else "mean"
+        if str(getattr(args, "agg_plane", "host") or "host") == "compiled":
+            from ..parallel.agg_plane import plane_for
+
+            return plane_for(args).aggregate(raw_grad_list, mode=mode)
+        t0 = time.perf_counter()
+        if mode == "sum":
+            out = unweighted_sum(raw_grad_list)
+        else:
+            out = weighted_mean(raw_grad_list)
+        obs.histogram_observe("agg.step_seconds", time.perf_counter() - t0,
+                              labels={"path": "host", "mode": mode})
+        return out

@@ -32,6 +32,7 @@ from .trace import (
     Span,
     SpanContext,
     Tracer,
+    active_ctx,
     round_root_ctx,
     span_id_for,
     trace_id_for,
@@ -40,6 +41,7 @@ from .trace import (
 __all__ = [
     "MetricsRegistry", "Tracer", "Span", "SpanContext", "NULL_SPAN",
     "DEFAULT_TIME_BUCKETS", "trace_id_for", "span_id_for", "round_root_ctx",
+    "active_ctx",
     "configure", "shutdown", "enabled", "tracer", "registry", "run_id",
     "span", "round_span", "unique_span", "span_event",
     "inject", "extract", "counter_inc", "gauge_set", "histogram_observe",

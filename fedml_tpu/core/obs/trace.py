@@ -45,6 +45,28 @@ TOPIC_SPAN_EVENT = "span_event"
 
 _TRACE_VERSION = "00"
 
+# ambient span stack (per-thread): ``with tracer.span(...)`` pushes its
+# context so library layers far below the call site (e.g. the compiled
+# aggregation plane under FedMLAggOperator) can parent their spans without
+# threading a ctx through every signature.  Entries are SpanContexts.
+_ambient = threading.local()
+
+
+def _ambient_stack() -> list:
+    stack = getattr(_ambient, "stack", None)
+    if stack is None:
+        stack = []
+        _ambient.stack = stack
+    return stack
+
+
+def active_ctx() -> Optional["SpanContext"]:
+    """The innermost ``with``-entered span's context on this thread, or
+    None.  Telemetry-only: callers use it as a default parent, never as a
+    correctness input."""
+    stack = getattr(_ambient, "stack", None)
+    return stack[-1] if stack else None
+
 
 def trace_id_for(run_id: Any, round_idx: int) -> str:
     """32-hex trace id: one trace per (run, round)."""
@@ -159,9 +181,19 @@ class Span:
         self.tracer._emit(TOPIC_SPAN_END, rec)
 
     def __enter__(self) -> "Span":
+        _ambient_stack().append(self.ctx)
+        self._pushed = True
         return self
 
     def __exit__(self, *exc) -> None:
+        if getattr(self, "_pushed", False):
+            self._pushed = False
+            stack = _ambient_stack()
+            # pop by identity from the top: tolerant of out-of-order exits
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] is self.ctx:
+                    del stack[i]
+                    break
         self.end()
 
 

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
-from ..aggregate import tree_scale, tree_sub, tree_add, weighted_mean
+from ..aggregate import tree_add, tree_scale, tree_stack, tree_sub, weighted_mean
 
 Pytree = Any
 Updates = List[Tuple[float, Pytree]]
@@ -73,7 +73,7 @@ def krum(updates: Updates, byzantine_num: int, multi: bool = False, krum_param_m
 # Coordinate-wise median / trimmed mean
 # ---------------------------------------------------------------------------
 def coordinate_wise_median(updates: Updates) -> Pytree:
-    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *[p for _, p in updates])
+    stacked = tree_stack([p for _, p in updates])
     return jax.tree_util.tree_map(lambda x: jnp.median(x, axis=0), stacked)
 
 
@@ -87,7 +87,7 @@ def _trimmed_mean_count(updates: Updates, k: int) -> Pytree:
     """Trim ``k`` updates per coordinate per end, then average the rest."""
     n = len(updates)
     k = max(0, min(int(k), (n - 1) // 2))
-    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *[p for _, p in updates])
+    stacked = tree_stack([p for _, p in updates])
 
     def _leaf(x):
         x = jnp.sort(x, axis=0)

@@ -13,10 +13,12 @@ from __future__ import annotations
 import logging
 import os
 import tempfile
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..core import obs
 from ..ml.aggregator.default_aggregator import DefaultServerAggregator
 from ..ml.engine.train import init_variables
 from .edge_model import flatten_params, load_edge_model, save_edge_model, unflatten_params
@@ -110,14 +112,28 @@ class FedMLAggregator:
         reference's all-received path)."""
         if indices is None:
             indices = list(range(self.worker_num))
-        total = sum(self.sample_num_dict[i] for i in indices) or 1.0
-        acc: Dict[str, np.ndarray] = {}
-        for i in indices:
-            flat = load_edge_model(self.model_file_dict[i])
-            w = self.sample_num_dict[i] / total
-            for name, arr in flat.items():
-                contrib = arr.astype(np.float64) * w
-                acc[name] = contrib if name not in acc else acc[name] + contrib
+        if str(getattr(self.args, "agg_plane", "host") or "host") == "compiled":
+            from ..parallel.agg_plane import plane_for
+
+            updates = [(self.sample_num_dict[i],
+                        load_edge_model(self.model_file_dict[i]))
+                       for i in indices]
+            reduced = plane_for(self.args).aggregate(updates, mode="mean")
+            acc: Dict[str, np.ndarray] = {
+                name: np.asarray(v) for name, v in reduced.items()}
+        else:
+            t0 = time.perf_counter()
+            total = sum(self.sample_num_dict[i] for i in indices) or 1.0
+            acc = {}
+            for i in indices:
+                flat = load_edge_model(self.model_file_dict[i])
+                w = self.sample_num_dict[i] / total
+                for name, arr in flat.items():
+                    contrib = arr.astype(np.float64) * w
+                    acc[name] = contrib if name not in acc else acc[name] + contrib
+            obs.histogram_observe(
+                "agg.step_seconds", time.perf_counter() - t0,
+                labels={"path": "host", "mode": "mean"})
         # preserve integer leaves (e.g. step counters) by casting back to the
         # current global dtype template (round first: a float64 weighted sum
         # of equal ints lands epsilon below the true value and astype truncates)

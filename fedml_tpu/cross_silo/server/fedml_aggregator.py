@@ -76,10 +76,14 @@ class FedMLAggregator:
             (self.sample_num_dict[i], self.model_dict[i]) for i in indices
         ]
         raw = self.aggregator.on_before_aggregation(raw)
+        # ServerAggregator.aggregate -> FedMLAggOperator.agg, which routes to
+        # parallel/agg_plane when args.agg_plane == "compiled"
         averaged = self.aggregator.aggregate(raw)
         averaged = self.aggregator.on_after_aggregation(averaged)
         self.aggregator.set_model_params(averaged)
-        logger.info("aggregate %d silos in %.3fs", len(raw), time.time() - t0)
+        logger.info("aggregate %d silos in %.3fs plane=%s", len(raw),
+                    time.time() - t0,
+                    getattr(self.args, "agg_plane", "host") or "host")
         return averaged
 
     # -- participant selection (reference :87-135) --------------------------

@@ -1,0 +1,377 @@
+"""Compiled sharded aggregation plane: one-jit GSPMD reduction over client deltas.
+
+The server's hottest loop — aggregating client updates — was per-client
+host-side pytree arithmetic (``core/aggregate.py`` ``weighted_mean``), so its
+cost scaled with Python object overhead and never touched the mesh this
+package already builds.  This module rebuilds it as ONE compiled,
+``NamedSharding``-annotated program over a device mesh, the cross-replica
+sharding of the weight update from "Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training" (arxiv 2004.13336): every device
+owns a shard of every parameter and reduces only its shard.
+
+Shape of the plane:
+
+* **Stacked deltas** — client updates are stacked on a leading axis
+  (``core/aggregate.flatten_checked`` validates structure/shape first, with
+  a clear error naming the offending client and leaf).
+* **Partition rules** — per-leaf ``PartitionSpec``\\s come from regex rules
+  matched against the ``/``-joined flattened param path (the
+  ``match_partition_rules`` pattern, SNIPPETS [2]/[3]) with the
+  ``parallel/sharding.py:param_spec`` largest-divisible-axis heuristic as
+  fallback; scalars always replicate.
+* **One jit, donated buffers** — the reduction is a single compiled
+  ``lax.scan`` over the client axis folding each delta into a running
+  accumulator.  The accumulator and the in-flight delta chunk are DONATED,
+  so steady-state HBM is one model-size accumulator plus one chunk.
+* **bf16 wire, f32 accumulate** — ``wire_dtype="bf16"`` halves host→device
+  traffic; accumulation is always f32 (integer leaves accumulate in their
+  own dtype under ``sum`` to mirror the host path).
+* **Microbatching** — ``microbatch_clients=K`` folds K clients at a time
+  into the accumulator, so 1k–10k deltas aggregate without ever
+  materializing the full stack in HBM.
+
+Bit-exactness contract (tier-1, CPU): in f32 mode the scan accumulates
+left-to-right — multiply-by-weight then add, exactly the op sequence of the
+host ``weighted_mean``/``unweighted_sum`` — so host and compiled paths agree
+bitwise, microbatched or not.  (bf16 wire trades that for bandwidth; the
+test suite pins its tolerance.)
+
+Observability: the plane emits an ``aggregate.compile`` span per new
+(treedef, shapes, K, mode) signature and an ``aggregate.reduce`` span per
+aggregation — parented under the caller's ambient span (the server
+managers' ``aggregate`` phase) so chaos traces stay single-rooted — plus
+``agg.step_seconds`` / ``agg.bytes_reduced`` metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import obs
+from ..core.aggregate import flatten_checked, leaf_paths
+from ..core.obs.trace import NULL_SPAN
+from .mesh import create_mesh
+from .sharding import param_spec
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+AGG_PLANES = ("host", "compiled")
+AGG_WIRE_DTYPES = ("f32", "bf16")
+
+_WIRE_JNP = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+
+
+def default_agg_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D ``tp`` mesh over all devices: each device owns one shard of every
+    (divisible) parameter and reduces only that shard — the weight-update
+    analogue of data-parallel replicas splitting the update step."""
+    devices = list(devices if devices is not None else jax.devices())
+    return create_mesh((len(devices),), ("tp",), devices)
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, Any]], names: Sequence[str],
+                          shapes: Sequence[Tuple[int, ...]], mesh: Mesh) -> List[P]:
+    """Per-leaf ``PartitionSpec``: first regex in ``rules`` that matches the
+    ``/``-joined param path wins; unmatched leaves fall back to the
+    ``param_spec`` largest-divisible-axis heuristic; scalars (and size-1
+    leaves) always replicate.  A rule naming a mesh axis that does not exist
+    (or that does not divide the leaf) degrades to replication rather than
+    failing the round — aggregation must work on any mesh."""
+    tp = int(mesh.shape.get("tp", 1))
+    out: List[P] = []
+    for name, shape in zip(names, shapes):
+        if len(shape) == 0 or int(np.prod(shape)) <= 1:
+            out.append(P())
+            continue
+        spec = None
+        for pat, ps in rules:
+            if re.search(pat, name):
+                spec = P(*ps) if not isinstance(ps, P) else ps
+                break
+        if spec is None:
+            out.append(param_spec(shape, tp))
+            continue
+        out.append(_sanitize_spec(spec, shape, mesh))
+    return out
+
+
+def _sanitize_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    if len(spec) > len(shape):
+        return P()
+    for dim, axis in zip(shape, spec):
+        if axis is None:
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = 1
+        for a in axes:
+            if a not in mesh.shape:
+                return P()
+            size *= int(mesh.shape[a])
+        if size > 1 and dim % size != 0:
+            return P()
+    return spec
+
+
+def stacked_reduce(stacked: Pytree, weights: jnp.ndarray) -> Pytree:
+    """Sequential in-mesh weighted reduction: fold ``stacked[i] * w_i`` into
+    a f32 accumulator left-to-right via ``lax.scan``.  Pure and traceable —
+    the XLA simulator's security tail uses it directly; the plane's compiled
+    step is the chunked/donated version of the same loop.  Unlike the
+    tensordot form, the fold order is the host path's, so results are
+    bit-identical to ``weighted_mean`` given the same f32 weights."""
+    zeros = jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape[1:], jnp.float32), stacked)
+
+    def body(acc, xw):
+        x, w = xw
+        return jax.tree_util.tree_map(
+            lambda a, v: a + v.astype(jnp.float32) * w, acc, x), None
+
+    acc, _ = jax.lax.scan(body, zeros, (stacked, weights.astype(jnp.float32)))
+    return acc
+
+
+class _Program:
+    """One compiled reduction: the AOT-compiled step plus the leaf plan."""
+
+    __slots__ = ("step", "acc_shardings", "chunk_shardings", "acc_dtypes",
+                 "wire_dtypes", "out_dtypes", "shapes", "wire_bytes")
+
+    def __init__(self, step, acc_shardings, chunk_shardings, acc_dtypes,
+                 wire_dtypes, out_dtypes, shapes, wire_bytes):
+        self.step = step
+        self.acc_shardings = acc_shardings
+        self.chunk_shardings = chunk_shardings
+        self.acc_dtypes = acc_dtypes
+        self.wire_dtypes = wire_dtypes
+        self.out_dtypes = out_dtypes
+        self.shapes = shapes
+        self.wire_bytes = wire_bytes
+
+
+class CompiledAggPlane:
+    """The compiled aggregation plane.
+
+    ``aggregate(updates, mode)`` mirrors :func:`core.aggregate.weighted_mean`
+    (``mode="mean"``) / :func:`core.aggregate.unweighted_sum`
+    (``mode="sum"``) over ``[(n_samples, pytree), ...]`` but runs as one
+    donated-buffer compiled program per microbatch chunk.
+
+    Programs are cached per (treedef, leaf shapes/dtypes, K, mode): the
+    first round at a new signature pays the XLA compile (visible as the
+    ``aggregate.compile`` span); every later round reuses it.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 rules: Sequence[Tuple[str, Any]] = (),
+                 wire_dtype: str = "f32",
+                 microbatch_clients: int = 0):
+        if wire_dtype not in AGG_WIRE_DTYPES:
+            raise ValueError(
+                f"agg_wire_dtype must be one of {AGG_WIRE_DTYPES} (got {wire_dtype!r})")
+        if int(microbatch_clients) < 0:
+            raise ValueError(
+                f"agg_microbatch_clients must be >= 0 (got {microbatch_clients})")
+        self.mesh = mesh if mesh is not None else default_agg_mesh()
+        self.rules = tuple(rules)
+        self.wire_dtype = wire_dtype
+        self.microbatch_clients = int(microbatch_clients)
+        self._programs: Dict[Any, _Program] = {}
+
+    # -- program construction ------------------------------------------------
+    def _leaf_plan(self, treedef, shapes, dtypes, mode):
+        names = leaf_paths(treedef)
+        specs = match_partition_rules(self.rules, names, shapes, self.mesh)
+        wire = _WIRE_JNP[self.wire_dtype]
+        acc_dtypes, wire_dtypes, out_dtypes = [], [], []
+        for dt in dtypes:
+            dt = jnp.dtype(dt)
+            if jnp.issubdtype(dt, jnp.floating):
+                wire_dtypes.append(jnp.dtype(wire))
+                acc_dtypes.append(jnp.dtype(jnp.float32))
+                # host parity: mean keeps the input float dtype, sum too
+                out_dtypes.append(dt)
+            else:
+                # integer leaves: no lossy wire cast; host sum stays integer
+                # while host mean promotes to f32
+                wire_dtypes.append(dt)
+                if mode == "sum":
+                    acc_dtypes.append(dt)
+                    out_dtypes.append(dt)
+                else:
+                    acc_dtypes.append(jnp.dtype(jnp.float32))
+                    out_dtypes.append(jnp.dtype(jnp.float32))
+        return specs, acc_dtypes, wire_dtypes, out_dtypes
+
+    def _build_program(self, treedef, shapes, dtypes, k, mode) -> _Program:
+        specs, acc_dtypes, wire_dtypes, out_dtypes = self._leaf_plan(
+            treedef, shapes, dtypes, mode)
+        mesh = self.mesh
+        acc_sh = [NamedSharding(mesh, s) for s in specs]
+        chunk_sh = [NamedSharding(mesh, P(None, *s)) for s in specs]
+        w_sh = NamedSharding(mesh, P())
+
+        def step(acc, chunk, w):
+            if mode == "mean":
+                # scale the whole chunk BEFORE the scan: the product must
+                # materialize at the while-loop boundary, so it rounds to
+                # f32 exactly like the host path's tree_scale — inside the
+                # loop body LLVM would contract a + v*w into an fma and
+                # break bit-exactness
+                chunk = [c.astype(a.dtype)
+                         * w.reshape((-1,) + (1,) * (c.ndim - 1)).astype(a.dtype)
+                         for a, c in zip(acc, chunk)]
+
+            def body(carry, x):
+                # padding rows are all-zero (rows AND weights), so adding
+                # them is exact; host sum mode never multiplies, nor do we
+                return [a + v.astype(a.dtype)
+                        for a, v in zip(carry, x)], None
+
+            acc, _ = jax.lax.scan(body, acc, chunk)
+            return acc
+
+        # acc and the in-flight chunk are donated: steady-state HBM is one
+        # accumulator + one chunk regardless of client count
+        jitted = jax.jit(step, donate_argnums=(0, 1),
+                         in_shardings=(acc_sh, chunk_sh, w_sh),
+                         out_shardings=acc_sh)
+        acc_sds = [jax.ShapeDtypeStruct(sh, dt, sharding=s)
+                   for sh, dt, s in zip(shapes, acc_dtypes, acc_sh)]
+        chunk_sds = [jax.ShapeDtypeStruct((k,) + sh, dt, sharding=s)
+                     for sh, dt, s in zip(shapes, wire_dtypes, chunk_sh)]
+        w_sds = jax.ShapeDtypeStruct((k,), jnp.float32, sharding=w_sh)
+        with warnings.catch_warnings():
+            # donation is a no-op on CPU backends; the warning is expected
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            compiled = jitted.lower(acc_sds, chunk_sds, w_sds).compile()
+        wire_bytes = int(sum(int(np.prod(sh) or 1) * jnp.dtype(dt).itemsize
+                             for sh, dt in zip(shapes, wire_dtypes)))
+        return _Program(compiled, acc_sh, chunk_sh, acc_dtypes, wire_dtypes,
+                        out_dtypes, shapes, wire_bytes)
+
+    def _program_for(self, treedef, shapes, dtypes, k, mode,
+                     parent) -> _Program:
+        sig = (treedef, shapes, dtypes, k, mode, self.wire_dtype)
+        prog = self._programs.get(sig)
+        if prog is None:
+            sp = (obs.span("aggregate.compile", parent, k=k, mode=mode,
+                           n_leaves=len(shapes))
+                  if parent is not None else NULL_SPAN)
+            with sp:
+                t0 = time.perf_counter()
+                prog = self._build_program(treedef, shapes, dtypes, k, mode)
+                logger.info(
+                    "agg_plane compiled %s k=%d leaves=%d in %.3fs",
+                    mode, k, len(shapes), time.perf_counter() - t0)
+            self._programs[sig] = prog
+        return prog
+
+    # -- the reduction -------------------------------------------------------
+    def aggregate(self, updates: Sequence[Tuple[float, Pytree]],
+                  mode: str = "mean",
+                  obs_parent: Any = None) -> Pytree:
+        """Aggregate ``[(n_samples, pytree), ...]`` on the mesh.
+
+        Returns a pytree of device arrays (same structure as the inputs;
+        dtypes mirror the host path).  Raises ``ValueError`` on an empty
+        update list, a non-positive total sample count (``mean``), or
+        structurally mismatched client pytrees.
+        """
+        if mode not in ("mean", "sum"):
+            raise ValueError(f"agg mode must be mean|sum (got {mode!r})")
+        if not updates:
+            raise ValueError("no updates to aggregate")
+        ns = [float(n) for n, _ in updates]
+        leaves_list, treedef = flatten_checked([t for _, t in updates])
+        n = len(leaves_list)
+        if mode == "mean":
+            total = float(sum(ns))
+            if total <= 0:
+                raise ValueError("total sample count must be positive")
+            # the same f64 divide the host path feeds tree_scale, rounded to
+            # f32 once — the multiply then matches bit-for-bit
+            w_all = np.asarray([x / total for x in ns], np.float32)
+        else:
+            w_all = np.ones(n, np.float32)
+
+        shapes = tuple(tuple(np.shape(l)) for l in leaves_list[0])
+        dtypes = tuple(jnp.dtype(jnp.result_type(l)) for l in leaves_list[0])
+        k = self.microbatch_clients or n
+        parent = obs_parent if obs_parent is not None else obs.active_ctx()
+        prog = self._program_for(treedef, shapes, dtypes, k, mode, parent)
+
+        t0 = time.perf_counter()
+        sp = (obs.span("aggregate.reduce", parent, n_clients=n, k=k,
+                       mode=mode)
+              if parent is not None else NULL_SPAN)
+        w_sharding = NamedSharding(self.mesh, P())
+        with sp:
+            acc = jax.device_put(
+                [np.zeros(sh, np.dtype(dt))
+                 for sh, dt in zip(shapes, prog.acc_dtypes)],
+                prog.acc_shardings)
+            for lo in range(0, n, k):
+                hi = min(lo + k, n)
+                chunk = []
+                for j, sh in enumerate(shapes):
+                    buf = np.zeros((k,) + sh, dtype=np.dtype(prog.wire_dtypes[j]))
+                    for row, c in enumerate(range(lo, hi)):
+                        buf[row] = np.asarray(leaves_list[c][j])
+                    chunk.append(buf)
+                # the final chunk is zero-padded (rows AND weights): acc + 0
+                # is exact, so padding never perturbs the result
+                w = np.zeros(k, np.float32)
+                w[: hi - lo] = w_all[lo:hi]
+                chunk = jax.device_put(chunk, prog.chunk_shardings)
+                acc = prog.step(acc, chunk, jax.device_put(w, w_sharding))
+            out = [a.astype(dt) if a.dtype != dt else a
+                   for a, dt in zip(acc, prog.out_dtypes)]
+            jax.block_until_ready(out)
+        dt_s = time.perf_counter() - t0
+        obs.histogram_observe("agg.step_seconds", dt_s,
+                              labels={"path": "compiled", "mode": mode})
+        obs.counter_inc("agg.bytes_reduced", n * prog.wire_bytes,
+                        labels={"path": "compiled"})
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# -- args-driven construction ------------------------------------------------
+
+_PLANES: Dict[Any, CompiledAggPlane] = {}
+
+
+def plane_config(args: Any) -> Tuple[str, int]:
+    wire = str(getattr(args, "agg_wire_dtype", "f32") or "f32").lower()
+    k = int(getattr(args, "agg_microbatch_clients", 0) or 0)
+    return wire, k
+
+
+def plane_for(args: Any) -> CompiledAggPlane:
+    """Process-cached plane for this config (the mesh — hence the compiled
+    programs — are per-process resources; every aggregator with the same
+    knobs shares one plane and its program cache)."""
+    key = plane_config(args)
+    plane = _PLANES.get(key)
+    if plane is None:
+        wire, k = key
+        plane = CompiledAggPlane(wire_dtype=wire, microbatch_clients=k)
+        _PLANES[key] = plane
+    return plane
+
+
+def reset_planes() -> None:
+    """Drop cached planes/programs (tests; device topology changes)."""
+    _PLANES.clear()

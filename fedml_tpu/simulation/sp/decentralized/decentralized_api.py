@@ -16,6 +16,7 @@ from typing import Any, Dict, List
 import jax
 import jax.numpy as jnp
 
+from ....core.aggregate import tree_stack
 from ....core.distributed.topology.topology_manager import SymmetricTopologyManager
 from ..fedavg.fedavg_api import FedAvgAPI
 
@@ -63,7 +64,7 @@ class DecentralizedFLAPI(FedAvgAPI):
                     self.train_data_local_num_dict[cid],
                 )
                 trained.append(slot.train(self.node_models[cid]))
-            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *trained)
+            stacked = tree_stack(trained)
             mixed = self._gossip(stacked, self.mix)
             self.node_models = [
                 jax.tree_util.tree_map(lambda x: x[i], mixed) for i in range(n)

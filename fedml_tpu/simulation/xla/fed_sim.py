@@ -132,6 +132,13 @@ class XLASimulator:
         self.algo = create_inmesh_algorithm(args)
         self.server_state = self.algo.init_server_state(self.variables)
         self.client_state = self.algo.init_client_state(self.num_clients, self.variables)
+        self.agg_plane = str(getattr(args, "agg_plane", "host") or "host")
+        if self.agg_plane not in ("host", "compiled"):
+            raise ValueError(
+                f"agg_plane must be host|compiled (got {self.agg_plane!r})")
+        self._model_bytes = int(sum(
+            l.size * l.dtype.itemsize
+            for l in jax.tree_util.tree_leaves(self.variables)))
         self.packed = bool(getattr(args, "xla_pack", False))
         if self.packed:
             self._build_packed_round_fn()
@@ -438,6 +445,7 @@ class XLASimulator:
 
         algo = self.algo
         via_acc = algo.aggregates_via_acc
+        use_plane = self.agg_plane == "compiled"
         attacker = FedMLAttacker.get_instance()
         defender = FedMLDefender.get_instance()
         attack_fn = (build_stacked_attack(self.args, attacker.attack_type)
@@ -475,6 +483,14 @@ class XLASimulator:
                     sub = jax.vmap(unravel)(mat)
                 if defend_fn is not None:
                     agg, dstate = defend_fn(sub, w, g32, kd, dstate)
+                elif use_plane:
+                    # the plane's sequential fold — same left-to-right order
+                    # as the host weighted_mean, so the simulator's compiled
+                    # security tail matches the server paths bit-for-bit
+                    from ...parallel.agg_plane import stacked_reduce
+
+                    agg = stacked_reduce(
+                        sub, w / jnp.maximum(jnp.sum(w), 1e-9))
                 else:
                     agg = jax.tree_util.tree_map(
                         lambda s: jnp.tensordot(w, s.astype(jnp.float32), axes=1)
@@ -702,20 +718,25 @@ class XLASimulator:
                     # (one split per round is the replayable invariant)
                     skey = jax.random.fold_in(sub, 999331)
                     meta = self.algo.security_meta(taus, cex, jnp.asarray(real_sel))
-                    self.variables, self.server_state, self._defense_state = (
-                        self._security_fn(
-                            stack,
-                            jnp.asarray(counts[real_sel], jnp.float32),
-                            jnp.asarray(real_sel),
-                            jnp.asarray(mal),
-                            meta,
-                            self.variables,
-                            self.server_state,
-                            ext,
-                            skey,
-                            dstate,
+                    with obs.span("aggregate.reduce", rsp.ctx,
+                                  round_idx=round_idx,
+                                  n_clients=int(real_sel.size),
+                                  mode="inmesh"):
+                        self.variables, self.server_state, self._defense_state = (
+                            self._security_fn(
+                                stack,
+                                jnp.asarray(counts[real_sel], jnp.float32),
+                                jnp.asarray(real_sel),
+                                jnp.asarray(mal),
+                                meta,
+                                self.variables,
+                                self.server_state,
+                                ext,
+                                skey,
+                                dstate,
+                            )
                         )
-                    )
+                        jax.block_until_ready(self.variables)
                     if self.analysis_attacked and round_idx % max(
                         1, int(getattr(self.args, "dlg_frequency", 1))
                     ) == 0:
@@ -753,6 +774,9 @@ class XLASimulator:
                     obs.span_event("slow_round", rsp.ctx, round_idx=round_idx,
                                    dt_s=round(dt, 4), median_s=round(med, 4))
             obs.histogram_observe("round.seconds", float(dt))
+            obs.counter_inc("agg.bytes_reduced",
+                            int(participated.sum()) * self._model_bytes,
+                            labels={"path": "inmesh"})
             rsp.end(reason="closed", loss=float(mean_loss))
             obs.maybe_export_metrics()
             self.round_times.append(dt)

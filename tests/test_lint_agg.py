@@ -1,0 +1,79 @@
+"""tools/lint_agg.py wired into tier-1: with ``core/aggregate.py`` (host)
+and ``parallel/agg_plane.py`` (compiled) as the only two aggregation
+surfaces, library code must not grow new hand-rolled star-lambda
+``tree_map`` aggregation loops — and the linter itself must actually catch
+violations, because a lint that can't fail is not a gate."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import lint_agg
+
+
+def test_library_tree_is_clean():
+    """The machine-enforced contract: every multi-client fold in fedml_tpu/
+    routes through core/aggregate or the compiled agg plane."""
+    assert lint_agg.main([]) == 0
+
+
+def test_catches_star_lambda_tree_map(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "def my_agg(models):\n"
+        "    return jax.tree_util.tree_map(lambda *xs: sum(xs), *models)\n"
+    )
+    violations = lint_agg.lint_file(str(bad))
+    assert [(lineno, kind) for _, lineno, kind, _ in violations] == [
+        (3, "host tree_map aggregation loop"),
+    ]
+    assert lint_agg.main(["--root", str(tmp_path)]) == 1
+
+
+def test_single_tree_maps_are_fine(tmp_path):
+    f = tmp_path / "good.py"
+    f.write_text(
+        "import jax\n"
+        "def scale(tree, s):\n"
+        "    return jax.tree_util.tree_map(lambda x: x * s, tree)\n"
+        "def pairwise(a, b):\n"
+        "    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)\n"
+    )
+    assert lint_agg.lint_file(str(f)) == []
+
+
+def test_pragma_allows_approved_seam(tmp_path):
+    f = tmp_path / "seam.py"
+    f.write_text(
+        "import jax\n"
+        "agg = jax.tree_util.tree_map(lambda *xs: sum(xs), *ts)  # lint_agg: allow\n"
+    )
+    assert lint_agg.lint_file(str(f)) == []
+    assert lint_agg.main(["--root", str(tmp_path)]) == 0
+
+
+def test_core_aggregate_is_exempt(tmp_path):
+    d = tmp_path / "core"
+    d.mkdir()
+    f = d / "aggregate.py"
+    f.write_text(
+        "import jax\n"
+        "def tree_sum(trees):\n"
+        "    return jax.tree_util.tree_map(lambda *xs: sum(xs), *trees)\n"
+    )
+    assert lint_agg.lint_file(str(f)) == []
+    assert lint_agg.main(["--root", str(tmp_path)]) == 0
+
+
+def test_docstrings_and_comments_do_not_false_positive(tmp_path):
+    f = tmp_path / "prose.py"
+    f.write_text(
+        '"""Never write tree_map(lambda *xs: ...) aggregation by hand."""\n'
+        "# the old loop was tree_map(lambda *w: np.mean(w), *models)\n"
+        "MSG = 'use core.aggregate, not tree_map(lambda *xs: sum(xs))'\n"
+    )
+    assert lint_agg.lint_file(str(f)) == []

@@ -2,10 +2,13 @@
 """Anti-flake gate for the chaos suite.
 
 Runs the fast chaos matrix plus the server-kill/restart tests
-(``tests/test_fault_tolerance.py``) AND the trace-integrity chaos tests
+(``tests/test_fault_tolerance.py``), the trace-integrity chaos tests
 (``tests/test_obs.py`` — every completed round must reconstruct as one
-closed span tree even under drop/dup/delay/server_kill) N consecutive
-times in fresh interpreter processes and fails on the FIRST non-green run.
+closed span tree even under drop/dup/delay/server_kill) AND the
+compiled-aggregation chaos tests (``tests/test_agg_plane.py`` —
+retransmit/dup chaos with ``agg_plane=compiled`` must converge
+bit-identical to the fault-free host run) N consecutive times in fresh
+interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
 operators stop believing red — so new fault kinds / backends must hold up
 under this before they land unmarked.
@@ -16,6 +19,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "chaos_matrix"
     python tools/chaos_check.py --runs 3 -k "server_kill"
     python tools/chaos_check.py --runs 3 -k "trace_integrity"
+    python tools/chaos_check.py --runs 3 -k "agg_plane"
 """
 
 from __future__ import annotations
@@ -35,16 +39,16 @@ def main(argv=None) -> int:
                     help="consecutive green runs required (default 3)")
     ap.add_argument(
         "-k", dest="keyword",
-        default="chaos or server_kill or trace_integrity",
-        help='pytest -k selector '
-             '(default: "chaos or server_kill or trace_integrity")')
+        default="chaos or server_kill or trace_integrity or agg_plane",
+        help='pytest -k selector (default: "chaos or server_kill or '
+             'trace_integrity or agg_plane")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     args = ap.parse_args(argv)
 
     env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
     cmd = [sys.executable, "-m", "pytest", "tests/test_fault_tolerance.py",
-           "tests/test_obs.py",
+           "tests/test_obs.py", "tests/test_agg_plane.py",
            "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
     for i in range(1, args.runs + 1):
         t0 = time.time()
