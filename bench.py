@@ -337,6 +337,8 @@ def main() -> int | None:
         out["autotuned"] = tuned
     out.update(obs_overhead)
     out.update(_measure_agg_step())
+    out.update(_measure_upload_saturation())
+    out.update(_measure_async_throughput())
     if os.environ.get("BENCH_SP"):
         out["sp_samples_per_sec"] = round(_measure_sp(args, dataset), 2)
     print(json.dumps(out))
@@ -410,6 +412,132 @@ def _measure_agg_step() -> dict:
         return {}
 
 
+def _measure_upload_saturation() -> dict:
+    """The "heavy traffic" number: sustained server ingest rate over the
+    real accept loop — per-sender dedup check, length+crc32-framed msgpack
+    journal append (fsynced before ack: the crash-safety contract), ack
+    frame encode — driven by a synthetic client firehose with ~11%
+    retransmits.  No sockets: this saturates the server-side loop itself,
+    not loopback plumbing.  Pure host work, so it is reported on BOTH the
+    full and CPU-degraded lines.  Failures degrade to empty keys."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    try:
+        from flax import serialization
+
+        from fedml_tpu.core.checkpoint import UpdateJournal
+
+        n_uploads = int(os.environ.get("BENCH_UPLOADS", "240"))
+        n_senders = 16
+        fsync = os.environ.get("BENCH_JOURNAL_FSYNC", "always")
+        rng = np.random.default_rng(0)
+        deltas = [
+            {"w/kernel": rng.standard_normal((64, 64)).astype(np.float32),
+             "w/bias": rng.standard_normal(64).astype(np.float32),
+             "head/kernel": rng.standard_normal((64, 10)).astype(np.float32)}
+            for _ in range(n_senders)
+        ]
+        payload_bytes = len(serialization.msgpack_serialize(
+            {"sender": 0, "n_samples": 32, "version": 0,
+             "model_params": deltas[0]}))
+        tmp = tempfile.mkdtemp(prefix="bench_journal_")
+        try:
+            journal = UpdateJournal(tmp, fsync=fsync)
+            seen = set()
+            deduped = 0
+            t0 = time.perf_counter()
+            for i in range(n_uploads):
+                sender = i % n_senders
+                version = i // n_senders
+                if i % 9 == 8:  # firehose retransmit: an already-sent key
+                    key = ((sender - 1) % n_senders, version)
+                else:
+                    key = (sender, version)
+                if key in seen:
+                    deduped += 1  # journaled once already: discard, no ack
+                    continue
+                seen.add(key)
+                if sender == 0 and version:
+                    journal.prune_before(version)  # flushed-cycle cleanup
+                journal.append(version, {
+                    "sender": key[0], "n_samples": 32, "version": version,
+                    "model_params": deltas[key[0]]})
+                serialization.msgpack_serialize(  # the ack frame
+                    {"sender": key[0], "version": version, "ok": True})
+            dt = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        accepted = n_uploads - deduped
+        return {
+            "uploads_per_s": round(accepted / max(dt, 1e-9), 2),
+            "upload_payload_bytes": payload_bytes,
+            "uploads_deduped": deduped,
+            "journal_fsync": fsync,
+        }
+    except Exception as e:
+        print(f"upload saturation measurement failed: {e}", file=sys.stderr)
+        return {}
+
+
+def _measure_async_throughput() -> dict:
+    """Buffered-async round-throughput keys: a small sp FedBuff run
+    (synthetic data, lr model) timed end-to-end — flushes (the async
+    'round') and accepted deltas per second.  CPU-cheap on purpose and
+    reported on both metric lines, so the async trend survives a dark
+    chip window.  Failures degrade to empty keys."""
+    try:
+        import fedml_tpu
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu.simulation.sp.async_fedavg.fedbuff_api import FedBuffAPI
+
+        cfg = {
+            "common_args": {"training_type": "simulation", "random_seed": 0,
+                            "run_id": "bench_async"},
+            "data_args": {"dataset": "mnist", "data_cache_dir": "",
+                          "partition_method": "hetero", "partition_alpha": 0.5,
+                          "synthetic_train_size": 480},
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "federated_optimizer": "FedAvg",
+                "client_num_in_total": 8,
+                "client_num_per_round": 4,
+                "comm_round": 6,
+                "epochs": 1,
+                "batch_size": 32,
+                "client_optimizer": "sgd",
+                "learning_rate": 0.1,
+                "fl_mode": "async",
+                "async_buffer_size": 2,
+                "async_max_staleness": 2,
+                "async_staleness_policy": "polynomial",
+            },
+            "validation_args": {"frequency_of_the_test": 100},
+            "comm_args": {"backend": "sp"},
+        }
+        args = fedml_tpu.init(Arguments.from_dict(cfg).validate(),
+                              should_init_logs=False)
+        dataset, out_dim = fedml_tpu.data.load(args)
+        model = fedml_tpu.models.create(args, out_dim)
+        api = FedBuffAPI(args, None, dataset, model)
+        t0 = time.perf_counter()
+        api.train()
+        dt = time.perf_counter() - t0
+        flushes = int(args.comm_round)
+        # the flush loop drains exactly `capacity` deltas per flush
+        deltas = flushes * api.buffer.capacity
+        return {
+            "async_flushes_per_s": round(flushes / max(dt, 1e-9), 3),
+            "async_deltas_per_s": round(deltas / max(dt, 1e-9), 3),
+            "async_buffer_size": api.buffer.capacity,
+        }
+    except Exception as e:
+        print(f"async throughput measurement failed: {e}", file=sys.stderr)
+        return {}
+
+
 def _run_degraded(reason: str) -> int:
     """No-TPU fallback: ONE JSON line with the relative keys (agg step host
     vs compiled, obs overhead on the agg step) instead of an empty BENCH
@@ -426,6 +554,8 @@ def _run_degraded(reason: str) -> int:
     agg = _measure_agg_step()
     out.update(agg)
     out["value"] = agg.get("agg_step_compiled_s", None)
+    out.update(_measure_upload_saturation())
+    out.update(_measure_async_throughput())
 
     # obs overhead on the measured path: the same compiled agg step with
     # tracing configured (spans to an in-memory sink, parented under a

@@ -104,6 +104,34 @@ Observability knobs (``tracking_args`` or ``obs_args``; consumed by
   event (straggler flagging in ``tools/trace_report.py`` uses the same
   factor).
 
+Async / buffered-FL knobs (``train_args`` or ``async_args``; consumed by
+``core/async_fl``, execution model in ``docs/ASYNC.md``):
+
+* ``fl_mode`` (``sync`` | ``async``, default ``sync``) — ``async`` turns
+  off quorum-gated rounds: the server buffers client deltas (tagged with
+  the global-model version they trained against) and flushes the buffer
+  through the aggregation plane; ``comm_round`` then counts flushes.
+* ``async_buffer_size`` (int >= 1, default = ``client_num_per_round``) —
+  deltas per flush.  Must not exceed ``client_num_per_round`` (a buffer
+  the active cohort can never fill would only flush by deadline).
+  ``async_buffer_size == client_num_per_round`` with the ``constant``
+  policy reproduces synchronous FedAvg bit-exactly.
+* ``async_staleness_policy`` (``constant`` | ``polynomial`` | ``hinge``,
+  default ``constant``) — per-delta aggregation-weight discount as a
+  function of staleness (closed forms in ``core/async_fl/staleness.py``).
+* ``async_staleness_alpha`` (float > 0, default 0.5) — decay exponent /
+  slope of the polynomial and hinge policies.
+* ``async_hinge_b`` (int >= 0, default 4) — the hinge policy's no-decay
+  grace window.
+* ``async_max_staleness`` (int >= 0, default 0) — inclusive staleness
+  bound: a delta staler than this is dropped (``async.dropped_stale``)
+  and its client immediately re-dispatched on the current global.  0
+  accepts only same-version deltas (the sync-equivalence setting); >= 1
+  also unlocks the scheduler's immediate re-dispatch of fast clients.
+* ``async_flush_deadline_s`` (float >= 0, default 0 = none) — flush a
+  non-empty buffer after this many seconds even below capacity (the
+  relative-delay timer seam from ``round_timeout_s``; no wall-clock math).
+
 Aggregation-plane knobs (``train_args``; consumed by
 ``parallel/agg_plane``, semantics in ``docs/AGGREGATION.md``):
 
@@ -154,6 +182,7 @@ _CONFIG_SECTIONS = (
     "fault_args",
     "population_args",
     "obs_args",
+    "async_args",
 )
 
 
@@ -337,6 +366,62 @@ class Arguments:
             if sv < 1.0:
                 raise ValueError(
                     f"obs_slow_round_factor must be >= 1.0 (got {sv})")
+        # async / buffered-FL knobs (core/async_fl) — a typo'd mode or policy
+        # must fail here, not silently run the sync state machine
+        mode = getattr(self, "fl_mode", None)
+        if mode is not None:
+            from .core.async_fl import FL_MODES
+
+            if str(mode).lower() not in FL_MODES:
+                raise ValueError(
+                    f"fl_mode must be one of {FL_MODES} (got {mode!r})")
+        bs = getattr(self, "async_buffer_size", None)
+        if bs is not None:
+            try:
+                bv = int(bs)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"async_buffer_size must be an integer >= 1 (got {bs!r})")
+            if bv < 1:
+                raise ValueError(f"async_buffer_size must be >= 1 (got {bv})")
+            k = getattr(self, "client_num_per_round", None)
+            if k is not None and bv > int(k):
+                raise ValueError(
+                    f"async_buffer_size ({bv}) must not exceed "
+                    f"client_num_per_round ({k}): a buffer the active cohort "
+                    "cannot fill would only ever flush by deadline")
+        spol = getattr(self, "async_staleness_policy", None)
+        if spol is not None:
+            from .core.async_fl import ASYNC_STALENESS_POLICIES
+
+            if str(spol).lower() not in ASYNC_STALENESS_POLICIES:
+                raise ValueError(
+                    "async_staleness_policy must be one of "
+                    f"{ASYNC_STALENESS_POLICIES} (got {spol!r})")
+        for knob, floor, kind in (
+                ("async_max_staleness", 0, int),
+                ("async_hinge_b", 0, int),
+                ("async_flush_deadline_s", 0.0, float)):
+            v = getattr(self, knob, None)
+            if v is None:
+                continue
+            try:
+                cv = kind(v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{knob} must be a {kind.__name__} >= {floor} (got {v!r})")
+            if cv < floor:
+                raise ValueError(f"{knob} must be >= {floor} (got {cv})")
+        sa = getattr(self, "async_staleness_alpha", None)
+        if sa is not None:
+            try:
+                sav = float(sa)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"async_staleness_alpha must be a number > 0 (got {sa!r})")
+            if sav <= 0:
+                raise ValueError(
+                    f"async_staleness_alpha must be > 0 (got {sav})")
         # aggregation-plane knobs (parallel/agg_plane) — a typo'd plane name
         # must not silently fall back to the host loop
         plane = getattr(self, "agg_plane", None)

@@ -1,0 +1,36 @@
+"""``fedml_tpu.core.async_fl`` — buffered asynchronous FL (FedBuff-style).
+
+The subsystem that closes the ROADMAP's "non-synchronous production FL"
+gap: instead of gating each round on a quorum, the server accumulates
+client deltas in an :class:`UpdateBuffer` (each tagged with the
+global-model version it trained against) and flushes through the
+aggregation plane once ``async_buffer_size`` deltas accrue or the flush
+deadline fires, down-weighting stale deltas by a configurable policy
+(:mod:`.staleness`).  Dispatch is heterogeneity-aware
+(:class:`StalenessScheduler`): fast clients are re-invited the moment
+they report, slow ones are paced against the staleness bound.
+
+Selected via ``args.fl_mode = "async"`` (knob reference in
+``arguments.py``; execution model and crash-safety contract in
+``docs/ASYNC.md``).  The message-plane half lives in
+:class:`AsyncBufferedServerMixin`; the simulators reuse the same buffer /
+policy / scheduler pieces with a :class:`VirtualArrivalQueue` and a
+:class:`ManualClock` for seed-reproducible virtual time.
+"""
+
+from .buffer import BufferedDelta, UpdateBuffer
+from .clock import ManualClock, MonotonicClock
+from .scheduler import StalenessScheduler, VirtualArrivalQueue
+from .server import FL_MODES, AsyncBufferedServerMixin
+from .staleness import (
+    ASYNC_STALENESS_POLICIES,
+    staleness_weight,
+    staleness_weights,
+)
+
+__all__ = [
+    "ASYNC_STALENESS_POLICIES", "FL_MODES",
+    "AsyncBufferedServerMixin", "BufferedDelta", "ManualClock",
+    "MonotonicClock", "StalenessScheduler", "UpdateBuffer",
+    "VirtualArrivalQueue", "staleness_weight", "staleness_weights",
+]

@@ -1,0 +1,115 @@
+"""The server-side update buffer — the heart of buffered-async FL.
+
+Instead of closing a round on quorum, the async server parks every
+accepted client delta here, tagged with the global-model *version* the
+client trained against, and flushes the whole buffer through the
+aggregation plane once ``capacity`` deltas accrue (or the flush deadline
+fires).  Two properties matter for correctness:
+
+* **one delta per sender per cycle** — ``add`` raises on a duplicate
+  sender; the server's journal dedup (``_uploads_this_round``) enforces
+  the same invariant on the accept path, so a crash-replay can never
+  double-fill a slot;
+* **canonical drain order** — ``drain`` returns entries sorted by
+  ``(version, sender)``, so the flush aggregate is a left-to-right fold
+  over a deterministic list regardless of upload-thread interleaving.
+  This is what makes flushes bit-reproducible given an arrival schedule,
+  and what lines async up with the sync participant order for the
+  FedAvg-equivalence guarantee (``docs/ASYNC.md``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+from .staleness import _check_policy, staleness_weight
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferedDelta:
+    """One accepted client update awaiting a flush."""
+    sender: int
+    params: Any
+    n_samples: float
+    version: int    # global-model version the client trained against
+    staleness: int  # flush version minus trained version, fixed at accept
+
+
+class UpdateBuffer:
+    """Fixed-capacity accumulator of :class:`BufferedDelta`."""
+
+    def __init__(self, capacity: int, policy: str = "constant",
+                 alpha: float = 0.5, hinge_b: int = 4):
+        capacity = int(capacity)
+        if capacity < 1:
+            raise ValueError(f"async_buffer_size must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.policy = _check_policy(policy)
+        self.alpha = float(alpha)
+        self.hinge_b = int(hinge_b)
+        self._entries: Dict[int, BufferedDelta] = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def occupancy(self) -> int:
+        return len(self._entries)
+
+    def ready(self) -> bool:
+        return len(self._entries) >= self.capacity
+
+    def senders(self) -> List[int]:
+        return sorted(self._entries)
+
+    def add(self, sender: int, params: Any, n_samples: float, version: int,
+            staleness: int) -> int:
+        """Park one delta; returns the new occupancy.  A duplicate sender is
+        a caller bug (the journal dedup must have dropped it first)."""
+        sender = int(sender)
+        if sender in self._entries:
+            raise ValueError(
+                f"sender {sender} already buffered this cycle — the journal "
+                "dedup must drop a same-cycle re-upload before it gets here")
+        if int(staleness) < 0:
+            raise ValueError(
+                f"negative staleness {staleness} for sender {sender} "
+                f"(version {version}): version tags may never lead the server")
+        self._entries[sender] = BufferedDelta(
+            sender=sender, params=params, n_samples=float(n_samples),
+            version=int(version), staleness=int(staleness))
+        return len(self._entries)
+
+    def drain(self) -> List[BufferedDelta]:
+        """Remove and return every entry in canonical ``(version, sender)``
+        order — the deterministic fold order for the flush aggregate."""
+        entries = sorted(self._entries.values(),
+                         key=lambda e: (e.version, e.sender))
+        self._entries.clear()
+        return entries
+
+    def weighted(self, entries: List[BufferedDelta]) -> List[Tuple[float, Any]]:
+        """The ``(weight, params)`` list the aggregation plane consumes:
+        ``weight = n_samples * staleness_weight(policy, s)``.  Under the
+        ``constant`` policy the multiplier is exactly ``1.0``, so the list
+        is bit-identical to the sync path's ``(n_samples, params)``."""
+        return [
+            (e.n_samples * staleness_weight(
+                self.policy, e.staleness, alpha=self.alpha,
+                hinge_b=self.hinge_b), e.params)
+            for e in entries
+        ]
+
+    @staticmethod
+    def staleness_stats(entries: List[BufferedDelta]) -> Dict[str, float]:
+        """Per-flush staleness distribution for the ``buffer.flush`` span."""
+        if not entries:
+            return {"staleness_min": 0.0, "staleness_mean": 0.0,
+                    "staleness_max": 0.0}
+        vals = [e.staleness for e in entries]
+        return {
+            "staleness_min": float(min(vals)),
+            "staleness_mean": round(float(sum(vals)) / len(vals), 4),
+            "staleness_max": float(max(vals)),
+        }

@@ -1,0 +1,50 @@
+"""Injectable monotonic clocks for async deadline / latency math.
+
+Deadline bookkeeping and the EMA latency feeds must never read wall-clock
+directly: ``time.time()`` jumps under NTP, is unmockable in tier-1, and the
+virtual-time simulators have no wall-clock at all.  Every ``async_fl``
+component that needs "now" takes a clock object with one method —
+``now() -> float`` (seconds since an arbitrary epoch, monotone
+non-decreasing) — defaulting to :class:`MonotonicClock`
+(``time.monotonic``).  Tests and the simulators inject
+:class:`ManualClock` and advance it explicitly, which is what makes the
+async schedules seed-reproducible on CPU.
+
+Audit note (the companion small-fix for this subsystem):
+``core/population/pacer.py`` was checked for the same hazard and is clean
+— it is pure arithmetic over counts; the only deadline it relies on is
+``round_timeout_s``, armed as a *relative* ``threading.Timer`` delay, not
+wall-clock math.  The async flush deadline reuses that timer seam and
+keeps all remaining time arithmetic (dispatch→report seconds, flush-period
+EMA) on the injected clock.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class MonotonicClock:
+    """The production clock: ``time.monotonic`` behind the one-method seam."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+
+class ManualClock:
+    """A clock that only moves when told to — virtual time for tests and
+    the simulators.  ``advance`` is the only mutation; going backwards is a
+    programming error and raises."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+
+    def now(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> float:
+        dt = float(dt)
+        if dt < 0:
+            raise ValueError(f"ManualClock cannot go backwards (dt={dt})")
+        self._t += dt
+        return self._t

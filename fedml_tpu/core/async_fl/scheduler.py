@@ -1,0 +1,132 @@
+"""Heterogeneity-aware dispatch scheduling for buffered-async FL.
+
+The synchronous modes pay the straggler tax once per round; async pays it
+per *dispatch decision*.  :class:`StalenessScheduler` reads the population
+registry's ``ema_seconds`` column (fed by every accepted report's
+dispatch→report latency) and answers the two questions the server asks:
+
+* :meth:`redispatch_now` — a client just reported mid-cycle: hand it the
+  current global immediately (keeping the buffer fed; its next report
+  lands at staleness >= 1) or hold it for the flush barrier?  Fast clients
+  (strictly below the fleet's median observed latency) go immediately;
+  slow clients wait — the Parrot-style pacing rule: dispatch frequency
+  adapts to client speed instead of one global cadence.
+* :meth:`defer_at_flush` — at a flush's re-dispatch wave, is this client
+  so slow that its report would exceed ``async_max_staleness`` flushes
+  anyway?  If its latency EMA is beyond ``(max_staleness + 1)`` expected
+  flush periods, training it now is wasted work; it is held back and
+  reconsidered at the next flush (the flush-period EMA moves, so the
+  decision is re-evaluated, never frozen).
+
+All time arithmetic runs on the injected clock (:mod:`.clock`), so the
+virtual-time simulators and tier-1 tests drive the same decision code
+deterministically.
+
+:class:`VirtualArrivalQueue` is the simulators' deterministic arrival
+schedule: a heapq of ``(finish_time, push_seq, client_id)`` whose tie-break
+is insertion order — two clients finishing at the same virtual instant pop
+in dispatch order, never hash order.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .clock import MonotonicClock
+
+
+class StalenessScheduler:
+    def __init__(self, registry, max_staleness: int, clock=None,
+                 flush_ema_alpha: float = 0.3):
+        self.registry = registry
+        self.max_staleness = int(max_staleness)
+        self.clock = clock if clock is not None else MonotonicClock()
+        self._alpha = float(flush_ema_alpha)
+        self._last_flush_t: Optional[float] = None
+        self.flush_period_ema: Optional[float] = None
+
+    # -- latency context -----------------------------------------------------
+    def _ema_of(self, client_id: int) -> float:
+        pos = int(self.registry.positions([int(client_id)])[0])
+        return float(self.registry.ema_seconds[pos])
+
+    def _fleet_median(self) -> Optional[float]:
+        ema = self.registry.ema_seconds
+        observed = ema[ema > 0]
+        if observed.size == 0:
+            return None
+        return float(np.median(observed))
+
+    # -- flush bookkeeping ---------------------------------------------------
+    def note_flush(self) -> None:
+        """Fold the just-completed inter-flush interval into the period EMA
+        (the denominator of the defer rule)."""
+        now = self.clock.now()
+        if self._last_flush_t is not None:
+            period = max(now - self._last_flush_t, 0.0)
+            if self.flush_period_ema is None:
+                self.flush_period_ema = period
+            else:
+                self.flush_period_ema = (
+                    (1 - self._alpha) * self.flush_period_ema
+                    + self._alpha * period)
+        self._last_flush_t = now
+
+    # -- dispatch decisions --------------------------------------------------
+    def redispatch_now(self, client_id: int) -> bool:
+        """Mid-cycle, on an accepted report: re-dispatch immediately?  Needs
+        a staleness budget (>= 1 — an immediate re-dispatch cannot report
+        before the next flush) and a strictly-faster-than-median latency
+        EMA.  With no observations yet everyone waits for the barrier."""
+        if self.max_staleness < 1:
+            return False
+        mine = self._ema_of(client_id)
+        median = self._fleet_median()
+        if mine <= 0 or median is None:
+            return False
+        return mine < median
+
+    def defer_at_flush(self, client_id: int) -> bool:
+        """At a flush's re-dispatch wave: hold this client out because its
+        expected report would be dropped as too stale anyway."""
+        if self.max_staleness < 1 or self.flush_period_ema is None \
+                or self.flush_period_ema <= 0:
+            return False
+        mine = self._ema_of(client_id)
+        if mine <= 0:
+            return False
+        return mine > (self.max_staleness + 1) * self.flush_period_ema
+
+
+class VirtualArrivalQueue:
+    """Deterministic virtual-time report schedule (simulator surface)."""
+
+    def __init__(self):
+        self._heap: List[Tuple[float, int, int]] = []
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __bool__(self) -> bool:
+        return bool(self._heap)
+
+    def push(self, client_id: int, finish_time: float) -> None:
+        heapq.heappush(self._heap,
+                       (float(finish_time), self._seq, int(client_id)))
+        self._seq += 1
+
+    def peek_time(self) -> float:
+        return self._heap[0][0]
+
+    def pop(self) -> Tuple[float, int]:
+        """``(finish_time, client_id)`` of the next virtual report."""
+        t, _, cid = heapq.heappop(self._heap)
+        return t, cid
+
+    def clients(self) -> List[int]:
+        """The client ids currently in flight (sorted, for set checks)."""
+        return sorted(cid for _, _, cid in self._heap)

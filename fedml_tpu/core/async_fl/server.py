@@ -1,0 +1,368 @@
+"""Buffered-async execution mode for the message-plane server managers.
+
+One mixin holds the transport-independent half of FedBuff-style serving so
+the cross-silo and cross-device managers stay a thin message-schema layer:
+
+* **accept** — an upload is matched against the sender's outstanding
+  dispatch (``_in_flight[sender]`` holds the global-model version it was
+  handed); a version-tag mismatch is a retransmit of an already-acked
+  upload and is dropped, giving exactly-once delta accounting without any
+  wire change (clients already echo ``MSG_ARG_KEY_ROUND_INDEX``).
+  Accepted deltas are journaled *before* the transport ack (PR 4's
+  journal-before-ack contract, now with a ``version`` field) and parked in
+  the :class:`~.buffer.UpdateBuffer`.
+* **flush** — once ``async_buffer_size`` deltas accrue (or the
+  ``async_flush_deadline_s`` timer fires), the buffer drains in canonical
+  order through the aggregation plane with staleness-discounted weights,
+  the model version (``args.round_idx``) bumps, and every idle participant
+  is re-dispatched on the fresh global.  ``comm_round`` counts flushes.
+* **schedule** — on each accepted report the
+  :class:`~.scheduler.StalenessScheduler` may re-dispatch a fast client
+  immediately (its report lands next cycle at staleness >= 1); slow
+  clients wait for the flush barrier, and clients too slow for the
+  staleness bound are held out of a wave entirely.
+
+Version/cycle mapping: ``args.round_idx`` IS the global-model version and
+bumps once per flush — so every existing per-round mechanism (round-open
+snapshot + journal reset, per-cycle sender dedup, deterministic round span
+ids, population cycle accounting) applies to async cycles unchanged.  A
+buffered delta may carry an *older* version tag than the cycle it is
+journaled in; the tag rides in the journal record so a crash-replay
+recomputes the same staleness.
+
+MRO: insert between ``ServerRecoveryMixin`` and ``PopulationPacingMixin``
+(``class Manager(RoundObsMixin, ServerRecoveryMixin,
+AsyncBufferedServerMixin, PopulationPacingMixin, RoundTimeoutMixin,
+FedMLCommManager)``): ``_close_round_if_complete`` branches to the flush
+check in async mode and defers to the pacing quorum logic otherwise.
+
+Host hooks: ``_async_send_model(client_id, parent_ctx=None)`` (build and
+send the dispatch message carrying the current global + version tag) and
+optionally ``_async_eval_round`` / ``_async_replay_params(record)``.
+Everything else rides the seams the sync mode already requires.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from .. import obs
+from .buffer import UpdateBuffer
+from .clock import MonotonicClock
+from .scheduler import StalenessScheduler
+
+logger = logging.getLogger(__name__)
+
+FL_MODES = ("sync", "async")
+
+
+class AsyncBufferedServerMixin:
+    # -- init ----------------------------------------------------------------
+    def init_async_fl(self, args, clock=None) -> None:
+        """Call from the manager's ``__init__`` after ``init_population``
+        and before ``init_server_recovery`` (replay fills the buffer)."""
+        self.fl_mode = str(getattr(args, "fl_mode", "sync") or "sync").lower()
+        if self.fl_mode not in FL_MODES:
+            raise ValueError(
+                f"fl_mode must be one of {FL_MODES}, got {self.fl_mode!r}")
+        self.async_enabled = self.fl_mode == "async"
+        if not self.async_enabled:
+            return
+        cap = int(getattr(args, "async_buffer_size", 0) or 0) or self.per_round
+        if cap > self.per_round:
+            # a buffer that can never fill from the active cohort would only
+            # flush by deadline; clamp instead of deadlocking deadline-less runs
+            logger.warning(
+                "async_buffer_size=%d exceeds the active cohort (%d): "
+                "clamping to the cohort size", cap, self.per_round)
+            cap = self.per_round
+        self.async_buffer = UpdateBuffer(
+            capacity=cap,
+            policy=str(getattr(args, "async_staleness_policy", "constant")
+                       or "constant"),
+            alpha=float(getattr(args, "async_staleness_alpha", 0.5) or 0.5),
+            hinge_b=int(getattr(args, "async_hinge_b", 4) or 4),
+        )
+        self.async_max_staleness = int(
+            getattr(args, "async_max_staleness", 0) or 0)
+        self.async_flush_deadline_s = float(
+            getattr(args, "async_flush_deadline_s", 0) or 0)
+        self._async_clock = clock if clock is not None else MonotonicClock()
+        self.async_scheduler = StalenessScheduler(
+            self.population.registry, self.async_max_staleness,
+            clock=self._async_clock)
+        self._flush_timer = None
+        self._in_flight: Dict[int, int] = {}   # client_id -> dispatched version
+        self._dispatch_t: Dict[int, float] = {}
+        self._async_active: set = set()        # the run's participant pool
+
+    # -- host hooks ----------------------------------------------------------
+    def _async_send_model(self, client_id: int, parent_ctx=None) -> None:
+        raise NotImplementedError  # message schema lives in the manager
+
+    def _async_eval_round(self, round_idx: int) -> None:
+        self.eval_history.append(
+            self.aggregator.test_on_server_for_all_clients(int(round_idx)))
+
+    def _async_replay_params(self, record: Dict[str, Any]):
+        """Extract the params tree from a journal record (cross-device
+        overrides this to re-read its model file); None = unreplayable."""
+        return record.get("model_params")
+
+    def _async_after_flush(self, entries) -> None:
+        """Called once the flushed cycle's successor snapshot is durable (or
+        the run finished) — the earliest point the flushed deltas' backing
+        artifacts may be released (cross-device deletes upload files here)."""
+
+    # -- dispatch ------------------------------------------------------------
+    def _async_note_dispatch_wave(self, wave: List[int]) -> None:
+        """(lock held) Cycle-0 bookkeeping for a wave the manager already
+        sent (and whose invites the population draw already counted)."""
+        now = self._async_clock.now()
+        v = int(self.args.round_idx)
+        self._async_active.update(int(c) for c in wave)
+        for cid in wave:
+            self._in_flight[int(cid)] = v
+            self._dispatch_t[int(cid)] = now
+        self._arm_flush_timer()
+
+    def _async_dispatch(self, client_id: int, parent_ctx=None) -> None:
+        """(lock held) Hand one idle client the current global + version."""
+        cid = int(client_id)
+        self._in_flight[cid] = int(self.args.round_idx)
+        self._dispatch_t[cid] = self._async_clock.now()
+        self._async_active.add(cid)
+        self.population.note_dispatch(cid)
+        self._async_send_model(cid, parent_ctx=parent_ctx)
+
+    def _async_idle_clients(self) -> List[int]:
+        return sorted(c for c in self._async_active if c not in self._in_flight)
+
+    # -- accept --------------------------------------------------------------
+    def _async_handle_upload(self, sender: int, model_params, n_samples,
+                             version_tag, parent_ctx=None,
+                             journal_extra: Optional[Dict[str, Any]] = None,
+                             journal_params: bool = True) -> bool:
+        """(lock held) The async accept path: match the dispatch, bound the
+        staleness, journal-before-ack, park in the buffer, schedule, and
+        flush when full.  ``journal_params=False`` keeps the tensors out of
+        the journal record when ``journal_extra`` already carries a durable
+        pointer to them (the cross-device file plane).  Returns True when
+        the delta was buffered (the manager may need to release a dropped
+        upload's backing artifact)."""
+        sender = int(sender)
+        v = int(self.args.round_idx)
+        if version_tag is None:
+            logger.warning(
+                "dropping UNTAGGED upload from client %d: async mode cannot "
+                "compute staleness without MSG_ARG_KEY_ROUND_INDEX", sender)
+            obs.counter_inc("async.dropped_untagged")
+            self._note_rejected_late(sender)
+            return False
+        tag = int(version_tag)
+        expected = self._in_flight.get(sender)
+        if expected is None or tag != expected:
+            # not this sender's outstanding dispatch: a retransmit of an
+            # already-acked upload (exactly-once) or a ghost
+            logger.info(
+                "dropping upload from client %d tagged v%d (outstanding "
+                "dispatch: %s) — duplicate or stray", sender, tag, expected)
+            obs.counter_inc("async.dropped_dup")
+            return False
+        staleness = v - tag
+        if staleness > self.async_max_staleness:
+            # too stale to aggregate — but the client is now idle and fresh
+            # work beats idling, so it gets the current global immediately
+            logger.warning(
+                "dropping stale delta from client %d (staleness %d > bound "
+                "%d); re-dispatching on v%d", sender, staleness,
+                self.async_max_staleness, v)
+            obs.counter_inc("async.dropped_stale")
+            self._note_rejected_late(sender)
+            self._in_flight.pop(sender, None)
+            self._dispatch_t.pop(sender, None)
+            self._async_dispatch(sender)
+            return False
+        payload: Dict[str, Any] = {"n_samples": n_samples, "version": tag}
+        if journal_params:
+            payload["model_params"] = model_params
+        payload.update(journal_extra or {})
+        with self._obs_phase("journal.append", parent=parent_ctx, seq=sender,
+                             sender=sender, version=tag) as jsp:
+            ok = self._journal_upload(sender, **payload)
+            if not ok:
+                jsp.event("dup", side="journal", sender=sender)
+        if not ok:
+            # this sender already filled its slot this cycle (a second
+            # same-cycle contribution after an immediate re-dispatch, or a
+            # replayed duplicate): one delta per sender per cycle
+            obs.counter_inc("async.dropped_dup")
+            self._in_flight.pop(sender, None)
+            self._dispatch_t.pop(sender, None)
+            return False
+        self._in_flight.pop(sender, None)
+        occ = self.async_buffer.add(sender, model_params, n_samples,
+                                    version=tag, staleness=staleness)
+        obs.histogram_observe("async.staleness", float(staleness))
+        obs.gauge_set("async.buffer_occupancy", float(occ))
+        t0 = self._dispatch_t.pop(sender, None)
+        secs = None if t0 is None else max(self._async_clock.now() - t0, 0.0)
+        self.population.note_report(
+            sender, round_idx=v,
+            n_samples=None if n_samples is None else int(n_samples),
+            seconds=secs)
+        if (not self.async_buffer.ready()
+                and self.async_scheduler.redispatch_now(sender)):
+            self._async_dispatch(sender)
+        self._close_round_if_complete()
+        return True
+
+    # -- close check (PopulationPacingMixin override point) ------------------
+    def _close_round_if_complete(self) -> bool:
+        if not getattr(self, "async_enabled", False):
+            return super()._close_round_if_complete()
+        if not self.async_buffer.ready():
+            return False
+        self._async_flush_safely("full")
+        return True
+
+    # -- flush ---------------------------------------------------------------
+    def _async_flush_safely(self, reason: str) -> None:
+        """(lock held) Flush with the shared error policy (see
+        ``straggler._finalize_safely``): with any tolerance knob on, a
+        flush failure shuts the run down cleanly instead of wedging it."""
+        if self.round_timeout_s <= 0 and self.async_flush_deadline_s <= 0:
+            self._async_flush(reason)
+            return
+        try:
+            self._async_flush(reason)
+        except Exception:
+            logger.exception("async flush failed; shutting down")
+            self._finished = True
+            self.send_finish_msg()
+            self.finish()
+
+    def _async_flush(self, reason: str) -> None:
+        """(lock held) Drain → weight → aggregate → bump version → re-open."""
+        self._gen += 1  # this cycle's deadline timer goes stale
+        self._cancel_flush_timer()
+        entries = self.async_buffer.drain()
+        closing_idx = int(self.args.round_idx)
+        closing_ctx = self._obs_round_ctx()
+        closing_root = self._obs_round
+        stats = UpdateBuffer.staleness_stats(entries)
+        with self._obs_phase("buffer.flush", n_deltas=len(entries),
+                             reason=reason, capacity=self.async_buffer.capacity,
+                             **stats):
+            weighted = self.async_buffer.weighted(entries)
+            self.aggregator.aggregate_buffered(weighted)
+            freq = int(getattr(self.args, "frequency_of_the_test", 1) or 0)
+            if freq and (closing_idx % freq == 0
+                         or closing_idx == self.round_num - 1):
+                self._async_eval_round(closing_idx)
+        obs.counter_inc("async.flushes", labels={"reason": reason})
+        obs.gauge_set("async.buffer_occupancy", 0.0)
+        obs.maybe_export_metrics()
+        self.async_scheduler.note_flush()
+        self.population.close_round(reason="flush", fail_missing=False)
+
+        self.args.round_idx += 1
+        if self.args.round_idx >= self.round_num:
+            self._finished = True
+            with self._obs_phase("broadcast", parent=closing_ctx,
+                                 round_idx=closing_idx, final=True):
+                self.send_finish_msg()
+            self._obs_close_round(reason="run_complete")
+            self._async_after_flush(entries)
+            self.finish()
+            return
+
+        # open the next cycle: fresh root span, fresh journal + snapshot,
+        # and a re-dispatch wave over every idle participant (in-flight
+        # clients keep training — their reports land here at staleness >= 1)
+        self._obs_round = None
+        self._obs_open_round(mode="async")
+        self.population.begin_cycle(self.args.round_idx, self.per_round)
+        wave = self._async_idle_clients()
+        self.client_id_list_in_this_round = sorted(
+            set(wave) | set(self._in_flight))
+        self._save_round_start()
+        # the new cycle's snapshot is durable: a crash from here on restores
+        # *after* the flush, so the flushed deltas' artifacts can be released
+        self._async_after_flush(entries)
+        chosen = [c for c in wave
+                  if not self.async_scheduler.defer_at_flush(c)]
+        if not chosen and not self._in_flight:
+            chosen = wave  # never stall: an all-deferred wave dispatches
+        deferred = len(wave) - len(chosen)
+        if deferred:
+            obs.counter_inc("async.deferred_dispatch", deferred)
+        bcast = self._obs_phase("broadcast", parent=closing_ctx,
+                                round_idx=closing_idx)
+        with self._obs_phase("invite", fanout=len(chosen),
+                             mode="async") as inv:
+            for cid in chosen:
+                self._async_dispatch(cid, parent_ctx=inv.ctx)
+        bcast.end()
+        if closing_root is not None:
+            closing_root.end(reason="flush")
+        self._arm_flush_timer()
+
+    # -- deadline timer ------------------------------------------------------
+    def _arm_flush_timer(self) -> None:
+        if self.async_flush_deadline_s <= 0 or self._finished:
+            return
+        self._start_phase_timer("_flush_timer", self._on_flush_deadline,
+                                delay=self.async_flush_deadline_s)
+
+    def _cancel_flush_timer(self) -> None:
+        t = getattr(self, "_flush_timer", None)
+        if t is not None:
+            t.cancel()
+            self._flush_timer = None
+
+    def _on_flush_deadline(self, gen: int) -> None:
+        with self._round_lock:
+            if self._finished or gen != self._gen:
+                return
+            if len(self.async_buffer) == 0:
+                self._arm_flush_timer()  # nothing to flush; keep waiting
+                return
+            logger.info("flush deadline: draining %d/%d buffered deltas",
+                        len(self.async_buffer), self.async_buffer.capacity)
+            self._async_flush_safely("deadline")
+
+    # -- crash recovery ------------------------------------------------------
+    def _async_replay_upload(self, record: Dict[str, Any]) -> bool:
+        """(recovery) Re-park one journaled delta.  The record's ``version``
+        field recomputes the same staleness the dead incarnation accepted
+        it at (the cycle index has not moved since the snapshot)."""
+        sender = int(record["sender"])
+        params = self._async_replay_params(record)
+        if params is None:
+            return False
+        v = int(record.get("version", record.get("round_idx", 0)))
+        staleness = int(self.args.round_idx) - v
+        if staleness < 0 or staleness > self.async_max_staleness:
+            return False
+        occ = self.async_buffer.add(sender, params, record["n_samples"],
+                                    version=v, staleness=staleness)
+        obs.gauge_set("async.buffer_occupancy", float(occ))
+        n = record.get("n_samples")
+        self.population.note_report(
+            sender, round_idx=int(self.args.round_idx),
+            n_samples=None if n is None else int(n))
+        return True
+
+    def _async_resync(self, client_id: int) -> None:
+        """(lock held) A client rejoined (or the server restarted and its
+        ONLINE reads as a rejoin): if its delta for this cycle is already
+        journaled it waits for the flush broadcast; otherwise it gets the
+        current global now."""
+        cid = int(client_id)
+        if cid in self._uploads_this_round:
+            return
+        if self._async_active and cid not in self._async_active:
+            return  # not part of this run's pool
+        self._async_dispatch(cid)

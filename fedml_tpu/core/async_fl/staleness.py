@@ -1,0 +1,68 @@
+"""Staleness-weighting policies for buffered-async aggregation.
+
+A buffered delta trained against global-model version ``v`` and flushed at
+version ``v + s`` has *staleness* ``s`` (the number of flushes it missed).
+Its aggregation weight is ``n_samples * weight(policy, s)`` where
+``weight`` is one of three closed-form down-weighting schedules (FedBuff,
+arXiv:2106.06639 §3.2 — the polynomial family is the paper's ``s(t) =
+1/(1+t)^a``; ``hinge`` tolerates a grace window before decaying):
+
+* ``constant``:    ``1.0`` — staleness ignored.  With
+  ``async_buffer_size == cohort`` this reproduces synchronous FedAvg
+  bit-exactly (the equivalence test in ``tests/test_async_fl.py``).
+* ``polynomial``:  ``(1 + s) ** -alpha``.
+* ``hinge``:       ``1.0`` for ``s <= b``, else ``1 / (1 + alpha*(s-b))``.
+
+Two callables cover both execution surfaces: :func:`staleness_weight` is
+the host-side scalar form (message-plane servers, sp simulator) and
+:func:`staleness_weights` is the jit-traceable array form the XLA in-mesh
+strategy folds into its one-program flush.
+"""
+
+from __future__ import annotations
+
+ASYNC_STALENESS_POLICIES = ("constant", "polynomial", "hinge")
+
+
+def _check_policy(policy: str) -> str:
+    p = str(policy).lower()
+    if p not in ASYNC_STALENESS_POLICIES:
+        raise ValueError(
+            f"async_staleness_policy must be one of {ASYNC_STALENESS_POLICIES}, "
+            f"got {policy!r}")
+    return p
+
+
+def staleness_weight(policy: str, staleness: float, alpha: float = 0.5,
+                     hinge_b: int = 4) -> float:
+    """Scalar weight multiplier for one delta of the given staleness."""
+    p = _check_policy(policy)
+    s = float(staleness)
+    if s < 0:
+        raise ValueError(f"staleness must be >= 0, got {s}")
+    if p == "constant":
+        return 1.0
+    if p == "polynomial":
+        return float((1.0 + s) ** -float(alpha))
+    b = float(hinge_b)
+    if s <= b:
+        return 1.0
+    return float(1.0 / (1.0 + float(alpha) * (s - b)))
+
+
+def staleness_weights(policy: str, staleness, alpha: float = 0.5,
+                      hinge_b: int = 4):
+    """Array form of :func:`staleness_weight` — pure ``jnp`` ops on an
+    f32 staleness vector, safe inside jit (the policy is a static Python
+    branch, the staleness values are traced)."""
+    import jax.numpy as jnp
+
+    p = _check_policy(policy)
+    s = jnp.asarray(staleness, jnp.float32)
+    if p == "constant":
+        return jnp.ones_like(s)
+    if p == "polynomial":
+        return (1.0 + s) ** jnp.float32(-float(alpha))
+    b = jnp.float32(float(hinge_b))
+    return jnp.where(s <= b, jnp.float32(1.0),
+                     1.0 / (1.0 + jnp.float32(float(alpha)) * (s - b)))

@@ -159,12 +159,17 @@ class RoundTimeoutMixin:
         'complete' | 'quorum' | 'deadline'; ``got`` the closing indices)."""
 
     # -- timers --------------------------------------------------------------
-    def _start_phase_timer(self, attr: str, callback) -> None:
-        """(lock held) Arm the daemon timer at ``attr``, generation-tagged."""
-        old = getattr(self, attr)
+    def _start_phase_timer(self, attr: str, callback,
+                           delay: Optional[float] = None) -> None:
+        """(lock held) Arm the daemon timer at ``attr``, generation-tagged.
+        ``delay`` defaults to ``round_timeout_s``; the async flush deadline
+        passes its own (both are *relative* delays — no wall-clock math)."""
+        old = getattr(self, attr, None)
         if old is not None:
             old.cancel()
-        t = threading.Timer(self.round_timeout_s, callback, args=(self._gen,))
+        t = threading.Timer(
+            self.round_timeout_s if delay is None else float(delay),
+            callback, args=(self._gen,))
         t.daemon = True
         t.start()
         setattr(self, attr, t)

@@ -102,6 +102,29 @@ class PopulationManager:
     def quorum_reached(self) -> bool:
         return len(self._reported) >= self.quorum
 
+    # -- async surface (core/async_fl) ---------------------------------------
+    def begin_cycle(self, round_idx: int, k: int) -> None:
+        """Open accounting for a buffered-async cycle WITHOUT a policy draw:
+        async dispatches arrive incrementally (:meth:`note_dispatch`) — the
+        flush wave, mid-cycle fast-client re-invites, rejoin resyncs — so
+        the invite list grows as the cycle runs instead of being fixed at
+        open."""
+        self._round_idx = int(round_idx)
+        self._target_k = int(k)
+        self._invited = []
+        self._reported = set()
+        self._rejected_late = 0
+
+    def note_dispatch(self, client_id: int) -> None:
+        """One async dispatch: count the invite and grow the cycle's
+        invite list (reports from clients dispatched in *earlier* cycles
+        still land through :meth:`note_report` — membership is not
+        required there)."""
+        cid = int(client_id)
+        self.registry.note_invited([cid], 0 if self._round_idx is None
+                                   else self._round_idx)
+        self._invited.append(cid)
+
     # -- crash-recovery surface (core/checkpoint.ServerRecoveryMixin) --------
     def export_registry(self) -> Dict[str, Any]:
         return self.registry.state_columns()
@@ -132,15 +155,19 @@ class PopulationManager:
         self.registry.note_rejoin(int(client_id))
 
     def close_round(self, reason: str = "complete",
-                    seconds: Optional[float] = None) -> Dict[str, Any]:
+                    seconds: Optional[float] = None,
+                    fail_missing: bool = True) -> Dict[str, Any]:
         """Close the open round: invited-but-missing become failures, and
-        one ``cohort_stats`` record is emitted."""
+        one ``cohort_stats`` record is emitted.  Async flush cycles pass
+        ``fail_missing=False`` — an invitee that has not reported is still
+        *in flight* (its delta lands in a later cycle), not failed."""
         r = self._round_idx if self._round_idx is not None else 0
         missing = [c for c in self._invited if c not in self._reported]
-        if missing:
+        if missing and fail_missing:
             self.registry.note_failures(missing, r)
         stats = self._stats(r, len(self._invited), len(self._reported),
-                            len(missing), self._rejected_late, reason, seconds)
+                            len(missing) if fail_missing else 0,
+                            self._rejected_late, reason, seconds)
         self._round_idx = None
         return stats
 

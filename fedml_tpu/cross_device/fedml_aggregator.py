@@ -134,16 +134,7 @@ class FedMLAggregator:
             obs.histogram_observe(
                 "agg.step_seconds", time.perf_counter() - t0,
                 labels={"path": "host", "mode": "mean"})
-        # preserve integer leaves (e.g. step counters) by casting back to the
-        # current global dtype template (round first: a float64 weighted sum
-        # of equal ints lands epsilon below the true value and astype truncates)
-        template = flatten_params(self.variables)
-        merged = {}
-        for name in acc:
-            dt = template[name].dtype if name in template else np.dtype(np.float32)
-            v = np.rint(acc[name]) if np.issubdtype(dt, np.integer) else acc[name]
-            merged[name] = v.astype(dt)
-        self.variables = unflatten_params(merged)
+        merged = self._install_merged(acc)
         # uploads are consumed — delete them or a long run fills the disk
         for path in self.model_file_dict.values():
             try:
@@ -152,6 +143,52 @@ class FedMLAggregator:
                 pass
         self.model_file_dict = {}
         self.sample_num_dict = {}
+        return merged
+
+    def aggregate_buffered(self, weighted_updates) -> Dict[str, np.ndarray]:
+        """Async-flush aggregate: the caller (core/async_fl) supplies
+        ``(weight, flat_params)`` pairs directly — params were loaded from
+        the upload files at accept time, and the weights already carry the
+        ``n_samples * staleness_weight`` discount.  The sync slot tables
+        (``model_file_dict`` etc.) are untouched; upload-file cleanup is the
+        server manager's ``_async_after_flush`` job, because the files must
+        outlive the flush until the successor cycle's snapshot is durable."""
+        if str(getattr(self.args, "agg_plane", "host") or "host") == "compiled":
+            from ..parallel.agg_plane import plane_for
+
+            reduced = plane_for(self.args).aggregate(
+                list(weighted_updates), mode="mean")
+            acc: Dict[str, np.ndarray] = {
+                name: np.asarray(v) for name, v in reduced.items()}
+        else:
+            t0 = time.perf_counter()
+            total = sum(w for w, _ in weighted_updates) or 1.0
+            acc = {}
+            for w, flat in weighted_updates:
+                frac = w / total
+                for name, arr in flat.items():
+                    contrib = np.asarray(arr).astype(np.float64) * frac
+                    acc[name] = contrib if name not in acc else acc[name] + contrib
+            obs.histogram_observe(
+                "agg.step_seconds", time.perf_counter() - t0,
+                labels={"path": "host", "mode": "mean"})
+        logger.info("buffered aggregate of %d deltas plane=%s",
+                    len(weighted_updates),
+                    getattr(self.args, "agg_plane", "host") or "host")
+        return self._install_merged(acc)
+
+    def _install_merged(self, acc: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Cast an accumulated flat dict back through the current global
+        dtype template and install it as the new global.  Preserves integer
+        leaves (e.g. step counters) — round first: a float64 weighted sum of
+        equal ints lands epsilon below the true value and astype truncates."""
+        template = flatten_params(self.variables)
+        merged = {}
+        for name in acc:
+            dt = template[name].dtype if name in template else np.dtype(np.float32)
+            v = np.rint(acc[name]) if np.issubdtype(dt, np.integer) else acc[name]
+            merged[name] = v.astype(dt)
+        self.variables = unflatten_params(merged)
         return merged
 
     # -- eval (reference :141 test_on_server_for_all_clients) ----------------

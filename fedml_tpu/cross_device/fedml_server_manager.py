@@ -20,18 +20,21 @@ import os
 from typing import Any, Dict, List, Optional
 
 from ..core import obs
+from ..core.async_fl import AsyncBufferedServerMixin
 from ..core.checkpoint import ServerRecoveryMixin
 from ..core.distributed.comm_manager import FedMLCommManager
 from ..core.distributed.communication.message import Message
 from ..core.distributed.straggler import RoundTimeoutMixin
 from ..core.obs.rounds import RoundObsMixin
 from ..core.population import PopulationPacingMixin
+from .edge_model import load_edge_model
 from .message_define import MNNMessage
 
 logger = logging.getLogger(__name__)
 
 
 class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
+                         AsyncBufferedServerMixin,
                          PopulationPacingMixin, RoundTimeoutMixin,
                          FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0,
@@ -53,6 +56,12 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         # fleet registry + selection policy + pacer (core/population)
         self.init_population(args, list(range(1, self.client_num + 1)),
                              rng_style="pcg64")
+        # buffered-async execution (fl_mode=async): buffer + staleness
+        # scheduler + version-tagged in-flight table (core/async_fl)
+        self.init_async_fl(args)
+        # accepted-upload file per (sender, version): deleted only once the
+        # flush that consumed the delta has a durable successor snapshot
+        self._async_files: Dict[tuple, str] = {}
         # crash recovery last: a restore overwrites round_idx / participant
         # list / registry columns and replays the open round's journal
         self.init_server_recovery(args)
@@ -60,6 +69,10 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             # restored mid-round: hold the open round's root span without
             # re-emitting its start (the dead incarnation opened it)
             self._obs_adopt_round()
+            if self.async_enabled:
+                # the snapshot's participants are the run's pool; their
+                # ONLINE re-reports resync them into the open cycle
+                self._async_active.update(self.client_id_list_in_this_round)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler("connection_ready", self._on_connection_ready)
@@ -94,6 +107,9 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         and waiting for the run to end would waste every rejoining device."""
         if self._finished:
             self._send_safe(Message(MNNMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
+            return
+        if self.async_enabled:
+            self._async_resync(client_id)
             return
         if client_id not in self.client_id_list_in_this_round:
             return
@@ -135,12 +151,20 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                 m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
                 obs.inject(m, inv.ctx)
                 self._send_safe(m)
+        if self.async_enabled:
+            # cycle 0: the wave above is the initial dispatch; from here on
+            # the flush loop re-dispatches (no round timer in async mode)
+            self._async_note_dispatch_wave(self.client_id_list_in_this_round)
+            return
         self._arm_round_timer()
 
     def _on_model_from_client(self, msg: Message) -> None:
         sender = int(msg.get_sender_id())
         with self._round_lock:
             if self._finished:
+                return
+            if self.async_enabled:
+                self._async_on_model(msg, sender)
                 return
             if self._is_stale_upload(msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, None), sender):
                 return
@@ -203,6 +227,75 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         if closing_root is not None:
             closing_root.end(reason="closed")
 
+    # -- AsyncBufferedServerMixin hooks (core/async_fl) ----------------------
+    def _async_on_model(self, msg: Message, sender: int) -> None:
+        """(lock held) File-plane async accept: load the uploaded file into
+        a flat params dict for the buffer; the journal records only the FILE
+        path (``journal_params=False``) like the sync path does.  The file
+        outlives the flush that consumes it (see ``_async_after_flush``) —
+        a crash between flush and the successor snapshot replays it."""
+        model_file = str(msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE))
+        n = msg.get(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        tag = msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, None)
+        try:
+            params = load_edge_model(model_file)
+        except Exception as e:
+            logger.warning("dropping unreadable upload file %s from device "
+                           "%d: %s", model_file, sender, e)
+            return
+        key = (int(sender), None if tag is None else int(tag))
+        self._async_files[key] = model_file
+        accepted = self._async_handle_upload(
+            sender, params, n, tag, parent_ctx=obs.extract(msg),
+            journal_extra={"model_file": model_file}, journal_params=False)
+        if not accepted:
+            # dropped (dup/stale/untagged): its file is dead weight now
+            self._async_files.pop(key, None)
+            try:
+                os.remove(model_file)
+            except OSError:
+                pass
+
+    def _async_send_model(self, client_id: int, parent_ctx=None) -> None:
+        model_file = self.aggregator.get_global_model_params_file(self.args.round_idx)
+        m = Message(MNNMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
+        m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, model_file)
+        m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_INDEX, client_id - 1)
+        m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+        obs.inject(m, parent_ctx)
+        self._send_safe(m)
+
+    def _async_eval_round(self, round_idx: int) -> None:
+        # appends to the AGGREGATOR's eval_history itself (the manager has
+        # none — see _round_start_extras)
+        self.aggregator.test_on_server_for_all_clients(int(round_idx))
+
+    def _async_replay_params(self, record: Dict[str, Any]):
+        model_file = str(record.get("model_file", ""))
+        if not model_file or not os.path.exists(model_file):
+            logger.warning("journal replay: upload file %s vanished; device "
+                           "%s will be re-synced", model_file or "<missing>",
+                           record.get("sender"))
+            return None
+        try:
+            params = load_edge_model(model_file)
+        except Exception as e:
+            logger.warning("journal replay: unreadable upload file %s: %s",
+                           model_file, e)
+            return None
+        v = int(record.get("version", record.get("round_idx", 0)))
+        self._async_files[(int(record["sender"]), v)] = model_file
+        return params
+
+    def _async_after_flush(self, entries) -> None:
+        for e in entries:
+            path = self._async_files.pop((e.sender, e.version), None)
+            if path:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
     # -- ServerRecoveryMixin hooks (core/checkpoint.py) ----------------------
     def _capture_global_params(self):
         return self.aggregator.export_state()
@@ -224,6 +317,8 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         """Re-insert one journaled upload.  The journal holds the upload's
         FILE path, not its tensors — if the file is gone (tmpdir wipe), the
         entry is dropped and the device is re-synced like any straggler."""
+        if self.async_enabled:
+            return self._async_replay_upload(record)
         sender = int(record["sender"])
         if sender not in self.client_id_list_in_this_round:
             return False

@@ -86,6 +86,24 @@ class FedMLAggregator:
                     getattr(self.args, "agg_plane", "host") or "host")
         return averaged
 
+    def aggregate_buffered(self, weighted_updates: List[Tuple[float, Any]]):
+        """Async-flush aggregate: the caller (core/async_fl) supplies the
+        ``(weight, params)`` list directly — weights already carry the
+        ``n_samples * staleness_weight`` discount and the list is in the
+        buffer's canonical drain order.  Runs the same ServerAggregator
+        hook chain (and therefore the same ``agg_plane`` routing) as
+        :meth:`aggregate`, so a constant-weight full-cohort flush is
+        bit-identical to the sync path."""
+        t0 = time.time()
+        raw = self.aggregator.on_before_aggregation(list(weighted_updates))
+        averaged = self.aggregator.aggregate(raw)
+        averaged = self.aggregator.on_after_aggregation(averaged)
+        self.aggregator.set_model_params(averaged)
+        logger.info("buffered aggregate of %d deltas in %.3fs plane=%s",
+                    len(raw), time.time() - t0,
+                    getattr(self.args, "agg_plane", "host") or "host")
+        return averaged
+
     # -- participant selection (reference :87-135) --------------------------
     def data_silo_selection(self, round_idx: int, data_silo_num_in_total: int, client_num_in_total: int) -> List[int]:
         """Map each of ``client_num_in_total`` FL client processes to a data

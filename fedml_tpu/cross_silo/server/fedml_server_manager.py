@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ...core import obs
+from ...core.async_fl import AsyncBufferedServerMixin
 from ...core.checkpoint import ServerRecoveryMixin
 from ...core.distributed.comm_manager import FedMLCommManager
 from ...core.distributed.communication.message import Message
@@ -35,8 +36,8 @@ logger = logging.getLogger(__name__)
 
 
 class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
-                         PopulationPacingMixin, RoundTimeoutMixin,
-                         FedMLCommManager):
+                         AsyncBufferedServerMixin, PopulationPacingMixin,
+                         RoundTimeoutMixin, FedMLCommManager):
     def __init__(self, args, aggregator, comm=None, client_rank: int = 0, client_num: int = 0, backend: str = "LOOPBACK"):
         super().__init__(args, comm, client_rank, client_num + 1, backend)
         self.aggregator = aggregator
@@ -56,6 +57,9 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         # uniform policy reproduces client_selection's legacy pcg64 schedule
         self.init_population(args, list(range(1, self.client_num + 1)),
                              rng_style="pcg64")
+        # buffered-async mode (core/async_fl) — needs the population
+        # registry, must precede recovery (journal replay fills the buffer)
+        self.init_async_fl(args)
         # crash recovery last: a restore overwrites round_idx / participant
         # list / registry columns and replays the open round's journal
         self.init_server_recovery(args)
@@ -63,6 +67,10 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             # restored mid-round: hold the open round's root span without
             # re-emitting its start (the dead incarnation opened it)
             self._obs_adopt_round()
+            if self.async_enabled:
+                # the snapshot's participants are the run's pool; their
+                # ONLINE re-reports resync them into the open cycle
+                self._async_active.update(self.client_id_list_in_this_round)
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -109,6 +117,9 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             # waiting for a FINISH that already went to its dead predecessor
             self._send_safe(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
             return
+        if self.async_enabled:
+            self._async_resync(client_id)
+            return
         if client_id not in self.client_id_list_in_this_round:
             return  # sitting this round out; selection may pick it up later
         pos = self.client_id_list_in_this_round.index(client_id)
@@ -149,6 +160,11 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                 # clients parent their train/upload spans under the invite
                 obs.inject(m, inv.ctx)
                 self._send_safe(m)
+        if self.async_enabled:
+            # cycle 0 of the buffered mode: the init fan-out IS the first
+            # dispatch wave; the flush deadline replaces the round timer
+            self._async_note_dispatch_wave(self.client_id_list_in_this_round)
+            return
         self._arm_round_timer()
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
@@ -158,9 +174,10 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         with self._round_lock:
             if self._finished:
                 return
-            if self._is_stale_upload(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, None), sender):
+            if not self.async_enabled and self._is_stale_upload(
+                    msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, None), sender):
                 return
-            if sender not in self.client_id_list_in_this_round:
+            if not self.async_enabled and sender not in self.client_id_list_in_this_round:
                 logger.warning("dropping upload from non-participant %d", sender)
                 return
             raw = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
@@ -168,7 +185,8 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             model_params = maybe_decompress_update(raw)
             if is_delta:
                 # compressed uploads carry the UPDATE; rebase onto the global
-                # params this round distributed
+                # params this round distributed (async: onto the CURRENT
+                # global — delta-application semantics, docs/ASYNC.md)
                 import jax
                 import jax.numpy as jnp
 
@@ -177,6 +195,14 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                     lambda g, d: jnp.asarray(g) + jnp.asarray(d), base, model_params
                 )
             local_sample_number = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+            if self.async_enabled:
+                # buffered mode: the version tag + in-flight match replace
+                # the round-tag staleness check and the participant gate
+                self._async_handle_upload(
+                    sender, model_params, local_sample_number,
+                    msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, None),
+                    parent_ctx=obs.extract(msg))
+                return
             # durably journal the accepted upload BEFORE it enters the slot
             # table; the transport ack goes out only after this handler
             # returns, so an acked upload is always journaled.  False means
@@ -272,6 +298,21 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         for client_id in range(1, self.client_num + 1):
             self._send_safe(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
 
+    # -- AsyncBufferedServerMixin hook (core/async_fl/server.py) -------------
+    def _async_send_model(self, client_id: int, parent_ctx=None) -> None:
+        """(lock held) One async dispatch: current global + version tag (the
+        client echoes the tag on its upload — the staleness bookkeeping
+        rides the existing wire)."""
+        cid = int(client_id)
+        m = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, cid)
+        m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                     self.aggregator.get_global_model_params())
+        m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                     self.data_silo_index_of_client.get(cid, cid - 1))
+        m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
+        obs.inject(m, parent_ctx)
+        self._send_safe(m)
+
     # -- ServerRecoveryMixin hooks (core/checkpoint.py) ----------------------
     def _capture_global_params(self):
         return self.aggregator.get_global_model_params()
@@ -300,6 +341,8 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
     def _replay_upload(self, record: Dict[str, Any]) -> bool:
         """Push one journaled upload back into the aggregator slot table —
         the same inserts the live handler performs, minus the transport."""
+        if self.async_enabled:
+            return self._async_replay_upload(record)
         sender = int(record["sender"])
         if sender not in self.client_id_list_in_this_round:
             return False

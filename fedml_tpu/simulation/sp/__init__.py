@@ -15,6 +15,16 @@ def create_sp_algorithm(optimizer: str, args, device, dataset, model):
 
 def _dispatch(optimizer: str, args, device, dataset, model):
     opt = optimizer.lower()
+    if str(getattr(args, "fl_mode", "sync") or "sync").lower() == "async":
+        # buffered-async execution (core/async_fl) replaces the round loop;
+        # only the FedAvg aggregation rule has an async counterpart so far
+        if opt != "fedavg":
+            raise ValueError(
+                f"fl_mode=async supports federated_optimizer 'fedavg' only "
+                f"in the sp simulator (got {optimizer!r})")
+        from .async_fedavg.fedbuff_api import FedBuffAPI
+
+        return FedBuffAPI(args, device, dataset, model)
     if opt == "fedavg":
         from .fedavg.fedavg_api import FedAvgAPI
 

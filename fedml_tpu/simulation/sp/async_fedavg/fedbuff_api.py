@@ -1,0 +1,154 @@
+"""Single-process FedBuff simulator (``fl_mode=async``).
+
+Buffered-async counterpart of :class:`~..fedavg.fedavg_api.FedAvgAPI`,
+sharing the exact execution model of the message-plane servers
+(``core/async_fl``): a deterministic virtual-arrival-time queue orders
+client report events (per-client simulated durations drawn once from
+``random_seed``); the server parks each accepted delta in an
+:class:`~....core.async_fl.UpdateBuffer` and flushes through
+``server_update`` once ``async_buffer_size`` deltas accrue.  Staleness is
+flushes missed (global version - version trained against) and discounts
+the aggregation weight via ``async_staleness_policy``.  ``comm_round``
+counts flushes.
+
+Unlike :class:`~.async_fedavg_api.AsyncFedAvgAPI` (per-update mixing, its
+own alpha/beta knobs), this class trains each client against the PINNED
+global it was dispatched (a by-version params ring), so a run is
+bit-reproducible from ``random_seed`` alone — and under full
+participation (``client_num_per_round == client_num_in_total``, so the
+sync loop's per-round draw equals the fixed cohort) with
+``async_buffer_size == cohort``, ``async_max_staleness == 0`` and the
+``constant`` policy it is bit-identical to the sync FedAvg loop (every
+cycle collects the full cohort at staleness 0 with weight ``n * 1.0``,
+drained in the same 0..k-1 client order the sync loop folds).
+
+The cohort is the round-0 population draw and stays fixed for the run,
+matching the message-plane servers (async cycles re-dispatch the same
+participant pool; there is no per-cycle re-selection).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ....core import obs
+from ....core.async_fl import UpdateBuffer, VirtualArrivalQueue
+from ..fedavg.fedavg_api import FedAvgAPI
+
+logger = logging.getLogger(__name__)
+
+
+class FedBuffAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model):
+        super().__init__(args, device, dataset, model)
+        per_round = int(args.client_num_per_round)
+        cap = int(getattr(args, "async_buffer_size", 0) or 0) or per_round
+        if cap > per_round:
+            logger.warning("async_buffer_size=%d exceeds the cohort (%d): "
+                           "clamping", cap, per_round)
+            cap = per_round
+        self.buffer = UpdateBuffer(
+            capacity=cap,
+            policy=str(getattr(args, "async_staleness_policy", "constant")
+                       or "constant"),
+            alpha=float(getattr(args, "async_staleness_alpha", 0.5) or 0.5),
+            hinge_b=int(getattr(args, "async_hinge_b", 4) or 4),
+        )
+        self.max_staleness = int(getattr(args, "async_max_staleness", 0) or 0)
+        rng = np.random.RandomState(int(getattr(args, "random_seed", 0)))
+        # heterogeneous simulated round durations per client (same draw
+        # idiom as AsyncFedAvgAPI: reproducible from the seed alone)
+        self.durations = 0.5 + rng.exponential(
+            1.0, size=int(args.client_num_in_total))
+
+    def train(self) -> Dict[str, Any]:
+        total_flushes = int(self.args.comm_round)
+        # 0 disables periodic eval (final-flush eval still runs)
+        freq = int(getattr(self.args, "frequency_of_the_test", 5)) or (1 << 30)
+        cohort = self._client_sampling(0)
+
+        version = 0
+        # pinned globals by version: a client trains against the exact model
+        # it was dispatched, however stale it is by the time it reports
+        params_ring: Dict[int, Any] = {0: self.w_global}
+        dispatched_version: Dict[int, int] = {}
+        queue = VirtualArrivalQueue()
+        for cid in cohort:
+            dispatched_version[cid] = 0
+            queue.push(cid, float(self.durations[cid]))
+
+        slot = self.client_list[0]
+        flushes = 0
+        dropped_stale = 0
+        last: Dict[str, Any] = {}
+        # one root span per cycle (version) so a traced async run keeps the
+        # round → phases tree shape trace_report asserts on
+        rsp = obs.round_span(version, mode="simulation_sp_async")
+        while flushes < total_flushes:
+            t, cid = queue.pop()
+            v_dispatch = dispatched_version[cid]
+            staleness = version - v_dispatch
+            if staleness > self.max_staleness:
+                # too stale to aggregate: fresh work beats idling
+                dropped_stale += 1
+                obs.counter_inc("async.dropped_stale")
+                dispatched_version[cid] = version
+                queue.push(cid, t + float(self.durations[cid]))
+                continue
+            # deterministic per-cycle RNG stream: the version trained
+            # against IS the sync loop's round_idx in the equivalence config
+            self.trainer.round_idx = v_dispatch
+            slot.update_local_dataset(
+                cid,
+                self.train_data_local_dict[cid],
+                self.test_data_local_dict[cid],
+                self.train_data_local_num_dict[cid],
+            )
+            with obs.span("client.train", rsp.ctx, round_idx=version,
+                          client=int(cid), staleness=int(staleness)):
+                w = slot.train(params_ring[v_dispatch])
+            self.buffer.add(cid, w, float(slot.local_sample_number),
+                            version=v_dispatch, staleness=staleness)
+            obs.histogram_observe("async.staleness", float(staleness))
+            obs.gauge_set("async.buffer_occupancy", float(len(self.buffer)))
+            if self.max_staleness >= 1 and not self.buffer.ready():
+                # FedBuff: the client keeps training while its delta waits
+                dispatched_version[cid] = version
+                queue.push(cid, t + float(self.durations[cid]))
+            if not self.buffer.ready():
+                continue
+
+            entries = self.buffer.drain()
+            stats = UpdateBuffer.staleness_stats(entries)
+            with obs.span("buffer.flush", rsp.ctx, round_idx=version,
+                          n_deltas=len(entries), reason="full",
+                          capacity=self.buffer.capacity, **stats):
+                self.w_global = self.server_update(self.buffer.weighted(entries))
+                self.aggregator.set_model_params(self.w_global)
+            obs.counter_inc("async.flushes", labels={"reason": "full"})
+            obs.gauge_set("async.buffer_occupancy", 0.0)
+            version += 1
+            params_ring[version] = self.w_global
+            for v in [v for v in params_ring
+                      if v < version - self.max_staleness]:
+                del params_ring[v]
+            self.metrics.log({"flush": flushes, "version": version,
+                              "n_deltas": len(entries),
+                              "dropped_stale": dropped_stale, **stats})
+            # re-dispatch every idle contributor on the fresh global
+            in_flight = set(queue.clients())
+            for c in cohort:
+                if c not in in_flight:
+                    dispatched_version[c] = version
+                    queue.push(c, t + float(self.durations[c]))
+            if flushes % freq == 0 or flushes == total_flushes - 1:
+                last = self._test_global(flushes)
+            flushes += 1
+            rsp.end(reason="flush")
+            obs.maybe_export_metrics()
+            if flushes < total_flushes:
+                rsp = obs.round_span(version, mode="simulation_sp_async")
+        return last

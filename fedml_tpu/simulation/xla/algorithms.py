@@ -453,6 +453,90 @@ class AsyncFedAvgInMesh(InMeshAlgorithm):
         return {"d": unravel(d_vec), "k": jnp.sum(r)}
 
 
+class FedBuffInMesh(InMeshAlgorithm):
+    """Buffered-async FedBuff flush (``fl_mode=async``) — the in-mesh twin
+    of ``sp/async_fedavg/fedbuff_api.py`` and the message-plane servers'
+    ``core/async_fl`` flush: each compiled round aggregates ONE buffer's
+    worth of arrivals with weights ``n_i * staleness_weight(policy, s_i)``
+    and the staleness values come from the simulator's host-side virtual
+    arrival queue (``fed_sim`` drives ``set_staleness`` before each round).
+    Like :class:`AsyncFedAvgInMesh`, clients train from the CURRENT global
+    (the discount models staleness; the stale-weights effect is not
+    simulated in-mesh — the sp FedBuffAPI pins per-version globals when
+    that effect matters).  With ``async_max_staleness == 0`` every arrival
+    has staleness 0, so the approximation is exact there."""
+
+    aggregates_via_acc = False
+
+    def __init__(self, args):
+        super().__init__(args)
+        from ...core.async_fl.staleness import _check_policy
+
+        self.policy = str(getattr(args, "async_staleness_policy", "constant")
+                          or "constant")
+        _check_policy(self.policy)
+        self.s_alpha = float(getattr(args, "async_staleness_alpha", 0.5) or 0.5)
+        self.hinge_b = int(getattr(args, "async_hinge_b", 4) or 4)
+        self._staleness: Dict[int, float] = {}
+
+    def set_staleness(self, mapping: Dict[int, float]) -> None:
+        """Host driver hook: this flush's per-client staleness (flushes the
+        delta missed; clients absent from the map get 0)."""
+        self._staleness = {int(k): float(v) for k, v in mapping.items()}
+
+    def gather_client_extras(self, client_state, ids, real, round_idx):
+        return jnp.asarray(
+            [self._staleness.get(int(c), 0.0) for c in ids], jnp.float32)
+
+    def _weight(self, w, cex):
+        from ...core.async_fl.staleness import staleness_weights
+
+        return w * staleness_weights(
+            self.policy, cex, alpha=self.s_alpha, hinge_b=self.hinge_b)
+
+    def zero_contrib(self, variables):
+        return {
+            "num": jax.tree_util.tree_map(
+                lambda v: jnp.zeros_like(v, jnp.float32), variables
+            ),
+            "den": jnp.zeros(()),
+        }
+
+    def client_contrib(self, variables, result, w, real, cex, server_state):
+        wi = self._weight(w, cex) * real
+        return {
+            "num": jax.tree_util.tree_map(
+                lambda p: wi * p.astype(jnp.float32), result.variables
+            ),
+            "den": wi,
+        }
+
+    def server_update(self, acc, wsum, ext, variables, server_state):
+        den = jnp.maximum(ext["den"], 1e-9)
+        new = jax.tree_util.tree_map(
+            lambda g, nm: (nm / den).astype(g.dtype), variables, ext["num"]
+        )
+        return new, server_state
+
+    def security_meta(self, taus, cex, real_sel):
+        # staleness, already gathered per slot by gather_client_extras
+        return cex[real_sel]
+
+    def ext_from_rows(self, mat, w, w_orig, meta, g_vec, unravel):
+        # the defended weights already carry the sample counts (selection
+        # defenses zero dropped rows); apply the staleness discount on top —
+        # the sp composition: defenses filter, then the buffer weights
+        wi = self._weight(w, meta)
+        return {"num": unravel(wi @ mat), "den": jnp.sum(wi)}
+
+    def host_state(self):
+        return {"staleness": {str(k): v for k, v in self._staleness.items()}}
+
+    def restore_host_state(self, state):
+        self._staleness = {
+            int(k): float(v) for k, v in state.get("staleness", {}).items()}
+
+
 _REGISTRY = {
     "fedavg": FedAvgInMesh,
     "fedprox": FedAvgInMesh,  # engine grad hook from args.proximal_mu
@@ -470,6 +554,14 @@ _REGISTRY = {
 
 def create_inmesh_algorithm(args) -> InMeshAlgorithm:
     opt = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+    if str(getattr(args, "fl_mode", "sync") or "sync").lower() == "async":
+        # buffered-async execution replaces the round loop (fed_sim's
+        # virtual-arrival driver); only FedAvg aggregation has an async twin
+        if opt != "fedavg":
+            raise ValueError(
+                f"fl_mode=async supports federated_optimizer 'fedavg' only "
+                f"in the XLA simulator (got {opt!r})")
+        return FedBuffInMesh(args)
     cls = _REGISTRY.get(opt)
     if cls is None:
         raise NotImplementedError(

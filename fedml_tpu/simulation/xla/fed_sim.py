@@ -172,6 +172,13 @@ class XLASimulator:
                 int(getattr(args, "comm_round", 1)),
                 seed=int(getattr(args, "random_seed", 0)),
             )
+        # buffered-async execution (fl_mode=async): a host-side virtual
+        # arrival queue decides each flush's cohort + staleness; the
+        # FedBuffInMesh strategy turns them into discounted weights in-mesh
+        self.async_mode = str(
+            getattr(args, "fl_mode", "sync") or "sync").lower() == "async"
+        if self.async_mode:
+            self._async_init()
         from ...ml.aggregator.aggregator_creator import create_server_aggregator
 
         self.aggregator = create_server_aggregator(model, args)
@@ -612,6 +619,85 @@ class XLASimulator:
             self.population.select(round_idx, self.clients_per_round), np.int64
         )
 
+    # ------------------------------------------------------------------
+    # buffered-async virtual-arrival driver (fl_mode=async)
+    # ------------------------------------------------------------------
+    def _async_init(self):
+        """Deterministic virtual-time schedule: per-client durations drawn
+        once from ``random_seed`` (the sp FedBuffAPI idiom), a fixed cohort
+        (the round-0 population draw — async cycles re-dispatch the same
+        pool, matching the message-plane servers), and a flush size of
+        ``async_buffer_size`` arrivals.  Each XLA round is one flush."""
+        from ...core.async_fl import VirtualArrivalQueue
+        from ...core.checkpoint import maybe_checkpointer
+
+        if maybe_checkpointer(self.args) is not None:
+            raise NotImplementedError(
+                "fl_mode=async does not checkpoint mid-run in the XLA "
+                "simulator (the virtual arrival queue is not persisted)")
+        cap = int(getattr(self.args, "async_buffer_size", 0) or 0) \
+            or self.clients_per_round
+        if cap > self.clients_per_round:
+            logger.warning("async_buffer_size=%d exceeds the cohort (%d): "
+                           "clamping", cap, self.clients_per_round)
+            cap = self.clients_per_round
+        self._async_cap = cap
+        self._async_max_staleness = int(
+            getattr(self.args, "async_max_staleness", 0) or 0)
+        rng = np.random.RandomState(int(getattr(self.args, "random_seed", 0)))
+        self._async_durations = 0.5 + rng.exponential(
+            1.0, size=self.num_clients)
+        self._async_cohort = [int(c) for c in self._client_sampling(0)]
+        self._async_version = 0
+        self._async_dispatched = {c: 0 for c in self._async_cohort}
+        self._async_queue = VirtualArrivalQueue()
+        for c in self._async_cohort:
+            self._async_queue.push(c, float(self._async_durations[c]))
+        self._async_t = 0.0
+        self._async_dropped_stale = 0
+
+    def _async_next_flush(self) -> Tuple[np.ndarray, Dict[int, int]]:
+        """Pop arrivals off the virtual queue until one buffer's worth
+        accrues; returns (cohort sorted by id, staleness by id).  Sorting
+        keeps the mesh layout id-deterministic — and makes the
+        full-participation constant-weight config schedule-identical to the
+        sync loop (the arrival ORDER carries no weight information; the
+        staleness map does)."""
+        picked: List[int] = []
+        stal: Dict[int, int] = {}
+        v = self._async_version
+        while len(picked) < self._async_cap:
+            t, cid = self._async_queue.pop()
+            self._async_t = t
+            s = v - self._async_dispatched[cid]
+            if s > self._async_max_staleness:
+                # too stale to aggregate: fresh work beats idling
+                self._async_dropped_stale += 1
+                obs.counter_inc("async.dropped_stale")
+                self._async_dispatched[cid] = v
+                self._async_queue.push(cid, t + float(self._async_durations[cid]))
+                continue
+            picked.append(cid)
+            stal[cid] = int(s)
+            obs.histogram_observe("async.staleness", float(s))
+            if self._async_max_staleness >= 1 and len(picked) < self._async_cap:
+                # FedBuff: the client keeps training while its delta waits
+                self._async_dispatched[cid] = v
+                self._async_queue.push(cid, t + float(self._async_durations[cid]))
+        return np.asarray(sorted(picked), np.int64), stal
+
+    def _async_round_end(self):
+        """The flush applied: bump the version and re-dispatch every idle
+        cohort member on the fresh global at the flush's virtual time."""
+        self._async_version += 1
+        obs.counter_inc("async.flushes", labels={"reason": "full"})
+        in_flight = set(self._async_queue.clients())
+        for c in self._async_cohort:
+            if c not in in_flight:
+                self._async_dispatched[c] = self._async_version
+                self._async_queue.push(
+                    c, self._async_t + float(self._async_durations[c]))
+
     def train(self) -> Dict[str, Any]:
         from ...core.checkpoint import checkpoint_frequency, maybe_checkpointer
 
@@ -663,8 +749,15 @@ class XLASimulator:
             # the whole round is one (or two) compiled XLA programs, so the
             # round root is the only meaningful span here; annotate=True nests
             # it inside the device trace when enable_profiler is on
-            rsp = obs.round_span(round_idx, annotate=True, mode="simulation_xla")
-            sampled = self._client_sampling(round_idx)
+            rsp = obs.round_span(
+                round_idx, annotate=True,
+                mode="simulation_xla_async" if self.async_mode
+                else "simulation_xla")
+            if self.async_mode:
+                sampled, stal_map = self._async_next_flush()
+                self.algo.set_staleness(stal_map)
+            else:
+                sampled = self._client_sampling(round_idx)
             ids, real = self._schedule(sampled)
             counts = np.where(real > 0, np.asarray(self.client_counts)[ids], 0)
             # participation mask as the compiled round sees it: a sampled
@@ -762,6 +855,20 @@ class XLASimulator:
                 )
             self.client_state = self.algo.apply_client_outs(self.client_state, ids, outs)
             self.algo.host_round_end(ids, participated, round_idx)
+            if self.async_mode:
+                # the flush's record span (the aggregation itself ran inside
+                # the compiled round): staleness distribution + buffer shape
+                # for trace_report's async columns
+                svals = list(stal_map.values()) or [0]
+                with obs.span("buffer.flush", rsp.ctx, round_idx=round_idx,
+                              n_deltas=len(sampled), reason="full",
+                              capacity=self._async_cap,
+                              staleness_min=int(min(svals)),
+                              staleness_mean=round(
+                                  float(np.mean(svals)), 4),
+                              staleness_max=int(max(svals))):
+                    pass
+                self._async_round_end()
             # host-side hooks (attack/defense need per-client updates and run
             # in the host path; central DP applies here)
             if dp.is_global_dp_enabled():

@@ -1,0 +1,628 @@
+"""Buffered-async FL (``fedml_tpu/core/async_fl``) — the FedBuff-style
+execution mode layered on PRs 3-6's substrates.
+
+Four strata:
+
+* **Golden** — the staleness-weight policies' closed forms (FedBuff,
+  arXiv:2106.06639 §3.2), scalar and jit-traceable array forms agreeing,
+  and the UpdateBuffer invariants (canonical drain order, per-sender
+  slots, insertion-order-invariant flushes).
+* **Scheduler** — heterogeneity-aware dispatch decisions driven purely by
+  the injected clock and the registry's ``ema_seconds`` column: fast
+  clients re-dispatch immediately, slow clients are paced, hopeless
+  clients are deferred at the flush wave.
+* **Simulators** — sp + XLA async runs are bit-reproducible from the seed
+  alone (deterministic virtual-arrival queue), and under full
+  participation with ``async_buffer_size == cohort``, ``constant``
+  weighting and zero staleness budget they reproduce the sync FedAvg loop
+  BIT-EXACTLY (the equivalence guarantee from docs/ASYNC.md).
+* **Message plane + chaos** — ``fl_mode=async`` end-to-end over LOOPBACK
+  (cross-silo and cross-device), sync-equivalence through the compiled
+  aggregation plane, every ``buffer.flush`` span closed under
+  ``trace_report --assert-closed``, and the crash-safety contract: a
+  ``server_kill`` mid-buffer replays journaled deltas with per-sender
+  dedup and converges bit-identically with exactly-once accounting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import trace_report
+
+import test_fault_tolerance as _ft
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core import mlops, obs
+from fedml_tpu.core.async_fl import (
+    ManualClock,
+    StalenessScheduler,
+    UpdateBuffer,
+    VirtualArrivalQueue,
+    staleness_weight,
+    staleness_weights,
+)
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+from fedml_tpu.core.mlops import FanoutSink, InMemorySink
+from fedml_tpu.core.obs.trace import trace_id_for
+
+# the FedAvg-equivalence knob set: buffer == cohort, no staleness budget,
+# staleness ignored — every cycle collects the full cohort exactly like a
+# synchronous round (see docs/ASYNC.md "Sync equivalence")
+_EQ2 = dict(fl_mode="async", async_buffer_size=2,
+            async_staleness_policy="constant", async_max_staleness=0)
+_EQ3 = dict(fl_mode="async", async_buffer_size=3,
+            async_staleness_policy="constant", async_max_staleness=0)
+
+
+class _TraceArgs:
+    """Minimal args for ``mlops.init``: tracing on, server-side identity."""
+    rank = 0
+
+    def __init__(self, run_id):
+        self.run_id = run_id
+        self.obs_trace = True
+
+
+@pytest.fixture(autouse=True)
+def _obs_hygiene():
+    """obs state is process-global: every test leaves it disabled and the
+    registry empty so no other module inherits a live tracer."""
+    yield
+    obs.shutdown()
+    obs.registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# Golden: staleness-weight closed forms
+# ---------------------------------------------------------------------------
+
+class TestStalenessWeightsGolden:
+    def test_constant_is_always_one(self):
+        for s in range(6):
+            assert staleness_weight("constant", s) == 1.0
+
+    def test_polynomial_closed_form(self):
+        # FedBuff's s(t) = 1/(1+t)^a
+        assert staleness_weight("polynomial", 0, alpha=0.5) == 1.0
+        assert staleness_weight("polynomial", 3, alpha=0.5) == pytest.approx(0.5)
+        assert staleness_weight("polynomial", 1, alpha=1.0) == pytest.approx(0.5)
+        assert staleness_weight("polynomial", 8, alpha=0.5) == pytest.approx(1 / 3)
+
+    def test_hinge_closed_form(self):
+        for s in range(5):  # grace window: s <= b keeps full weight
+            assert staleness_weight("hinge", s, alpha=0.5, hinge_b=4) == 1.0
+        assert staleness_weight("hinge", 6, alpha=0.5, hinge_b=4) == pytest.approx(0.5)
+        assert staleness_weight("hinge", 5, alpha=1.0, hinge_b=4) == pytest.approx(0.5)
+        assert staleness_weight("hinge", 8, alpha=1.0, hinge_b=4) == pytest.approx(0.2)
+
+    def test_array_form_matches_scalar_form(self):
+        s = np.arange(8, dtype=np.float32)
+        for policy in ("constant", "polynomial", "hinge"):
+            arr = np.asarray(staleness_weights(policy, s, alpha=0.7, hinge_b=2))
+            ref = np.asarray(
+                [staleness_weight(policy, float(v), alpha=0.7, hinge_b=2)
+                 for v in s], np.float32)
+            np.testing.assert_allclose(arr, ref, rtol=1e-6)
+
+    def test_bad_policy_and_negative_staleness_raise(self):
+        with pytest.raises(ValueError):
+            staleness_weight("exponential", 1)
+        with pytest.raises(ValueError):
+            staleness_weight("constant", -1)
+
+
+# ---------------------------------------------------------------------------
+# Golden: buffer invariants + flush bit-determinism
+# ---------------------------------------------------------------------------
+
+class TestUpdateBuffer:
+    @staticmethod
+    def _fill(order):
+        buf = UpdateBuffer(capacity=4, policy="polynomial", alpha=0.5)
+        for sender in order:
+            buf.add(sender, {"w": np.full(3, float(sender), np.float32)},
+                    n_samples=10 + sender, version=sender % 2,
+                    staleness=sender % 3)
+        return buf
+
+    def test_flush_is_insertion_order_invariant(self):
+        """Canonical (version, sender) drain: the weighted list the agg
+        plane folds is bit-identical no matter the upload interleaving."""
+        buf_a, buf_b = self._fill([2, 0, 3, 1]), self._fill([1, 3, 0, 2])
+        a, b = buf_a.drain(), buf_b.drain()
+        assert [(e.version, e.sender) for e in a] == \
+            [(e.version, e.sender) for e in b]
+        assert [(e.version, e.sender) for e in a] == \
+            sorted((e.version, e.sender) for e in a)
+        wa, wb = buf_a.weighted(a), buf_b.weighted(b)
+        assert [w for w, _ in wa] == [w for w, _ in wb]
+        for (_, pa), (_, pb) in zip(wa, wb):
+            assert np.array_equal(pa["w"], pb["w"])
+
+    def test_weights_are_n_samples_times_policy(self):
+        buf = self._fill([0, 1, 2, 3])
+        entries = buf.drain()
+        for (w, _), e in zip(buf.weighted(entries), entries):
+            assert w == pytest.approx(
+                (10 + e.sender) * staleness_weight("polynomial", e.staleness,
+                                                   alpha=0.5))
+
+    def test_duplicate_sender_and_negative_staleness_raise(self):
+        buf = UpdateBuffer(capacity=2)
+        buf.add(1, {}, 8.0, version=0, staleness=0)
+        with pytest.raises(ValueError):
+            buf.add(1, {}, 8.0, version=0, staleness=0)
+        with pytest.raises(ValueError):
+            buf.add(2, {}, 8.0, version=3, staleness=-1)
+
+    def test_ready_occupancy_and_stats(self):
+        buf = UpdateBuffer(capacity=2)
+        assert not buf.ready() and buf.occupancy == 0
+        buf.add(5, {}, 1.0, version=0, staleness=2)
+        assert not buf.ready()
+        buf.add(3, {}, 1.0, version=1, staleness=1)
+        assert buf.ready() and buf.senders() == [3, 5]
+        stats = UpdateBuffer.staleness_stats(buf.drain())
+        assert stats == {"staleness_min": 1.0, "staleness_mean": 1.5,
+                         "staleness_max": 2.0}
+        assert len(buf) == 0
+
+    def test_bad_capacity_raises(self):
+        with pytest.raises(ValueError):
+            UpdateBuffer(capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: EMA-driven dispatch decisions on the injected clock
+# ---------------------------------------------------------------------------
+
+class _FakeRegistry:
+    """positions() == identity; just the ema_seconds column the scheduler
+    reads (the real registry is exercised by the topology tests below)."""
+
+    def __init__(self, emas):
+        self.ema_seconds = np.asarray(emas, np.float64)
+
+    def positions(self, ids):
+        return np.asarray(ids, np.int64)
+
+
+class TestStalenessScheduler:
+    def test_fast_clients_redispatch_slow_clients_wait(self):
+        reg = _FakeRegistry([0.1, 1.0, 10.0, 0.0])
+        sched = StalenessScheduler(reg, max_staleness=2, clock=ManualClock())
+        assert sched.redispatch_now(0) is True    # strictly below median (1.0)
+        assert sched.redispatch_now(1) is False   # at the median: hold
+        assert sched.redispatch_now(2) is False   # straggler: hold
+        assert sched.redispatch_now(3) is False   # unobserved: hold
+
+    def test_no_staleness_budget_means_no_early_redispatch(self):
+        reg = _FakeRegistry([0.1, 1.0, 10.0])
+        sched = StalenessScheduler(reg, max_staleness=0, clock=ManualClock())
+        assert sched.redispatch_now(0) is False
+
+    def test_defer_at_flush_uses_flush_period_ema(self):
+        reg = _FakeRegistry([0.1, 5.0])
+        clock = ManualClock()
+        sched = StalenessScheduler(reg, max_staleness=1, clock=clock)
+        assert sched.defer_at_flush(1) is False  # no period observed yet
+        sched.note_flush()
+        clock.advance(1.0)
+        sched.note_flush()
+        assert sched.flush_period_ema == pytest.approx(1.0)
+        # 5.0s EMA > (max_staleness + 1) * 1.0s: training it now is wasted
+        assert sched.defer_at_flush(1) is True
+        assert sched.defer_at_flush(0) is False
+        # the period EMA keeps moving — the decision is re-evaluated
+        clock.advance(8.0)
+        sched.note_flush()
+        assert sched.flush_period_ema > 1.0
+
+    def test_manual_clock_rejects_going_backwards(self):
+        with pytest.raises(ValueError):
+            ManualClock().advance(-1.0)
+
+    def test_virtual_queue_tie_break_is_push_order(self):
+        q = VirtualArrivalQueue()
+        q.push(5, 1.0)
+        q.push(2, 1.0)
+        q.push(9, 0.5)
+        assert q.clients() == [2, 5, 9]
+        assert q.pop() == (0.5, 9)
+        assert q.pop() == (1.0, 5)  # same finish time: dispatch order wins
+        assert q.pop() == (1.0, 2)
+        assert not q
+
+
+# ---------------------------------------------------------------------------
+# sp simulator: seed-determinism + bit-exact sync equivalence
+# ---------------------------------------------------------------------------
+
+def _sp_args(**over):
+    base = {
+        "common_args": {"training_type": "simulation", "random_seed": 0,
+                        "run_id": over.pop("run_id", "async-sp")},
+        "data_args": {"dataset": "mnist", "data_cache_dir": "",
+                      "partition_method": "hetero", "partition_alpha": 0.5,
+                      "synthetic_train_size": 600},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": 4,
+            "client_num_per_round": 4,
+            "comm_round": 2,
+            "epochs": 1,
+            "batch_size": 32,
+            "client_optimizer": "sgd",
+            "learning_rate": 0.1,
+        },
+        "validation_args": {"frequency_of_the_test": 1},
+        "comm_args": {"backend": "sp"},
+    }
+    args = Arguments.from_dict(base)
+    for k, v in over.items():
+        setattr(args, k, v)
+    return args.validate()
+
+
+def _sp_build(args):
+    args = fedml_tpu.init(args, should_init_logs=False)
+    dataset, out_dim = fedml_tpu.data.load(args)
+    model = fedml_tpu.models.create(args, out_dim)
+    return args, dataset, model
+
+
+def _sp_fedbuff(**over):
+    from fedml_tpu.simulation.sp.async_fedavg.fedbuff_api import FedBuffAPI
+
+    args, dataset, model = _sp_build(_sp_args(**over))
+    return FedBuffAPI(args, None, dataset, model)
+
+
+class TestSPFedBuff:
+    def test_sp_sync_equivalence_bit_exact(self):
+        """Full participation + buffer == cohort + constant weighting + zero
+        staleness budget == the sync FedAvg loop, bit for bit."""
+        from fedml_tpu.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+
+        args, dataset, model = _sp_build(_sp_args())
+        sync = FedAvgAPI(args, None, dataset, model)
+        m_sync = sync.train()
+        asyn = _sp_fedbuff(fl_mode="async", async_buffer_size=4,
+                           async_max_staleness=0,
+                           async_staleness_policy="constant")
+        m_async = asyn.train()
+        assert m_sync == m_async
+        assert _ft._trees_bit_identical(sync.w_global, asyn.w_global)
+
+    def test_sp_async_dispatch_raises_on_non_fedavg(self):
+        from fedml_tpu.simulation.sp import create_sp_algorithm
+
+        args, dataset, model = _sp_build(_sp_args(fl_mode="async"))
+        with pytest.raises(ValueError, match="fedavg"):
+            create_sp_algorithm("FedProx", args, None, dataset, model)
+
+    def test_sp_deterministic_traced_and_report_closed(self, tmp_path, capsys):
+        """One buffered run (cohort 4, buffer 2, no staleness budget — late
+        reports are DROPPED and re-dispatched) traced + one untraced: the
+        final models are bit-identical (tracing never perturbs the math),
+        every cycle reconstructs as a closed span tree with its
+        ``buffer.flush`` span, the dropped-stale counter surfaces in the
+        exported metrics, and ``trace_report --assert-closed`` passes while
+        printing the async flush/staleness columns."""
+        knobs = dict(fl_mode="async", async_buffer_size=2,
+                     async_max_staleness=0,
+                     async_staleness_policy="constant", run_id="async-sp-tr")
+        plain = _sp_fedbuff(**knobs)
+        plain.train()
+        mem = InMemorySink()
+        mlops.init(_TraceArgs("async-sp-tr"), FanoutSink([mem]))
+        try:
+            traced = _sp_fedbuff(**knobs)
+            traced.train()
+        finally:
+            mlops.finish()
+        assert _ft._trees_bit_identical(plain.w_global, traced.w_global)
+
+        recs = [dict(rec, topic=t) for t, rec in list(mem.records)
+                if t in trace_report.SPAN_TOPICS]
+        traces = trace_report.build_traces(recs)
+        for r in range(2):
+            tr = traces[trace_id_for("async-sp-tr", r)]
+            assert tr.problems() == [], tr.problems()
+            assert tr.is_async()
+            flushes = tr.flushes()
+            assert len(flushes) == 1
+            assert flushes[0].start["n_deltas"] == 2
+            assert flushes[0].start["capacity"] == 2
+        metric_names = {r["metric"] for r in mem.by_topic("metrics")}
+        assert "async.staleness" in metric_names
+        assert "async.buffer_occupancy" in metric_names
+        assert "async.dropped_stale" in metric_names  # late v0 reports died
+
+        path = tmp_path / "trace.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        assert trace_report.main([str(path), "--assert-closed"]) == 0
+        out = capsys.readouterr().out
+        assert "flush round=" in out
+        assert "time_to_report=" in out  # async straggler metric, not dur
+
+
+# ---------------------------------------------------------------------------
+# XLA simulator: one-program async flush on the virtual mesh
+# ---------------------------------------------------------------------------
+
+def _xla_args(**over):
+    over.setdefault("backend", "XLA")
+    return _sp_args(**over)
+
+
+@pytest.mark.heavy
+class TestXLAAsyncFL:
+    def test_xla_sync_equivalence_bit_exact(self):
+        """Full participation + constant weighting + zero staleness budget:
+        the async virtual-arrival driver collects the whole (id-sorted)
+        cohort every cycle, so the in-mesh flush is schedule-identical to
+        the sync round — bit for bit."""
+        from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+        args_s, ds_s, m_s = _sp_build(_xla_args(partition_method="homo"))
+        sim_sync = XLASimulator(args_s, ds_s, m_s)
+        sim_sync.train()
+        args_a, ds_a, m_a = _sp_build(_xla_args(
+            partition_method="homo", fl_mode="async", async_buffer_size=4,
+            async_max_staleness=0, async_staleness_policy="constant"))
+        sim_async = XLASimulator(args_a, ds_a, m_a)
+        sim_async.train()
+        assert _ft._trees_bit_identical(sim_sync.variables,
+                                        sim_async.variables)
+
+    def test_xla_async_deterministic_traced_and_report_closed(
+            self, tmp_path, capsys):
+        """A genuinely-async XLA config (partial cohorts, staleness budget,
+        polynomial discount) run untraced then traced: bit-identical final
+        models, every cycle's span tree closed with a ``buffer.flush``
+        record, and ``trace_report --assert-closed`` green."""
+        from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+        knobs = dict(client_num_in_total=8, client_num_per_round=4,
+                     fl_mode="async", async_buffer_size=2,
+                     async_max_staleness=2,
+                     async_staleness_policy="polynomial",
+                     run_id="async-xla-tr")
+        args1, ds1, m1 = _sp_build(_xla_args(**knobs))
+        sim1 = XLASimulator(args1, ds1, m1)
+        sim1.train()
+        mem = InMemorySink()
+        mlops.init(_TraceArgs("async-xla-tr"), FanoutSink([mem]))
+        try:
+            args2, ds2, m2 = _sp_build(_xla_args(**knobs))
+            sim2 = XLASimulator(args2, ds2, m2)
+            sim2.train()
+        finally:
+            mlops.finish()
+        assert _ft._trees_bit_identical(sim1.variables, sim2.variables)
+
+        recs = [dict(rec, topic=t) for t, rec in list(mem.records)
+                if t in trace_report.SPAN_TOPICS]
+        traces = trace_report.build_traces(recs)
+        for r in range(2):
+            tr = traces[trace_id_for("async-xla-tr", r)]
+            assert tr.problems() == [], tr.problems()
+            assert tr.is_async()
+            assert len(tr.flushes()) == 1
+        path = tmp_path / "trace.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        assert trace_report.main([str(path), "--assert-closed"]) == 0
+        assert "flush round=" in capsys.readouterr().out
+
+    def test_xla_async_with_checkpointing_is_loudly_unsupported(self,
+                                                                tmp_path):
+        from fedml_tpu.simulation.xla.fed_sim import XLASimulator
+
+        args, ds, m = _sp_build(_xla_args(
+            fl_mode="async", async_buffer_size=2,
+            checkpoint_dir=str(tmp_path / "ckpt")))
+        with pytest.raises(NotImplementedError):
+            XLASimulator(args, ds, m)
+
+
+# ---------------------------------------------------------------------------
+# Cross-silo message plane over LOOPBACK
+# ---------------------------------------------------------------------------
+
+def _run_silo_topology(run_id, n=2, **extra):
+    """1 server + ``n`` silos to completion; returns (history, final
+    params, server)."""
+    from fedml_tpu.cross_silo.server.server import Server
+
+    args_s = _ft._args(run_id, n, **extra)
+    args_s.role, args_s.rank = "server", 0
+    args_s = fedml_tpu.init(args_s, should_init_logs=False)
+    ds, out_dim = fedml_tpu.data.load(args_s)
+    server = Server(args_s, None, ds, fedml_tpu.models.create(args_s, out_dim))
+    clients = [_ft._build_client(run_id, r, n, **extra)
+               for r in range(1, n + 1)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    history = _ft._run_server_bounded(server)
+    _ft._join_all(threads)
+    final = server.server_manager.aggregator.get_global_model_params()
+    return history, final, server
+
+
+class TestCrossSiloAsync:
+    def test_async_loopback_sync_equivalence_through_compiled_plane(self):
+        """The acceptance check: buffer == cohort + constant weighting,
+        both runs flushing through ``agg_plane=compiled`` — async must
+        reproduce the sync FedAvg result bit-exactly."""
+        LoopbackHub.reset()
+        h_sync, f_sync, _ = _run_silo_topology(
+            "async-eq-sync", agg_plane="compiled")
+        LoopbackHub.reset()
+        h_async, f_async, _ = _run_silo_topology(
+            "async-eq-async", agg_plane="compiled", **_EQ2)
+        assert len(h_sync) == len(h_async) == 2
+        assert _ft._trees_bit_identical(f_sync, f_async)
+
+    def test_async_loopback_buffered_run_traced_and_closed(self, tmp_path,
+                                                           capsys):
+        """A genuinely-buffered LOOPBACK run (buffer of 1, staleness budget
+        1: the second silo's delta lands one flush late and is still
+        aggregated, discounted): completes, evals every flush, and every
+        cycle + buffer.flush span closes under --assert-closed."""
+        LoopbackHub.reset()
+        run_id = "async-loop-tr"
+        mem = InMemorySink()
+        mlops.init(_TraceArgs(run_id), FanoutSink([mem]))
+        try:
+            history, final, _ = _run_silo_topology(
+                run_id, fl_mode="async", async_buffer_size=1,
+                async_max_staleness=1, async_staleness_policy="polynomial")
+        finally:
+            mlops.finish()
+        assert len(history) == 2
+        assert 0.0 <= history[-1]["test_acc"] <= 1.0
+
+        recs = [dict(rec, topic=t) for t, rec in list(mem.records)
+                if t in trace_report.SPAN_TOPICS]
+        traces = trace_report.build_traces(recs)
+        for r in range(2):
+            tr = traces[trace_id_for(run_id, r)]
+            assert tr.problems() == [], (r, tr.problems())
+            assert tr.is_async()
+            flushes = tr.flushes()
+            assert len(flushes) == 1
+            assert flushes[0].start["n_deltas"] >= 1
+        metric_names = {r["metric"] for r in mem.by_topic("metrics")}
+        assert "async.staleness" in metric_names
+        assert "async.flushes" in metric_names
+        path = tmp_path / "trace.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        assert trace_report.main([str(path), "--assert-closed"]) == 0
+        assert "flush round=" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Cross-device (Beehive) file plane
+# ---------------------------------------------------------------------------
+
+def _run_device_topology(tmp_path, tag, **extra):
+    from fedml_tpu.cross_device.fake_device import FakeDeviceManager
+    from fedml_tpu.cross_device.fedml_aggregator import FedMLAggregator
+    from fedml_tpu.cross_device.fedml_server_manager import FedMLServerManager
+    from fedml_tpu.models.linear import LogisticRegression
+
+    LoopbackHub.reset()
+    args = Arguments.from_dict({
+        "common_args": {"training_type": "cross_device", "random_seed": 0,
+                        "run_id": f"async-beehive-{tag}"},
+        "data_args": {"dataset": "synthetic"},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": 2, "client_num_per_round": 2,
+            "comm_round": 3, "epochs": 2, "batch_size": 16,
+            "learning_rate": 0.2, **extra,
+        },
+        "validation_args": {"frequency_of_the_test": 1},
+        "comm_args": {"backend": "LOOPBACK"},
+    }).validate()
+    sep = __import__("test_cross_device")._separable
+    x_test, y_test = sep(128, seed=9)
+    aggregator = FedMLAggregator(
+        args, LogisticRegression(output_dim=4), (x_test, y_test),
+        worker_num=2, model_dir=str(tmp_path / f"models-{tag}"))
+    server = FedMLServerManager(args, aggregator, client_rank=0, client_num=2)
+    devices = [
+        FakeDeviceManager(args, rank, sep(96, seed=rank), client_num=2,
+                          upload_dir=str(tmp_path / f"dev{rank}-{tag}"))
+        for rank in (1, 2)
+    ]
+    threads = [server.run_async()] + [d.run_async() for d in devices]
+    for t in threads:
+        t.join(timeout=60)
+    for t in threads:
+        assert not t.is_alive(), "protocol did not terminate"
+    return aggregator, devices, server
+
+
+class TestCrossDeviceAsync:
+    def test_async_file_plane_matches_sync_and_releases_uploads(self,
+                                                                tmp_path):
+        """The equivalence config on the device file plane: bit-identical
+        final model, every device trained every cycle, and every flushed
+        upload file was released after its cycle's snapshot went durable."""
+        agg_s, dev_s, _ = _run_device_topology(tmp_path, "sync")
+        agg_a, dev_a, server = _run_device_topology(tmp_path, "async", **_EQ2)
+        assert [d.rounds_trained for d in dev_a] == \
+            [d.rounds_trained for d in dev_s] == [3, 3]
+        assert agg_a.eval_history and \
+            agg_a.eval_history[-1] == agg_s.eval_history[-1]
+        assert _ft._trees_bit_identical(agg_s.variables, agg_a.variables)
+        assert server._async_files == {}, "flushed upload files not released"
+
+
+# ---------------------------------------------------------------------------
+# Chaos: transport faults + server_kill mid-buffer (exactly-once accounting)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def async_fault_free_final():
+    """The fault-free async reference model every chaos/kill run must
+    bit-match (same claim as the sync chaos suite: the final model is a
+    pure function of config, never of transport weather)."""
+    LoopbackHub.reset()
+    history, final, _ = _ft._run_chaos_topology(
+        "async-ff", knobs=dict(_ft._CHAOS_KNOBS, **_EQ3))
+    assert len(history) == 2
+    return final
+
+
+def test_async_fl_chaos_drop_dup_delay_bit_identical(async_fault_free_final):
+    """The full scripted fault plan (drop + reset + duplicate + delay)
+    against the buffered server: every fault is healed or deduped and the
+    run converges to the bit-identical fault-free async model."""
+    LoopbackHub.reset()
+    history, final, stats = _ft._run_chaos_topology(
+        "async-chaos", fault_plan=_ft._full_chaos_plan(),
+        knobs=dict(_ft._CHAOS_KNOBS, **_EQ3))
+    assert len(history) == 2
+    assert _ft._trees_bit_identical(final, async_fault_free_final)
+    assert stats[2]["faults_reset"] >= 1  # the scripted RST actually fired
+
+
+def test_async_fl_server_kill_mid_buffer_replays_journal(
+        async_fault_free_final, tmp_path):
+    """The crash-safety contract, mid-buffer: the server dies after
+    journaling the first delta of a 3-deep buffer; the restarted
+    incarnation replays the journal INTO the buffer (per-sender dedup),
+    collects the re-sent + still-pending deltas, and finishes with the
+    bit-identical model — no delta applied twice across the restore."""
+    LoopbackHub.reset()
+    history, final, stats, restarts, killed_stats, server = \
+        _ft._run_server_kill_topology("async-kill", tmp_path / "srv",
+                                      knobs=_EQ3)
+    assert restarts >= 1
+    assert len(history) == 2
+    assert _ft._trees_bit_identical(final, async_fault_free_final), \
+        "restarted async run diverged from the fault-free model"
+    assert sum(s.get("faults_killed", 0) for s in killed_stats) >= 1
+    srv = stats[0]
+    assert srv["server_restores"] >= 1
+    assert srv["journal_replays"] >= 1
+    # exactly-once accounting: journal replay + retransmits must not
+    # double-count any report (3 silos x 2 flushes, each counted once)
+    reg = server.server_manager.population.registry.snapshot()
+    assert reg["reported_total"] == 3 * 2, reg

@@ -585,7 +585,7 @@ def _without_kill(plan):
 
 def _run_server_kill_topology(run_id, ckpt_dir, backend="LOOPBACK", n=3,
                               fault_plan=None, comm_extra=None,
-                              max_restarts=3):
+                              max_restarts=3, knobs=None):
     """1 server + ``n`` silos; the server is KILLED mid-round by the fault
     seam and a supervisor loop restarts it from its durable state
     (``server_checkpoint_dir``).  Only incarnation 0 carries the kill rule —
@@ -595,6 +595,7 @@ def _run_server_kill_topology(run_id, ckpt_dir, backend="LOOPBACK", n=3,
     plan = fault_plan if fault_plan is not None else _server_kill_plan()
     client_plan = _without_kill(plan)
     extra = dict(_CHAOS_KNOBS)
+    extra.update(knobs or {})  # e.g. the async_fl suite's fl_mode knobs
     extra["server_checkpoint_dir"] = str(ckpt_dir)
     comm_extra = comm_extra or {}
 

@@ -7,7 +7,10 @@ Runs the fast chaos matrix plus the server-kill/restart tests
 closed span tree even under drop/dup/delay/server_kill) AND the
 compiled-aggregation chaos tests (``tests/test_agg_plane.py`` —
 retransmit/dup chaos with ``agg_plane=compiled`` must converge
-bit-identical to the fault-free host run) N consecutive times in fresh
+bit-identical to the fault-free host run) AND the buffered-async chaos
+tests (``tests/test_async_fl.py`` — drop/dup/delay plus ``server_kill``
+mid-buffer must converge deterministically with exactly-once delta
+accounting) N consecutive times in fresh
 interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
 operators stop believing red — so new fault kinds / backends must hold up
@@ -20,6 +23,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "server_kill"
     python tools/chaos_check.py --runs 3 -k "trace_integrity"
     python tools/chaos_check.py --runs 3 -k "agg_plane"
+    python tools/chaos_check.py --runs 3 -k "async_fl"
 """
 
 from __future__ import annotations
@@ -39,9 +43,10 @@ def main(argv=None) -> int:
                     help="consecutive green runs required (default 3)")
     ap.add_argument(
         "-k", dest="keyword",
-        default="chaos or server_kill or trace_integrity or agg_plane",
+        default="chaos or server_kill or trace_integrity or agg_plane "
+                "or async_fl",
         help='pytest -k selector (default: "chaos or server_kill or '
-             'trace_integrity or agg_plane")')
+             'trace_integrity or agg_plane or async_fl")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     args = ap.parse_args(argv)
@@ -49,6 +54,7 @@ def main(argv=None) -> int:
     env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
     cmd = [sys.executable, "-m", "pytest", "tests/test_fault_tolerance.py",
            "tests/test_obs.py", "tests/test_agg_plane.py",
+           "tests/test_async_fl.py",
            "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
     for i in range(1, args.runs + 1):
         t0 = time.time()

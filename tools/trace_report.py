@@ -17,6 +17,12 @@ span tree per (run, round) trace:
 * **Straggler ranking** — ``client.train`` spans sorted by duration;
   anything slower than ``--slow-factor`` x the round's median is flagged
   (the same factor ``obs_slow_round_factor`` uses online).
+* **Async mode** — a trace whose round span carries an async ``mode`` (or
+  any ``buffer.flush`` span) reports per-flush staleness distribution and
+  buffer occupancy columns, and ranks stragglers by TIME-TO-REPORT (span
+  close relative to the cycle open) instead of train duration: under
+  buffered execution a slow client hurts by *when its delta lands*, not
+  by how long its local step ran.
 
 Durations prefer the end record's monotonic ``duration_s``; adopted ends
 (crash recovery) carry none and fall back to the sink wall-timestamp delta.
@@ -194,18 +200,45 @@ class Trace:
             path.append(nxt)
         return path
 
+    def is_async(self) -> bool:
+        """Buffered-async trace: the round span's ``mode`` says so, or a
+        ``buffer.flush`` span is present (server-lifetime traces)."""
+        for root in self.roots():
+            if "async" in str((root.start or {}).get("mode", "")):
+                return True
+        return any(sn.name == "buffer.flush" for sn in self.spans.values())
+
+    def flushes(self) -> List[SpanNode]:
+        """``buffer.flush`` spans in close order (one per drained buffer)."""
+        return sorted(
+            (sn for sn in self.spans.values()
+             if sn.name == "buffer.flush" and sn.start is not None),
+            key=lambda s: (s.end_ts(), s.span_id))
+
+    def _root_start_ts(self) -> float:
+        roots = self.roots()
+        if roots and isinstance((roots[0].start or {}).get("ts"), (int, float)):
+            return float(roots[0].start["ts"])
+        return 0.0
+
     def stragglers(self, slow_factor: float) -> List[Tuple[SpanNode, float, bool]]:
         """``client.train`` spans ranked slowest-first with their duration
-        and a flag for > slow_factor x median."""
+        (sync) or time-to-report since cycle open (async) and a flag for
+        > slow_factor x median."""
         trains = [sn for sn in self.spans.values()
                   if sn.name == "client.train" and sn.start is not None]
         if not trains:
             return []
-        durs = sorted(sn.duration_s() for sn in trains)
-        median = durs[len(durs) // 2]
-        ranked = sorted(trains, key=lambda s: -s.duration_s())
-        return [(sn, sn.duration_s(),
-                 median > 0 and sn.duration_s() > slow_factor * median)
+        if self.is_async():
+            t0 = self._root_start_ts()
+            metric = lambda sn: max(0.0, sn.end_ts() - t0)  # noqa: E731
+        else:
+            metric = lambda sn: sn.duration_s()  # noqa: E731
+        vals = sorted(metric(sn) for sn in trains)
+        median = vals[len(vals) // 2]
+        ranked = sorted(trains, key=lambda s: -metric(s))
+        return [(sn, metric(sn),
+                 median > 0 and metric(sn) > slow_factor * median)
                 for sn in ranked]
 
 
@@ -253,9 +286,27 @@ def report(traces: Dict[str, Trace], slow_factor: float,
         path = tr.critical_path()
         if path:
             print(f"  critical path: {_fmt_path(path)}", file=out)
+        is_async = tr.is_async()
+        for fl in tr.flushes():
+            st = fl.start or {}
+            n = st.get("n_deltas", "?")
+            cap = st.get("capacity", None)
+            occ = (f"{int(n) / int(cap):.2f}"
+                   if isinstance(n, int) and isinstance(cap, int) and cap
+                   else "?")
+            stal = "/".join(
+                str(st.get(k, "?")) for k in
+                ("staleness_min", "staleness_mean", "staleness_max"))
+            print(f"  flush round={fl.round_idx} n_deltas={n} "
+                  f"capacity={cap} occupancy={occ} "
+                  f"reason={st.get('reason', '?')} "
+                  f"staleness(min/mean/max)={stal} "
+                  f"dur={fl.duration_s():.3f}s", file=out)
+        metric_name = "time_to_report" if is_async else "dur"
         for sn, d, slow in tr.stragglers(slow_factor):
             flag = "  << STRAGGLER" if slow else ""
-            print(f"  client.train node={sn.node}: {d:.3f}s{flag}", file=out)
+            print(f"  client.train node={sn.node}: "
+                  f"{metric_name}={d:.3f}s{flag}", file=out)
         events = [ev for sn in tr.spans.values() for ev in sn.events]
         for ev in events:
             print(f"  event {ev.get('event')}: node={ev.get('node')} "
