@@ -103,6 +103,17 @@ Observability knobs (``tracking_args`` or ``obs_args``; consumed by
   than ``factor * median(previous rounds)`` gets a ``slow_round`` span
   event (straggler flagging in ``tools/trace_report.py`` uses the same
   factor).
+* ``obs_flight_capacity`` (int >= 0, default 2048) — size of the flight
+  recorder's in-memory ring of recent telemetry records; 0 disables the
+  recorder entirely.
+* ``obs_flight_dir`` (path, default unset) — where crc-framed flight
+  dumps land on ``server_kill`` / ``server_restore`` / ``slow_round`` /
+  unhandled handler exceptions.  Unset keeps the ring (inspectable via
+  ``obs.flight_recorder()``) but writes no dumps.
+* ``obs_export_port`` (int 0..65535, default 0) — localhost port for the
+  OpenMetrics pull endpoint (``GET /metrics``); 0 disables HTTP.
+* ``obs_export_path`` (path, default unset) — file that receives atomic
+  OpenMetrics snapshots on each rate-limited export and at shutdown.
 
 Async / buffered-FL knobs (``train_args`` or ``async_args``; consumed by
 ``core/async_fl``, execution model in ``docs/ASYNC.md``):
@@ -366,6 +377,28 @@ class Arguments:
             if sv < 1.0:
                 raise ValueError(
                     f"obs_slow_round_factor must be >= 1.0 (got {sv})")
+        cap = getattr(self, "obs_flight_capacity", None)
+        if cap is not None:
+            try:
+                cv = int(cap)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_flight_capacity must be an integer >= 0 "
+                    f"(got {cap!r})")
+            if cv < 0:
+                raise ValueError(
+                    f"obs_flight_capacity must be >= 0 (got {cv})")
+        port = getattr(self, "obs_export_port", None)
+        if port is not None:
+            try:
+                pv = int(port)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_export_port must be an integer in 0..65535 "
+                    f"(got {port!r})")
+            if not 0 <= pv <= 65535:
+                raise ValueError(
+                    f"obs_export_port must be in 0..65535 (got {pv})")
         # async / buffered-FL knobs (core/async_fl) — a typo'd mode or policy
         # must fail here, not silently run the sync state machine
         mode = getattr(self, "fl_mode", None)

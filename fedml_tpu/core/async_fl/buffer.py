@@ -26,6 +26,22 @@ from typing import Any, Dict, List, Tuple
 from .staleness import _check_policy, staleness_weight
 
 
+def _approx_nbytes(obj: Any) -> int:
+    """Array-leaf byte count of a params pytree, dependency-free (anything
+    exposing ``nbytes`` counts; scalars and exotic leaves count as 0)."""
+    nb = getattr(obj, "nbytes", None)
+    if nb is not None:
+        try:
+            return int(nb)
+        except (TypeError, ValueError):
+            return 0
+    if isinstance(obj, dict):
+        return sum(_approx_nbytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_approx_nbytes(v) for v in obj)
+    return 0
+
+
 @dataclasses.dataclass(frozen=True)
 class BufferedDelta:
     """One accepted client update awaiting a flush."""
@@ -49,6 +65,7 @@ class UpdateBuffer:
         self.alpha = float(alpha)
         self.hinge_b = int(hinge_b)
         self._entries: Dict[int, BufferedDelta] = {}
+        self._bytes = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -62,6 +79,12 @@ class UpdateBuffer:
 
     def senders(self) -> List[int]:
         return sorted(self._entries)
+
+    @property
+    def approx_bytes(self) -> int:
+        """Approximate bytes of buffered delta payloads (array leaves only —
+        the ``async.buffer_bytes`` live-memory gauge)."""
+        return self._bytes
 
     def add(self, sender: int, params: Any, n_samples: float, version: int,
             staleness: int) -> int:
@@ -79,6 +102,7 @@ class UpdateBuffer:
         self._entries[sender] = BufferedDelta(
             sender=sender, params=params, n_samples=float(n_samples),
             version=int(version), staleness=int(staleness))
+        self._bytes += _approx_nbytes(params)
         return len(self._entries)
 
     def drain(self) -> List[BufferedDelta]:
@@ -87,6 +111,7 @@ class UpdateBuffer:
         entries = sorted(self._entries.values(),
                          key=lambda e: (e.version, e.sender))
         self._entries.clear()
+        self._bytes = 0
         return entries
 
     def weighted(self, entries: List[BufferedDelta]) -> List[Tuple[float, Any]]:

@@ -206,6 +206,8 @@ class AsyncBufferedServerMixin:
                                     version=tag, staleness=staleness)
         obs.histogram_observe("async.staleness", float(staleness))
         obs.gauge_set("async.buffer_occupancy", float(occ))
+        obs.gauge_set("async.buffer_bytes",
+                      float(self.async_buffer.approx_bytes))
         t0 = self._dispatch_t.pop(sender, None)
         secs = None if t0 is None else max(self._async_clock.now() - t0, 0.0)
         self.population.note_report(
@@ -263,6 +265,7 @@ class AsyncBufferedServerMixin:
                 self._async_eval_round(closing_idx)
         obs.counter_inc("async.flushes", labels={"reason": reason})
         obs.gauge_set("async.buffer_occupancy", 0.0)
+        obs.gauge_set("async.buffer_bytes", 0.0)
         obs.maybe_export_metrics()
         self.async_scheduler.note_flush()
         self.population.close_round(reason="flush", fail_missing=False)
@@ -349,6 +352,8 @@ class AsyncBufferedServerMixin:
         occ = self.async_buffer.add(sender, params, record["n_samples"],
                                     version=v, staleness=staleness)
         obs.gauge_set("async.buffer_occupancy", float(occ))
+        obs.gauge_set("async.buffer_bytes",
+                      float(self.async_buffer.approx_bytes))
         n = record.get("n_samples")
         self.population.note_report(
             sender, round_idx=int(self.args.round_idx),

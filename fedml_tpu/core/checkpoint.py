@@ -251,14 +251,20 @@ class UpdateJournal:
     def append(self, round_idx: int, record: Dict[str, Any]) -> None:
         """Durably append one record; returns only once it is on disk (under
         the default ``always`` policy), so callers may ack afterwards."""
+        t0 = time.perf_counter()
         payload = serialization.msgpack_serialize(_to_host(record))
         frame = _FRAME_HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
         with open(self._path(round_idx), "ab") as f:
             f.write(frame + payload)
             f.flush()
             if self.fsync == "always":
+                t_sync = time.perf_counter()
                 os.fsync(f.fileno())
+                obs.histogram_observe("journal.fsync_seconds",
+                                      time.perf_counter() - t_sync)
         obs.counter_inc("journal.appends")
+        obs.histogram_observe("journal.append_seconds",
+                              time.perf_counter() - t0)
 
     def replay(self, round_idx: int) -> Tuple[List[Dict[str, Any]], int]:
         """Read back ``(records, bad_tail)`` for a round.  ``bad_tail`` is 1

@@ -386,7 +386,18 @@ class FedMLCommManager(Observer):
             logger.debug("rank %s: no handler for msg_type=%s",
                          self.rank, msg_params.get_type())
             return
-        handler(msg_params)
+        try:
+            handler(msg_params)
+        except Exception:
+            # an unhandled handler exception is about to unwind the receive
+            # loop — preserve the last telemetry window before it's lost
+            try:
+                from ..obs import flight_dump
+
+                flight_dump("unhandled_exception")
+            except Exception:
+                pass
+            raise
 
     # -- backend factory (reference ``fedml_comm_manager.py:78-134``) -------
     def _init_manager(self) -> None:

@@ -23,9 +23,12 @@ no wall-clock, and nothing round-critical may ever depend on a span.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Callable, Dict, Optional
 
+from .exposition import MetricsExporter
+from .flight import DEFAULT_FLIGHT_CAPACITY, FlightRecorder
 from .metrics import DEFAULT_TIME_BUCKETS, MetricsRegistry
 from .trace import (
     NULL_SPAN,
@@ -41,11 +44,13 @@ from .trace import (
 __all__ = [
     "MetricsRegistry", "Tracer", "Span", "SpanContext", "NULL_SPAN",
     "DEFAULT_TIME_BUCKETS", "trace_id_for", "span_id_for", "round_root_ctx",
-    "active_ctx",
+    "active_ctx", "FlightRecorder", "MetricsExporter",
     "configure", "shutdown", "enabled", "tracer", "registry", "run_id",
     "span", "round_span", "unique_span", "span_event",
     "inject", "extract", "counter_inc", "gauge_set", "histogram_observe",
     "maybe_export_metrics", "slow_round_factor",
+    "flight_recorder", "flight_dump", "exporter",
+    "sample_resource_gauges", "compile_seconds_total",
 ]
 
 _lock = threading.Lock()
@@ -56,30 +61,81 @@ _ctx: Dict[str, Any] = {"enabled": False}
 _registry = MetricsRegistry()
 
 
+def _tapped_emit(flight: FlightRecorder,
+                 emit: Callable[[str, Dict[str, Any]], None]):
+    """Wrap the sink emit so every record also lands in the flight ring,
+    and trigger events (``server_kill`` / ``server_restore`` /
+    ``slow_round``) dump the ring AFTER the record is forwarded — the
+    trigger itself is the dump's last line."""
+    def tapped(topic: str, rec: Dict[str, Any]) -> None:
+        try:
+            reason = flight.record(topic, rec)
+        except Exception:  # recorder trouble must never block the sink
+            reason = None
+        emit(topic, rec)
+        if reason is not None:
+            try:
+                flight.dump(reason)
+            except Exception:
+                pass
+    return tapped
+
+
 def configure(args: Any, emit: Callable[[str, Dict[str, Any]], None]) -> None:
     """Enable tracing for this process.  ``emit`` is sink-shaped
     (``(topic, record)``) — ``mlops.init`` passes its fan's emit."""
+    run = str(getattr(args, "run_id", "0"))
+    flight: Optional[FlightRecorder] = None
+    cap = int(getattr(args, "obs_flight_capacity", DEFAULT_FLIGHT_CAPACITY)
+              or 0)
+    if cap > 0:
+        flight = FlightRecorder(
+            capacity=cap,
+            directory=getattr(args, "obs_flight_dir", None) or None,
+            run_id=run)
+        emit = _tapped_emit(flight, emit)
+    exporter_obj: Optional[MetricsExporter] = None
+    port = getattr(args, "obs_export_port", None)
+    path = getattr(args, "obs_export_path", None) or None
+    port = int(port) if port not in (None, "") else 0
+    if port > 0 or path:
+        try:
+            exporter_obj = MetricsExporter(
+                _registry, port=port if port > 0 else None,
+                snapshot_path=path).start()
+        except Exception:  # a taken port must not take the run down
+            exporter_obj = None
     with _lock:
         _ctx.update(
             enabled=True,
-            run_id=str(getattr(args, "run_id", "0")),
+            run_id=run,
             emit=emit,
-            tracer=Tracer(str(getattr(args, "run_id", "0")), emit),
+            tracer=Tracer(run, emit),
             export_interval_s=float(
                 getattr(args, "obs_metrics_export_interval", 0) or 0),
             slow_round_factor=float(
                 getattr(args, "obs_slow_round_factor", 2.0) or 2.0),
+            flight=flight,
+            exporter=exporter_obj,
         )
+    _register_compile_listener()
 
 
 def shutdown() -> None:
-    """Final metrics flush + disable (idempotent)."""
+    """Final metrics flush + exporter/recorder teardown (idempotent)."""
     with _lock:
         emit = _ctx.get("emit")
         if emit is not None:
+            sample_resource_gauges()
             _registry.export_to(emit)
+        exporter_obj = _ctx.get("exporter")
         _ctx.clear()
         _ctx["enabled"] = False
+    if exporter_obj is not None:
+        try:  # joins the serve thread — outside the facade lock
+            exporter_obj.shutdown()
+        except Exception:
+            pass
 
 
 def enabled() -> bool:
@@ -100,6 +156,91 @@ def run_id() -> str:
 
 def slow_round_factor() -> float:
     return float(_ctx.get("slow_round_factor", 2.0))
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    return _ctx.get("flight")
+
+
+def flight_dump(reason: str) -> Optional[str]:
+    """Dump the flight ring now (server managers call this on unhandled
+    handler exceptions); returns the dump path or None."""
+    flight = _ctx.get("flight")
+    if flight is None:
+        return None
+    try:
+        return flight.dump(reason)
+    except Exception:  # telemetry never raises into the round path
+        return None
+
+
+def exporter() -> Optional[MetricsExporter]:
+    return _ctx.get("exporter")
+
+
+# -- resource attribution ---------------------------------------------------
+
+def sample_resource_gauges() -> None:
+    """Host memory gauges: current RSS (``/proc/self/statm``) and peak RSS
+    (``getrusage``).  Called from every ``maybe_export_metrics`` site, so
+    the round-close paths of both managers and both simulators sample it
+    for free.  Best-effort on non-Linux."""
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux
+        _registry.gauge_set("proc.max_rss_bytes", float(ru.ru_maxrss) * 1024.0)
+    except Exception:
+        pass
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            rss_pages = int(f.read().split()[1])
+        _registry.gauge_set(
+            "proc.rss_bytes", float(rss_pages * os.sysconf("SC_PAGE_SIZE")))
+    except (OSError, ValueError, IndexError):
+        pass
+
+
+# XLA compile-time accumulator: jax.monitoring fires
+# /jax/core/compile/backend_compile_duration for EVERY backend compile in
+# the process (round fns, eval fns, the agg plane), so one listener gives
+# the compile side of the compile-vs-execute split without touching any
+# hot path.  Registered once per process; reads the live _ctx per event.
+_compile_state = {"lock": threading.Lock(), "total": 0.0, "registered": False}
+
+
+def _on_jax_event_duration(event: str, duration: float, **kw: Any) -> None:
+    if not _ctx.get("enabled") or not str(event).endswith(
+            "backend_compile_duration"):
+        return
+    with _compile_state["lock"]:
+        _compile_state["total"] += float(duration)
+    try:
+        _registry.histogram_observe("xla.compile_seconds", float(duration))
+    except Exception:
+        pass
+
+
+def _register_compile_listener() -> None:
+    if _compile_state["registered"]:
+        return
+    try:
+        from jax import monitoring as _monitoring
+
+        _monitoring.register_event_duration_secs_listener(
+            _on_jax_event_duration)
+        _compile_state["registered"] = True
+    except Exception:  # jax absent or API moved: attribution degrades
+        pass
+
+
+def compile_seconds_total() -> float:
+    """Cumulative XLA backend-compile seconds observed so far; snapshot
+    before/after a round call and the difference is that round's compile
+    share."""
+    with _compile_state["lock"]:
+        return float(_compile_state["total"])
 
 
 # -- span helpers (no-ops until configure) ----------------------------------
@@ -183,8 +324,19 @@ def histogram_observe(name: str, value: float,
 
 def maybe_export_metrics() -> bool:
     """Rate-limited registry flush to the sink (round-close call sites);
-    obeys ``obs_metrics_export_interval`` (0 = only the shutdown flush)."""
+    obeys ``obs_metrics_export_interval`` (0 = only the shutdown flush).
+    Also samples the host resource gauges and, when a sink flush fires,
+    refreshes the exporter's file snapshot."""
     emit = _ctx.get("emit")
     if emit is None:
         return False
-    return _registry.maybe_export(emit, float(_ctx.get("export_interval_s", 0)))
+    sample_resource_gauges()
+    did = _registry.maybe_export(emit, float(_ctx.get("export_interval_s", 0)))
+    if did:
+        exporter_obj = _ctx.get("exporter")
+        if exporter_obj is not None:
+            try:
+                exporter_obj.snapshot()
+            except OSError:
+                pass
+    return did

@@ -1,0 +1,298 @@
+"""OpenMetrics-style exposition for the :class:`MetricsRegistry`.
+
+The registry's native export is sink-shaped (flat JSON records on the
+``metrics`` topic, flushed on an interval) — fine for offline analysis,
+useless for a long-lived cross-silo server an operator wants to *scrape*.
+This module is the one rendering path from registry state to the
+Prometheus/OpenMetrics text format, plus the two delivery mechanisms:
+
+* :func:`render_openmetrics` — deterministic text rendering of every
+  family: counters as ``name_total``, gauges as ``name``, histograms as
+  cumulative ``name_bucket{le="..."}`` + ``name_sum`` / ``name_count``.
+  Metric names are sanitized (``agg.step_seconds`` →
+  ``agg_step_seconds``); label values are escaped per the spec
+  (backslash, double-quote, newline).  Cardinality-cap overflow series
+  render like any other series (their ``overflow="true"`` label is the
+  marker) and per-family drop counts surface as one
+  ``fedml_metric_dropped_series`` gauge family.
+* :class:`MetricsExporter` — an optional stdlib ``ThreadingHTTPServer``
+  pull endpoint (``GET /metrics``) on a daemon thread plus atomic file
+  snapshots, both rendering the live registry.  ``shutdown`` is
+  idempotent and writes a final snapshot so a finished run leaves its
+  last state on disk.
+
+``tools/lint_obs.py`` forbids calling :func:`render_openmetrics` outside
+``core/obs`` — the exporter is the single exposition path, so overhead
+stays accounted by bench.py's obs-overhead keys.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .metrics import MetricsRegistry
+
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+# one synthetic gauge family carrying every family's cardinality-cap drop
+# count (labeled by the original metric name), rendered after the real
+# families so scrapes can alert on label explosions
+DROPPED_SERIES_METRIC = "fedml_metric_dropped_series"
+
+
+def sanitize_metric_name(name: str) -> str:
+    """A legal exposition metric name: bad chars (``.`` most commonly)
+    become ``_``; a leading digit gets an underscore prefix."""
+    out = _NAME_BAD.sub("_", str(name))
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def escape_label_value(value: Any) -> str:
+    return (str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _unescape_label_value(value: str) -> str:
+    out: List[str] = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _fmt_value(v: Any) -> str:
+    """Exact round-trip formatting: ints as ints, floats via ``repr`` (the
+    shortest string that parses back to the same float)."""
+    if isinstance(v, bool):  # pragma: no cover - registries never store bools
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def _labels_text(labels: Dict[str, Any],
+                 extra: Optional[List[Tuple[str, str]]] = None) -> str:
+    pairs = [(str(k), str(v)) for k, v in sorted(labels.items())]
+    if extra:
+        pairs.extend(extra)
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in pairs)
+    return "{" + body + "}"
+
+
+def render_openmetrics(registry: MetricsRegistry) -> str:
+    """The registry's full state in OpenMetrics text format, deterministic
+    in content (families and series render in sorted order)."""
+    records = registry.export()
+    by_family: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:  # export() is already (family, label-key) sorted
+        by_family.setdefault(rec["metric"], []).append(rec)
+
+    lines: List[str] = []
+    dropped: List[Tuple[str, int]] = []
+    for name in sorted(by_family):
+        recs = by_family[name]
+        kind = recs[0]["kind"]
+        sname = sanitize_metric_name(name)
+        lines.append(f"# TYPE {sname} {kind}")
+        for rec in recs:
+            labels = rec.get("labels", {})
+            if kind == "counter":
+                lines.append(f"{sname}_total{_labels_text(labels)} "
+                             f"{_fmt_value(rec['value'])}")
+            elif kind == "gauge":
+                lines.append(f"{sname}{_labels_text(labels)} "
+                             f"{_fmt_value(rec['value'])}")
+            else:  # histogram: registry buckets are per-bin, wire is cumulative
+                cum = 0
+                bounds = list(rec["buckets"]) + [None]
+                for ub, n in zip(bounds, rec["bucket_counts"]):
+                    cum += n
+                    le = "+Inf" if ub is None else _fmt_value(float(ub))
+                    lines.append(
+                        f"{sname}_bucket"
+                        f"{_labels_text(labels, extra=[('le', le)])} {cum}")
+                lines.append(f"{sname}_sum{_labels_text(labels)} "
+                             f"{_fmt_value(rec['sum'])}")
+                lines.append(f"{sname}_count{_labels_text(labels)} "
+                             f"{_fmt_value(rec['count'])}")
+        n_dropped = recs[0].get("dropped_series", 0)
+        if n_dropped:
+            dropped.append((name, int(n_dropped)))
+    if dropped:
+        lines.append(f"# TYPE {DROPPED_SERIES_METRIC} gauge")
+        for name, n in dropped:
+            lines.append(
+                f"{DROPPED_SERIES_METRIC}"
+                f"{_labels_text({'metric': name})} {n}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def parse_openmetrics(text: str) -> Dict[str, Any]:
+    """Minimal parser for the renderer's output (round-trip tests, gate
+    tooling).  Returns ``{"types": {name: kind}, "samples": {(sample_name,
+    ((label, value), ...)): float}}``."""
+    types: Dict[str, str] = {}
+    samples: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        name, labels, value = _parse_sample(line)
+        samples[(name, labels)] = value
+    return {"types": types, "samples": samples}
+
+
+def _parse_sample(line: str) -> Tuple[str, Tuple[Tuple[str, str], ...], float]:
+    brace = line.find("{")
+    if brace < 0:
+        name, _, val = line.partition(" ")
+        return name, (), float(val)
+    name = line[:brace]
+    labels: List[Tuple[str, str]] = []
+    i = brace + 1
+    while i < len(line) and line[i] != "}":
+        eq = line.index("=", i)
+        key = line[i:eq]
+        assert line[eq + 1] == '"', f"malformed label in {line!r}"
+        j = eq + 2
+        buf: List[str] = []
+        while line[j] != '"':
+            if line[j] == "\\":
+                buf.append(line[j:j + 2])
+                j += 2
+            else:
+                buf.append(line[j])
+                j += 1
+        labels.append((key, _unescape_label_value("".join(buf))))
+        i = j + 1
+        if i < len(line) and line[i] == ",":
+            i += 1
+    val = line[i + 1:].strip()
+    return name, tuple(labels), float(val)
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    tmp = path + ".tmp"
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class MetricsExporter:
+    """Pull endpoint + file snapshots over one registry.
+
+    ``port``: None disables HTTP; 0 binds an ephemeral localhost port
+    (tests); >0 binds that port.  ``snapshot_path``: None disables file
+    snapshots.  Both render the *live* registry at request/snapshot time.
+    ``shutdown`` is idempotent and safe to call without ``start``.
+    """
+
+    def __init__(self, registry: MetricsRegistry,
+                 port: Optional[int] = None,
+                 snapshot_path: Optional[str] = None,
+                 host: str = "127.0.0.1"):
+        self._registry = registry
+        self._requested_port = port
+        self.snapshot_path = str(snapshot_path) if snapshot_path else None
+        self.host = host
+        self.port: Optional[int] = None
+        self._server: Any = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._shut = False
+
+    def start(self) -> "MetricsExporter":
+        if self._requested_port is None or self._server is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        registry = self._registry
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - stdlib API
+                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render_openmetrics(registry).encode("utf-8")
+                except Exception as e:  # registry must never 500 silently
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:
+                pass  # scrapes must not spam the run's stderr
+
+        self._server = ThreadingHTTPServer(
+            (self.host, int(self._requested_port)), _Handler)
+        self._server.daemon_threads = True
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="obs-metrics-exporter",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> Optional[str]:
+        if self.port is None:
+            return None
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def snapshot(self) -> Optional[str]:
+        """Atomic file snapshot of the current rendering (or None when file
+        snapshots are off)."""
+        if self.snapshot_path is None:
+            return None
+        _atomic_write_text(self.snapshot_path,
+                           render_openmetrics(self._registry))
+        return self.snapshot_path
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._shut:
+                return
+            self._shut = True
+            server, self._server = self._server, None
+            thread, self._thread = self._thread, None
+        try:
+            self.snapshot()
+        except OSError:
+            pass
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None:
+            thread.join(timeout=2.0)

@@ -1,0 +1,199 @@
+"""Flight recorder: a bounded ring of recent telemetry, dumped on trouble.
+
+The JSONL sink tells you what happened *if the file survives and someone
+kept it*; a crashed server's most valuable records are the last few
+hundred before the crash, and under ``server_kill`` chaos those are
+exactly the ones a supervisor restart scrolls past.  The recorder keeps a
+fixed-capacity in-memory ring of every record the obs fan emits
+(span_start / span_end / span_event / metrics / ...) and writes an atomic,
+crc-framed JSONL snapshot when something goes wrong:
+
+* ``server_kill`` / ``server_restore`` / ``slow_round`` span events (the
+  obs facade's emit tap watches for them);
+* an unhandled exception in a server manager's message handler
+  (``comm_manager._dispatch`` calls :func:`fedml_tpu.core.obs.flight_dump`);
+* any explicit ``obs.flight_dump(reason)`` call.
+
+Frame format — one record per line, ``crc32_hex8 + " " + json``:
+
+    1c291ca3 {"topic": "span_start", ...}
+
+The crc covers the JSON payload bytes, so :meth:`FlightRecorder.load` can
+drop a torn tail line (the dump itself is atomic, but operators also point
+``load`` at live sink JSONL or partially copied files) and any line a text
+editor mangled, without losing the rest.  Everything here is telemetry:
+dump failures return ``None`` and never raise into the round path.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_FLIGHT_CAPACITY = 2048
+
+# span events that trigger an automatic dump when seen on the emit tap
+DUMP_EVENTS = ("server_kill", "server_restore", "slow_round")
+
+# hard cap on dumps per recorder: a slow-round storm must not turn the
+# flight recorder into a disk-filling firehose
+DEFAULT_MAX_DUMPS = 32
+
+_REASON_SAFE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+def frame_line(rec: Dict[str, Any]) -> str:
+    """One crc-framed line for ``rec`` (no trailing newline)."""
+    payload = json.dumps(rec, sort_keys=True, default=str)
+    crc = zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+    return f"{crc:08x} {payload}"
+
+
+def parse_line(line: str) -> Optional[Dict[str, Any]]:
+    """The record behind one framed line, or None for a corrupt/torn line."""
+    if len(line) < 10 or line[8] != " ":
+        return None
+    crc_hex, payload = line[:8], line[9:]
+    try:
+        want = int(crc_hex, 16)
+    except ValueError:
+        return None
+    if zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF != want:
+        return None
+    try:
+        rec = json.loads(payload)
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+class FlightRecorder:
+    """Fixed-capacity ring of ``(topic, record)`` telemetry + atomic dump.
+
+    ``record`` is called from the obs emit tap on whatever thread emitted
+    (round loop, upload handlers, retransmitter), so everything is under
+    one lock and the per-record work is one dict copy + deque append.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_FLIGHT_CAPACITY,
+                 directory: Optional[str] = None, run_id: Any = "0",
+                 max_dumps: int = DEFAULT_MAX_DUMPS):
+        capacity = int(capacity)
+        if capacity < 1:
+            raise ValueError(f"flight capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.directory = str(directory) if directory else None
+        self.run_id = str(run_id)
+        self.max_dumps = int(max_dumps)
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._dropped = 0      # records aged out of the ring
+        self._n_dumps = 0
+        self._last_dump_path: Optional[str] = None
+
+    # -- recording -----------------------------------------------------------
+    def record(self, topic: str, rec: Dict[str, Any]) -> Optional[str]:
+        """Append one record; returns a dump *reason* when ``rec`` is a
+        trigger event (the caller decides whether/when to dump so the
+        trigger record itself is already in the ring)."""
+        entry = dict(rec)
+        entry["topic"] = str(topic)
+        with self._lock:
+            if len(self._ring) == self.capacity:
+                self._dropped += 1
+            self._ring.append(entry)
+        if topic == "span_event" and rec.get("event") in DUMP_EVENTS:
+            return str(rec["event"])
+        return None
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    @property
+    def n_dumps(self) -> int:
+        with self._lock:
+            return self._n_dumps
+
+    @property
+    def last_dump_path(self) -> Optional[str]:
+        with self._lock:
+            return self._last_dump_path
+
+    # -- dumping -------------------------------------------------------------
+    def dump(self, reason: str) -> Optional[str]:
+        """Atomically write the ring as crc-framed JSONL; returns the dump
+        path, or None when no directory is configured, the dump budget is
+        exhausted, or the write fails (telemetry never raises)."""
+        with self._lock:
+            if self.directory is None or self._n_dumps >= self.max_dumps:
+                return None
+            self._n_dumps += 1
+            seq = self._n_dumps
+            records = list(self._ring)
+            dropped = self._dropped
+        safe = _REASON_SAFE.sub("_", str(reason)) or "dump"
+        meta = {
+            "topic": "flight_meta", "reason": str(reason),
+            "run_id": self.run_id, "seq": seq, "n_records": len(records),
+            "capacity": self.capacity, "dropped": dropped,
+        }
+        name = f"flight-{self.run_id}-{seq:03d}-{safe}.jsonl"
+        path = os.path.join(self.directory, name)
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write("\n".join(
+                    [frame_line(meta)] + [frame_line(r) for r in records]
+                ) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            try:  # directory entry durability, best-effort
+                dfd = os.open(self.directory, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        with self._lock:
+            self._last_dump_path = path
+        return path
+
+    # -- reloading -----------------------------------------------------------
+    @staticmethod
+    def load(path: str) -> Tuple[List[Dict[str, Any]], int]:
+        """Parse a dump tolerantly: returns ``(records, n_bad_lines)``.
+        Corrupt or truncated lines (crc mismatch, torn json) are counted and
+        skipped — a partial dump still yields every intact record."""
+        records: List[Dict[str, Any]] = []
+        n_bad = 0
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                rec = parse_line(line)
+                if rec is None:
+                    n_bad += 1
+                else:
+                    records.append(rec)
+        return records, n_bad

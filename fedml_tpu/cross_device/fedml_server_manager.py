@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 from ..core import obs
@@ -238,7 +239,11 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         n = msg.get(MNNMessage.MSG_ARG_KEY_NUM_SAMPLES)
         tag = msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, None)
         try:
+            t_dec = time.perf_counter()
             params = load_edge_model(model_file)
+            obs.histogram_observe("upload.decode_seconds",
+                                  time.perf_counter() - t_dec,
+                                  labels={"plane": "cross_device"})
         except Exception as e:
             logger.warning("dropping unreadable upload file %s from device "
                            "%d: %s", model_file, sender, e)

@@ -18,6 +18,7 @@ waits (aggregating nothing is worse than waiting).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -182,7 +183,11 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                 return
             raw = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
             is_delta = is_compressed(raw) and bool(raw.get("is_delta"))
+            t_dec = time.perf_counter()
             model_params = maybe_decompress_update(raw)
+            obs.histogram_observe("upload.decode_seconds",
+                                  time.perf_counter() - t_dec,
+                                  labels={"plane": "cross_silo"})
             if is_delta:
                 # compressed uploads carry the UPDATE; rebase onto the global
                 # params this round distributed (async: onto the CURRENT

@@ -146,7 +146,8 @@ class _Program:
     """One compiled reduction: the AOT-compiled step plus the leaf plan."""
 
     __slots__ = ("step", "acc_shardings", "chunk_shardings", "acc_dtypes",
-                 "wire_dtypes", "out_dtypes", "shapes", "wire_bytes")
+                 "wire_dtypes", "out_dtypes", "shapes", "wire_bytes",
+                 "flops_per_step")
 
     def __init__(self, step, acc_shardings, chunk_shardings, acc_dtypes,
                  wire_dtypes, out_dtypes, shapes, wire_bytes):
@@ -158,6 +159,19 @@ class _Program:
         self.out_dtypes = out_dtypes
         self.shapes = shapes
         self.wire_bytes = wire_bytes
+        self.flops_per_step = _compiled_flops(step)
+
+
+def _compiled_flops(compiled: Any) -> float:
+    """XLA's flop estimate for one compiled step (``Compiled
+    .cost_analysis``), 0.0 when the backend doesn't report one."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", 0.0) or 0.0)
+    except Exception:
+        return 0.0
 
 
 class CompiledAggPlane:
@@ -273,9 +287,16 @@ class CompiledAggPlane:
             with sp:
                 t0 = time.perf_counter()
                 prog = self._build_program(treedef, shapes, dtypes, k, mode)
+                compile_s = time.perf_counter() - t0
+                obs.histogram_observe("agg.compile_seconds", compile_s,
+                                      labels={"mode": mode})
+                # end with attribution attrs; the context-manager re-end is
+                # an idempotent no-op
+                sp.end(compile_s=round(compile_s, 6),
+                       flops_per_step=prog.flops_per_step)
                 logger.info(
                     "agg_plane compiled %s k=%d leaves=%d in %.3fs",
-                    mode, k, len(shapes), time.perf_counter() - t0)
+                    mode, k, len(shapes), compile_s)
             self._programs[sig] = prog
         return prog
 

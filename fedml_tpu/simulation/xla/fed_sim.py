@@ -746,6 +746,7 @@ class XLASimulator:
             logger.info("jax profiler trace -> %s", prof_dir)
         for round_idx in range(start_round, comm_round):
             t0 = time.time()
+            compile_s0 = obs.compile_seconds_total()
             # the whole round is one (or two) compiled XLA programs, so the
             # round root is the only meaningful span here; annotate=True nests
             # it inside the device trace when enable_profiler is on
@@ -884,7 +885,16 @@ class XLASimulator:
             obs.counter_inc("agg.bytes_reduced",
                             int(participated.sum()) * self._model_bytes,
                             labels={"path": "inmesh"})
-            rsp.end(reason="closed", loss=float(mean_loss))
+            # compile-vs-execute attribution: the jax.monitoring listener
+            # accumulated every backend compile this round triggered (round
+            # fn, security fn, eval fn); the rest of the wall time is
+            # execute + host orchestration
+            compile_s = max(0.0, obs.compile_seconds_total() - compile_s0)
+            if compile_s > 0.0:
+                obs.histogram_observe("round.compile_seconds", compile_s)
+            rsp.end(reason="closed", loss=float(mean_loss),
+                    compile_s=round(compile_s, 6),
+                    execute_s=round(max(0.0, dt - compile_s), 6))
             obs.maybe_export_metrics()
             self.round_times.append(dt)
             if round_idx > 0:  # round 0 is dominated by XLA compile

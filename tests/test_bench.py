@@ -117,3 +117,75 @@ class TestBackendWait:
 
         threading.Timer(2.0, marker.touch).start()
         assert bench._wait_for_backend() is True
+
+    def test_probe_timeout_knob_bounds_a_hung_probe(self, monkeypatch):
+        """BENCH_PROBE_TIMEOUT_S: a hung tunnel (probe that never answers)
+        is killed per-attempt instead of eating the whole wait window."""
+        sys.path.insert(0, ".")
+        import time
+
+        import bench
+
+        monkeypatch.setattr(bench, "_PROBE_CODE", "import time; time.sleep(60)")
+        monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "0.5")
+        monkeypatch.setenv("BENCH_WAIT_MIN", "0")
+        t0 = time.time()
+        assert bench._wait_for_backend() is False
+        assert time.time() - t0 < 10
+
+
+class TestMetricLineContract:
+    """Schema-2 stamping + the exactly-one-JSON-line guarantee on every
+    exit path (r03-r05 shipped EMPTY tails; tools/perf_gate.py now rejects
+    a round that does that again)."""
+
+    def test_emit_stamps_schema_provenance(self, capsys, monkeypatch):
+        sys.path.insert(0, ".")
+        import bench
+
+        monkeypatch.setattr(bench, "_emitted", False)
+        bench._emit({"metric": "m", "value": 1.0, "unit": "u"}, "full")
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["bench_schema"] == bench.BENCH_SCHEMA
+        assert rec["mode"] == "full"
+        assert rec["git_rev"]  # short rev or "unknown", never absent
+        assert rec["metric"] == "m" and rec["value"] == 1.0
+        assert bench._emitted is True
+
+    def test_required_tpu_missing_emits_one_failed_line(self, capsys,
+                                                        monkeypatch):
+        sys.path.insert(0, ".")
+        import bench
+
+        monkeypatch.setattr(bench, "_PROBE_CODE", "import sys; sys.exit(3)")
+        monkeypatch.setattr(bench, "_emitted", False)
+        monkeypatch.setenv("BENCH_REQUIRE_TPU", "1")
+        monkeypatch.setenv("BENCH_WAIT_MIN", "0")
+        assert bench.main() == 1
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        assert len(lines) == 1  # the dark round still leaves a record
+        rec = json.loads(lines[0])
+        assert rec["mode"] == "failed" and rec["value"] is None
+        assert rec["bench_schema"] == bench.BENCH_SCHEMA
+        assert "without a metric line" in rec["degraded_reason"]
+
+    def test_unhandled_exception_emits_failed_record_then_reraises(
+            self, capsys, monkeypatch):
+        sys.path.insert(0, ".")
+        import bench
+
+        def _boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(bench, "_emitted", False)
+        monkeypatch.setattr(bench, "_main", _boom)
+        with pytest.raises(RuntimeError):
+            bench.main()
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["mode"] == "failed"
+        assert "RuntimeError" in rec["degraded_reason"]
+        assert "boom" in rec["degraded_reason"]

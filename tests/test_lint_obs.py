@@ -89,3 +89,51 @@ def test_registry_and_facade_calls_are_not_flagged(tmp_path):
         "    obs.histogram_observe('round.seconds', 0.5)\n"
     )
     assert lint_obs.lint_file(str(f)) == []
+
+
+def test_catches_printed_metric_json(tmp_path):
+    # stdout JSON emission is the bench driver's contract line and nobody
+    # else's — a library print(json.dumps(...)) races the exactly-one-
+    # metric-line guarantee
+    f = tmp_path / "printer.py"
+    f.write_text(
+        "import json\n"
+        "def report(stats):\n"
+        "    print(json.dumps({'metric': 'x', 'value': stats}))\n"
+        "    blob = json.dumps(stats)\n"          # dumps alone is fine
+        "    print('round done')\n"               # print alone is fine
+    )
+    violations = lint_obs.lint_file(str(f))
+    assert [(lineno, kind) for _, lineno, kind, _ in violations] == [
+        (3, "printed metric json"),
+    ]
+
+
+def test_catches_direct_registry_render(tmp_path):
+    # exposition belongs to the exporter inside core/obs — a stray
+    # render_openmetrics() call forks the export seam
+    f = tmp_path / "renderer.py"
+    f.write_text(
+        "from fedml_tpu.core.obs.exposition import render_openmetrics\n"
+        "def scrape(reg):\n"
+        "    return render_openmetrics(reg)\n"
+    )
+    violations = lint_obs.lint_file(str(f))
+    kinds = [kind for _, _, kind, _ in violations]
+    assert kinds == ["direct registry render"]
+
+
+def test_exposition_rules_respect_pragma_and_exemption(tmp_path):
+    allowed = tmp_path / "allowed.py"
+    allowed.write_text(
+        "import json\n"
+        "print(json.dumps({'v': 1}))  # lint_obs: allow\n"
+        "body = render_openmetrics(reg)  # lint_obs: allow\n"
+    )
+    assert lint_obs.lint_file(str(allowed)) == []
+    # core/obs itself (the exporter) renders freely
+    d = tmp_path / "core" / "obs"
+    d.mkdir(parents=True)
+    f = d / "exposition.py"
+    f.write_text("def snapshot(reg):\n    return render_openmetrics(reg)\n")
+    assert lint_obs.lint_file(str(f)) == []

@@ -42,6 +42,10 @@ from fedml_tpu.core.mlops import FanoutSink, InMemorySink
 from fedml_tpu.core.mlops.mlops_profiler_event import MLOpsProfilerEvent
 from fedml_tpu.core.mlops.sinks import JsonlFileSink
 from fedml_tpu.core.obs import MetricsRegistry, SpanContext, Tracer
+from fedml_tpu.core.obs.exposition import (
+    DROPPED_SERIES_METRIC, MetricsExporter, parse_openmetrics,
+    render_openmetrics, sanitize_metric_name)
+from fedml_tpu.core.obs.flight import FlightRecorder, frame_line, parse_line
 from fedml_tpu.core.obs.trace import round_root_ctx, span_id_for, trace_id_for
 
 
@@ -242,9 +246,11 @@ class TestMetricsRegistry:
 class _ObsArgs:
     rank = 0
 
-    def __init__(self, run_id, obs_trace=True):
+    def __init__(self, run_id, obs_trace=True, **extra):
         self.run_id = run_id
         self.obs_trace = obs_trace
+        for k, v in extra.items():
+            setattr(self, k, v)
 
 
 class TestFacade:
@@ -405,12 +411,13 @@ class TestTraceReport:
 # ---------------------------------------------------------------------------
 
 @contextlib.contextmanager
-def _traced(run_id):
+def _traced(run_id, **extra):
     """Process-wide tracing through an in-memory sink: obs is configured by
     ``mlops.init`` (the production seam) and covers every in-process node
-    thread of the topology."""
+    thread of the topology.  ``extra`` lands as attributes on the args
+    (e.g. ``obs_flight_dir`` for flight-recorder tests)."""
     mem = InMemorySink()
-    mlops.init(_ObsArgs(run_id), FanoutSink([mem]))
+    mlops.init(_ObsArgs(run_id, **extra), FanoutSink([mem]))
     try:
         yield mem
     finally:
@@ -554,3 +561,396 @@ def test_trace_integrity_all_backends(backend, tmp_path):
     finally:
         if broker is not None:
             broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Exposition: OpenMetrics rendering + pull endpoint
+# ---------------------------------------------------------------------------
+
+class TestExposition:
+    def test_golden_fixture_render(self):
+        """The exact wire text for one registry with every kind — any
+        rendering change must consciously update this golden."""
+        r = MetricsRegistry()
+        r.counter_inc("comm.retransmits", 3, {"node": 0})
+        r.gauge_set("async.buffer_bytes", 1024.0)
+        r.histogram_observe("round.seconds", 0.5, buckets=(1.0, 10.0))
+        assert render_openmetrics(r) == (
+            "# TYPE async_buffer_bytes gauge\n"
+            "async_buffer_bytes 1024.0\n"
+            "# TYPE comm_retransmits counter\n"
+            'comm_retransmits_total{node="0"} 3\n'
+            "# TYPE round_seconds histogram\n"
+            'round_seconds_bucket{le="1.0"} 1\n'
+            'round_seconds_bucket{le="10.0"} 1\n'
+            'round_seconds_bucket{le="+Inf"} 1\n'
+            "round_seconds_sum 0.5\n"
+            "round_seconds_count 1\n"
+            "# EOF\n"
+        )
+
+    def test_round_trip_every_kind(self):
+        r = MetricsRegistry()
+        r.counter_inc("c", 7, {"node": 3})
+        r.counter_inc("c", 1)
+        r.gauge_set("g", 0.1 + 0.2)  # repr() must round-trip exactly
+        for v in (0.05, 0.1, 5.0, 50.0):
+            r.histogram_observe("h", v, buckets=(0.1, 10.0))
+        parsed = parse_openmetrics(render_openmetrics(r))
+        assert parsed["types"] == {"c": "counter", "g": "gauge",
+                                   "h": "histogram"}
+        s = parsed["samples"]
+        assert s[("c_total", (("node", "3"),))] == 7
+        assert s[("c_total", ())] == 1
+        assert s[("g", ())] == 0.1 + 0.2  # exact, not approx
+        # wire buckets are CUMULATIVE; le="+Inf" equals the count
+        assert s[("h_bucket", (("le", "0.1"),))] == 2
+        assert s[("h_bucket", (("le", "10.0"),))] == 3
+        assert s[("h_bucket", (("le", "+Inf"),))] == 4
+        assert s[("h_count", ())] == 4
+        assert s[("h_sum", ())] == pytest.approx(55.15)
+
+    def test_label_escaping_round_trips(self):
+        hostile = 'quote:" backslash:\\ newline:\nend'
+        r = MetricsRegistry()
+        r.counter_inc("c", 1, {"path": hostile})
+        text = render_openmetrics(r)
+        assert "\\n" in text and '\\"' in text  # escaped on the wire
+        parsed = parse_openmetrics(text)
+        assert parsed["samples"][("c_total", (("path", hostile),))] == 1
+
+    def test_name_sanitization(self):
+        assert sanitize_metric_name("agg.step_seconds") == "agg_step_seconds"
+        assert sanitize_metric_name("7rounds") == "_7rounds"
+        assert sanitize_metric_name("a b/c") == "a_b_c"
+
+    def test_overflow_series_and_dropped_gauge(self):
+        r = MetricsRegistry(max_series_per_metric=2)
+        for i in range(4):
+            r.counter_inc("c", 1, {"client": i})
+        text = render_openmetrics(r)
+        parsed = parse_openmetrics(text)
+        # the overflow series renders like any other, marker label intact
+        assert parsed["samples"][("c_total", (("overflow", "true"),))] == 2
+        # and the per-family drop count surfaces as the synthetic gauge
+        assert parsed["types"][DROPPED_SERIES_METRIC] == "gauge"
+        assert parsed["samples"][
+            (DROPPED_SERIES_METRIC, (("metric", "c"),))] == 2
+
+    def test_render_ends_with_eof_terminator(self):
+        assert render_openmetrics(MetricsRegistry()).endswith("# EOF\n")
+
+
+class TestMetricsExporter:
+    def test_http_pull_on_ephemeral_port(self):
+        import urllib.error
+        import urllib.request
+
+        r = MetricsRegistry()
+        r.counter_inc("scrapes.test", 5)
+        exp = MetricsExporter(r, port=0).start()
+        try:
+            assert exp.url and exp.port
+            with urllib.request.urlopen(exp.url, timeout=5) as resp:
+                body = resp.read().decode("utf-8")
+                assert resp.headers["Content-Type"].startswith(
+                    "application/openmetrics-text")
+            assert parse_openmetrics(body)["samples"][
+                ("scrapes_test_total", ())] == 5
+            # the endpoint renders LIVE state, not a start()-time copy
+            r.counter_inc("scrapes.test", 1)
+            with urllib.request.urlopen(exp.url, timeout=5) as resp:
+                live = parse_openmetrics(resp.read().decode("utf-8"))
+            assert live["samples"][("scrapes_test_total", ())] == 6
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    exp.url.replace("/metrics", "/secrets"), timeout=5)
+        finally:
+            exp.shutdown()
+
+    def test_shutdown_is_idempotent_and_writes_final_snapshot(self, tmp_path):
+        snap = tmp_path / "metrics.prom"
+        r = MetricsRegistry()
+        r.gauge_set("g", 2.5)
+        exp = MetricsExporter(r, port=0, snapshot_path=str(snap)).start()
+        exp.shutdown()
+        exp.shutdown()  # second shutdown: no-op, no raise
+        text = snap.read_text()
+        assert text.endswith("# EOF\n")
+        assert parse_openmetrics(text)["samples"][("g", ())] == 2.5
+
+    def test_shutdown_without_start_is_safe(self):
+        MetricsExporter(MetricsRegistry(), port=0).shutdown()
+
+    def test_snapshot_only_mode_never_binds(self, tmp_path):
+        snap = tmp_path / "m.prom"
+        exp = MetricsExporter(MetricsRegistry(),
+                              snapshot_path=str(snap)).start()
+        assert exp.url is None and exp.port is None
+        assert exp.snapshot() == str(snap)
+        exp.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: ring, framing, dump/reload
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_frame_parse_round_trip_and_corruption(self):
+        rec = {"topic": "span_end", "name": "round", "duration_s": 1.5}
+        line = frame_line(rec)
+        assert parse_line(line) == rec
+        assert parse_line("") is None
+        assert parse_line("zzzzzzzz " + line[9:]) is None   # non-hex crc
+        assert parse_line(line[:-3]) is None                # torn tail
+        assert parse_line(line.replace('"round"', '"r0und"')) is None
+        # framed non-dict payloads are rejected on load
+        import zlib as _zlib
+        payload = "[1, 2]"
+        crc = _zlib.crc32(payload.encode()) & 0xFFFFFFFF
+        assert parse_line(f"{crc:08x} {payload}") is None
+
+    def test_ring_wraparound_keeps_newest_and_counts_dropped(self):
+        fr = FlightRecorder(capacity=3)
+        for i in range(5):
+            fr.record("metrics", {"i": i})
+        snap = fr.snapshot()
+        assert [r["i"] for r in snap] == [2, 3, 4]
+        assert fr.dropped == 2
+
+    def test_trigger_events_return_reason(self):
+        fr = FlightRecorder(capacity=4)
+        assert fr.record("span_event", {"event": "server_kill"}) == "server_kill"
+        assert fr.record("span_event", {"event": "slow_round"}) == "slow_round"
+        assert fr.record("span_event", {"event": "drop"}) is None
+        assert fr.record("span_start", {"name": "round"}) is None
+
+    def test_dump_and_tolerant_reload(self, tmp_path):
+        fr = FlightRecorder(capacity=8, directory=str(tmp_path), run_id="r1")
+        for i in range(3):
+            fr.record("span_start", {"name": "round", "i": i})
+        path = fr.dump("server_kill")
+        assert path and os.path.basename(path).endswith("server_kill.jsonl")
+        records, n_bad = FlightRecorder.load(path)
+        assert n_bad == 0
+        assert records[0]["topic"] == "flight_meta"
+        assert records[0]["reason"] == "server_kill"
+        assert records[0]["n_records"] == 3
+        assert [r.get("i") for r in records[1:]] == [0, 1, 2]
+
+    def test_truncated_tail_reload_drops_only_the_torn_line(self, tmp_path):
+        fr = FlightRecorder(capacity=8, directory=str(tmp_path), run_id="r2")
+        for i in range(4):
+            fr.record("metrics", {"i": i})
+        path = fr.dump("manual")
+        text = open(path, "r", encoding="utf-8").read()
+        torn = text.rstrip("\n")[:-7]  # tear the last record mid-payload
+        open(path, "w", encoding="utf-8").write(torn)
+        records, n_bad = FlightRecorder.load(path)
+        assert n_bad == 1
+        assert [r.get("i") for r in records[1:]] == [0, 1, 2]
+
+    def test_dump_budget_and_no_directory(self, tmp_path):
+        fr = FlightRecorder(capacity=2, directory=str(tmp_path),
+                            run_id="r3", max_dumps=1)
+        fr.record("metrics", {"x": 1})
+        assert fr.dump("one") is not None
+        assert fr.dump("two") is None  # budget exhausted
+        assert FlightRecorder(capacity=2).dump("nowhere") is None
+
+    def test_facade_wires_flight_and_dumps_on_trigger_event(self, tmp_path):
+        emitted = []
+        obs.configure(_ObsArgs("fl", obs_flight_dir=str(tmp_path)),
+                      lambda t, rec: emitted.append(t))
+        try:
+            assert obs.flight_recorder() is not None
+            with obs.round_span(0):
+                obs.span_event("server_kill", round_idx=0)
+        finally:
+            obs.shutdown()
+        assert "span_event" in emitted  # the tap forwards, never swallows
+        dumps = list(tmp_path.glob("flight-fl-*-server_kill.jsonl"))
+        assert len(dumps) == 1
+        records, n_bad = FlightRecorder.load(str(dumps[0]))
+        assert n_bad == 0
+        assert any(r.get("event") == "server_kill" for r in records)
+
+    def test_flight_dump_accessor_never_raises(self, tmp_path):
+        assert obs.flight_dump("manual") is None  # disabled: no-op
+        obs.configure(_ObsArgs("fd", obs_flight_dir=str(tmp_path)),
+                      lambda t, rec: None)
+        try:
+            path = obs.flight_dump("unhandled_exception")
+            assert path and "unhandled_exception" in path
+        finally:
+            obs.shutdown()
+
+    def test_flight_capacity_zero_disables(self):
+        obs.configure(_ObsArgs("off", obs_flight_capacity=0),
+                      lambda t, rec: None)
+        try:
+            assert obs.flight_recorder() is None
+        finally:
+            obs.shutdown()
+
+
+def test_flight_dump_on_server_kill_chaos(tmp_path):
+    """The acceptance leg: a server killed mid-round triggers an automatic
+    flight dump whose crc-framed snapshot reloads cleanly and contains the
+    killed round's span records — the post-mortem an operator actually
+    needs after a crash."""
+    LoopbackHub.reset()
+    run_id = "obs-flight-kill"
+    fdir = tmp_path / "flight"
+    with _traced(run_id, obs_flight_dir=str(fdir)) as mem:
+        history, final, stats, restarts, killed, server = \
+            _ft._run_server_kill_topology(run_id, tmp_path / "srv")
+        assert restarts >= 1 and len(history) == 2
+    kill_dumps = sorted(fdir.glob("flight-*-server_kill.jsonl"))
+    assert kill_dumps, "server_kill must trigger a flight dump"
+    records, n_bad = FlightRecorder.load(str(kill_dumps[0]))
+    assert n_bad == 0, "an atomic dump reloads with zero bad lines"
+    assert records[0]["topic"] == "flight_meta"
+    assert records[0]["reason"] == "server_kill"
+    assert any(r.get("event") == "server_kill" for r in records)
+    # the killed round's spans are in the ring: round 0's trace was live
+    tid0 = trace_id_for(run_id, 0)
+    killed_round = [r for r in records if r.get("trace_id") == tid0
+                    and r.get("topic") in trace_report.SPAN_TOPICS]
+    assert any(r["topic"] == "span_start" and r.get("name") == "round"
+               for r in killed_round)
+    # the sink records and the flight ring agree (same emit fan)
+    assert mem.by_topic("span_start")
+
+
+# ---------------------------------------------------------------------------
+# Resource attribution: gauges, compile split, trace_report views
+# ---------------------------------------------------------------------------
+
+class TestResourceAttribution:
+    def test_resource_gauges_sampled(self):
+        obs.sample_resource_gauges()
+        assert obs.registry().get_gauge("proc.max_rss_bytes") > 0
+
+    def test_compile_seconds_total_monotonic(self):
+        before = obs.compile_seconds_total()
+        assert before >= 0.0
+        assert obs.compile_seconds_total() >= before
+
+    def test_attribution_self_seconds_with_clamp(self):
+        tid, recs = _golden_round()
+        att = trace_report.build_traces(recs)[tid].attribution()
+        assert att["round_s"] == pytest.approx(2.0)
+        # self = duration minus children, clamped at 0: invite's children
+        # (0.2 + 0.21 + 1.5 = 1.91) exceed its own 0.05s wall
+        assert att["self_seconds"]["invite"] == 0.0
+        assert att["self_seconds"]["client.train"] == pytest.approx(1.91)
+        assert att["self_seconds"]["round"] == pytest.approx(1.95)
+        # no compile split in the golden records: the keys stay absent
+        assert "compile_s" not in att
+
+    def test_attribution_copies_compile_split_from_root_end(self):
+        tid, recs = _golden_round()
+        for r in recs:
+            if r["topic"] == "span_end" and r["name"] == "round":
+                r["compile_s"] = 0.8
+                r["execute_s"] = 1.2
+        att = trace_report.build_traces(recs)[tid].attribution()
+        assert att["compile_s"] == 0.8 and att["execute_s"] == 1.2
+
+    def test_report_attribution_view(self, tmp_path, capsys):
+        _, recs = _golden_round()
+        p = tmp_path / "t.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        assert trace_report.main([str(p), "--attribution"]) == 0
+        out = capsys.readouterr().out
+        assert "attribution:" in out and "client.train" in out
+
+    def test_report_format_json(self, tmp_path, capsys):
+        _, recs = _golden_round()
+        p = tmp_path / "t.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        assert trace_report.main([str(p), "--format", "json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["n_traces"] == 1 and payload["n_problems"] == 0
+        (tr,) = payload["traces"]
+        assert tr["attribution"]["self_seconds"]["client.train"] == \
+            pytest.approx(1.91)
+        assert [s["name"] for s in tr["critical_path"]][0] == "round"
+
+    def test_report_format_json_assert_closed_still_exits_2(
+            self, tmp_path, capsys):
+        _, recs = _golden_round()
+        p = tmp_path / "bad.jsonl"
+        p.write_text("\n".join(
+            json.dumps(r) for r in recs
+            if not (r["topic"] == "span_end" and r["name"] == "round"))
+            + "\n")
+        rc = trace_report.main([str(p), "--format", "json",
+                                "--assert-closed"])
+        assert rc == 2
+        payload = json.loads(capsys.readouterr().out)  # stdout stays JSON
+        assert payload["n_problems"] >= 1
+
+
+def _knob_args(**over):
+    from fedml_tpu.arguments import Arguments
+
+    args = Arguments.from_dict({
+        "common_args": {"training_type": "simulation", "random_seed": 0,
+                        "run_id": "knobs"},
+        "data_args": {"dataset": "mnist", "data_cache_dir": "",
+                      "partition_method": "hetero", "partition_alpha": 0.5,
+                      "synthetic_train_size": 100},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 2, "client_num_per_round": 2,
+                       "comm_round": 1, "epochs": 1, "batch_size": 16,
+                       "learning_rate": 0.1},
+        "validation_args": {"frequency_of_the_test": 1},
+        "comm_args": {"backend": "sp"},
+    })
+    for k, v in over.items():
+        setattr(args, k, v)
+    return args
+
+
+class TestExportKnobValidation:
+    def test_export_knobs_accepted(self):
+        _knob_args(obs_export_port=9464, obs_flight_capacity=0,
+                   obs_export_path="/tmp/m.prom").validate()
+
+    def test_bad_export_port_rejected(self):
+        with pytest.raises(ValueError):
+            _knob_args(obs_export_port=99999).validate()
+
+    def test_negative_flight_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            _knob_args(obs_flight_capacity=-1).validate()
+
+    def test_exporter_configured_from_args(self, tmp_path):
+        import socket
+        import urllib.request
+
+        # configure() treats port 0 as "HTTP off", so reserve a real one
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        snap = tmp_path / "m.prom"
+        obs.configure(_ObsArgs("exp", obs_export_port=port,
+                               obs_export_path=str(snap)),
+                      lambda t, rec: None)
+        try:
+            exp = obs.exporter()
+            assert exp is not None and exp.port == port
+            obs.counter_inc("exp.test", 2)
+            with urllib.request.urlopen(exp.url, timeout=5) as resp:
+                body = resp.read().decode("utf-8")
+            assert parse_openmetrics(body)["samples"][
+                ("exp_test_total", ())] == 2
+        finally:
+            obs.shutdown()
+        # shutdown wrote the final snapshot and tore the server down
+        assert snap.exists() and snap.read_text().endswith("# EOF\n")
+        assert obs.exporter() is None

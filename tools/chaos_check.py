@@ -16,6 +16,15 @@ A fault-injection suite that only mostly passes is worse than none —
 operators stop believing red — so new fault kinds / backends must hold up
 under this before they land unmarked.
 
+Before the pytest loop it runs the **perf gate** over the checked-in
+bench trajectory, advisory-then-strict: first ``tools/perf_gate.py
+--advisory`` on the FULL trajectory (the historical BENCH_r03-r05 dark
+window prints loudly every time, so it can't fade into folklore), then
+strict with ``--known-dark 3,4,5`` grandfathering exactly that window —
+any NEW dark round or regression fails the chaos gate before a single
+pytest process spawns.  ``--skip-perf-gate`` opts out (e.g. a checkout
+without bench artifacts).
+
 Usage::
 
     python tools/chaos_check.py --runs 5
@@ -24,6 +33,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "trace_integrity"
     python tools/chaos_check.py --runs 3 -k "agg_plane"
     python tools/chaos_check.py --runs 3 -k "async_fl"
+    python tools/chaos_check.py --runs 3 --skip-perf-gate
 """
 
 from __future__ import annotations
@@ -35,6 +45,33 @@ import sys
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the historical dark window (BENCH_r03-r05 probe timeouts) — grandfathered
+# in the strict leg; anything dark beyond these rounds fails the gate
+KNOWN_DARK = "3,4,5"
+
+
+def run_perf_gate(timeout: float) -> int:
+    """Advisory pass over the full trajectory, then strict with the
+    historical dark rounds grandfathered.  Returns the strict leg's rc."""
+    import glob
+    if not glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")):
+        print("chaos_check: perf gate skipped — no BENCH_r*.json "
+              "trajectory in this checkout", flush=True)
+        return 0
+    gate = [sys.executable, os.path.join(REPO_ROOT, "tools", "perf_gate.py")]
+    try:
+        print("chaos_check: perf gate (advisory, full trajectory)",
+              flush=True)
+        subprocess.run(gate + ["--advisory"], cwd=REPO_ROOT, timeout=timeout)
+        print(f"chaos_check: perf gate (strict, --known-dark {KNOWN_DARK})",
+              flush=True)
+        strict = subprocess.run(gate + ["--known-dark", KNOWN_DARK],
+                                cwd=REPO_ROOT, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print("chaos_check: perf gate TIMED OUT", flush=True)
+        return 2
+    return strict.returncode
 
 
 def main(argv=None) -> int:
@@ -49,7 +86,17 @@ def main(argv=None) -> int:
              'trace_integrity or agg_plane or async_fl")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
+    ap.add_argument("--skip-perf-gate", action="store_true",
+                    help="skip the bench-trajectory perf gate leg")
     args = ap.parse_args(argv)
+
+    if not args.skip_perf_gate:
+        gate_rc = run_perf_gate(args.timeout)
+        if gate_rc != 0:
+            print(f"chaos_check: PERF GATE FAILED (rc={gate_rc}) — a new "
+                  "dark round or regression in the bench trajectory",
+                  flush=True)
+            return 1
 
     env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
     cmd = [sys.executable, "-m", "pytest", "tests/test_fault_tolerance.py",

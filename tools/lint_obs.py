@@ -8,7 +8,13 @@ code that grows its own ``defaultdict(int)`` counter bag or calls
 ``<sink>.emit(...)`` directly bypasses both: those numbers never reach the
 registry export and never ride the sink fan's JSONL/broker legs.
 
-This tool greps ``fedml_tpu/`` for the two patterns with comments/strings
+Two more patterns guard the exposition seam: ``print(json.dumps(...))``
+(the bench driver's stdout metric contract — library code printing JSON
+blobs races the exactly-one-metric-line guarantee) and
+``render_openmetrics(...)`` outside ``core/obs`` (exposition belongs to
+the exporter, not ad-hoc render calls).
+
+This tool greps ``fedml_tpu/`` for these patterns with comments/strings
 stripped.  ``core/obs`` and ``core/mlops`` — the two layers that ARE the
 seam — are exempt; anything else needing an exception carries a
 ``# lint_obs: allow`` pragma on the flagged line.  Wired into tier-1 via
@@ -39,6 +45,15 @@ _COUNTER_BAG = re.compile(r"(?<![\w.])defaultdict\s*\(\s*int\s*\)")
 # (or the mlops fan) calling .emit(...) — metrics and spans go through the
 # obs facade; records go through core/mlops helpers
 _SINK_EMIT = re.compile(r"(?i)\w*(?:sink|fan)\w*\s*\.\s*emit\s*\(")
+# stdout metric emission: print(json.dumps(...)) is the bench driver's
+# contract line and NOBODY else's — a library module printing JSON blobs
+# races the bench's exactly-one-metric-line stdout guarantee and is
+# invisible to the registry export
+_PRINTED_JSON = re.compile(r"(?<![\w.])print\s*\(\s*json\s*\.\s*dumps\s*\(")
+# direct exposition: rendering the registry to OpenMetrics text belongs to
+# the exporter inside core/obs — library code calling render_openmetrics
+# (or reaching for the exposition module) forks the export seam
+_DIRECT_RENDER = re.compile(r"(?<![\w.])render_openmetrics\s*\(")
 _PRAGMA = "lint_obs: allow"
 
 # the two layers that implement the seam may touch sinks/registries freely
@@ -90,6 +105,12 @@ def lint_file(path: str) -> list:
             violations.append((path, lineno, "bare counter bag", raw.rstrip()))
         if _SINK_EMIT.search(code):
             violations.append((path, lineno, "direct sink emit", raw.rstrip()))
+        if _PRINTED_JSON.search(code):
+            violations.append(
+                (path, lineno, "printed metric json", raw.rstrip()))
+        if _DIRECT_RENDER.search(code):
+            violations.append(
+                (path, lineno, "direct registry render", raw.rstrip()))
     return violations
 
 
@@ -115,8 +136,9 @@ def main(argv=None) -> int:
         print(f"lint_obs: {rel}:{lineno}: {kind}: {line.strip()}", flush=True)
     if violations:
         print(f"lint_obs: {len(violations)} violation(s) — use "
-              "obs.counter_inc/gauge_set/histogram_observe for metrics and "
-              "the core/mlops helpers for records, or mark an approved seam "
+              "obs.counter_inc/gauge_set/histogram_observe for metrics, "
+              "the core/mlops helpers for records, and the core/obs "
+              "exporter for exposition, or mark an approved seam "
               f"with '# {_PRAGMA}'", flush=True)
         return 1
     print("lint_obs: clean", flush=True)

@@ -221,6 +221,45 @@ class Trace:
             return float(roots[0].start["ts"])
         return 0.0
 
+    def attribution(self) -> Optional[Dict[str, Any]]:
+        """Where the round's wall time went: per-name SELF seconds (span
+        duration minus its children's — concurrent children can legitimately
+        sum past the round wall), plus the compile-vs-execute split the
+        simulator attached to the round-end record when available."""
+        roots = self.roots()
+        if not roots:
+            return None
+        self.link()
+        root = roots[0]
+        by_name: Dict[str, float] = {}
+        seen = set()
+
+        def walk(sn: SpanNode) -> None:
+            if sn.span_id in seen:  # defensive: corrupt parent links
+                return
+            seen.add(sn.span_id)
+            child_sum = 0.0
+            for c in sn.children:
+                child_sum += c.duration_s()
+                walk(c)
+            self_s = max(0.0, sn.duration_s() - child_sum)
+            by_name[sn.name] = by_name.get(sn.name, 0.0) + self_s
+
+        walk(root)
+        end = root.end or {}
+        out: Dict[str, Any] = {
+            "round": self.round_idx(),
+            "round_s": round(root.duration_s(), 6),
+            "n_spans": len(self.spans),
+            "self_seconds": {
+                k: round(v, 6)
+                for k, v in sorted(by_name.items(), key=lambda kv: -kv[1])},
+        }
+        for key in ("compile_s", "execute_s"):
+            if isinstance(end.get(key), (int, float)):
+                out[key] = float(end[key])
+        return out
+
     def stragglers(self, slow_factor: float) -> List[Tuple[SpanNode, float, bool]]:
         """``client.train`` spans ranked slowest-first with their duration
         (sync) or time-to-report since cycle open (async) and a flag for
@@ -261,19 +300,73 @@ def _fmt_path(path: List[SpanNode]) -> str:
     )
 
 
+def trace_payload(tr: Trace, slow_factor: float) -> Dict[str, Any]:
+    """One trace as machine-readable data (the ``--format json`` shape —
+    same numbers as the text report, so perf tooling and CI consume this
+    instead of screen-scraping)."""
+    problems = tr.problems()
+    roots = tr.roots()
+    metric_name = "time_to_report" if tr.is_async() else "dur"
+    return {
+        "trace_id": tr.trace_id,
+        "round": tr.round_idx(),
+        "duration_s": round(roots[0].duration_s(), 6) if roots else 0.0,
+        "n_spans": len(tr.spans),
+        "async": tr.is_async(),
+        "critical_path": [
+            {"name": sn.name, "node": sn.node,
+             "duration_s": round(sn.duration_s(), 6)}
+            for sn in tr.critical_path()],
+        "stragglers": [
+            {"node": sn.node, "metric": metric_name,
+             "value": round(d, 6), "slow": bool(slow)}
+            for sn, d, slow in tr.stragglers(slow_factor)],
+        "flushes": [
+            {"round": fl.round_idx,
+             "n_deltas": (fl.start or {}).get("n_deltas"),
+             "capacity": (fl.start or {}).get("capacity"),
+             "reason": (fl.start or {}).get("reason"),
+             "duration_s": round(fl.duration_s(), 6)}
+            for fl in tr.flushes()],
+        "events": [
+            {k: v for k, v in sorted(ev.items())
+             if k not in ("topic", "trace_id", "span_id")}
+            for sn in tr.spans.values() for ev in sn.events],
+        "attribution": tr.attribution(),
+        "problems": problems,
+    }
+
+
+def _ordered(traces: Dict[str, Trace]) -> List[Trace]:
+    return sorted(
+        traces.values(),
+        key=lambda t: (t.round_idx() if t.round_idx() is not None else -1,
+                       t.trace_id),
+    )
+
+
+def report_json(traces: Dict[str, Trace], slow_factor: float,
+                round_filter: Optional[int] = None, out=None) -> int:
+    """Emit the whole report as one JSON document; returns problem count."""
+    out = out if out is not None else sys.stdout
+    payloads = [trace_payload(tr, slow_factor) for tr in _ordered(traces)
+                if round_filter is None or tr.round_idx() == round_filter]
+    n_problems = sum(len(p["problems"]) for p in payloads)
+    json.dump({"n_traces": len(payloads), "n_problems": n_problems,
+               "traces": payloads}, out, sort_keys=True)
+    out.write("\n")
+    return n_problems
+
+
 def report(traces: Dict[str, Trace], slow_factor: float,
-           round_filter: Optional[int] = None, out=None) -> int:
+           round_filter: Optional[int] = None, out=None,
+           attribution: bool = False) -> int:
     """Print the per-round report; returns the total problem count."""
     # bind the stream late: a def-time sys.stdout default would dodge any
     # redirection installed after import (test capture, CLI piping)
     out = out if out is not None else sys.stdout
     n_problems = 0
-    ordered = sorted(
-        traces.values(),
-        key=lambda t: (t.round_idx() if t.round_idx() is not None else -1,
-                       t.trace_id),
-    )
-    for tr in ordered:
+    for tr in _ordered(traces):
         ri = tr.round_idx()
         if round_filter is not None and ri != round_filter:
             continue
@@ -302,6 +395,22 @@ def report(traces: Dict[str, Trace], slow_factor: float,
                   f"reason={st.get('reason', '?')} "
                   f"staleness(min/mean/max)={stal} "
                   f"dur={fl.duration_s():.3f}s", file=out)
+        if attribution:
+            att = tr.attribution()
+            if att:
+                split = ""
+                if "compile_s" in att:
+                    split = (f"  compile={att['compile_s']:.3f}s "
+                             f"execute={att.get('execute_s', 0.0):.3f}s")
+                print(f"  attribution: round={att['round_s']:.3f}s"
+                      f"{split}", file=out)
+                for name, secs in att["self_seconds"].items():
+                    if secs <= 0.0:
+                        continue
+                    pct = (100.0 * secs / att["round_s"]
+                           if att["round_s"] > 0 else 0.0)
+                    print(f"    {name:<20s} {secs:8.3f}s  {pct:5.1f}%",
+                          file=out)
         metric_name = "time_to_report" if is_async else "dur"
         for sn, d, slow in tr.stragglers(slow_factor):
             flag = "  << STRAGGLER" if slow else ""
@@ -328,16 +437,29 @@ def main(argv=None) -> int:
                     help="straggler flag threshold vs round median (default 2.0)")
     ap.add_argument("--assert-closed", action="store_true",
                     help="exit 2 if any trace has orphan/unclosed spans")
+    ap.add_argument("--attribution", action="store_true",
+                    help="per-round wall-clock attribution: self-time by "
+                         "span name + the simulator's compile/execute split")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json emits one machine-readable document with the "
+                         "same data as the text report")
     args = ap.parse_args(argv)
 
     records: List[Dict[str, Any]] = []
     for path in args.paths:
         records.extend(load_records(path))
     if not records:
-        print("trace_report: no span records found", flush=True)
+        if args.format == "json":
+            print(json.dumps({"n_traces": 0, "n_problems": 0, "traces": []}))
+        else:
+            print("trace_report: no span records found", flush=True)
         return 0
     traces = build_traces(records)
-    n_problems = report(traces, args.slow_factor, args.round)
+    if args.format == "json":
+        n_problems = report_json(traces, args.slow_factor, args.round)
+        return 2 if n_problems and args.assert_closed else 0
+    n_problems = report(traces, args.slow_factor, args.round,
+                        attribution=args.attribution)
     if n_problems:
         print(f"trace_report: {n_problems} integrity problem(s)", flush=True)
         if args.assert_closed:
