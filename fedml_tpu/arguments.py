@@ -88,6 +88,26 @@ Checkpoint / crash-recovery knobs (``train_args``; consumed by
   whether each journal append fsyncs before the upload is acked.
   ``never`` trades the power-loss guarantee for upload-path latency
   (process crashes are still covered by the OS page cache).
+* ``journal_group_commit_ms`` (float >= 0, default 0 = per-record
+  commits) — group-commit window for the update journal: concurrent
+  appends within the window coalesce into ONE write+fsync batch and
+  their transport acks are released together once the batch is durable
+  ("ack implies journaled" amortized, see ``docs/INGEST.md``).
+* ``journal_group_commit_max`` (int >= 1, default 32) — records per
+  group-commit batch before the committer stops waiting out the window.
+
+Server ingest-pipeline knobs (``train_args`` or ``comm_args``; consumed
+by ``core/distributed/comm_manager.py`` + ``core/ingest.py``, stage
+anatomy in ``docs/INGEST.md``):
+
+* ``ingest_pipeline`` (bool, default False) — stage the server receive
+  path: framing/crc/dedup stay on the transport (io) thread, handler
+  dispatch moves to a bounded-queue worker, and upload acks are released
+  by the journal's group-commit thread.  Off keeps the synchronous
+  receive loop bit-identically.
+* ``ingest_queue_depth`` (int >= 1, default 64) — bound of the io→
+  dispatch queue; a full queue backpressures the transport thread
+  instead of growing an unbounded handler backlog.
 
 Observability knobs (``tracking_args`` or ``obs_args``; consumed by
 ``core/obs``, semantics in ``docs/OBSERVABILITY.md``):
@@ -353,6 +373,36 @@ class Arguments:
                 raise ValueError(
                     "server_journal_fsync must be one of "
                     f"{JOURNAL_FSYNC_POLICIES} (got {fsync!r})")
+        # ingest-pipeline knobs (core/ingest + comm_manager staged path)
+        pipe = getattr(self, "ingest_pipeline", None)
+        if pipe is not None and not isinstance(pipe, bool):
+            if (not isinstance(pipe, str) or pipe.strip().lower() not in
+                    ("1", "true", "on", "yes", "0", "false", "off", "no")):
+                raise ValueError(
+                    "ingest_pipeline must be a bool or on/off string "
+                    f"(got {pipe!r})")
+        gc_ms = getattr(self, "journal_group_commit_ms", None)
+        if gc_ms is not None:
+            try:
+                gv = float(gc_ms)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "journal_group_commit_ms must be a number >= 0 "
+                    f"(got {gc_ms!r})")
+            if gv < 0:
+                raise ValueError(
+                    f"journal_group_commit_ms must be >= 0 (got {gv})")
+        for knob in ("journal_group_commit_max", "ingest_queue_depth"):
+            v = getattr(self, knob, None)
+            if v is None:
+                continue
+            try:
+                iv = int(v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{knob} must be an integer >= 1 (got {v!r})")
+            if iv < 1:
+                raise ValueError(f"{knob} must be >= 1 (got {iv})")
         # observability knobs (core/obs) — bad values fail here so a typo'd
         # interval doesn't silently disable the periodic metrics export
         interval = getattr(self, "obs_metrics_export_interval", None)

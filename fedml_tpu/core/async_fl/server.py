@@ -202,6 +202,14 @@ class AsyncBufferedServerMixin:
             self._dispatch_t.pop(sender, None)
             return False
         self._in_flight.pop(sender, None)
+        zero_copy = getattr(self, "_zero_copy", None)
+        if zero_copy is not None and model_params is not None:
+            # accepted (every drop path already returned): land the delta in
+            # this sender's arena — one accepted delta per sender per cycle
+            # (journal dedup above), and the flush drains the buffer before
+            # the sender can be re-dispatched, so arena reuse never clobbers
+            # a buffered delta
+            model_params = zero_copy.intern(sender, model_params)
         occ = self.async_buffer.add(sender, model_params, n_samples,
                                     version=tag, staleness=staleness)
         obs.histogram_observe("async.staleness", float(staleness))

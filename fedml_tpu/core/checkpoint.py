@@ -22,15 +22,16 @@ import logging
 import os
 import re
 import struct
+import threading
 import time
 import zlib
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
 
-from . import obs
+from . import ingest, obs
 
 logger = logging.getLogger(__name__)
 
@@ -212,6 +213,53 @@ def checkpoint_frequency(args: Any) -> int:
 
 _FRAME_HEADER = struct.Struct("!II")  # (payload length, crc32)
 
+_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+class JournalTicket:
+    """Durability handle for one asynchronously appended journal record.
+
+    Returned by :meth:`UpdateJournal.append_async`; becomes *durable* when
+    the group-commit thread has fsynced the batch containing the record.
+    Callbacks added via :meth:`add_done_callback` run on the committer
+    thread (or inline when the ticket is already settled) — the ingest
+    pipeline uses them to release the transport ack, so ``error`` must be
+    checked: an ack for a failed append would break "ack implies journaled".
+    """
+
+    __slots__ = ("_event", "_lock", "_callbacks", "error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._callbacks: List[Callable[["JournalTicket"], None]] = []
+        self.error: Optional[BaseException] = None
+
+    @property
+    def durable(self) -> bool:
+        return self._event.is_set() and self.error is None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def add_done_callback(self, fn: Callable[["JournalTicket"], None]) -> None:
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _mark(self, error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self.error = error
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:  # a bad callback must not kill the committer
+                logger.exception("journal ticket callback failed")
+
 
 class UpdateJournal:
     """Append-only per-round journal of accepted client uploads.
@@ -221,9 +269,21 @@ class UpdateJournal:
     permitting) fsynced before the caller acks the upload.  ``replay()``
     tolerates a truncated or corrupt tail — exactly what a crash mid-append
     leaves behind — by returning every complete record before it.
+
+    **Group commit** (``group_commit_ms > 0``): concurrent appends coalesce
+    into one write+fsync batch, bounded by a time window and by
+    ``group_commit_max`` records.  :meth:`append_async` serializes and
+    frames the record *eagerly* on the calling thread (so the caller may
+    reuse/mutate the tree afterwards), enqueues the frame, and returns a
+    :class:`JournalTicket` that settles once the batch is durable — the
+    PR 4 "ack implies journaled" contract is preserved, the fsync merely
+    amortized.  A torn *batch* tail looks to :meth:`replay` exactly like a
+    torn record tail (frames are self-delimiting), and every record in a
+    torn batch was by construction un-acked, so clients retransmit them.
     """
 
-    def __init__(self, directory: str, fsync: str = "always"):
+    def __init__(self, directory: str, fsync: str = "always",
+                 group_commit_ms: float = 0.0, group_commit_max: int = 32):
         fsync = str(fsync).lower()
         if fsync not in JOURNAL_FSYNC_POLICIES:
             raise ValueError(
@@ -231,7 +291,18 @@ class UpdateJournal:
                 f"got {fsync!r}")
         self.directory = directory
         self.fsync = fsync
+        self.group_commit_ms = float(group_commit_ms)
+        self.group_commit_max = max(int(group_commit_max), 1)
+        self._gc_cond = threading.Condition()
+        self._gc_queue: List[Tuple[int, bytes, JournalTicket, float]] = []
+        self._gc_urgent = False
+        self._gc_stop = False
+        self._gc_thread: Optional[threading.Thread] = None
         os.makedirs(directory, exist_ok=True)
+
+    @property
+    def group_commit_enabled(self) -> bool:
+        return self.group_commit_ms > 0.0
 
     def _path(self, round_idx: int) -> str:
         return os.path.join(self.directory, f"journal_r{int(round_idx)}.bin")
@@ -248,14 +319,33 @@ class UpdateJournal:
                 found.append(int(m.group(1)))
         return sorted(found)
 
+    def _frame(self, record: Dict[str, Any]) -> bytes:
+        return self._frame_payload(
+            serialization.msgpack_serialize(_to_host(record)))
+
+    @staticmethod
+    def _frame_payload(payload: bytes) -> bytes:
+        header = _FRAME_HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+        return header + payload
+
     def append(self, round_idx: int, record: Dict[str, Any]) -> None:
         """Durably append one record; returns only once it is on disk (under
-        the default ``always`` policy), so callers may ack afterwards."""
+        the default ``always`` policy), so callers may ack afterwards.
+
+        With group commit enabled the append still routes through the
+        committer thread (single-writer: two threads appending to the same
+        file could interleave torn frames) as an *urgent* entry and blocks
+        on its ticket — durable-on-return semantics are unchanged."""
+        if self.group_commit_enabled:
+            ticket = self.append_async(round_idx, record, urgent=True)
+            ticket.wait()
+            if ticket.error is not None:
+                raise ticket.error
+            return
         t0 = time.perf_counter()
-        payload = serialization.msgpack_serialize(_to_host(record))
-        frame = _FRAME_HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+        frame = self._frame(record)
         with open(self._path(round_idx), "ab") as f:
-            f.write(frame + payload)
+            f.write(frame)
             f.flush()
             if self.fsync == "always":
                 t_sync = time.perf_counter()
@@ -265,6 +355,152 @@ class UpdateJournal:
         obs.counter_inc("journal.appends")
         obs.histogram_observe("journal.append_seconds",
                               time.perf_counter() - t0)
+
+    def append_async(self, round_idx: int, record: Dict[str, Any],
+                     urgent: bool = False) -> JournalTicket:
+        """Enqueue one record for the next group-commit batch and return its
+        :class:`JournalTicket`.  Serialization happens HERE, on the calling
+        thread — the record (and any arena-backed arrays inside it) may be
+        reused the moment this returns.  With group commit disabled this
+        degrades to a blocking :meth:`append` returning a settled ticket."""
+        ticket = JournalTicket()
+        if not self.group_commit_enabled:
+            try:
+                self.append(round_idx, record)
+            except Exception as e:
+                ticket._mark(e)
+                return ticket
+            ticket._mark()
+            return ticket
+        t0 = time.perf_counter()
+        frame = self._frame(record)
+        return self._enqueue(round_idx, frame, ticket, t0, urgent)
+
+    def append_blob_async(self, round_idx: int, payload: bytes,
+                          urgent: bool = False) -> JournalTicket:
+        """Zero-copy variant of :meth:`append_async`: ``payload`` is already
+        the canonical msgpack record bytes (e.g. the received wire blob, the
+        exact bytes :meth:`_frame` would have produced), so it is framed
+        verbatim with no decode→re-encode round trip.  :meth:`replay` reads
+        it back identically to a record serialized here."""
+        ticket = JournalTicket()
+        t0 = time.perf_counter()
+        frame = self._frame_payload(payload)
+        if not self.group_commit_enabled:
+            try:
+                with open(self._path(round_idx), "ab") as f:
+                    f.write(frame)
+                    f.flush()
+                    if self.fsync == "always":
+                        t_sync = time.perf_counter()
+                        os.fsync(f.fileno())
+                        obs.histogram_observe("journal.fsync_seconds",
+                                              time.perf_counter() - t_sync)
+            except Exception as e:
+                ticket._mark(e)
+                return ticket
+            obs.counter_inc("journal.appends")
+            obs.histogram_observe("journal.append_seconds",
+                                  time.perf_counter() - t0)
+            ticket._mark()
+            return ticket
+        return self._enqueue(round_idx, frame, ticket, t0, urgent)
+
+    def _enqueue(self, round_idx: int, frame: bytes, ticket: JournalTicket,
+                 t0: float, urgent: bool) -> JournalTicket:
+        with self._gc_cond:
+            if self._gc_stop:
+                ticket._mark(RuntimeError("journal is closed"))
+                return ticket
+            if self._gc_thread is None:
+                self._gc_thread = threading.Thread(
+                    target=self._commit_loop, daemon=True,
+                    name="journal-group-commit")
+                self._gc_thread.start()
+            self._gc_queue.append((int(round_idx), frame, ticket, t0))
+            if urgent:
+                self._gc_urgent = True
+                self._gc_cond.notify_all()
+            elif (len(self._gc_queue) == 1
+                    or len(self._gc_queue) >= self.group_commit_max):
+                # wake the committer only when its state can change: the
+                # first record ends its idle wait, a full batch ends the
+                # coalesce window early.  Waking it on EVERY append costs
+                # two context switches per record and dominates the enqueue
+                # path; mid-window it re-checks on its own timed wait.
+                self._gc_cond.notify_all()
+        return ticket
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every record enqueued so far is durable."""
+        with self._gc_cond:
+            pending = [t for _, _, t, _ in self._gc_queue]
+            self._gc_urgent = self._gc_urgent or bool(pending)
+            self._gc_cond.notify_all()
+        for t in pending:
+            t.wait(timeout)
+
+    def close(self) -> None:
+        """Commit any pending batch and stop the committer thread."""
+        with self._gc_cond:
+            self._gc_stop = True
+            self._gc_cond.notify_all()
+            thread = self._gc_thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=10.0)
+
+    def _commit_loop(self) -> None:
+        while True:
+            with self._gc_cond:
+                while not self._gc_queue and not self._gc_stop:
+                    self._gc_cond.wait()
+                if not self._gc_queue and self._gc_stop:
+                    return
+                # window: give concurrent appends a chance to coalesce,
+                # bounded by time, batch size, and urgency (blocking append
+                # or explicit flush must not eat the full window)
+                deadline = time.monotonic() + self.group_commit_ms / 1000.0
+                while (len(self._gc_queue) < self.group_commit_max
+                       and not self._gc_urgent and not self._gc_stop):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._gc_cond.wait(timeout=remaining)
+                batch = self._gc_queue[:self.group_commit_max]
+                del self._gc_queue[:self.group_commit_max]
+                self._gc_urgent = bool(self._gc_queue)
+            self._commit_batch(batch)
+
+    def _commit_batch(
+            self, batch: List[Tuple[int, bytes, JournalTicket, float]]) -> None:
+        t_batch = time.perf_counter()
+        by_round: Dict[int, List[Tuple[bytes, JournalTicket, float]]] = {}
+        for rid, frame, ticket, t0 in batch:
+            by_round.setdefault(rid, []).append((frame, ticket, t0))
+        for rid, entries in by_round.items():
+            err: Optional[BaseException] = None
+            try:
+                with open(self._path(rid), "ab") as f:
+                    f.write(b"".join(frame for frame, _, _ in entries))
+                    f.flush()
+                    if self.fsync == "always":
+                        t_sync = time.perf_counter()
+                        os.fsync(f.fileno())
+                        obs.histogram_observe("journal.fsync_seconds",
+                                              time.perf_counter() - t_sync)
+            except Exception as e:  # tickets carry the error; acks stay held
+                logger.exception("journal group commit failed for round %d", rid)
+                err = e
+            now = time.perf_counter()
+            for _, ticket, t0 in entries:
+                if err is None:
+                    obs.counter_inc("journal.appends")
+                    obs.histogram_observe("journal.append_seconds", now - t0)
+                ticket._mark(err)
+        obs.histogram_observe("journal.batch_records", len(batch),
+                              buckets=_BATCH_BUCKETS)
+        obs.histogram_observe("ingest.batch_fsync_seconds",
+                              time.perf_counter() - t_batch)
 
     def replay(self, round_idx: int) -> Tuple[List[Dict[str, Any]], int]:
         """Read back ``(records, bad_tail)`` for a round.  ``bad_tail`` is 1
@@ -323,10 +559,17 @@ class ServerStateStore:
     flight; journals for finished rounds are pruned at the next round open.
     """
 
-    def __init__(self, directory: str, keep: int = 3, fsync: str = "always"):
+    def __init__(self, directory: str, keep: int = 3, fsync: str = "always",
+                 group_commit_ms: float = 0.0, group_commit_max: int = 32):
         self.directory = directory
         self.snapshots = CheckpointManager(os.path.join(directory, "state"), keep=keep)
-        self.journal = UpdateJournal(os.path.join(directory, "journal"), fsync=fsync)
+        self.journal = UpdateJournal(os.path.join(directory, "journal"), fsync=fsync,
+                                     group_commit_ms=group_commit_ms,
+                                     group_commit_max=group_commit_max)
+
+    def close(self) -> None:
+        self.journal.flush(timeout=10.0)
+        self.journal.close()
 
     def save_round_start(self, round_idx: int, state: Any,
                          metadata: Optional[Dict[str, Any]] = None) -> str:
@@ -347,7 +590,9 @@ def maybe_server_store(args: Any) -> Optional[ServerStateStore]:
 
     Config keys: ``server_checkpoint_dir`` (enables), ``checkpoint_keep``
     (snapshot retention, default 3), ``server_journal_fsync``
-    (``always`` | ``never``, default ``always``)."""
+    (``always`` | ``never``, default ``always``),
+    ``journal_group_commit_ms`` / ``journal_group_commit_max`` (group-commit
+    window; 0 ms = per-record commits, the pre-PR-10 behaviour)."""
     directory = getattr(args, "server_checkpoint_dir", None)
     if not directory:
         return None
@@ -355,6 +600,8 @@ def maybe_server_store(args: Any) -> Optional[ServerStateStore]:
         str(directory),
         keep=int(getattr(args, "checkpoint_keep", 3)),
         fsync=str(getattr(args, "server_journal_fsync", "always")),
+        group_commit_ms=float(getattr(args, "journal_group_commit_ms", 0.0)),
+        group_commit_max=int(getattr(args, "journal_group_commit_max", 32)),
     )
 
 
@@ -462,9 +709,14 @@ class ServerRecoveryMixin:
 
     def _journal_upload(self, sender: int, **payload: Any) -> bool:
         """Record one accepted upload; False = duplicate for this round (the
-        caller must drop it without touching the slot table).  The append is
-        durable before return, and the transport ack happens only after the
-        handler returns (ack-after-dispatch), so ack implies journaled."""
+        caller must drop it without touching the slot table).  On the host
+        path the append is durable before return, and the transport ack
+        happens only after the handler returns (ack-after-dispatch), so ack
+        implies journaled.  Under the ingest pipeline the append is enqueued
+        for group commit and its ticket handed to the ambient
+        :func:`~fedml_tpu.core.ingest.deferred_ack_scope` sink — the
+        pipeline releases the ack only once the ticket is durable, so the
+        contract holds there too, just amortized."""
         sender = int(sender)
         if sender in self._uploads_this_round:
             self._comm_stats.inc("dup_uploads_discarded")
@@ -474,9 +726,23 @@ class ServerRecoveryMixin:
         if self._store is not None:
             record = {"round_idx": int(self.args.round_idx), "sender": sender}
             record.update(payload)
-            self._store.journal.append(self.args.round_idx, record)
+            journal = self._store.journal
+            sink = (ingest.current_sink()
+                    if journal.group_commit_enabled else None)
+            if sink is not None:
+                sink.add(journal.append_async(self.args.round_idx, record))
+            else:
+                journal.append(self.args.round_idx, record)
         self._uploads_this_round.add(sender)
         return True
+
+    def finish(self) -> None:
+        """Flush any pending group-commit batch (releasing its held acks)
+        before the transport goes down, then tear the store down."""
+        store = getattr(self, "_store", None)
+        if store is not None:
+            store.close()
+        super().finish()
 
     def _maybe_close_recovered_round(self) -> None:
         """One-shot, called from the status handler once transport is live:

@@ -34,6 +34,7 @@ differ from clean runs only in config.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 import uuid
@@ -47,7 +48,7 @@ from ...constants import (
     FEDML_BACKEND_MQTT_S3_MNN,
     FEDML_BACKEND_TRPC,
 )
-from .. import obs
+from .. import ingest, obs
 from .communication.base_com_manager import BaseCommunicationManager, Observer
 from .communication.message import Message
 from .faults import CommStats
@@ -191,7 +192,8 @@ class _ReliableLink:
 
     # -- receive side --------------------------------------------------------
     def on_receive(self, msg: Message,
-                   dispatch: Optional[Callable[[Message], None]] = None) -> bool:
+                   dispatch: Optional[Callable[[Message], None]] = None,
+                   pipeline: Optional["_IngestPipeline"] = None) -> bool:
         """Return True iff ``msg`` is (or should be) dispatched to handlers.
 
         Consumes acks, acks every stamped message (dup or not — the ack may
@@ -202,6 +204,13 @@ class _ReliableLink:
         duty — ack implies processed.  A dispatch that raises withholds the
         ack and forgets the msg_id, so the sender's retransmit retries the
         delivery instead of losing it.
+
+        With ``pipeline`` set (the server's staged receive path), this
+        method becomes the io stage: ack consumption, dedup and re-acking
+        of duplicates stay on the transport thread, but fresh messages are
+        handed to the pipeline's bounded queue — the worker dispatches and
+        the ack is released once the handler's journal batch is durable,
+        so the contract is unchanged, only off-thread.
         """
         if msg.get_type() == COMM_ACK_TYPE:
             acked = msg.get(Message.MSG_ARG_KEY_MSG_ID)
@@ -211,8 +220,11 @@ class _ReliableLink:
                     self._pending.pop(str(acked), None)
             return False
         if msg.get_type() in _LOCAL_TYPES or msg.get(Message.MSG_ARG_KEY_MSG_ID) is None:
-            # local pseudo-message or legacy peer: no dedup, no ack
-            if dispatch is not None:
+            # local pseudo-message or legacy peer: no dedup, no ack — still
+            # staged through the pipeline so handler FIFO order is preserved
+            if pipeline is not None:
+                pipeline.submit(msg, needs_ack=False)
+            elif dispatch is not None:
                 dispatch(msg)
             return True
         msg_id = msg.get(Message.MSG_ARG_KEY_MSG_ID)
@@ -231,6 +243,9 @@ class _ReliableLink:
                         self.rank, msg_id, msg.get_type())
             self._send_ack(msg)  # re-ack: the first ack may have been lost
             return False
+        if pipeline is not None:
+            pipeline.submit(msg, needs_ack=True)
+            return True
         if dispatch is not None:
             try:
                 dispatch(msg)
@@ -240,6 +255,14 @@ class _ReliableLink:
                 raise
         self._send_ack(msg)
         return True
+
+    def forget(self, msg: Message) -> None:
+        """Drop ``msg`` from the dedup window so the sender's retransmit is
+        redelivered instead of re-acked (failed-dispatch recovery)."""
+        msg_id = msg.get(Message.MSG_ARG_KEY_MSG_ID)
+        if msg_id is not None:
+            with self._seen_lock:
+                self._seen.pop(msg_id, None)
 
     def _send_ack(self, msg: Message) -> None:
         ack = Message(COMM_ACK_TYPE, self.rank, msg.get_sender_id())
@@ -253,6 +276,130 @@ class _ReliableLink:
             # best-effort: a lost ack just means the peer retransmits into
             # the dedup window
             logger.info("rank %s: ack send failed (%s)", self.rank, e)
+
+
+class _IngestPipeline:
+    """Staged server receive path (the PR 10 tentpole's transport stage).
+
+    Splits the per-message work the host path serializes on the transport
+    thread across three actors:
+
+    * **io stage** — the transport receive thread runs only
+      :meth:`_ReliableLink.on_receive`'s framing/ack/dedup and a bounded
+      ``queue.Queue.put`` (backpressure: a full queue stalls the wire
+      instead of growing an unbounded handler backlog);
+    * **dispatch stage** — ONE worker thread runs the registered handlers,
+      preserving the single-threaded-handler invariant every manager's
+      round state machine assumes (FIFO per connection is also kept: the io
+      stage enqueues in arrival order, including local pseudo-messages);
+    * **durability stage** — handlers journal uploads via
+      ``append_async``; their tickets are collected by the ambient
+      :func:`~fedml_tpu.core.ingest.deferred_ack_scope` and the transport
+      ack is released from the group-commit thread once the whole batch is
+      fsynced.  "Ack implies journaled" (PR 4) holds exactly; a message
+      whose dispatch (or journal batch) fails is forgotten from the dedup
+      window and never acked, so the sender retransmits it.
+
+    Observability: ``ingest.queue_depth`` gauge, per-stage
+    ``ingest.stage_seconds`` histograms, and one ``ingest.accept`` span per
+    traced message nested under the round tree (closed on every path, so
+    ``trace_report --assert-closed`` stays green).
+    """
+
+    def __init__(self, manager: "FedMLCommManager", link: _ReliableLink,
+                 depth: int = 64):
+        self._manager = manager
+        self._link = link
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
+        self._stop_flag = False
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True,
+            name=f"ingest-rank{manager.rank}")
+        self._thread.start()
+
+    def submit(self, msg: Message, needs_ack: bool) -> None:
+        self._queue.put((msg, needs_ack, time.perf_counter()))
+        obs.gauge_set("ingest.queue_depth", self._queue.qsize())
+
+    def stop(self) -> None:
+        self._stop_flag = True
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=10.0)
+
+    def _worker(self) -> None:
+        while True:
+            try:
+                msg, needs_ack, t_enq = self._queue.get(timeout=0.25)
+            except queue.Empty:
+                if self._stop_flag:
+                    return
+                continue
+            obs.gauge_set("ingest.queue_depth", self._queue.qsize())
+            obs.histogram_observe("ingest.stage_seconds",
+                                  time.perf_counter() - t_enq,
+                                  labels={"stage": "queue"})
+            try:
+                self._process(msg, needs_ack)
+            except Exception:  # the worker must survive any one message
+                logger.exception("ingest worker: unexpected failure on %s",
+                                 msg.get_type())
+
+    def _process(self, msg: Message, needs_ack: bool) -> None:
+        t0 = time.perf_counter()
+        ctx = obs.extract(msg)
+        span = (obs.unique_span("ingest.accept", ctx,
+                                node=self._manager.rank,
+                                msg_type=str(msg.get_type()))
+                if ctx is not None else obs.NULL_SPAN)
+        try:
+            with ingest.deferred_ack_scope() as sink:
+                self._manager._dispatch(msg)
+        except BaseException as e:
+            # sync-path parity: withhold the ack and forget the msg_id so
+            # the sender's retransmit retries the delivery — but keep the
+            # worker alive (the receive loop it replaces would have died)
+            self._link.forget(msg)
+            span.end(error=str(e))
+            logger.exception("ingest worker: dispatch of %s failed",
+                             msg.get_type())
+            return
+        obs.histogram_observe("ingest.stage_seconds",
+                              time.perf_counter() - t0,
+                              labels={"stage": "dispatch"})
+        if not needs_ack:
+            span.end()
+            return
+        if not sink.tickets:
+            self._link._send_ack(msg)
+            span.end()
+            return
+        self._ack_when_durable(msg, list(sink.tickets), span)
+
+    def _ack_when_durable(self, msg: Message, tickets, span) -> None:
+        """Release the transport ack once every journal ticket the dispatch
+        produced is durable (runs on the group-commit thread)."""
+        state = {"remaining": len(tickets), "error": None}
+        lock = threading.Lock()
+
+        def _done(ticket) -> None:
+            with lock:
+                if ticket.error is not None and state["error"] is None:
+                    state["error"] = ticket.error
+                state["remaining"] -= 1
+                if state["remaining"]:
+                    return
+                error = state["error"]
+            if error is not None:
+                # no ack for a failed batch: forget the msg_id so the
+                # sender's retransmit re-journals the upload
+                self._link.forget(msg)
+                span.end(error=str(error))
+                return
+            self._link._send_ack(msg)
+            span.end()
+
+        for t in tickets:
+            t.add_done_callback(_done)
 
 
 class FedMLCommManager(Observer):
@@ -269,6 +416,7 @@ class FedMLCommManager(Observer):
         self._init_manager()
         if self._link is not None:
             self._link.bind(self._raw_send)
+        self._pipeline = self._init_pipeline()
 
     def _init_link(self) -> Optional[_ReliableLink]:
         a = self.args
@@ -283,6 +431,16 @@ class FedMLCommManager(Observer):
             jitter=float(g("comm_backoff_jitter", 0.25)),
             dedup_window=int(g("comm_dedup_window", 8192)),
         )
+
+    def _init_pipeline(self) -> Optional[_IngestPipeline]:
+        """The staged ingest path is a SERVER feature (rank 0 fans in the
+        whole cohort's uploads); clients keep the synchronous receive loop."""
+        a = self.args
+        if (self._link is None or a is None or self.rank != 0
+                or not ingest.pipeline_enabled(a)):
+            return None
+        depth = int(getattr(a, "ingest_queue_depth", 64))
+        return _IngestPipeline(self, self._link, depth=depth)
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -301,6 +459,8 @@ class FedMLCommManager(Observer):
 
     def finish(self) -> None:
         """Stop the transport (reference ``fedml_comm_manager.py:61-76``)."""
+        if self._pipeline is not None:
+            self._pipeline.stop()
         if self._link is not None:
             self._link.stop()
         self._report_comm_stats()
@@ -375,6 +535,16 @@ class FedMLCommManager(Observer):
     def receive_message(self, msg_type: str, msg_params: Message) -> None:
         if self._link is None:
             self._dispatch(msg_params)
+            return
+        if self._pipeline is not None:
+            # staged path: this thread is the io stage — dedup + enqueue
+            # only; dispatch and (post-durability) ack happen downstream
+            t0 = time.perf_counter()
+            self._link.on_receive(msg_params, self._dispatch,
+                                  pipeline=self._pipeline)
+            obs.histogram_observe("ingest.stage_seconds",
+                                  time.perf_counter() - t0,
+                                  labels={"stage": "io"})
             return
         # the link calls _dispatch for fresh messages BEFORE acking them, so
         # handler-side durable effects (update journal) precede the ack

@@ -80,7 +80,15 @@ class Message:
         return self.msg_params
 
     def get(self, key: str, default: Any = None) -> Any:
-        return self.msg_params.get(key, default)
+        value = self.msg_params.get(key, default)
+        # duck-typed unwrap of serialization.CachedPayload (imported lazily
+        # by name to avoid a cycle): loopback passes the wrapper by
+        # reference, so the in-process receiver unwraps here; wire backends
+        # already unwrapped via pickle __reduce__
+        unwrap = getattr(value, "__fedml_unwrap__", None)
+        if unwrap is not None:
+            return unwrap()
+        return value
 
     def get_type(self) -> str:
         return str(self.msg_params[Message.MSG_ARG_KEY_TYPE])

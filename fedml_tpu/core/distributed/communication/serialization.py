@@ -10,6 +10,7 @@ and (b) transfers happen once, explicitly.
 from __future__ import annotations
 
 import pickle
+import threading
 from typing import Any
 
 
@@ -31,3 +32,48 @@ def dumps(obj: Any) -> bytes:
 
 def loads(data: bytes) -> Any:
     return pickle.loads(data)
+
+
+def _load_cached(blob: bytes) -> Any:
+    return pickle.loads(blob)
+
+
+class CachedPayload:
+    """A pytree wrapper whose wire serialization is computed once and reused.
+
+    The server broadcast path serializes the identical global model once per
+    invited client (and once more per retransmit).  Wrapping the tree in
+    ``CachedPayload`` makes every wire backend reuse ONE precomputed pickle
+    blob: the wrapper is an unregistered pytree node, so ``tree_map`` /
+    ``device_get_tree`` pass it through as a leaf, and ``pickle`` hits
+    :meth:`__reduce__`, which substitutes the cached bytes.  The blob is
+    built lazily under a lock on first pickle — a loopback run (pass by
+    reference) never pays for serialization at all; receivers (and the
+    loopback in-process path via ``Message.get``) unwrap through
+    ``__fedml_unwrap__``.
+    """
+
+    __slots__ = ("_tree", "_blob", "_lock")
+
+    def __init__(self, tree: Any):
+        self._tree = tree
+        self._blob: bytes = b""
+        self._lock = threading.Lock()
+
+    def __fedml_unwrap__(self) -> Any:
+        return self._tree
+
+    def wire_bytes(self) -> bytes:
+        from ... import obs
+
+        with self._lock:
+            if not self._blob:
+                self._blob = pickle.dumps(device_get_tree(self._tree),
+                                          protocol=pickle.HIGHEST_PROTOCOL)
+                obs.counter_inc("broadcast.payload_builds")
+            else:
+                obs.counter_inc("broadcast.payload_cache_hits")
+        return self._blob
+
+    def __reduce__(self):
+        return (_load_cached, (self.wire_bytes(),))

@@ -20,7 +20,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
-from ..core import obs
+from ..core import ingest, obs
 from ..core.async_fl import AsyncBufferedServerMixin
 from ..core.checkpoint import ServerRecoveryMixin
 from ..core.distributed.comm_manager import FedMLCommManager
@@ -63,6 +63,13 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         # accepted-upload file per (sender, version): deleted only once the
         # flush that consumed the delta has a durable successor snapshot
         self._async_files: Dict[tuple, str] = {}
+        # broadcast cache: export the global model FILE once per round — the
+        # file-plane analog of cross_silo's serialized-payload cache
+        self._model_file_cache: tuple = (None, None)
+        # zero-copy ingest arenas for the async accept path (the sync path
+        # stores file references, nothing to intern)
+        self._zero_copy = (ingest.ZeroCopyDecoder()
+                           if ingest.pipeline_enabled(args) else None)
         # crash recovery last: a restore overwrites round_idx / participant
         # list / registry columns and replays the open round's journal
         self.init_server_recovery(args)
@@ -116,12 +123,23 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             return
         if self.client_id_list_in_this_round.index(client_id) in self.aggregator.received_indices():
             return
-        model_file = self.aggregator.get_global_model_params_file(self.args.round_idx)
+        model_file = self._round_model_file()
         m = Message(MNNMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
         m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, model_file)
         m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_INDEX, client_id - 1)
         m.add_params(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
         self._send_safe(m)
+
+    def _round_model_file(self) -> str:
+        """Export the global model file at most once per round: every invite,
+        resync and async dispatch of one round hands out the same path
+        instead of re-serializing the identical model per device."""
+        key = int(self.args.round_idx)
+        cached_key, path = self._model_file_cache
+        if cached_key != key:
+            path = self.aggregator.get_global_model_params_file(key)
+            self._model_file_cache = (key, path)
+        return path
 
     def send_init_msg(self) -> None:
         self._send_round(MNNMessage.MSG_TYPE_S2C_INIT_CONFIG)
@@ -139,7 +157,7 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             self.client_id_list_in_this_round = self._population_round_list(
                 self.args.round_idx, self.per_round
             )
-        model_file = self.aggregator.get_global_model_params_file(self.args.round_idx)
+        model_file = self._round_model_file()
         # durable round-open point: cohort is fixed, no upload accepted yet —
         # a crash from here on resumes this round in a fresh incarnation
         self._save_round_start()
@@ -262,7 +280,7 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                 pass
 
     def _async_send_model(self, client_id: int, parent_ctx=None) -> None:
-        model_file = self.aggregator.get_global_model_params_file(self.args.round_idx)
+        model_file = self._round_model_file()
         m = Message(MNNMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
         m.add_params(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE, model_file)
         m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_INDEX, client_id - 1)

@@ -23,11 +23,12 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ...core import obs
+from ...core import ingest, obs
 from ...core.async_fl import AsyncBufferedServerMixin
 from ...core.checkpoint import ServerRecoveryMixin
 from ...core.distributed.comm_manager import FedMLCommManager
 from ...core.distributed.communication.message import Message
+from ...core.distributed.communication.serialization import CachedPayload
 from ...core.distributed.straggler import RoundTimeoutMixin
 from ...core.obs.rounds import RoundObsMixin
 from ...core.population import PopulationPacingMixin
@@ -51,6 +52,11 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         self.client_id_list_in_this_round: List[int] = []
         self.data_silo_index_of_client: Dict[int, int] = {}
         self.eval_history: List[Dict[str, Any]] = []
+        # broadcast-payload cache: one serialized blob per round's fan-out
+        self._bcast_cache: tuple = (None, None)
+        # zero-copy ingest arenas (per-sender), active with the pipeline
+        self._zero_copy = (ingest.ZeroCopyDecoder()
+                           if ingest.pipeline_enabled(args) else None)
         # straggler tolerance (0 = reference semantics: wait forever) —
         # the shared machinery lives in core/distributed/straggler.py
         self.init_straggler_tolerance(args)
@@ -127,10 +133,22 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         if pos in self.aggregator.received_indices():
             return  # its upload already landed; the round-close sync suffices
         m = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
-        m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.aggregator.get_global_model_params())
+        m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self._broadcast_payload())
         m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, self.data_silo_index_of_client[client_id])
         m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)
         self._send_safe(m)
+
+    def _broadcast_payload(self) -> CachedPayload:
+        """The round's global model wrapped for serialize-once fan-out: every
+        invite/sync/resync (and the reliable link's retransmits, which reuse
+        the tracked Message object) of one round shares ONE wire blob instead
+        of re-pickling the identical tree per client."""
+        key = int(self.args.round_idx)
+        cached_key, payload = self._bcast_cache
+        if cached_key != key:
+            payload = CachedPayload(self.aggregator.get_global_model_params())
+            self._bcast_cache = (key, payload)
+        return payload
 
     def send_init_msg(self) -> None:
         """Round-0 kick-off (reference send_message_init_config :182)."""
@@ -147,7 +165,7 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                     len(self.client_id_list_in_this_round),
                 ),
             ))
-        global_model = self.aggregator.get_global_model_params()
+        global_model = self._broadcast_payload()
         # durable round-open point: participants + silo map are fixed, no
         # upload has been accepted yet — a crash from here on resumes round 0
         self._save_round_start()
@@ -221,6 +239,10 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                     jsp.event("dup", side="journal", sender=sender)
             if not ok:
                 return
+            if self._zero_copy is not None:
+                # accepted: land the leaves in this sender's preallocated
+                # arena (reused next round, AFTER aggregation consumed it)
+                model_params = self._zero_copy.intern(sender, model_params)
             self.aggregator.add_local_trained_result(
                 self.client_id_list_in_this_round.index(sender), model_params,
                 local_sample_number,
@@ -278,7 +300,7 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                     len(self.client_id_list_in_this_round),
                 ),
             ))
-        global_model = self.aggregator.get_global_model_params()
+        global_model = self._broadcast_payload()
         # durable round-open point (see send_init_msg): a crash during or
         # after the sync sends resumes THIS round, and clients that already
         # got the sync are re-synced idempotently on their next ONLINE
@@ -311,7 +333,7 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         cid = int(client_id)
         m = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, cid)
         m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                     self.aggregator.get_global_model_params())
+                     self._broadcast_payload())
         m.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
                      self.data_silo_index_of_client.get(cid, cid - 1))
         m.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.args.round_idx)

@@ -157,6 +157,164 @@ class TestUpdateJournal:
             self._journal(tmp_path, fsync="sometimes")
 
 
+class TestGroupCommitJournal:
+    """PR 10 group-commit semantics: one fsync per batch, ack (ticket)
+    released only once the batch is durable, replay tolerant of a batch
+    torn by a crash mid-write."""
+
+    def _journal(self, tmp_path, **kw):
+        from fedml_tpu.core.checkpoint import UpdateJournal
+
+        kw.setdefault("group_commit_ms", 5.0)
+        return UpdateJournal(str(tmp_path / "j"), **kw)
+
+    def test_concurrent_appends_coalesce_and_all_go_durable(self, tmp_path):
+        import threading
+
+        from fedml_tpu.core import obs
+
+        def batches_committed():
+            h = obs.registry().get_histogram("journal.batch_records")
+            return int(h["count"]) if h else 0
+
+        j = self._journal(tmp_path, group_commit_max=16)
+        b0 = batches_committed()
+        tickets = []
+        lock = threading.Lock()
+
+        def producer(base):
+            for i in range(10):
+                t = j.append_async(0, {"sender": base + i})
+                with lock:
+                    tickets.append(t)
+
+        threads = [threading.Thread(target=producer, args=(base,))
+                   for base in (0, 100, 200, 300)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        j.flush(timeout=10.0)
+        assert all(t.durable for t in tickets)
+        records, bad_tail = j.replay(0)
+        assert bad_tail == 0
+        assert sorted(int(r["sender"]) for r in records) == sorted(
+            base + i for base in (0, 100, 200, 300) for i in range(10))
+        # the whole point: 40 records reached disk in far fewer commits
+        assert 1 <= batches_committed() - b0 < 40
+        j.close()
+
+    def test_blocking_append_is_durable_on_return(self, tmp_path):
+        # a blocking append routes through the committer as urgent — it
+        # must not wait out a long coalesce window, and the record must be
+        # on disk (replayable) the moment it returns
+        import time as _time
+
+        j = self._journal(tmp_path, group_commit_ms=30000.0)
+        t0 = _time.monotonic()
+        j.append(0, {"sender": 7})
+        assert _time.monotonic() - t0 < 5.0
+        records, bad_tail = j.replay(0)
+        assert bad_tail == 0 and [int(r["sender"]) for r in records] == [7]
+        j.close()
+
+    def test_kill_mid_batch_drops_only_torn_tail(self, tmp_path):
+        # first batch acked and durable; then a crash tears the trailing
+        # batch mid-write — replay must keep every acked record and drop
+        # only the torn frame(s)
+        j = self._journal(tmp_path)
+        acked = [j.append_async(0, {"sender": s}) for s in (1, 2, 3)]
+        j.flush(timeout=10.0)
+        assert all(t.durable for t in acked)
+        path = tmp_path / "j" / "journal_r0.bin"
+        durable_blob = path.read_bytes()
+        second = [j.append_async(0, {"sender": s}) for s in (4, 5)]
+        j.flush(timeout=10.0)
+        assert all(t.durable for t in second)
+        torn = path.read_bytes()
+        # the "kill": the second batch's write only partially hit the disk
+        path.write_bytes(torn[:len(durable_blob) + 7])
+        records, bad_tail = j.replay(0)
+        assert bad_tail == 1
+        assert [int(r["sender"]) for r in records] == [1, 2, 3]
+        j.close()
+
+    def test_unacked_tickets_never_claim_durability_on_io_error(self, tmp_path):
+        import shutil
+
+        j = self._journal(tmp_path)
+        probe = j.append_async(0, {"sender": 1})
+        j.flush(timeout=10.0)
+        assert probe.durable
+        # yank the directory out from under the committer: the next batch
+        # cannot commit, its tickets must carry the error and stay
+        # non-durable (the pipeline withholds those acks; senders retry)
+        shutil.rmtree(tmp_path / "j")
+        t = j.append_async(0, {"sender": 2})
+        assert t.wait(10.0)
+        assert not t.durable
+        assert t.error is not None
+        j.close()
+
+    def test_append_after_close_is_refused(self, tmp_path):
+        j = self._journal(tmp_path)
+        ok = j.append_async(0, {"sender": 1})
+        assert ok.wait(10.0) and ok.durable
+        j.close()
+        late = j.append_async(0, {"sender": 2})
+        assert late.wait(1.0)
+        assert not late.durable and isinstance(late.error, RuntimeError)
+
+    def test_done_callback_fires_after_durability(self, tmp_path):
+        import threading
+
+        j = self._journal(tmp_path)
+        fired = threading.Event()
+        seen = {}
+
+        t = j.append_async(0, {"sender": 1})
+        t.add_done_callback(lambda tk: (seen.setdefault("durable", tk.durable),
+                                        fired.set()))
+        assert fired.wait(10.0)
+        assert seen["durable"] is True
+        # late registration on a settled ticket fires inline
+        late = threading.Event()
+        t.add_done_callback(lambda tk: late.set())
+        assert late.is_set()
+        j.close()
+
+    def test_append_blob_async_replays_like_append(self, tmp_path):
+        from flax import serialization
+
+        j = self._journal(tmp_path)
+        tree = {"w": np.arange(4.0, dtype=np.float32)}
+        blob = serialization.msgpack_serialize(
+            {"sender": 1, "n_samples": 8, "model_params": tree})
+        tb = j.append_blob_async(0, blob)
+        tr = j.append_async(0, {"sender": 2, "n_samples": 8,
+                                "model_params": tree})
+        j.flush(timeout=10.0)
+        assert tb.durable and tr.durable
+        records, bad_tail = j.replay(0)
+        assert bad_tail == 0
+        assert [int(r["sender"]) for r in records] == [1, 2]
+        np.testing.assert_array_equal(records[0]["model_params"]["w"],
+                                      tree["w"])
+        j.close()
+
+    def test_group_commit_disabled_append_async_degrades_to_blocking(
+            self, tmp_path):
+        from fedml_tpu.core.checkpoint import UpdateJournal
+
+        j = UpdateJournal(str(tmp_path / "j"))  # group commit off
+        assert not j.group_commit_enabled
+        t = j.append_async(0, {"sender": 1})
+        assert t.durable  # settled before return: the blocking path
+        records, bad_tail = j.replay(0)
+        assert bad_tail == 0 and len(records) == 1
+        j.close()
+
+
 class TestServerStateStore:
     def test_roundtrip_and_journal_reset_on_round_open(self, tmp_path):
         from fedml_tpu.core.checkpoint import ServerStateStore

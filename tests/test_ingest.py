@@ -1,0 +1,582 @@
+"""The staged server ingest path (PR 10): deferred acks + zero-copy decode.
+
+Three strata, mirroring the fault-tolerance and obs suites:
+
+* **Unit** — the knob parse, the thread-local ticket sink, and the
+  ``ZeroCopyDecoder``'s two planes (pytree intern, flax-msgpack bytes):
+  arena reuse, signature-drift fallback, and the ``forget`` lifecycle.
+* **Pipeline** — ``_IngestPipeline`` against fake manager/link seams: a
+  message is NEVER acked before every journal ticket its dispatch produced
+  is durable; a failed dispatch or failed batch forgets the msg-id (so the
+  sender retransmits) and withholds the ack; FIFO dispatch order survives
+  the staging.
+* **Topology** — the acceptance layer, reusing the chaos harness from
+  ``test_fault_tolerance``: the full chaos plan and the server-kill plan
+  run with ``ingest_pipeline=True`` must converge BIT-IDENTICAL to the
+  fault-free host-path model with exactly-once upload accounting, and a
+  traced pipelined run must keep every round a single CLOSED span tree
+  (``trace_report --assert-closed``) with the per-message ``ingest.accept``
+  span present — on LOOPBACK in tier 1 and on every socketed backend in
+  the slow sweep.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import trace_report
+
+import test_fault_tolerance as _ft
+from fedml_tpu.core import mlops, obs
+from fedml_tpu.core import ingest
+from fedml_tpu.core.checkpoint import JournalTicket
+from fedml_tpu.core.distributed.comm_manager import _IngestPipeline
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+from fedml_tpu.core.distributed.communication.message import Message
+from fedml_tpu.core.ingest import ZeroCopyDecoder, deferred_ack_scope
+from fedml_tpu.core.mlops import FanoutSink, InMemorySink
+from fedml_tpu.core.obs.trace import trace_id_for
+
+# the pipeline knobs every pipelined topology in this file runs under: a
+# visible coalescing window with a small batch cap, so group commit is
+# exercised (not degenerate single-record batches) inside a test budget
+_PIPELINE_KNOBS = dict(
+    ingest_pipeline=True,
+    journal_group_commit_ms=20.0,
+    journal_group_commit_max=8,
+)
+
+
+@pytest.fixture(autouse=True)
+def _obs_hygiene():
+    """obs state is process-global: every test leaves it disabled and the
+    registry empty so no other module inherits a live tracer."""
+    yield
+    obs.shutdown()
+    obs.registry().reset()
+
+
+def _fallbacks() -> float:
+    return obs.registry().get_counter("ingest.decode_fallbacks")
+
+
+# ---------------------------------------------------------------------------
+# Unit: knob parse + ticket sink
+# ---------------------------------------------------------------------------
+
+class TestPipelineKnob:
+    class _A:
+        def __init__(self, v):
+            self.ingest_pipeline = v
+
+    def test_absent_is_off(self):
+        class _Bare:
+            pass
+
+        assert ingest.pipeline_enabled(_Bare()) is False
+
+    @pytest.mark.parametrize("v", [True, 1, "1", "true", "True", " on ", "yes"])
+    def test_truthy_forms(self, v):
+        assert ingest.pipeline_enabled(self._A(v)) is True
+
+    @pytest.mark.parametrize("v", [False, 0, "", "0", "false", "off", "no"])
+    def test_falsy_forms(self, v):
+        assert ingest.pipeline_enabled(self._A(v)) is False
+
+
+class TestTicketSink:
+    def test_no_ambient_sink_outside_scope(self):
+        assert ingest.current_sink() is None
+
+    def test_scope_collects_and_restores(self):
+        with deferred_ack_scope() as sink:
+            assert ingest.current_sink() is sink
+            t = JournalTicket()
+            ingest.current_sink().add(t)
+            assert sink.tickets == [t]
+        assert ingest.current_sink() is None
+
+    def test_nested_scopes_restore_outer(self):
+        with deferred_ack_scope() as outer:
+            with deferred_ack_scope() as inner:
+                assert ingest.current_sink() is inner
+            assert ingest.current_sink() is outer
+            assert inner is not outer
+
+    def test_scope_is_thread_local(self):
+        seen = []
+        with deferred_ack_scope():
+            t = threading.Thread(target=lambda: seen.append(ingest.current_sink()))
+            t.start()
+            t.join()
+        assert seen == [None]
+
+
+# ---------------------------------------------------------------------------
+# Unit: zero-copy decoder, pytree plane
+# ---------------------------------------------------------------------------
+
+def _tree(scale=1.0, shape=(4, 3)):
+    return {
+        "w": np.arange(np.prod(shape), dtype=np.float32).reshape(shape) * scale,
+        "b": np.ones(shape[0], dtype=np.float32) * scale,
+    }
+
+
+class TestInternPlane:
+    def test_intern_reuses_arena_storage(self):
+        dec = ZeroCopyDecoder()
+        before = _fallbacks()
+        out1 = dec.intern("slot", _tree(1.0))
+        out2 = dec.intern("slot", _tree(2.0))
+        # the second intern refills the SAME storage the first allocated
+        assert out1["w"] is out2["w"] and out1["b"] is out2["b"]
+        np.testing.assert_array_equal(out2["w"], _tree(2.0)["w"])
+        np.testing.assert_array_equal(out2["b"], _tree(2.0)["b"])
+        assert _fallbacks() == before
+
+    def test_interned_tree_detached_from_source(self):
+        dec = ZeroCopyDecoder()
+        src = _tree(3.0)
+        out = dec.intern("slot", src)
+        src["w"][:] = -1.0
+        np.testing.assert_array_equal(out["w"], _tree(3.0)["w"])
+
+    def test_signature_drift_falls_back(self):
+        dec = ZeroCopyDecoder()
+        dec.intern("slot", _tree())
+        before = _fallbacks()
+        drifted = _tree(shape=(5, 3))
+        out = dec.intern("slot", drifted)
+        assert out is drifted  # fallback returns the original tree untouched
+        assert _fallbacks() == before + 1
+        # other slots are unaffected: each slot has its own arena
+        other = dec.intern("other", _tree(shape=(5, 3)))
+        np.testing.assert_array_equal(other["w"], drifted["w"])
+
+    def test_forget_drops_the_arena(self):
+        dec = ZeroCopyDecoder()
+        out1 = dec.intern("slot", _tree())
+        dec.forget("slot")
+        out2 = dec.intern("slot", _tree())
+        assert out1["w"] is not out2["w"]
+
+
+# ---------------------------------------------------------------------------
+# Unit: zero-copy decoder, bytes plane (flax msgpack blobs)
+# ---------------------------------------------------------------------------
+
+def _blob(scale=1.0, shape=(4, 3), extra_scalars=True):
+    from flax import serialization
+
+    tree = _tree(scale, shape)
+    if extra_scalars:
+        # the wire payload mixes ndarray leaves with plain scalars — the
+        # shape that forced the decoder's separate blob-arena plane
+        tree.update({"sender": 2, "n_samples": 80})
+    return serialization.msgpack_serialize(tree)
+
+
+def _restored(blob):
+    from flax import serialization
+
+    return serialization.msgpack_restore(blob)
+
+
+class TestBytesPlane:
+    def test_learning_then_steady_state_no_fallback(self):
+        dec = ZeroCopyDecoder()
+        before = _fallbacks()
+        out1 = dec.decode("slot", _blob(1.0))
+        out2 = dec.decode("slot", _blob(2.0))
+        assert _fallbacks() == before
+        np.testing.assert_array_equal(out2["w"], _restored(_blob(2.0))["w"])
+        assert out2["sender"] == 2 and out2["n_samples"] == 80
+        # the learning pass's decoded leaves BECAME the arena storage, and
+        # the steady state refills them in place
+        assert out1["w"] is out2["w"] and out1["b"] is out2["b"]
+
+    def test_steady_state_matches_plain_restore_bitwise(self):
+        dec = ZeroCopyDecoder()
+        dec.decode("slot", _blob(1.0))
+        for scale in (2.0, -0.5, 7.25):
+            got = dec.decode("slot", _blob(scale))
+            ref = _restored(_blob(scale))
+            for k in ("w", "b"):
+                np.testing.assert_array_equal(got[k], ref[k])
+                assert got[k].dtype == ref[k].dtype
+
+    def test_shape_drift_falls_back_correctly(self):
+        dec = ZeroCopyDecoder()
+        dec.decode("slot", _blob())
+        before = _fallbacks()
+        drifted = _blob(shape=(5, 3))
+        out = dec.decode("slot", drifted)
+        assert _fallbacks() == before + 1
+        np.testing.assert_array_equal(out["w"], _restored(drifted)["w"])
+
+    def test_leaf_count_drift_falls_back(self):
+        from flax import serialization
+
+        dec = ZeroCopyDecoder()
+        dec.decode("slot", _blob())
+        before = _fallbacks()
+        extra = _tree()
+        extra["extra"] = np.zeros(2, dtype=np.float32)
+        out = dec.decode("slot", serialization.msgpack_serialize(extra))
+        assert _fallbacks() == before + 1
+        np.testing.assert_array_equal(out["extra"], np.zeros(2, np.float32))
+
+    def test_scalar_only_payload_never_learns(self):
+        from flax import serialization
+
+        dec = ZeroCopyDecoder()
+        blob = serialization.msgpack_serialize({"sender": 1, "n": 40})
+        assert dec.decode("s", blob) == {"sender": 1, "n": 40}
+        assert dec.decode("s", blob) == {"sender": 1, "n": 40}
+        assert dec._blob_arenas == {}  # nothing to arena: no ndarray frames
+
+    def test_decoded_leaves_are_writable(self):
+        dec = ZeroCopyDecoder()
+        out = dec.decode("slot", _blob())
+        out["w"] += 1.0  # the learning pass must detach from the wire buffer
+        out = dec.decode("slot", _blob(2.0))
+        np.testing.assert_array_equal(out["w"], _restored(_blob(2.0))["w"])
+
+    def test_forget_drops_blob_arena(self):
+        dec = ZeroCopyDecoder()
+        out1 = dec.decode("slot", _blob())
+        dec.forget("slot")
+        out2 = dec.decode("slot", _blob())
+        assert out1["w"] is not out2["w"]
+
+
+# ---------------------------------------------------------------------------
+# Pipeline: ack-after-durability against fake seams
+# ---------------------------------------------------------------------------
+
+class _FakeLink:
+    def __init__(self):
+        self.acked, self.forgotten = [], []
+
+    def _send_ack(self, msg):
+        self.acked.append(msg)
+
+    def forget(self, msg):
+        self.forgotten.append(msg)
+
+
+class _FakeManager:
+    rank = 0
+
+    def __init__(self, handler=None):
+        self.dispatched = []
+        self._handler = handler
+
+    def _dispatch(self, msg):
+        self.dispatched.append(msg)
+        if self._handler is not None:
+            self._handler(msg)
+
+
+def _msg(mtype=3, msg_id="2:abc:1"):
+    m = Message(mtype, 2, 0)
+    if msg_id is not None:
+        m.add_params(Message.MSG_ARG_KEY_MSG_ID, msg_id)
+    return m
+
+
+@contextlib.contextmanager
+def _pipeline(handler=None, depth=8):
+    link = _FakeLink()
+    manager = _FakeManager(handler)
+    pipe = _IngestPipeline(manager, link, depth=depth)
+    try:
+        yield pipe, manager, link
+    finally:
+        pipe.stop()
+
+
+class TestIngestPipeline:
+    def test_no_tickets_acks_after_dispatch(self):
+        with _pipeline() as (pipe, manager, link):
+            m = _msg()
+            pipe._process(m, needs_ack=True)
+            assert manager.dispatched == [m]
+            assert link.acked == [m] and link.forgotten == []
+
+    def test_needs_ack_false_never_acks(self):
+        with _pipeline() as (pipe, manager, link):
+            pipe._process(_msg(mtype="connection_ready", msg_id=None),
+                          needs_ack=False)
+            assert len(manager.dispatched) == 1
+            assert link.acked == []
+
+    def test_ack_released_only_after_ticket_durable(self):
+        """The tentpole contract: no transport ack before the journal batch
+        holding the upload is fsynced."""
+        ticket = JournalTicket()
+
+        def handler(msg):
+            ingest.current_sink().add(ticket)
+
+        with _pipeline(handler) as (pipe, manager, link):
+            m = _msg()
+            pipe._process(m, needs_ack=True)
+            assert manager.dispatched == [m]
+            assert link.acked == []  # dispatched, journaled... NOT acked yet
+            ticket._mark()  # the group-commit thread fsyncs the batch
+            assert link.acked == [m] and link.forgotten == []
+
+    def test_ack_waits_for_every_ticket(self):
+        t1, t2 = JournalTicket(), JournalTicket()
+
+        def handler(msg):
+            ingest.current_sink().add(t1)
+            ingest.current_sink().add(t2)
+
+        with _pipeline(handler) as (pipe, _, link):
+            pipe._process(_msg(), needs_ack=True)
+            t1._mark()
+            assert link.acked == []  # one durable ticket is not the batch
+            t2._mark()
+            assert len(link.acked) == 1
+
+    def test_failed_batch_forgets_and_withholds_ack(self):
+        ticket = JournalTicket()
+
+        def handler(msg):
+            ingest.current_sink().add(ticket)
+
+        with _pipeline(handler) as (pipe, _, link):
+            m = _msg()
+            pipe._process(m, needs_ack=True)
+            ticket._mark(error=OSError("disk gone"))
+            assert link.acked == []
+            assert link.forgotten == [m]  # sender's retransmit re-journals
+
+    def test_failed_dispatch_forgets_and_withholds_ack(self):
+        def handler(msg):
+            raise RuntimeError("handler blew up")
+
+        with _pipeline(handler) as (pipe, _, link):
+            m = _msg()
+            pipe._process(m, needs_ack=True)  # must not raise: worker parity
+            assert link.acked == []
+            assert link.forgotten == [m]
+
+    def test_already_durable_ticket_acks_inline(self):
+        ticket = JournalTicket()
+        ticket._mark()
+
+        def handler(msg):
+            ingest.current_sink().add(ticket)
+
+        with _pipeline(handler) as (pipe, _, link):
+            pipe._process(_msg(), needs_ack=True)
+            assert len(link.acked) == 1
+
+    def test_submit_preserves_fifo_dispatch_order(self):
+        """The io stage enqueues in arrival order and ONE worker dispatches:
+        the single-threaded-handler invariant every round state machine
+        assumes survives the staging."""
+        done = threading.Event()
+        order = []
+
+        def handler(msg):
+            order.append(msg.get(Message.MSG_ARG_KEY_MSG_ID))
+            if len(order) == 16:
+                done.set()
+
+        with _pipeline(handler) as (pipe, _, link):
+            msgs = [_msg(msg_id=f"2:abc:{i}") for i in range(16)]
+            for m in msgs:
+                pipe.submit(m, needs_ack=True)
+            assert done.wait(10.0), "pipeline worker did not drain the queue"
+        assert order == [f"2:abc:{i}" for i in range(16)]
+        assert len(link.acked) == 16
+
+    def test_worker_survives_poison_message(self):
+        calls = []
+
+        def handler(msg):
+            calls.append(msg)
+            if len(calls) == 1:
+                raise RuntimeError("poison")
+
+        with _pipeline(handler) as (pipe, _, link):
+            pipe.submit(_msg(msg_id="2:abc:1"), needs_ack=True)
+            pipe.submit(_msg(msg_id="2:abc:2"), needs_ack=True)
+            deadline = time.time() + 10.0
+            while len(link.acked) < 1 and time.time() < deadline:
+                time.sleep(0.01)
+        assert len(calls) == 2  # the poison did not kill the worker
+        assert len(link.acked) == 1 and len(link.forgotten) == 1
+
+
+# ---------------------------------------------------------------------------
+# Topology: the acceptance layer (chaos harness with ingest_pipeline=True)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def reference_model():
+    """The fault-free HOST-PATH reference every pipelined run must bit-match:
+    the staged receive path is a transport optimization, so the final model
+    must be a pure function of config — not of which ingest path ran."""
+    LoopbackHub.reset()
+    history, final, _ = _ft._run_chaos_topology("ingest-base", knobs={})
+    assert len(history) == 2
+    return final
+
+
+def test_pipeline_chaos_converges_bit_identical(reference_model):
+    """Full chaos plan (drop + reset + duplicate + delay) + crash-and-rejoin
+    with the staged pipeline on: all rounds complete, the final model is
+    bit-identical to the host path's, and dedup still runs on the io stage
+    (the duplicate never reaches a handler)."""
+    LoopbackHub.reset()
+    history, final, stats = _ft._run_chaos_topology(
+        "ingest-chaos", fault_plan=_ft._full_chaos_plan(), crash_rank=1,
+        knobs=dict(_ft._CHAOS_KNOBS, **_PIPELINE_KNOBS))
+    assert len(history) == 2
+    assert _ft._trees_bit_identical(final, reference_model), \
+        "pipelined chaos run diverged from the host-path model"
+    srv = stats[0]
+    assert srv["dup_dropped"] >= 1  # io-stage dedup, off the worker thread
+    assert srv["rejoins"] >= 1
+    assert srv["acks_sent"] > 0
+
+
+def test_pipeline_server_kill_exactly_once(reference_model, tmp_path):
+    """The durability acceptance: a server killed between two round-0
+    uploads while running the staged pipeline + group-commit journal
+    restarts from snapshot + journal and converges bit-identical with
+    exactly-once upload accounting — an ack was never sent for anything the
+    journal had not fsynced, so replay + retransmit cannot double-count."""
+    LoopbackHub.reset()
+    out = _ft._run_server_kill_topology(
+        "ingest-kill", tmp_path / "srv", knobs=dict(_PIPELINE_KNOBS))
+    _ft._assert_recovered(*out, reference_model)
+
+
+def test_pipeline_traced_rounds_closed(reference_model, tmp_path):
+    """Tracing acceptance on LOOPBACK: a pipelined run (journal on, so acks
+    ride the group-commit thread) keeps every round ONE closed span tree
+    with the per-message ``ingest.accept`` span present, and the exported
+    JSONL passes ``trace_report --assert-closed`` — the off-thread ack
+    release closes its span on every path."""
+    LoopbackHub.reset()
+    run_id = "ingest-traced"
+    mem = InMemorySink()
+
+    class _A:
+        rank = 0
+
+        def __init__(self):
+            self.run_id = run_id
+            self.obs_trace = True
+
+    mlops.init(_A(), FanoutSink([mem]))
+    try:
+        history, final, _ = _ft._run_chaos_topology(
+            run_id, knobs=dict(_PIPELINE_KNOBS,
+                               server_checkpoint_dir=str(tmp_path / "srv")))
+        assert len(history) == 2
+    finally:
+        mlops.finish()
+    assert _ft._trees_bit_identical(final, reference_model)
+
+    records = [dict(rec, topic=t) for t, rec in list(mem.records)
+               if t in trace_report.SPAN_TOPICS]
+    traces = trace_report.build_traces(records)
+    names = set()
+    for r in range(2):
+        tid = trace_id_for(run_id, r)
+        assert tid in traces, f"round {r}: no trace emitted"
+        assert traces[tid].problems() == [], (r, traces[tid].problems())
+        names |= {sn.name for sn in traces[tid].spans.values()}
+    assert "ingest.accept" in names, names
+    assert "journal.append" in names, names
+
+    path = tmp_path / "trace.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    assert trace_report.main([str(path), "--assert-closed"]) == 0
+
+    # the pipeline's stage accounting reached the registry on every stage
+    reg = obs.registry()
+    for stage in ("io", "queue", "dispatch"):
+        h = reg.get_histogram("ingest.stage_seconds", {"stage": stage})
+        assert h is not None and h["count"] > 0, stage
+    assert reg.get_histogram("ingest.batch_fsync_seconds") is not None
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["TRPC", "GRPC", "MQTT_S3"])
+def test_pipeline_traced_all_backends(backend, reference_model, tmp_path):
+    """The cross-backend acceptance sweep: the staged pipeline over every
+    socketed transport converges bit-identical AND every round still
+    reconstructs as one closed span tree with ``ingest.accept`` present —
+    LOOPBACK in tier 1 plus these three makes all four backends."""
+    comm_extra = {}
+    broker = None
+    if backend == "TRPC":
+        comm_extra = {"trpc_base_port": 29710, "trpc_connect_retries": 3,
+                      "trpc_retry_interval_s": 0.1}
+    elif backend == "GRPC":
+        comm_extra = {"grpc_base_port": 29810, "grpc_send_retries": 3,
+                      "grpc_send_backoff_base_s": 0.05}
+    else:
+        from fedml_tpu.core.distributed.communication.mqtt_s3.broker import LocalBroker
+
+        broker = LocalBroker().start()
+        comm_extra = {"mqtt_host": "127.0.0.1", "mqtt_port": broker.port,
+                      "s3_blob_root": str(tmp_path / "blobs"),
+                      "mqtt_reconnect_retries": 10,
+                      "mqtt_reconnect_base_s": 0.05}
+    run_id = f"ingest-{backend.lower()}"
+    mem = InMemorySink()
+
+    class _A:
+        rank = 0
+
+        def __init__(self):
+            self.run_id = run_id
+            self.obs_trace = True
+
+    mlops.init(_A(), FanoutSink([mem]))
+    try:
+        history, final, _ = _ft._run_chaos_topology(
+            run_id, backend=backend, comm_extra=comm_extra,
+            knobs=dict(_ft._CHAOS_KNOBS, **_PIPELINE_KNOBS,
+                       server_checkpoint_dir=str(tmp_path / "srv")))
+        assert len(history) == 2
+    finally:
+        mlops.finish()
+        if broker is not None:
+            broker.stop()
+    assert _ft._trees_bit_identical(final, reference_model)
+
+    records = [dict(rec, topic=t) for t, rec in list(mem.records)
+               if t in trace_report.SPAN_TOPICS]
+    traces = trace_report.build_traces(records)
+    names = set()
+    for r in range(2):
+        tid = trace_id_for(run_id, r)
+        assert tid in traces, f"round {r}: no trace emitted"
+        assert traces[tid].problems() == [], (r, traces[tid].problems())
+        names |= {sn.name for sn in traces[tid].spans.values()}
+    assert "ingest.accept" in names, names
+    path = tmp_path / "trace.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    assert trace_report.main([str(path), "--assert-closed"]) == 0

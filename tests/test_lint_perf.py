@@ -1,0 +1,105 @@
+"""tools/lint_perf.py wired into tier-1: with the staged ingest pipeline
+in place (PR 10), per-record ``os.fsync`` belongs to the checkpoint
+durability seam and msgpack (de)serialization to the journal framer and
+the zero-copy decoder — and the linter itself must actually catch
+violations, because a lint that can't fail is not a gate."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import lint_perf
+
+
+def test_library_tree_is_clean():
+    """The machine-enforced contract: no hot path pays a private fsync or
+    a dispatcher-thread msgpack codec outside the seams."""
+    assert lint_perf.main([]) == 0
+
+
+def test_catches_stray_fsync_and_hot_codec(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\n"
+        "from flax.serialization import msgpack_restore\n"
+        "def persist(f, blob):\n"
+        "    f.write(blob)\n"
+        "    os.fsync(f.fileno())\n"
+        "    return msgpack_restore(blob)\n"
+    )
+    violations = lint_perf.lint_file(str(bad))
+    assert [(lineno, kind) for _, lineno, kind, _ in violations] == [
+        (5, "per-record fsync outside the durability seam"),
+        (6, "hot-path msgpack codec outside the seams"),
+    ]
+    assert lint_perf.main(["--root", str(tmp_path)]) == 1
+
+
+def test_catches_raw_msgpack_module_calls(tmp_path):
+    f = tmp_path / "codec.py"
+    f.write_text(
+        "import msgpack\n"
+        "def decode(blob):\n"
+        "    return msgpack.unpackb(blob, raw=False)\n"
+        "def encode(tree):\n"
+        "    return msgpack.packb(tree)\n"
+        "def serialize(tree):\n"
+        "    return msgpack_serialize(tree)\n"
+    )
+    kinds = [kind for _, _, kind, _ in lint_perf.lint_file(str(f))]
+    assert kinds == ["hot-path msgpack codec outside the seams"] * 3
+
+
+def test_pragma_allows_approved_seam(tmp_path):
+    f = tmp_path / "seam.py"
+    f.write_text(
+        "import os\n"
+        "def sync(f):\n"
+        "    os.fsync(f.fileno())  # lint_perf: allow\n"
+    )
+    assert lint_perf.lint_file(str(f)) == []
+    assert lint_perf.main(["--root", str(tmp_path)]) == 0
+
+
+def test_seam_owners_are_exempt(tmp_path):
+    # checkpoint (durability + framing), ingest (zero-copy decode) and
+    # core/obs (export file integrity) ARE the seams
+    body = ("import os, msgpack\n"
+            "def go(f, blob):\n"
+            "    os.fsync(f.fileno())\n"
+            "    return msgpack.unpackb(blob)\n")
+    obs_dir = tmp_path / "core" / "obs"
+    obs_dir.mkdir(parents=True)
+    for rel in (("core", "checkpoint.py"), ("core", "ingest.py"),
+                ("core", "obs", "flight.py")):
+        f = tmp_path.joinpath(*rel)
+        f.write_text(body)
+        assert lint_perf.lint_file(str(f)) == []
+    assert lint_perf.main(["--root", str(tmp_path)]) == 0
+
+
+def test_docstrings_and_comments_do_not_false_positive(tmp_path):
+    f = tmp_path / "prose.py"
+    f.write_text(
+        '"""Never call os.fsync(...) per record; msgpack_restore(blob) is\n'
+        'reserved for the checkpoint seam."""\n'
+        "# the old code ran os.fsync() and msgpack.unpackb() right here\n"
+        "MSG = 'route decodes through ZeroCopyDecoder, not msgpack_restore(b)'\n"
+    )
+    assert lint_perf.lint_file(str(f)) == []
+
+
+def test_lookalike_names_are_not_flagged(tmp_path):
+    f = tmp_path / "good.py"
+    f.write_text(
+        "def my_os_fsync(fd):\n"
+        "    pass\n"
+        "def run(self, blob):\n"
+        "    self.os.fsync = None\n"          # attribute chain, not os.fsync
+        "    tree = self.msgpack_restore(blob)\n"  # method, not the codec
+        "    return my_os_fsync(0)\n"
+    )
+    assert lint_perf.lint_file(str(f)) == []

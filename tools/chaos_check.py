@@ -10,8 +10,11 @@ retransmit/dup chaos with ``agg_plane=compiled`` must converge
 bit-identical to the fault-free host run) AND the buffered-async chaos
 tests (``tests/test_async_fl.py`` — drop/dup/delay plus ``server_kill``
 mid-buffer must converge deterministically with exactly-once delta
-accounting) N consecutive times in fresh
-interpreter processes and fails on the FIRST non-green run.
+accounting) AND the staged-ingest chaos tests (``tests/test_ingest.py`` —
+the full chaos plan and the server kill with ``ingest_pipeline=True`` and
+group commit must converge bit-identical to the host-path model, with
+every traced round still one closed span tree) N consecutive times in
+fresh interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
 operators stop believing red — so new fault kinds / backends must hold up
 under this before they land unmarked.
@@ -33,6 +36,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "trace_integrity"
     python tools/chaos_check.py --runs 3 -k "agg_plane"
     python tools/chaos_check.py --runs 3 -k "async_fl"
+    python tools/chaos_check.py --runs 3 -k "ingest"
     python tools/chaos_check.py --runs 3 --skip-perf-gate
 """
 
@@ -81,9 +85,9 @@ def main(argv=None) -> int:
     ap.add_argument(
         "-k", dest="keyword",
         default="chaos or server_kill or trace_integrity or agg_plane "
-                "or async_fl",
+                "or async_fl or ingest",
         help='pytest -k selector (default: "chaos or server_kill or '
-             'trace_integrity or agg_plane or async_fl")')
+             'trace_integrity or agg_plane or async_fl or ingest")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     ap.add_argument("--skip-perf-gate", action="store_true",
@@ -101,7 +105,7 @@ def main(argv=None) -> int:
     env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
     cmd = [sys.executable, "-m", "pytest", "tests/test_fault_tolerance.py",
            "tests/test_obs.py", "tests/test_agg_plane.py",
-           "tests/test_async_fl.py",
+           "tests/test_async_fl.py", "tests/test_ingest.py",
            "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
     for i in range(1, args.runs + 1):
         t0 = time.time()

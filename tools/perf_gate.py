@@ -53,6 +53,7 @@ BENCH_SCHEMA_CURRENT = 2
 
 # higher-is-better relative keys banded against the prior-round median
 RELATIVE_KEYS = ("vs_baseline", "agg_speedup", "uploads_per_s",
+                 "uploads_per_s_host", "uploads_per_s_pipelined",
                  "async_flushes_per_s", "async_deltas_per_s")
 # lower-is-better: absolute cap (obs must stay cheap, PR 5 contract)
 OVERHEAD_KEY = "obs_overhead_frac"
