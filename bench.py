@@ -405,6 +405,7 @@ def _main() -> int | None:
         # failed (distinct from BENCH_AUTOTUNE=0, where the key is absent)
         out["autotuned"] = tuned
     out.update(obs_overhead)
+    out.update(_measure_telemetry_overhead())
     out.update(_measure_agg_step())
     out.update(_measure_upload_saturation())
     out.update(_measure_async_throughput())
@@ -704,6 +705,7 @@ def _run_degraded(reason: str) -> int:
     out["value"] = agg.get("agg_step_compiled_s", None)
     out.update(_measure_upload_saturation())
     out.update(_measure_async_throughput())
+    out.update(_measure_telemetry_overhead())
 
     # obs overhead on the measured path: the same compiled agg step with
     # tracing configured (spans to an in-memory sink, parented under a
@@ -755,6 +757,87 @@ def _run_degraded(reason: str) -> int:
 
     _emit(out, "degraded")
     return 0
+
+
+def _measure_telemetry_overhead() -> dict:
+    """Telemetry-plane relative keys: a synthetic federated round — the
+    server's real per-round work (one compiled agg step over N client
+    deltas) plus N client report messages — timed with the plane ON
+    (every client records its train sub-spans + a resource sample,
+    attaches the blob to its upload ``Message``, the server-side merger
+    absorbs) vs the IDENTICAL loop with ``obs_telemetry`` off, where the
+    facade hands out no capture/merger, so the off leg pays exactly what
+    a telemetry-off run pays.  Anchoring both legs on the agg step keeps
+    ``telemetry_overhead_frac`` comparable to ``obs_overhead_frac``'s
+    budget (telemetry vs real round cost, not vs an empty loop).  Also
+    prices the wire: mean blob bytes per round.  Emitted on BOTH the full
+    and degraded lines; failures degrade to empty keys."""
+    import numpy as np
+
+    from fedml_tpu.core import obs
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.parallel.agg_plane import CompiledAggPlane
+
+    import jax
+
+    n_clients = 8
+    rounds = int(os.environ.get("BENCH_TELEMETRY_ROUNDS", "15"))
+
+    def _loop(enabled: bool, plane, updates):
+        class _Args:
+            run_id = "bench_telemetry"
+            obs_telemetry = 1 if enabled else 0
+
+        obs.configure(_Args(), lambda topic, rec: None)
+        try:
+            caps = [obs.make_client_telemetry(i + 1)
+                    for i in range(n_clients)]
+            merger = obs.make_telemetry_merger()
+            wire_bytes = 0
+            ts = []
+            for r in range(rounds):
+                t0 = time.perf_counter()
+                for i, cap in enumerate(caps):
+                    msg = Message("send_model_to_server", i + 1, 0)
+                    if cap is not None:
+                        tctx = cap.record_span(
+                            "client.train", 0.01, round_idx=r,
+                            client_index=i)
+                        cap.record_span("client.train.step", 0.01,
+                                        parent=tctx, round_idx=r)
+                        cap.record_counter("comm.bytes_sent", 1024.0)
+                        cap.sample_resources()
+                        wire_bytes += cap.attach(msg)
+                    if merger is not None:
+                        merger.absorb(msg)
+                jax.block_until_ready(plane.aggregate(updates))
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts)), wire_bytes
+        finally:
+            obs.shutdown()
+
+    try:
+        updates = _synthetic_updates(n_clients)
+        plane = CompiledAggPlane()
+        plane.aggregate(updates)  # compile outside the timed legs
+        on_s, wire_bytes = _loop(True, plane, updates)
+        off_s, _ = _loop(False, plane, updates)
+        if on_s <= 0 or off_s <= 0:
+            return {}
+        return {
+            "telemetry_rounds_per_s": round(1.0 / on_s, 2),
+            "telemetry_rounds_per_s_off": round(1.0 / off_s, 2),
+            "telemetry_overhead_frac": round(
+                max(on_s - off_s, 0.0) / off_s, 4),
+            "telemetry_bytes_per_round": round(wire_bytes / rounds, 1),
+        }
+    except Exception as e:
+        print(f"telemetry overhead measurement failed: {e}", file=sys.stderr)
+        try:
+            obs.shutdown()
+        except Exception:
+            pass
+        return {}
 
 
 def _measure_obs_overhead(sim) -> dict:

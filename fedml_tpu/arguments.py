@@ -134,6 +134,18 @@ Observability knobs (``tracking_args`` or ``obs_args``; consumed by
   OpenMetrics pull endpoint (``GET /metrics``); 0 disables HTTP.
 * ``obs_export_path`` (path, default unset) — file that receives atomic
   OpenMetrics snapshots on each rate-limited export and at shutdown.
+* ``obs_telemetry`` (bool, default False) — the cross-host telemetry
+  plane: clients buffer span/metric records into a bounded ring and
+  piggyback one msgpack blob per upload/report (strictly best-effort:
+  duplicates dedup by sequence number, gaps are counted, nothing is ever
+  retried, and training stays bit-identical on or off).  Requires
+  ``obs_trace``.
+* ``obs_telemetry_ring`` (int >= 1, default 512) — per-client telemetry
+  ring capacity; overflow drops the oldest records (surfacing as
+  sequence gaps at the server).
+* ``obs_telemetry_flush_s`` (float seconds >= 0, default 0) — minimum
+  interval between standalone ``telemetry`` flush messages in async
+  mode; 0 restricts telemetry to piggybacked blobs only.
 
 Async / buffered-FL knobs (``train_args`` or ``async_args``; consumed by
 ``core/async_fl``, execution model in ``docs/ASYNC.md``):
@@ -449,6 +461,28 @@ class Arguments:
             if not 0 <= pv <= 65535:
                 raise ValueError(
                     f"obs_export_port must be in 0..65535 (got {pv})")
+        ring = getattr(self, "obs_telemetry_ring", None)
+        if ring is not None:
+            try:
+                rv = int(ring)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_telemetry_ring must be an integer >= 1 "
+                    f"(got {ring!r})")
+            if rv < 1:
+                raise ValueError(
+                    f"obs_telemetry_ring must be >= 1 (got {rv})")
+        flush = getattr(self, "obs_telemetry_flush_s", None)
+        if flush is not None:
+            try:
+                fs = float(flush)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_telemetry_flush_s must be a number >= 0 "
+                    f"(got {flush!r})")
+            if fs < 0:
+                raise ValueError(
+                    f"obs_telemetry_flush_s must be >= 0 (got {fs})")
         # async / buffered-FL knobs (core/async_fl) — a typo'd mode or policy
         # must fail here, not silently run the sync state machine
         mode = getattr(self, "fl_mode", None)

@@ -143,14 +143,19 @@ class AsyncBufferedServerMixin:
     def _async_handle_upload(self, sender: int, model_params, n_samples,
                              version_tag, parent_ctx=None,
                              journal_extra: Optional[Dict[str, Any]] = None,
-                             journal_params: bool = True) -> bool:
+                             journal_params: bool = True,
+                             measured_seconds: Optional[float] = None) -> bool:
         """(lock held) The async accept path: match the dispatch, bound the
         staleness, journal-before-ack, park in the buffer, schedule, and
         flush when full.  ``journal_params=False`` keeps the tensors out of
         the journal record when ``journal_extra`` already carries a durable
-        pointer to them (the cross-device file plane).  Returns True when
-        the delta was buffered (the manager may need to release a dropped
-        upload's backing artifact)."""
+        pointer to them (the cross-device file plane).
+        ``measured_seconds`` (the telemetry plane's remote ``client.train``
+        duration) replaces the dispatch→report wall clock in the EMA when
+        available — the wall clock conflates network and queueing time
+        with compute.  Returns True when the delta was buffered (the
+        manager may need to release a dropped upload's backing
+        artifact)."""
         sender = int(sender)
         v = int(self.args.round_idx)
         if version_tag is None:
@@ -218,6 +223,8 @@ class AsyncBufferedServerMixin:
                       float(self.async_buffer.approx_bytes))
         t0 = self._dispatch_t.pop(sender, None)
         secs = None if t0 is None else max(self._async_clock.now() - t0, 0.0)
+        if measured_seconds is not None:
+            secs = max(float(measured_seconds), 0.0)
         self.population.note_report(
             sender, round_idx=v,
             n_samples=None if n_samples is None else int(n_samples),

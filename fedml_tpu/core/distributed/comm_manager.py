@@ -327,6 +327,17 @@ class _IngestPipeline:
             self._thread.join(timeout=10.0)
 
     def _worker(self) -> None:
+        # a dying dispatch worker is exactly the crash whose last records
+        # matter most — dump the flight ring on the way out, not just on
+        # transport-handler exceptions (pre-PR 11 only _dispatch dumped)
+        try:
+            self._worker_loop()
+        except BaseException:
+            obs.flight_dump("ingest_worker_died")
+            logger.exception("ingest worker: thread died")
+            raise
+
+    def _worker_loop(self) -> None:
         while True:
             try:
                 msg, needs_ack, t_enq = self._queue.get(timeout=0.25)
@@ -341,6 +352,7 @@ class _IngestPipeline:
             try:
                 self._process(msg, needs_ack)
             except Exception:  # the worker must survive any one message
+                obs.flight_dump("ingest_worker_exception")
                 logger.exception("ingest worker: unexpected failure on %s",
                                  msg.get_type())
 

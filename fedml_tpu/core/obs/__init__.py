@@ -30,6 +30,13 @@ from typing import Any, Callable, Dict, Optional
 from .exposition import MetricsExporter
 from .flight import DEFAULT_FLIGHT_CAPACITY, FlightRecorder
 from .metrics import DEFAULT_TIME_BUCKETS, MetricsRegistry
+from .telemetry import (
+    DEFAULT_FLUSH_S,
+    DEFAULT_RING_CAPACITY,
+    TOPIC_TELEMETRY,
+    ClientTelemetry,
+    TelemetryMerger,
+)
 from .trace import (
     NULL_SPAN,
     Span,
@@ -51,6 +58,9 @@ __all__ = [
     "maybe_export_metrics", "slow_round_factor",
     "flight_recorder", "flight_dump", "exporter",
     "sample_resource_gauges", "compile_seconds_total",
+    "ClientTelemetry", "TelemetryMerger", "TOPIC_TELEMETRY",
+    "telemetry_enabled", "telemetry_flush_s",
+    "make_client_telemetry", "make_telemetry_merger",
 ]
 
 _lock = threading.Lock()
@@ -117,6 +127,13 @@ def configure(args: Any, emit: Callable[[str, Dict[str, Any]], None]) -> None:
                 getattr(args, "obs_slow_round_factor", 2.0) or 2.0),
             flight=flight,
             exporter=exporter_obj,
+            telemetry=bool(int(getattr(args, "obs_telemetry", 0) or 0)),
+            telemetry_ring=int(
+                getattr(args, "obs_telemetry_ring", DEFAULT_RING_CAPACITY)
+                or DEFAULT_RING_CAPACITY),
+            telemetry_flush_s=float(
+                getattr(args, "obs_telemetry_flush_s", DEFAULT_FLUSH_S)
+                or DEFAULT_FLUSH_S),
         )
     _register_compile_listener()
 
@@ -176,6 +193,36 @@ def flight_dump(reason: str) -> Optional[str]:
 
 def exporter() -> Optional[MetricsExporter]:
     return _ctx.get("exporter")
+
+
+# -- cross-host telemetry plane ---------------------------------------------
+
+def telemetry_enabled() -> bool:
+    return bool(_ctx.get("telemetry"))
+
+
+def telemetry_flush_s() -> float:
+    return float(_ctx.get("telemetry_flush_s", DEFAULT_FLUSH_S))
+
+
+def make_client_telemetry(node: Any) -> Optional[ClientTelemetry]:
+    """A per-manager telemetry capture ring, or None with the plane off.
+    Per-instance on purpose: the in-process test harness runs every node
+    of a deployment in one interpreter, where a process-global buffer
+    would interleave nodes' sequence spaces."""
+    if not _ctx.get("telemetry"):
+        return None
+    return ClientTelemetry(
+        node, _ctx.get("run_id", "0"),
+        capacity=int(_ctx.get("telemetry_ring", DEFAULT_RING_CAPACITY)))
+
+
+def make_telemetry_merger() -> Optional[TelemetryMerger]:
+    """A per-manager blob merger bound to the configured sink fan and the
+    process registry, or None with the plane off."""
+    if not _ctx.get("telemetry"):
+        return None
+    return TelemetryMerger(emit=_ctx.get("emit"), registry=_registry)
 
 
 # -- resource attribution ---------------------------------------------------

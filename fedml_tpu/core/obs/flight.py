@@ -95,6 +95,10 @@ class FlightRecorder:
         self._dropped = 0      # records aged out of the ring
         self._n_dumps = 0
         self._last_dump_path: Optional[str] = None
+        # optional zero-arg callable returning extra dict keys for the dump
+        # meta line (the telemetry merger hangs its merge counters here);
+        # failures are swallowed — meta enrichment must not cost a dump
+        self.meta_provider = None
 
     # -- recording -----------------------------------------------------------
     def record(self, topic: str, rec: Dict[str, Any]) -> Optional[str]:
@@ -148,6 +152,15 @@ class FlightRecorder:
             "run_id": self.run_id, "seq": seq, "n_records": len(records),
             "capacity": self.capacity, "dropped": dropped,
         }
+        provider = self.meta_provider
+        if provider is not None:
+            try:
+                extra = provider()
+                if isinstance(extra, dict):
+                    for k, v in extra.items():
+                        meta.setdefault(str(k), v)
+            except Exception:
+                pass
         name = f"flight-{self.run_id}-{seq:03d}-{safe}.jsonl"
         path = os.path.join(self.directory, name)
         tmp = path + ".tmp"

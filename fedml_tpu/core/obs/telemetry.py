@@ -1,0 +1,425 @@
+"""Cross-host telemetry plane: best-effort span/metric fan-in.
+
+PRs 5 and 8 built tracing, metrics, and the flight recorder, but every
+record still lives in the process that emitted it — the server
+reconstructs a round's span tree without ever seeing the client-side
+``client.train`` interior, so "straggler" meant "slow upload span" with
+no way to tell compute-bound from network-bound from scheduler-deferred.
+This module closes that gap without adding a transport:
+
+* **Client side** — :class:`ClientTelemetry` buffers compact span/metric
+  records (train sub-phases, per-step timings, proc RSS, comm stats)
+  into a bounded ring with monotonically increasing sequence numbers,
+  and :meth:`ClientTelemetry.attach` drains the ring into ONE msgpack
+  blob piggybacked on an existing upload/report :class:`Message` under
+  :data:`TELEMETRY_KEY` (plus :meth:`flush_message` for a standalone
+  :data:`TOPIC_TELEMETRY` message in async mode).
+* **Server side** — :class:`TelemetryMerger` decodes blobs, dedups by
+  sequence number (a retransmitted message carries the *same* blob, so
+  duplicates collapse; a dropped message shows up as a counted gap,
+  never a retry), re-emits remote spans into the local sink fan keyed by
+  the existing deterministic trace ids (``tools/trace_report.py``'s
+  first-wins pairing grafts them into the round tree), and merges metric
+  records into the process registry as ``client``-labeled series (the
+  PR 5 cardinality cap bounds the fan-in).
+
+**Best-effort contract** (the hard requirement): telemetry must never
+perturb training.  Records only read clocks and ``/proc``; the blob is a
+single extra message param that JSON transports silently drop and binary
+transports carry opaquely; decode/merge failures count a metric and
+return.  Dropped, duplicated, or delayed telemetry under the PR 1 fault
+seam changes *observability output only* — convergence is bit-exact with
+telemetry on or off.
+
+This file is the ONE wire seam: ``tools/lint_obs.py`` forbids the
+:data:`TELEMETRY_KEY` message param anywhere else in the tree.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .trace import (
+    TOPIC_SPAN_END,
+    TOPIC_SPAN_START,
+    SpanContext,
+    round_root_ctx,
+    span_id_for,
+    trace_id_for,
+)
+
+# The single Message-param wire key (lint-enforced to stay in this file).
+TELEMETRY_KEY = "__obs_telemetry__"
+
+# Standalone flush message type for async mode, where uploads can be
+# minutes apart but the operator still wants live straggler data.
+TOPIC_TELEMETRY = "telemetry"
+
+BLOB_VERSION = 1
+
+DEFAULT_RING_CAPACITY = 512
+DEFAULT_FLUSH_S = 0.0  # 0 = piggyback-only (no standalone flush messages)
+
+# record kinds (one-letter keys keep the wire blob small: a full ring of
+# 512 records stays well under a single model-delta chunk)
+_KIND_SPAN = "s"
+_KIND_COUNTER = "c"
+_KIND_GAUGE = "g"
+
+
+def encode_blob(node: Any, run_id: Any, records: List[Dict[str, Any]],
+                dropped: int) -> bytes:
+    import msgpack
+
+    return msgpack.packb(
+        {"v": BLOB_VERSION, "node": node, "run": str(run_id),
+         "recs": records, "dropped": int(dropped)},
+        use_bin_type=True)
+
+
+def decode_blob(blob: bytes) -> Dict[str, Any]:
+    import msgpack
+
+    data = msgpack.unpackb(bytes(blob), raw=False, strict_map_key=False)
+    if not isinstance(data, dict) or data.get("v") != BLOB_VERSION:
+        raise ValueError("unknown telemetry blob version")
+    if not isinstance(data.get("recs"), list):
+        raise ValueError("telemetry blob missing record list")
+    return data
+
+
+class ClientTelemetry:
+    """Per-node bounded telemetry ring + blob encoder.
+
+    One instance per manager/simulator object, NOT process-global: the
+    test harness runs every node of a deployment in one process, where a
+    shared buffer would interleave nodes' sequence spaces and break the
+    gap/dup accounting.
+    """
+
+    def __init__(self, node: Any, run_id: Any,
+                 capacity: int = DEFAULT_RING_CAPACITY):
+        self.node = node
+        self.run_id = str(run_id)
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        self._seq = 0  # next sequence number to assign (never reused)
+        self.dropped_total = 0  # aged out of the ring before a drain
+        self.bytes_sent = 0
+        self.blobs_sent = 0
+        self._last_flush = time.monotonic()
+
+    # -- recording -----------------------------------------------------------
+    def _append(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            rec["q"] = self._seq
+            self._seq += 1
+            if len(self._ring) == self.capacity:
+                # ring overflow: the oldest record is lost client-side and
+                # will be accounted as a sequence gap by the merger
+                self.dropped_total += 1
+            self._ring.append(rec)
+
+    def _ids(self, name: str, parent: Optional[SpanContext],
+             round_idx: Optional[int], seq: int):
+        if parent is not None:
+            tid, psid = parent.trace_id, parent.span_id
+        elif round_idx is not None:
+            root = round_root_ctx(self.run_id, round_idx)
+            tid, psid = root.trace_id, root.span_id
+        else:
+            tid, psid = trace_id_for(self.run_id, -1), None
+        return tid, span_id_for(tid, name, self.node, seq), psid
+
+    def record_span(self, name: str, duration_s: float,
+                    parent: Optional[SpanContext] = None,
+                    round_idx: Optional[int] = None, seq: int = 0,
+                    **attrs: Any) -> SpanContext:
+        """Record one completed remote span; returns its context so
+        sub-phases can nest under it.  Ids are the same deterministic
+        hashes the live tracer uses, so a span recorded here and one
+        emitted locally for the same (name, node, seq) coordinates
+        collapse to one node in the report — which is exactly what makes
+        in-process loopback tests safe."""
+        tid, sid, psid = self._ids(name, parent, round_idx, seq)
+        rec: Dict[str, Any] = {
+            "k": _KIND_SPAN, "t": tid, "s": sid, "n": str(name),
+            "d": round(float(duration_s), 6),
+        }
+        if psid is not None:
+            rec["p"] = psid
+        if round_idx is not None:
+            rec["r"] = int(round_idx)
+        if attrs:
+            rec["a"] = attrs
+        self._append(rec)
+        return SpanContext(tid, sid)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, parent: Optional[SpanContext] = None,
+              round_idx: Optional[int] = None, seq: int = 0, **attrs: Any):
+        """Time a client-side sub-phase and record it as a remote span.
+        Yields the phase's :class:`SpanContext` for nesting."""
+        tid, sid, psid = self._ids(name, parent, round_idx, seq)
+        ctx = SpanContext(tid, sid)
+        t0 = time.monotonic()
+        try:
+            yield ctx
+        finally:
+            rec: Dict[str, Any] = {
+                "k": _KIND_SPAN, "t": tid, "s": sid, "n": str(name),
+                "d": round(time.monotonic() - t0, 6),
+            }
+            if psid is not None:
+                rec["p"] = psid
+            if round_idx is not None:
+                rec["r"] = int(round_idx)
+            if attrs:
+                rec["a"] = attrs
+            self._append(rec)
+
+    def record_counter(self, name: str, value: float,
+                       labels: Optional[Dict[str, Any]] = None) -> None:
+        """A counter DELTA since the last record (merged additively)."""
+        rec: Dict[str, Any] = {"k": _KIND_COUNTER, "n": str(name),
+                               "v": float(value)}
+        if labels:
+            rec["l"] = {str(k): str(v) for k, v in labels.items()}
+        self._append(rec)
+
+    def record_gauge(self, name: str, value: float,
+                     labels: Optional[Dict[str, Any]] = None) -> None:
+        """A gauge sample (merged last-value-wins)."""
+        rec: Dict[str, Any] = {"k": _KIND_GAUGE, "n": str(name),
+                               "v": float(value)}
+        if labels:
+            rec["l"] = {str(k): str(v) for k, v in labels.items()}
+        self._append(rec)
+
+    def sample_resources(self) -> None:
+        """Snapshot this process's RSS into the ring (best-effort)."""
+        try:
+            import os
+
+            with open("/proc/self/statm", "rb") as f:
+                rss_pages = int(f.read().split()[1])
+            self.record_gauge(
+                "proc.rss_bytes",
+                float(rss_pages * os.sysconf("SC_PAGE_SIZE")))
+        except (OSError, ValueError, IndexError):
+            pass
+
+    # -- draining ------------------------------------------------------------
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def drain(self) -> Optional[bytes]:
+        """Encode-and-clear the ring; None when there is nothing to send."""
+        with self._lock:
+            if not self._ring:
+                return None
+            records = list(self._ring)
+            self._ring.clear()
+            dropped = self.dropped_total
+        try:
+            blob = encode_blob(self.node, self.run_id, records, dropped)
+        except Exception:
+            # encoding trouble loses these records (best-effort); the seq
+            # gap at the merger accounts for them
+            return None
+        with self._lock:
+            self.bytes_sent += len(blob)
+            self.blobs_sent += 1
+            self._last_flush = time.monotonic()
+        return blob
+
+    def attach(self, message: Any) -> int:
+        """Piggyback the pending ring onto ``message``; returns the blob
+        size in bytes (0 when nothing was pending).  The retransmitter
+        reuses the same Message object, so a retransmit re-carries the
+        SAME blob and the merger's seq dedup collapses it."""
+        blob = self.drain()
+        if blob is None:
+            return 0
+        message.add_params(TELEMETRY_KEY, blob)
+        return len(blob)
+
+    def flush_due(self, flush_s: float) -> bool:
+        """True when a standalone flush message is warranted: records are
+        pending and ``flush_s`` has elapsed since the last drain."""
+        if flush_s <= 0:
+            return False
+        with self._lock:
+            return (bool(self._ring)
+                    and time.monotonic() - self._last_flush >= flush_s)
+
+    def flush_message(self, sender: Any, receiver: Any) -> Optional[Any]:
+        """A standalone :data:`TOPIC_TELEMETRY` message carrying the ring
+        (async mode's periodic flush), or None when nothing is pending."""
+        from ..distributed.communication.message import Message
+
+        m = Message(TOPIC_TELEMETRY, sender, receiver)
+        if self.attach(m) == 0:
+            return None
+        return m
+
+
+class TelemetryMerger:
+    """Server-side blob fan-in: seq dedup/gap accounting, remote-span
+    re-emission, ``client``-labeled metric merge.
+
+    Per-manager-instance for the same reason as :class:`ClientTelemetry`.
+    ``emit`` is sink-shaped (``(topic, record)``); ``registry`` is the
+    process :class:`~.metrics.MetricsRegistry`.  Both may be None (merger
+    then only keeps counters — the chaos tests use this shape).
+    """
+
+    def __init__(self, emit: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+                 registry: Any = None):
+        self._emit = emit
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._next: Dict[Any, int] = {}       # node -> next expected seq
+        self._train_seconds: Dict[Any, float] = {}
+        self.blobs_merged = 0
+        self.records_merged = 0
+        self.dup_records = 0
+        self.gap_records = 0
+        self.bad_blobs = 0
+        self.bytes_total = 0
+
+    # -- ingestion -----------------------------------------------------------
+    def absorb(self, message: Any) -> int:
+        """Merge the blob riding ``message`` (if any); returns the number
+        of FRESH records applied.  Never raises."""
+        try:
+            blob = message.get(TELEMETRY_KEY)
+        except Exception:
+            return 0
+        if not isinstance(blob, (bytes, bytearray)):
+            return 0
+        return self.merge(bytes(blob))
+
+    def merge(self, blob: bytes) -> int:
+        try:
+            data = decode_blob(blob)
+        except Exception:
+            with self._lock:
+                self.bad_blobs += 1
+            self._mirror_counter("telemetry.bad_blobs", 1)
+            return 0
+        node = data.get("node")
+        fresh: List[Dict[str, Any]] = []
+        dups = gaps = 0
+        with self._lock:
+            self.blobs_merged += 1
+            self.bytes_total += len(blob)
+            nxt = self._next.get(node, None)
+            for rec in data["recs"]:
+                q = rec.get("q")
+                if not isinstance(q, int):
+                    continue
+                if nxt is None:
+                    nxt = q  # first blob from this node seeds the window
+                if q < nxt:
+                    dups += 1
+                    continue
+                if q > nxt:
+                    gaps += q - nxt
+                nxt = q + 1
+                fresh.append(rec)
+            if nxt is not None:
+                self._next[node] = nxt
+            self.dup_records += dups
+            self.gap_records += gaps
+            self.records_merged += len(fresh)
+        for rec in fresh:
+            try:
+                self._apply(rec, node)
+            except Exception:  # telemetry never raises into the round path
+                pass
+        self._mirror_counter("telemetry.blobs_merged", 1)
+        if fresh:
+            self._mirror_counter("telemetry.records_merged", len(fresh))
+        if dups:
+            self._mirror_counter("telemetry.dup_records", dups)
+        if gaps:
+            self._mirror_counter("telemetry.gap_records", gaps)
+        self._mirror_counter("telemetry.bytes_total", len(blob))
+        return len(fresh)
+
+    def _mirror_counter(self, name: str, n: float) -> None:
+        if self._registry is not None:
+            try:
+                self._registry.counter_inc(name, n)
+            except Exception:
+                pass
+
+    def _apply(self, rec: Dict[str, Any], node: Any) -> None:
+        kind = rec.get("k")
+        if kind == _KIND_SPAN:
+            self._apply_span(rec, node)
+            return
+        labels = dict(rec.get("l") or {})
+        labels["client"] = str(node)
+        name = str(rec.get("n"))
+        value = float(rec.get("v", 0.0))
+        if self._registry is None:
+            return
+        if kind == _KIND_COUNTER:
+            self._registry.counter_inc(name, value, labels)
+        elif kind == _KIND_GAUGE:
+            self._registry.gauge_set(name, value, labels)
+
+    def _apply_span(self, rec: Dict[str, Any], node: Any) -> None:
+        name = str(rec.get("n"))
+        dur = float(rec.get("d", 0.0))
+        if name == "client.train":
+            # the freshest measured train time feeds the population EMA
+            with self._lock:
+                self._train_seconds[node] = dur
+        if self._emit is None:
+            return
+        start: Dict[str, Any] = {
+            "trace_id": rec.get("t"), "span_id": rec.get("s"),
+            "name": name, "node": node, "remote": True,
+        }
+        if rec.get("p") is not None:
+            start["parent_span_id"] = rec["p"]
+        if rec.get("r") is not None:
+            start["round_idx"] = int(rec["r"])
+        attrs = rec.get("a")
+        if isinstance(attrs, dict):
+            start.update(attrs)
+        end = {"trace_id": rec.get("t"), "span_id": rec.get("s"),
+               "name": name, "duration_s": dur, "remote": True}
+        try:
+            self._emit(TOPIC_SPAN_START, start)
+            self._emit(TOPIC_SPAN_END, end)
+        except Exception:
+            pass
+
+    # -- readback ------------------------------------------------------------
+    def train_seconds(self, node: Any) -> Optional[float]:
+        """The latest remote-measured ``client.train`` duration for
+        ``node`` (the pacing/staleness EMA hint), or None."""
+        with self._lock:
+            return self._train_seconds.get(node)
+
+    def counters(self) -> Dict[str, int]:
+        """Merge counters for flight-recorder dump meta."""
+        with self._lock:
+            return {
+                "telemetry_blobs_merged": self.blobs_merged,
+                "telemetry_records_merged": self.records_merged,
+                "telemetry_dup_records": self.dup_records,
+                "telemetry_gap_records": self.gap_records,
+                "telemetry_bad_blobs": self.bad_blobs,
+                "telemetry_bytes_total": self.bytes_total,
+            }

@@ -43,11 +43,19 @@ class PopulationPacingMixin:
 
     # -- per-upload ----------------------------------------------------------
     def _note_population_report(self, sender: int,
-                                n_samples: Optional[float] = None) -> None:
-        """(lock held) An accepted upload for the CURRENT round."""
+                                n_samples: Optional[float] = None,
+                                seconds: Optional[float] = None) -> None:
+        """(lock held) An accepted upload for the CURRENT round.
+
+        ``seconds`` is an optional MEASURED duration for the client's work
+        (the telemetry plane's remote ``client.train`` span) — when
+        present it feeds the registry's ``ema_seconds`` directly, so
+        pacing and the async staleness scheduler consume real phase
+        breakdowns instead of server-side wall-clock guesses."""
         self.population.note_report(
             int(sender), round_idx=int(self.args.round_idx),
             n_samples=None if n_samples is None else int(n_samples),
+            seconds=None if seconds is None else float(seconds),
         )
 
     # -- RoundTimeoutMixin hook overrides ------------------------------------

@@ -169,11 +169,22 @@ class FakeDeviceManager(FedMLCommManager):
         m.add_params(MNNMessage.MSG_ARG_KEY_CLIENT_EPOCH, self.client_epoch)
         self.send_message(m)
 
+    def _telemetry_capture(self):
+        """This device's telemetry ring (lazily bound to the obs plane)."""
+        cap = getattr(self, "_telemetry", None)
+        if cap is None:
+            cap = obs.make_client_telemetry(self.rank)
+            self._telemetry = cap
+        return cap
+
     def _on_model(self, msg: Message) -> None:
+        import time as _time
+
         model_file = msg.get(MNNMessage.MSG_ARG_KEY_MODEL_PARAMS_FILE)
         round_idx = int(msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX) or 0)
         invite_ctx = obs.extract(msg)  # server invite span (or None)
         out_path = os.path.join(self.upload_dir, f"model_r{round_idx}_c{self.rank}.ftem")
+        t_train0 = _time.monotonic()
         train_span = obs.span("client.train", invite_ctx, round_idx=round_idx,
                               node=self.rank, native=self.use_native)
         if self.use_native:
@@ -208,6 +219,18 @@ class FakeDeviceManager(FedMLCommManager):
             save_edge_model(out_path, trained)
         train_span.end()
         self.rounds_trained += 1
+        cap = self._telemetry_capture()
+        if cap is not None:
+            # mirror the train interior for the server's cross-host report
+            # (same deterministic span ids as the local span above)
+            train_ctx = cap.record_span(
+                "client.train", _time.monotonic() - t_train0,
+                parent=invite_ctx, round_idx=round_idx,
+                native=self.use_native)
+            cap.record_span("client.train.step",
+                            _time.monotonic() - t_train0, parent=train_ctx,
+                            round_idx=round_idx)
+            cap.sample_resources()
         m = Message(MNNMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         # round tag: lets a straggler-tolerant server drop uploads that
         # arrive after their round was closed by round_timeout_s
@@ -217,4 +240,6 @@ class FakeDeviceManager(FedMLCommManager):
         with obs.span("upload", invite_ctx, round_idx=round_idx,
                       node=self.rank) as up:
             obs.inject(m, up.ctx)
+            if cap is not None:
+                cap.attach(m)  # retransmits re-carry this same blob
             self.send_message(m)

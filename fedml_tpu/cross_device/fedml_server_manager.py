@@ -90,6 +90,27 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         self.register_message_receive_handler(
             MNNMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self._on_model_from_client
         )
+        self.register_message_receive_handler(
+            obs.TOPIC_TELEMETRY, self._on_telemetry
+        )
+
+    def _telemetry_merger(self):
+        """This server's telemetry fan-in (lazily bound, per-instance);
+        merge counters land in flight-recorder dump meta."""
+        merger = getattr(self, "_telemetry", None)
+        if merger is None:
+            merger = obs.make_telemetry_merger()
+            self._telemetry = merger
+            if merger is not None:
+                flight = obs.flight_recorder()
+                if flight is not None:
+                    flight.meta_provider = merger.counters
+        return merger
+
+    def _on_telemetry(self, msg: Message) -> None:
+        merger = self._telemetry_merger()
+        if merger is not None:
+            merger.absorb(msg)
 
     # -- handshake ------------------------------------------------------------
     def _on_connection_ready(self, msg: Message) -> None:
@@ -180,10 +201,17 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
     def _on_model_from_client(self, msg: Message) -> None:
         sender = int(msg.get_sender_id())
         with self._round_lock:
+            # best-effort telemetry merge first: even a stale or dropped
+            # upload's piggybacked blob is valid observability data
+            merger = self._telemetry_merger()
+            measured = None
+            if merger is not None:
+                merger.absorb(msg)
+                measured = merger.train_seconds(sender)
             if self._finished:
                 return
             if self.async_enabled:
-                self._async_on_model(msg, sender)
+                self._async_on_model(msg, sender, measured_seconds=measured)
                 return
             if self._is_stale_upload(msg.get(MNNMessage.MSG_ARG_KEY_ROUND_INDEX, None), sender):
                 return
@@ -207,7 +235,7 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             self.aggregator.add_local_trained_result(
                 self.client_id_list_in_this_round.index(sender), model_file, n
             )
-            self._note_population_report(sender, n)
+            self._note_population_report(sender, n, seconds=measured)
             self._close_round_if_complete()
 
     def _finalize_round(self, indices: Optional[List[int]]) -> None:
@@ -247,7 +275,8 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             closing_root.end(reason="closed")
 
     # -- AsyncBufferedServerMixin hooks (core/async_fl) ----------------------
-    def _async_on_model(self, msg: Message, sender: int) -> None:
+    def _async_on_model(self, msg: Message, sender: int,
+                        measured_seconds: Optional[float] = None) -> None:
         """(lock held) File-plane async accept: load the uploaded file into
         a flat params dict for the buffer; the journal records only the FILE
         path (``journal_params=False``) like the sync path does.  The file
@@ -270,7 +299,8 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         self._async_files[key] = model_file
         accepted = self._async_handle_upload(
             sender, params, n, tag, parent_ctx=obs.extract(msg),
-            journal_extra={"model_file": model_file}, journal_params=False)
+            journal_extra={"model_file": model_file}, journal_params=False,
+            measured_seconds=measured_seconds)
         if not accepted:
             # dropped (dup/stale/untagged): its file is dead weight now
             self._async_files.pop(key, None)

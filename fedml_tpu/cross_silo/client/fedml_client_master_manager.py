@@ -12,6 +12,7 @@ host-plane ProcessGroup (see trainer_dist_adapter.py).
 from __future__ import annotations
 
 import logging
+import time
 import uuid
 
 from ...core import obs
@@ -66,7 +67,9 @@ class ClientMasterManager(FedMLCommManager):
         self._invite_ctx = obs.extract(msg)  # server invite span (or None)
         self._last_global = global_model_params  # delta base for compression
         self._update_client_index(client_index)
+        t0 = time.monotonic()
         self.trainer_dist_adapter.set_model_params(global_model_params)
+        self._load_s = time.monotonic() - t0
         self.__train()
 
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
@@ -76,7 +79,10 @@ class ClientMasterManager(FedMLCommManager):
         self._invite_ctx = obs.extract(msg)
         self._last_global = global_model_params
         self._update_client_index(client_index)
+        self._maybe_flush_telemetry()
+        t0 = time.monotonic()
         self.trainer_dist_adapter.set_model_params(global_model_params)
+        self._load_s = time.monotonic() - t0
         self.__train()
 
     def _update_client_index(self, client_index: int) -> None:
@@ -137,14 +143,70 @@ class ClientMasterManager(FedMLCommManager):
             # the upload's own context rides the message: the server's
             # journal.append and any retransmit attempts parent under it
             obs.inject(m, up.ctx)
+            cap = self._telemetry_capture()
+            if cap is not None:
+                cap.attach(m)  # retransmits re-carry this same blob
             self.send_message(m)
+
+    def _telemetry_capture(self):
+        """This silo's telemetry ring (lazily bound: obs is configured by
+        mlops.init, which may run after the manager is constructed)."""
+        cap = getattr(self, "_telemetry", None)
+        if cap is None:
+            cap = obs.make_client_telemetry(self.rank)
+            self._telemetry = cap
+        return cap
+
+    def _maybe_flush_telemetry(self) -> None:
+        """Standalone flush for records that outlived the piggyback window
+        (async mode can leave a client idle between uploads)."""
+        cap = self._telemetry_capture()
+        if cap is None or not cap.flush_due(obs.telemetry_flush_s()):
+            return
+        m = cap.flush_message(self.rank, 0)
+        if m is not None:
+            self.send_message(m)
+
+    def _record_train_telemetry(self, dur_s: float, compile_s: float) -> None:
+        """Mirror the train interior into the telemetry ring: the server
+        grafts these into its round tree (same deterministic span ids as
+        the locally emitted spans, so in-process runs dedup cleanly)."""
+        cap = self._telemetry_capture()
+        if cap is None:
+            return
+        invite = getattr(self, "_invite_ctx", None)
+        train_ctx = cap.record_span(
+            "client.train", dur_s, parent=invite, round_idx=self.round_idx,
+            client_index=int(self.trainer_dist_adapter.client_index))
+        load_s = float(getattr(self, "_load_s", 0.0) or 0.0)
+        if load_s > 0:
+            cap.record_span("client.train.load", load_s, parent=train_ctx,
+                            round_idx=self.round_idx)
+        if compile_s > 0:
+            cap.record_span("client.train.compile", compile_s,
+                            parent=train_ctx, round_idx=self.round_idx)
+        cap.record_span("client.train.step",
+                        max(dur_s - compile_s, 0.0), parent=train_ctx,
+                        round_idx=self.round_idx)
+        cap.sample_resources()
+        snap = self.comm_stats_snapshot()
+        prev = getattr(self, "_tele_comm_prev", {})
+        for k, v in snap.items():
+            delta = int(v) - int(prev.get(k, 0))
+            if delta:
+                cap.record_counter(f"comm.{k}", delta)
+        self._tele_comm_prev = snap
 
     def __train(self) -> None:
         logger.info("client rank %d: train round %d (silo idx %d)",
                     self.rank, self.round_idx, self.trainer_dist_adapter.client_index)
+        t0 = time.monotonic()
+        c0 = obs.compile_seconds_total()
         with obs.span("client.train", getattr(self, "_invite_ctx", None),
                       round_idx=self.round_idx, node=self.rank,
                       annotate=True,
                       client_index=int(self.trainer_dist_adapter.client_index)):
             weights, local_sample_num = self.trainer_dist_adapter.train(self.round_idx)
+        self._record_train_telemetry(time.monotonic() - t0,
+                                     obs.compile_seconds_total() - c0)
         self.send_model_to_server(0, weights, local_sample_num)

@@ -91,6 +91,30 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.handle_message_receive_model_from_client
         )
+        self.register_message_receive_handler(
+            obs.TOPIC_TELEMETRY, self.handle_message_telemetry
+        )
+
+    def _telemetry_merger(self):
+        """This server's telemetry fan-in (lazily bound, per-instance so
+        the in-process test harness keeps nodes' sequence spaces apart).
+        On first creation the merger's counters are hung on the flight
+        recorder's dump meta."""
+        merger = getattr(self, "_telemetry", None)
+        if merger is None:
+            merger = obs.make_telemetry_merger()
+            self._telemetry = merger
+            if merger is not None:
+                flight = obs.flight_recorder()
+                if flight is not None:
+                    flight.meta_provider = merger.counters
+        return merger
+
+    def handle_message_telemetry(self, msg: Message) -> None:
+        """Standalone telemetry flush (async mode's periodic blob)."""
+        merger = self._telemetry_merger()
+        if merger is not None:
+            merger.absorb(msg)
 
     # -- handlers -----------------------------------------------------------
     def handle_message_connection_ready(self, msg: Message) -> None:
@@ -191,6 +215,13 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
 
         sender = int(msg.get_sender_id())
         with self._round_lock:
+            # best-effort telemetry merge first: even a stale or dropped
+            # upload's piggybacked blob is valid observability data
+            merger = self._telemetry_merger()
+            measured = None
+            if merger is not None:
+                merger.absorb(msg)
+                measured = merger.train_seconds(sender)
             if self._finished:
                 return
             if not self.async_enabled and self._is_stale_upload(
@@ -224,7 +255,8 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                 self._async_handle_upload(
                     sender, model_params, local_sample_number,
                     msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, None),
-                    parent_ctx=obs.extract(msg))
+                    parent_ctx=obs.extract(msg),
+                    measured_seconds=measured)
                 return
             # durably journal the accepted upload BEFORE it enters the slot
             # table; the transport ack goes out only after this handler
@@ -247,7 +279,8 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                 self.client_id_list_in_this_round.index(sender), model_params,
                 local_sample_number,
             )
-            self._note_population_report(sender, local_sample_number)
+            self._note_population_report(sender, local_sample_number,
+                                         seconds=measured)
             self._close_round_if_complete()
 
     def _finalize_round(self, indices: Optional[List[int]]) -> None:

@@ -147,7 +147,7 @@ class _Program:
 
     __slots__ = ("step", "acc_shardings", "chunk_shardings", "acc_dtypes",
                  "wire_dtypes", "out_dtypes", "shapes", "wire_bytes",
-                 "flops_per_step")
+                 "flops_per_step", "bytes_per_step")
 
     def __init__(self, step, acc_shardings, chunk_shardings, acc_dtypes,
                  wire_dtypes, out_dtypes, shapes, wire_bytes):
@@ -159,17 +159,18 @@ class _Program:
         self.out_dtypes = out_dtypes
         self.shapes = shapes
         self.wire_bytes = wire_bytes
-        self.flops_per_step = _compiled_flops(step)
+        self.flops_per_step = _compiled_cost(step, "flops")
+        self.bytes_per_step = _compiled_cost(step, "bytes accessed")
 
 
-def _compiled_flops(compiled: Any) -> float:
-    """XLA's flop estimate for one compiled step (``Compiled
-    .cost_analysis``), 0.0 when the backend doesn't report one."""
+def _compiled_cost(compiled: Any, key: str) -> float:
+    """One key of XLA's per-program cost model (``Compiled.cost_analysis``:
+    "flops", "bytes accessed", ...), 0.0 when the backend doesn't report it."""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
-        return float(ca.get("flops", 0.0) or 0.0)
+        return float(ca.get(key, 0.0) or 0.0)
     except Exception:
         return 0.0
 
@@ -290,10 +291,17 @@ class CompiledAggPlane:
                 compile_s = time.perf_counter() - t0
                 obs.histogram_observe("agg.compile_seconds", compile_s,
                                       labels={"mode": mode})
+                # XLA's own cost model for the cached program: what one
+                # reduction step costs in flops / memory traffic
+                obs.gauge_set("agg.program_flops", prog.flops_per_step,
+                              labels={"mode": mode})
+                obs.gauge_set("agg.program_bytes", prog.bytes_per_step,
+                              labels={"mode": mode})
                 # end with attribution attrs; the context-manager re-end is
                 # an idempotent no-op
                 sp.end(compile_s=round(compile_s, 6),
-                       flops_per_step=prog.flops_per_step)
+                       flops_per_step=prog.flops_per_step,
+                       bytes_per_step=prog.bytes_per_step)
                 logger.info(
                     "agg_plane compiled %s k=%d leaves=%d in %.3fs",
                     mode, k, len(shapes), compile_s)

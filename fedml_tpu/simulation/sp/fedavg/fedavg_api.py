@@ -135,6 +135,12 @@ class FedAvgAPI:
             self.aggregator.set_model_params(self.w_global)
             start_round = step + 1
             logger.info("resumed from checkpoint round %d", step)
+        # in-process loopback telemetry: the simulator runs the same
+        # capture→blob→merge pipeline the distributed managers use, so a
+        # simulation's trace_report has the identical cross-host shape
+        # (remote train sub-spans, per-client attribution) as a real run
+        tele_cap = obs.make_client_telemetry(0)
+        tele_merger = obs.make_telemetry_merger()
         for round_idx in range(start_round, comm_round):
             t0 = time.time()
             # one span tree per round; in-process simulation means select/
@@ -164,9 +170,24 @@ class FedAvgAPI:
                     self.test_data_local_dict[idx],
                     self.train_data_local_num_dict[idx],
                 )
+                tc0 = time.monotonic()
+                cc0 = obs.compile_seconds_total()
                 with obs.span("client.train", rsp.ctx, round_idx=round_idx,
                               seq=slot, annotate=True, client=int(idx)):
                     w = client.train(self.w_global)
+                if tele_cap is not None:
+                    dt_c = time.monotonic() - tc0
+                    compile_s = obs.compile_seconds_total() - cc0
+                    tctx = tele_cap.record_span(
+                        "client.train", dt_c, parent=rsp.ctx,
+                        round_idx=round_idx, seq=slot, client=int(idx))
+                    if compile_s > 0:
+                        tele_cap.record_span(
+                            "client.train.compile", compile_s, parent=tctx,
+                            round_idx=round_idx, seq=slot)
+                    tele_cap.record_span(
+                        "client.train.step", max(dt_c - compile_s, 0.0),
+                        parent=tctx, round_idx=round_idx, seq=slot)
                 w_locals.append((float(client.local_sample_number), w))
             self.samples_per_round.append(
                 int(sum(n for n, _ in w_locals)) * int(getattr(self.args, "epochs", 1))
@@ -185,6 +206,11 @@ class FedAvgAPI:
                                    dt_s=round(dt, 4), median_s=round(med, 4))
             obs.histogram_observe("round.seconds", float(dt))
             rsp.end(reason="closed")
+            if tele_cap is not None and tele_merger is not None:
+                tele_cap.sample_resources()
+                blob = tele_cap.drain()
+                if blob:
+                    tele_merger.merge(blob)
             obs.maybe_export_metrics()
             self.round_times.append(dt)
             self.metrics.log({"round": round_idx, "round_time_s": round(dt, 4)})

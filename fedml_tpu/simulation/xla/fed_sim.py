@@ -744,6 +744,12 @@ class XLASimulator:
                                "xla_trace"))
             jax.profiler.start_trace(prof_dir)
             logger.info("jax profiler trace -> %s", prof_dir)
+        # in-process loopback telemetry (cohort-level: the in-mesh round has
+        # no per-client wall times, so the remote "client.train" leg covers
+        # the whole cohort's execute time) — keeps the trace_report shape
+        # identical between simulation and distributed runs
+        tele_cap = obs.make_client_telemetry(0)
+        tele_merger = obs.make_telemetry_merger()
         for round_idx in range(start_round, comm_round):
             t0 = time.time()
             compile_s0 = obs.compile_seconds_total()
@@ -895,6 +901,21 @@ class XLASimulator:
             rsp.end(reason="closed", loss=float(mean_loss),
                     compile_s=round(compile_s, 6),
                     execute_s=round(max(0.0, dt - compile_s), 6))
+            if tele_cap is not None and tele_merger is not None:
+                tctx = tele_cap.record_span(
+                    "client.train", max(0.0, dt - compile_s), parent=rsp.ctx,
+                    round_idx=round_idx, cohort=int(participated.sum()))
+                if compile_s > 0.0:
+                    tele_cap.record_span(
+                        "client.train.compile", compile_s, parent=tctx,
+                        round_idx=round_idx)
+                tele_cap.record_span(
+                    "client.train.step", max(0.0, dt - compile_s),
+                    parent=tctx, round_idx=round_idx)
+                tele_cap.sample_resources()
+                tele_blob = tele_cap.drain()
+                if tele_blob:
+                    tele_merger.merge(tele_blob)
             obs.maybe_export_metrics()
             self.round_times.append(dt)
             if round_idx > 0:  # round 0 is dominated by XLA compile

@@ -123,6 +123,41 @@ def test_catches_direct_registry_render(tmp_path):
     assert kinds == ["direct registry render"]
 
 
+def test_catches_telemetry_wire_key_outside_seam(tmp_path):
+    # the piggybacked blob rides messages under ONE param key owned by
+    # core/obs/telemetry.py — any other module spelling it builds or reads
+    # telemetry params off-seam, dodging the seq/dedup protocol
+    f = tmp_path / "manager.py"
+    f.write_text(
+        "def upload(msg, blob):\n"
+        "    msg.add_params('__obs_telemetry__', blob)\n"
+    )
+    violations = lint_obs.lint_file(str(f))
+    assert [(lineno, kind) for _, lineno, kind, _ in violations] == [
+        (2, "telemetry wire key"),
+    ]
+    assert lint_obs.main(["--root", str(tmp_path)]) == 1
+
+
+def test_telemetry_wire_key_seam_and_pragma(tmp_path):
+    # the owning module spells the key freely...
+    d = tmp_path / "core" / "obs"
+    d.mkdir(parents=True)
+    seam = d / "telemetry.py"
+    seam.write_text("TELEMETRY_KEY = '__obs_telemetry__'\n")
+    assert lint_obs.lint_file(str(seam)) == []
+    # ...but the rule pierces the core/obs blanket exemption: a SIBLING
+    # module in the exempt layer is still flagged
+    sibling = d / "helpers.py"
+    sibling.write_text("KEY = '__obs_telemetry__'\n")
+    kinds = [kind for _, _, kind, _ in lint_obs.lint_file(str(sibling))]
+    assert kinds == ["telemetry wire key"]
+    # and the pragma still grants an approved exception
+    allowed = tmp_path / "approved.py"
+    allowed.write_text("KEY = '__obs_telemetry__'  # lint_obs: allow\n")
+    assert lint_obs.lint_file(str(allowed)) == []
+
+
 def test_exposition_rules_respect_pragma_and_exemption(tmp_path):
     allowed = tmp_path / "allowed.py"
     allowed.write_text(

@@ -1,0 +1,531 @@
+"""The cross-host telemetry plane (``fedml_tpu.core.obs.telemetry``).
+
+Three strata, mirroring the plane's contract:
+
+* **Unit** — EXACT sequence accounting on the client ring + server
+  merger: a retransmitted message re-carries the same blob and dedups
+  record-for-record; a dropped blob shows up as a counted gap (never a
+  retry); ring overflow surfaces as a gap of exactly ``dropped_total``;
+  a delayed blob arriving after the window passed is dropped as dups;
+  garbage blobs count ``bad_blobs`` and never raise.
+* **Graft** — remote span records re-emitted by the merger carry the
+  same deterministic ids the live tracer would mint, so they land inside
+  the locally reconstructed round tree (``remote: True``), and metric
+  records merge as ``client``-labeled registry series.
+* **Chaos** — the acceptance claim: the full drop + duplicate + delay +
+  reset plan and a server kill + restart, run WITH telemetry enabled,
+  converge to the bit-identical final model of a telemetry-off run, the
+  merged trees still pass ``--assert-closed``, and the grafted
+  client-side sub-spans are present.  Reuses the harnesses from
+  ``test_fault_tolerance`` and ``test_obs``.
+
+Plus golden-record coverage for the report side: ``Trace.clients()``
+straggler classification (compute / network / deferred) and the
+``trace_report --diff`` regression exit contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import trace_report
+
+import test_fault_tolerance as _ft
+import test_obs as _to
+from fedml_tpu.core import obs
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+from fedml_tpu.core.distributed.communication.message import Message
+from fedml_tpu.core.obs import MetricsRegistry, telemetry
+from fedml_tpu.core.obs.telemetry import ClientTelemetry, TelemetryMerger
+from fedml_tpu.core.obs.trace import round_root_ctx, span_id_for, trace_id_for
+
+
+@pytest.fixture(autouse=True)
+def _obs_hygiene():
+    yield
+    obs.shutdown()
+    obs.registry().reset()
+
+
+RUN = "tel-unit"
+
+
+def _cap(node=1, capacity=telemetry.DEFAULT_RING_CAPACITY):
+    return ClientTelemetry(node, RUN, capacity=capacity)
+
+
+def _fill(cap, n, round_idx=0, start_seq=0):
+    for i in range(n):
+        cap.record_span(f"phase{i}", 0.01, round_idx=round_idx,
+                        seq=start_seq + i)
+
+
+def _upload(sender=1):
+    return Message("send_model_to_server", sender, 0)
+
+
+# ---------------------------------------------------------------------------
+# Unit: exact sequence accounting
+# ---------------------------------------------------------------------------
+
+class TestExactAccounting:
+    def test_attach_absorb_counts_every_record_once(self):
+        cap = _cap()
+        _fill(cap, 3)
+        cap.record_counter("comm.bytes_sent", 100.0)
+        cap.record_gauge("proc.rss_bytes", 1.0)
+        assert cap.pending() == 5
+        msg = _upload()
+        nbytes = cap.attach(msg)
+        assert nbytes > 0 and cap.pending() == 0
+        assert cap.blobs_sent == 1 and cap.bytes_sent == nbytes
+        merger = TelemetryMerger()
+        assert merger.absorb(msg) == 5
+        assert merger.counters() == {
+            "telemetry_blobs_merged": 1,
+            "telemetry_records_merged": 5,
+            "telemetry_dup_records": 0,
+            "telemetry_gap_records": 0,
+            "telemetry_bad_blobs": 0,
+            "telemetry_bytes_total": nbytes,
+        }
+
+    def test_retransmitted_message_dedups_record_for_record(self):
+        # the retransmitter resends the SAME Message object, so the same
+        # blob arrives twice: every record must be counted as a dup,
+        # none applied twice
+        cap = _cap()
+        _fill(cap, 4)
+        msg = _upload()
+        cap.attach(msg)
+        merger = TelemetryMerger()
+        assert merger.absorb(msg) == 4
+        assert merger.absorb(msg) == 0
+        c = merger.counters()
+        assert c["telemetry_blobs_merged"] == 2
+        assert c["telemetry_records_merged"] == 4
+        assert c["telemetry_dup_records"] == 4
+        assert c["telemetry_gap_records"] == 0
+
+    def test_dropped_blob_is_a_counted_gap_never_a_retry(self):
+        cap = _cap()
+        merger = TelemetryMerger()
+        _fill(cap, 3)
+        m1 = _upload()
+        cap.attach(m1)
+        assert merger.absorb(m1) == 3        # window now expects q=3
+        _fill(cap, 2, start_seq=3)
+        assert cap.drain() is not None        # this blob is "lost in flight"
+        _fill(cap, 4, start_seq=5)
+        m3 = _upload()
+        cap.attach(m3)
+        assert merger.absorb(m3) == 4
+        c = merger.counters()
+        assert c["telemetry_gap_records"] == 2   # exactly the lost blob
+        assert c["telemetry_records_merged"] == 7
+        assert c["telemetry_dup_records"] == 0
+
+    def test_first_blob_seeds_the_window(self):
+        # a drop BEFORE the merger has seen the node at all is invisible:
+        # the first observed seq seeds the window, no false gap
+        cap = _cap()
+        _fill(cap, 3)
+        assert cap.drain() is not None        # lost before first contact
+        _fill(cap, 2, start_seq=3)
+        msg = _upload()
+        cap.attach(msg)
+        merger = TelemetryMerger()
+        assert merger.absorb(msg) == 2
+        assert merger.counters()["telemetry_gap_records"] == 0
+
+    def test_ring_overflow_accounts_exactly_as_gap(self):
+        cap = _cap(capacity=4)
+        merger = TelemetryMerger()
+        _fill(cap, 2)
+        m1 = _upload()
+        cap.attach(m1)
+        merger.absorb(m1)                     # window seeded, expects q=2
+        _fill(cap, 6, start_seq=2)            # 2 records age out client-side
+        assert cap.dropped_total == 2
+        m2 = _upload()
+        cap.attach(m2)
+        assert merger.absorb(m2) == 4
+        c = merger.counters()
+        assert c["telemetry_gap_records"] == cap.dropped_total == 2
+        assert c["telemetry_dup_records"] == 0
+
+    def test_delayed_stale_blob_is_dropped_as_dups(self):
+        # a delayed flush arriving AFTER a later piggyback already moved
+        # the window is entirely behind it: dropped as dups, not applied
+        cap = _cap()
+        _fill(cap, 3)
+        early = cap.drain()
+        _fill(cap, 2, start_seq=3)
+        late = _upload()
+        cap.attach(late)
+        merger = TelemetryMerger()
+        merger.absorb(late)                   # q3-4 arrive first (seeds at 3)
+        assert merger.merge(early) == 0       # q0-2 arrive delayed
+        c = merger.counters()
+        assert c["telemetry_dup_records"] == 3
+        assert c["telemetry_records_merged"] == 2
+
+    def test_bad_blob_counts_and_never_raises(self):
+        merger = TelemetryMerger()
+        assert merger.merge(b"\x00garbage") == 0
+        assert merger.counters()["telemetry_bad_blobs"] == 1
+        # a message with no blob, and one with a non-bytes payload
+        assert merger.absorb(_upload()) == 0
+        junk = _upload()
+        junk.add_params(telemetry.TELEMETRY_KEY, "not-bytes")
+        assert merger.absorb(junk) == 0
+        assert merger.counters()["telemetry_blobs_merged"] == 0
+
+    def test_interleaved_nodes_keep_independent_windows(self):
+        a, b = _cap(node=1), _cap(node=2)
+        merger = TelemetryMerger()
+        for cap in (a, b):
+            _fill(cap, 2)
+            m = _upload(cap.node)
+            cap.attach(m)
+            assert merger.absorb(m) == 2
+        # node 1 loses a blob; node 2 must not inherit the gap
+        _fill(a, 2, start_seq=2)
+        assert a.drain() is not None
+        _fill(a, 1, start_seq=4)
+        _fill(b, 3, start_seq=2)
+        for cap in (a, b):
+            m = _upload(cap.node)
+            cap.attach(m)
+            merger.absorb(m)
+        assert merger.counters()["telemetry_gap_records"] == 2
+        assert merger.counters()["telemetry_records_merged"] == 8
+
+    def test_flush_message_contract(self):
+        cap = _cap()
+        assert cap.flush_message(1, 0) is None      # nothing pending
+        _fill(cap, 3)
+        assert cap.flush_due(0.0) is False           # piggyback-only mode
+        assert cap.flush_due(3600.0) is False        # interval not elapsed
+        assert cap.flush_due(1e-9) is True
+        m = cap.flush_message(1, 0)
+        assert m is not None and m.get_type() == telemetry.TOPIC_TELEMETRY
+        # flush messages carry no round_idx: the fault seam can target the
+        # topic but round-scoped rules must never match them
+        assert m.get("round_idx") is None
+        merger = TelemetryMerger()
+        assert merger.absorb(m) == 3
+
+
+# ---------------------------------------------------------------------------
+# Graft: remote spans + client-labeled metric merge
+# ---------------------------------------------------------------------------
+
+class TestGraft:
+    def test_remote_spans_reemit_with_deterministic_ids(self):
+        emitted = []
+        merger = TelemetryMerger(emit=lambda t, r: emitted.append((t, dict(r))))
+        cap = _cap(node=1)
+        tctx = cap.record_span("client.train", 1.5, round_idx=2, seq=4,
+                               client=7)
+        cap.record_span("client.train.step", 1.4, parent=tctx,
+                        round_idx=2, seq=4)
+        msg = _upload()
+        cap.attach(msg)
+        assert merger.absorb(msg) == 2
+        assert [t for t, _ in emitted] == [
+            "span_start", "span_end", "span_start", "span_end"]
+        root = round_root_ctx(RUN, 2)
+        train_start = emitted[0][1]
+        assert train_start["remote"] is True
+        assert train_start["trace_id"] == root.trace_id
+        assert train_start["parent_span_id"] == root.span_id
+        assert train_start["span_id"] == span_id_for(
+            root.trace_id, "client.train", 1, 4)
+        assert train_start["client"] == 7 and train_start["round_idx"] == 2
+        assert emitted[1][1]["duration_s"] == 1.5
+        step_start = emitted[2][1]
+        assert step_start["parent_span_id"] == train_start["span_id"]
+        # the measured train time is readable as the pacing/staleness hint
+        assert merger.train_seconds(1) == 1.5
+        assert merger.train_seconds(99) is None
+
+    def test_remote_spans_graft_into_a_closed_local_tree(self):
+        collected = []
+        merger = TelemetryMerger(
+            emit=lambda t, r: collected.append(dict(r, topic=t)))
+        cap = _cap(node=1)
+        tctx = cap.record_span("client.train", 0.5, round_idx=0, seq=0)
+        cap.record_span("client.train.step", 0.4, parent=tctx, round_idx=0)
+        msg = _upload()
+        cap.attach(msg)
+        merger.absorb(msg)
+        root = round_root_ctx(RUN, 0)
+        local = [
+            {"topic": "span_start", "trace_id": root.trace_id,
+             "span_id": root.span_id, "name": "round", "node": 0,
+             "round_idx": 0, "ts": 10.0},
+            {"topic": "span_end", "trace_id": root.trace_id,
+             "span_id": root.span_id, "name": "round", "duration_s": 1.0,
+             "ts": 11.0},
+        ]
+        tr = trace_report.build_traces(local + collected)[root.trace_id]
+        assert tr.problems() == []
+        names = {sn.name for sn in tr.spans.values()}
+        assert {"round", "client.train", "client.train.step"} <= names
+        remote = [sn for sn in tr.spans.values()
+                  if (sn.start or {}).get("remote") is True]
+        assert len(remote) == 2
+
+    def test_metric_records_merge_as_client_labeled_series(self):
+        reg = MetricsRegistry()
+        merger = TelemetryMerger(registry=reg)
+        cap = _cap(node=3)
+        cap.record_counter("comm.bytes_sent", 100.0, labels={"link": "up"})
+        cap.record_counter("comm.bytes_sent", 50.0, labels={"link": "up"})
+        cap.record_gauge("proc.rss_bytes", 2048.0)
+        cap.record_gauge("proc.rss_bytes", 4096.0)  # gauges: last wins
+        msg = _upload(3)
+        cap.attach(msg)
+        assert merger.absorb(msg) == 4
+        by_metric = {(r["metric"], tuple(sorted(r["labels"].items()))): r
+                     for r in reg.export()}
+        counter = by_metric[("comm.bytes_sent",
+                             (("client", "3"), ("link", "up")))]
+        assert counter["value"] == 150.0       # deltas merge additively
+        gauge = by_metric[("proc.rss_bytes", (("client", "3"),))]
+        assert gauge["value"] == 4096.0
+        # merge bookkeeping mirrors into the same registry
+        assert ("telemetry.records_merged", ()) in by_metric
+
+
+# ---------------------------------------------------------------------------
+# Report: clients() classification + --diff golden sets
+# ---------------------------------------------------------------------------
+
+def _attributed_round(run_id, round_idx, phases, mode=None):
+    """One closed round with named child phases (``{name: seconds}``)."""
+    tid = trace_id_for(run_id, round_idx)
+    root = span_id_for(tid, "round", 0, 0)
+    start = {"topic": "span_start", "trace_id": tid, "span_id": root,
+             "name": "round", "node": 0, "round_idx": round_idx, "ts": 10.0}
+    if mode:
+        start["mode"] = mode
+    recs = [start]
+    t = 10.0
+    for name, dur in phases.items():
+        sid = span_id_for(tid, name, 0, 0)
+        recs.append({"topic": "span_start", "trace_id": tid, "span_id": sid,
+                     "name": name, "node": 0, "parent_span_id": root,
+                     "ts": t})
+        recs.append({"topic": "span_end", "trace_id": tid, "span_id": sid,
+                     "name": name, "duration_s": dur, "ts": t + dur})
+        t += dur
+    recs.append({"topic": "span_end", "trace_id": tid, "span_id": root,
+                 "name": "round", "duration_s": t - 10.0, "ts": t})
+    return recs
+
+
+def _client_leg(recs, tid, root, node, train_s, upload_s, t0,
+                upload_child_s=0.0):
+    sid = span_id_for(tid, "client.train", node, 0)
+    recs += [{"topic": "span_start", "trace_id": tid, "span_id": sid,
+              "name": "client.train", "node": node, "parent_span_id": root,
+              "ts": t0},
+             {"topic": "span_end", "trace_id": tid, "span_id": sid,
+              "name": "client.train", "duration_s": train_s,
+              "ts": t0 + train_s}]
+    up = span_id_for(tid, "upload", node, 0)
+    t1 = t0 + train_s
+    recs += [{"topic": "span_start", "trace_id": tid, "span_id": up,
+              "name": "upload", "node": node, "parent_span_id": root,
+              "ts": t1},
+             {"topic": "span_end", "trace_id": tid, "span_id": up,
+              "name": "upload", "duration_s": upload_s, "ts": t1 + upload_s}]
+    if upload_child_s > 0:
+        ch = span_id_for(tid, "journal.append", node, 0)
+        recs += [{"topic": "span_start", "trace_id": tid, "span_id": ch,
+                  "name": "journal.append", "node": node,
+                  "parent_span_id": up, "ts": t1},
+                 {"topic": "span_end", "trace_id": tid, "span_id": ch,
+                  "name": "journal.append", "duration_s": upload_child_s,
+                  "ts": t1 + upload_child_s}]
+
+
+class TestClientsTable:
+    def test_sync_compute_vs_network_classes(self):
+        run = "cl-sync"
+        tid = trace_id_for(run, 0)
+        root = span_id_for(tid, "round", 0, 0)
+        recs = [{"topic": "span_start", "trace_id": tid, "span_id": root,
+                 "name": "round", "node": 0, "round_idx": 0, "ts": 10.0}]
+        # node 1: compute-bound; node 2: network-bound (upload self-time
+        # excludes the nested server-side journal work)
+        _client_leg(recs, tid, root, 1, train_s=1.0, upload_s=0.1, t0=10.0,
+                    upload_child_s=0.06)
+        _client_leg(recs, tid, root, 2, train_s=0.1, upload_s=0.9, t0=10.0)
+        recs.append({"topic": "span_end", "trace_id": tid, "span_id": root,
+                     "name": "round", "duration_s": 2.0, "ts": 12.0})
+        tr = trace_report.build_traces(recs)[tid]
+        rows = {row["client"]: row for row in tr.clients()}
+        assert rows[1]["class"] == "compute"
+        assert rows[1]["network_s"] == pytest.approx(0.04)  # 0.1 - 0.06
+        assert rows[2]["class"] == "network"
+        assert rows[2]["deferred_s"] == 0.0     # sync: nothing deferred
+        assert tr.is_async() is False
+
+    def test_async_deferred_class(self):
+        run = "cl-async"
+        tid = trace_id_for(run, 0)
+        root = span_id_for(tid, "round", 0, 0)
+        recs = [{"topic": "span_start", "trace_id": tid, "span_id": root,
+                 "name": "round", "node": 0, "round_idx": 0, "ts": 10.0,
+                 "mode": "async_buffered"}]
+        # trained fast, uploaded fast, but the report landed 1.9s after the
+        # cycle opened: the unexplained residency is buffer deferral
+        _client_leg(recs, tid, root, 5, train_s=0.1, upload_s=0.05, t0=11.75)
+        recs.append({"topic": "span_end", "trace_id": tid, "span_id": root,
+                     "name": "round", "duration_s": 2.0, "ts": 12.0})
+        tr = trace_report.build_traces(recs)[tid]
+        (row,) = tr.clients()
+        assert row["client"] == 5 and row["class"] == "deferred"
+        assert row["deferred_s"] == pytest.approx(1.75, abs=1e-6)
+
+    def test_clients_table_rides_the_cli(self, tmp_path, capsys):
+        run = "cl-cli"
+        tid = trace_id_for(run, 0)
+        root = span_id_for(tid, "round", 0, 0)
+        recs = [{"topic": "span_start", "trace_id": tid, "span_id": root,
+                 "name": "round", "node": 0, "round_idx": 0, "ts": 10.0}]
+        _client_leg(recs, tid, root, 1, train_s=0.5, upload_s=0.1, t0=10.0)
+        recs.append({"topic": "span_end", "trace_id": tid, "span_id": root,
+                     "name": "round", "duration_s": 1.0, "ts": 11.0})
+        p = tmp_path / "run.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        assert trace_report.main([str(p), "--clients"]) == 0
+        out = capsys.readouterr().out
+        assert "compute_s" in out and "class" in out
+        # and the JSON payload carries the same table
+        payload = trace_report.trace_payload(
+            trace_report.build_traces(recs)[tid], 2.0)
+        assert payload["clients"][0]["class"] == "compute"
+
+
+class TestDiff:
+    def _write(self, path, phases):
+        recs = _attributed_round(os.path.basename(str(path)), 0, phases)
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+
+    def test_identical_runs_diff_clean(self, tmp_path, capsys):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        self._write(a, {"aggregate": 0.1, "client.train": 0.2})
+        self._write(b, {"aggregate": 0.1, "client.train": 0.2})
+        assert trace_report.main(["--diff", str(a), str(b)]) == 0
+        assert "REGRESSED" not in capsys.readouterr().out
+
+    def test_regressed_phase_fails_and_is_named(self, tmp_path, capsys):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        self._write(a, {"aggregate": 0.1, "client.train": 0.2})
+        self._write(b, {"aggregate": 0.5, "client.train": 0.2})
+        assert trace_report.main(["--diff", str(a), str(b)]) == 1
+        out = capsys.readouterr().out
+        agg_line = [l for l in out.splitlines()
+                    if l.strip().startswith("aggregate")]
+        assert agg_line and "REGRESSED" in agg_line[0]
+        assert "client.train" in out
+        assert not any("REGRESSED" in l for l in out.splitlines()
+                       if "client.train" in l)
+
+    def test_sub_millisecond_jitter_is_not_a_regression(self, tmp_path):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        self._write(a, {"aggregate": 0.0004})
+        self._write(b, {"aggregate": 0.0009})   # +125% but under the floor
+        assert trace_report.main(["--diff", str(a), str(b)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the acceptance layer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fault_free_final():
+    """Telemetry-OFF, fault-free final model: the bit-exactness reference
+    for every chaos leg below."""
+    obs.shutdown()
+    obs.registry().reset()
+    LoopbackHub.reset()
+    _, final, _ = _ft._run_chaos_topology("tel-baseline")
+    return final
+
+
+def _remote_spans(traces):
+    return [sn for tr in traces.values() for sn in tr.spans.values()
+            if (sn.start or {}).get("remote") is True]
+
+
+def test_telemetry_chaos_bit_identical_and_grafted(fault_free_final):
+    """Drop + reset + duplicate + delay with telemetry ON: the final model
+    is bit-identical to the telemetry-off fault-free run, every round still
+    closes, and the client-side sub-spans are grafted into the merged
+    trees with the merge counters exported."""
+    LoopbackHub.reset()
+    run_id = "tel-chaos"
+    with _to._traced(run_id, obs_telemetry=1) as mem:
+        history, final, stats = _ft._run_chaos_topology(
+            run_id, fault_plan=_ft._full_chaos_plan())
+        assert len(history) == 2
+    assert _ft._trees_bit_identical(final, fault_free_final), \
+        "telemetry perturbed convergence under chaos"
+    traces = _to._assert_rounds_closed(mem, run_id, 2)
+    remote = _remote_spans(traces)
+    assert remote, "no remote telemetry spans grafted into the round trees"
+    assert {sn.name for sn in remote} >= {"client.train.step"}
+    # remote sub-spans hang off the (deduped) local client.train spans
+    for tr in traces.values():
+        steps = [sn for sn in tr.spans.values()
+                 if sn.name == "client.train.step"]
+        assert steps
+    metric_names = {r["metric"] for r in mem.by_topic("metrics")}
+    assert "telemetry.blobs_merged" in metric_names
+    assert "telemetry.records_merged" in metric_names
+    # every round still exposes an attribution table with real numbers
+    for tr in traces.values():
+        rows = tr.clients()
+        assert rows and all(row["compute_s"] > 0 for row in rows)
+
+
+def test_telemetry_off_matches_on_without_faults(fault_free_final):
+    """The other half of bit-exactness: a clean telemetry-ON run equals the
+    telemetry-OFF reference too (the blob is pure observability)."""
+    LoopbackHub.reset()
+    with _to._traced("tel-clean", obs_telemetry=1) as mem:
+        history, final, _ = _ft._run_chaos_topology("tel-clean")
+        assert len(history) == 2
+    assert _ft._trees_bit_identical(final, fault_free_final)
+    traces = _to._assert_rounds_closed(mem, "tel-clean", 2)
+    assert _remote_spans(traces)
+
+
+def test_telemetry_server_kill_still_converges(fault_free_final, tmp_path):
+    """A server killed mid-round and restarted: blobs in flight die with
+    it, the fresh incarnation's merger re-seeds its sequence windows, and
+    the run still converges bit-identically with closed merged trees."""
+    LoopbackHub.reset()
+    run_id = "tel-kill"
+    with _to._traced(run_id, obs_telemetry=1) as mem:
+        history, final, stats, restarts, killed, server = \
+            _ft._run_server_kill_topology(run_id, tmp_path / "srv")
+        assert restarts >= 1 and len(history) == 2
+    assert _ft._trees_bit_identical(final, fault_free_final), \
+        "telemetry perturbed the server-kill recovery path"
+    traces = _to._assert_rounds_closed(mem, run_id, 2)
+    assert _remote_spans(traces)
+    metric_names = {r["metric"] for r in mem.by_topic("metrics")}
+    assert "telemetry.blobs_merged" in metric_names

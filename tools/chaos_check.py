@@ -13,7 +13,11 @@ mid-buffer must converge deterministically with exactly-once delta
 accounting) AND the staged-ingest chaos tests (``tests/test_ingest.py`` —
 the full chaos plan and the server kill with ``ingest_pipeline=True`` and
 group commit must converge bit-identical to the host-path model, with
-every traced round still one closed span tree) N consecutive times in
+every traced round still one closed span tree) AND the telemetry-plane
+chaos tests (``tests/test_telemetry.py`` — drop/dup/delay/server_kill
+with ``obs_telemetry=1`` must converge bit-identical to the
+telemetry-off run, with the remote spans grafted and the seq gap/dup
+accounting exact) N consecutive times in
 fresh interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
 operators stop believing red — so new fault kinds / backends must hold up
@@ -37,6 +41,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "agg_plane"
     python tools/chaos_check.py --runs 3 -k "async_fl"
     python tools/chaos_check.py --runs 3 -k "ingest"
+    python tools/chaos_check.py --runs 3 -k "telemetry"
     python tools/chaos_check.py --runs 3 --skip-perf-gate
 """
 
@@ -85,9 +90,10 @@ def main(argv=None) -> int:
     ap.add_argument(
         "-k", dest="keyword",
         default="chaos or server_kill or trace_integrity or agg_plane "
-                "or async_fl or ingest",
+                "or async_fl or ingest or telemetry",
         help='pytest -k selector (default: "chaos or server_kill or '
-             'trace_integrity or agg_plane or async_fl or ingest")')
+             'trace_integrity or agg_plane or async_fl or ingest or '
+             'telemetry")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     ap.add_argument("--skip-perf-gate", action="store_true",
@@ -106,6 +112,7 @@ def main(argv=None) -> int:
     cmd = [sys.executable, "-m", "pytest", "tests/test_fault_tolerance.py",
            "tests/test_obs.py", "tests/test_agg_plane.py",
            "tests/test_async_fl.py", "tests/test_ingest.py",
+           "tests/test_telemetry.py",
            "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
     for i in range(1, args.runs + 1):
         t0 = time.time()

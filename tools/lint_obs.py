@@ -14,6 +14,14 @@ blobs races the exactly-one-metric-line guarantee) and
 ``render_openmetrics(...)`` outside ``core/obs`` (exposition belongs to
 the exporter, not ad-hoc render calls).
 
+One pattern guards the telemetry wire seam: the piggybacked telemetry
+blob rides messages under exactly one Message-param key, owned by
+``core/obs/telemetry.py`` (attach/absorb).  Any other module spelling
+that key constructs or reads telemetry params off-seam — it would dodge
+the seq/dedup protocol and the best-effort contract.  Unlike the other
+rules this one scans RAW lines (the key is a string literal) and applies
+even inside ``core/obs``; only ``core/obs/telemetry.py`` is exempt.
+
 This tool greps ``fedml_tpu/`` for these patterns with comments/strings
 stripped.  ``core/obs`` and ``core/mlops`` — the two layers that ARE the
 seam — are exempt; anything else needing an exception carries a
@@ -54,6 +62,10 @@ _PRINTED_JSON = re.compile(r"(?<![\w.])print\s*\(\s*json\s*\.\s*dumps\s*\(")
 # the exporter inside core/obs — library code calling render_openmetrics
 # (or reaching for the exposition module) forks the export seam
 _DIRECT_RENDER = re.compile(r"(?<![\w.])render_openmetrics\s*\(")
+# the telemetry wire key: one Message-param seam, owned by
+# core/obs/telemetry.py (attach/absorb).  Built by concatenation so this
+# linter's own source never trips the rule if it is ever linted.
+_TELEMETRY_WIRE = re.compile("__obs_" + "telemetry__")
 _PRAGMA = "lint_obs: allow"
 
 # the two layers that implement the seam may touch sinks/registries freely
@@ -62,11 +74,18 @@ _EXEMPT_PARTS = (
     os.path.join("core", "mlops"),
 )
 
+_TELEMETRY_SEAM = os.path.join("core", "obs", "telemetry.py")
+
 
 def _exempt(path: str) -> bool:
     norm = os.path.normpath(os.path.abspath(path))
     return any(os.sep + part + os.sep in norm or
                norm.endswith(os.sep + part) for part in _EXEMPT_PARTS)
+
+
+def _is_telemetry_seam(path: str) -> bool:
+    norm = os.path.normpath(os.path.abspath(path))
+    return norm.endswith(os.sep + _TELEMETRY_SEAM)
 
 
 def _code_lines(source: str) -> list:
@@ -91,7 +110,9 @@ def _code_lines(source: str) -> list:
 
 
 def lint_file(path: str) -> list:
-    if _exempt(path):
+    exempt = _exempt(path)
+    seam = _is_telemetry_seam(path)
+    if exempt and seam:
         return []
     violations = []
     with open(path, "r", encoding="utf-8", errors="replace") as f:
@@ -101,16 +122,25 @@ def lint_file(path: str) -> list:
         raw = raw_lines[lineno - 1]
         if _PRAGMA in raw:
             continue
-        if _COUNTER_BAG.search(code):
-            violations.append((path, lineno, "bare counter bag", raw.rstrip()))
-        if _SINK_EMIT.search(code):
-            violations.append((path, lineno, "direct sink emit", raw.rstrip()))
-        if _PRINTED_JSON.search(code):
+        if not exempt:
+            if _COUNTER_BAG.search(code):
+                violations.append(
+                    (path, lineno, "bare counter bag", raw.rstrip()))
+            if _SINK_EMIT.search(code):
+                violations.append(
+                    (path, lineno, "direct sink emit", raw.rstrip()))
+            if _PRINTED_JSON.search(code):
+                violations.append(
+                    (path, lineno, "printed metric json", raw.rstrip()))
+            if _DIRECT_RENDER.search(code):
+                violations.append(
+                    (path, lineno, "direct registry render", raw.rstrip()))
+        # the wire key is a string literal, so this rule reads the RAW
+        # line — and pierces the core/obs blanket exemption: only the
+        # telemetry module itself may spell the key
+        if not seam and _TELEMETRY_WIRE.search(raw):
             violations.append(
-                (path, lineno, "printed metric json", raw.rstrip()))
-        if _DIRECT_RENDER.search(code):
-            violations.append(
-                (path, lineno, "direct registry render", raw.rstrip()))
+                (path, lineno, "telemetry wire key", raw.rstrip()))
     return violations
 
 
@@ -137,9 +167,10 @@ def main(argv=None) -> int:
     if violations:
         print(f"lint_obs: {len(violations)} violation(s) — use "
               "obs.counter_inc/gauge_set/histogram_observe for metrics, "
-              "the core/mlops helpers for records, and the core/obs "
-              "exporter for exposition, or mark an approved seam "
-              f"with '# {_PRAGMA}'", flush=True)
+              "the core/mlops helpers for records, the core/obs "
+              "exporter for exposition, and ClientTelemetry.attach / "
+              "TelemetryMerger.absorb for the telemetry wire key, or "
+              f"mark an approved seam with '# {_PRAGMA}'", flush=True)
         return 1
     print("lint_obs: clean", flush=True)
     return 0

@@ -17,12 +17,13 @@ check.  It parses every ``BENCH_rNN.json`` driver record (``{"n", "cmd",
   ``metric``/numeric ``value``.
 * **regressions** — for each relative key (``vs_baseline``,
   ``agg_speedup``, ``uploads_per_s``, ``async_flushes_per_s``,
-  ``async_deltas_per_s``) the LATEST value must stay within
-  ``--tolerance`` of the median of the prior rounds that report the key
-  (keys absent in older-schema rounds are simply not banded yet).
-  ``obs_overhead_frac`` is lower-better and capped absolutely by
-  ``--obs-overhead-max``.  ``BASELINE.json``'s ``published`` map, when
-  populated, bands the same way against the published numbers.
+  ``async_deltas_per_s``, ``telemetry_rounds_per_s``) the LATEST value
+  must stay within ``--tolerance`` of the median of the prior rounds
+  that report the key (keys absent in older-schema rounds are simply
+  not banded yet).  ``obs_overhead_frac`` and ``telemetry_overhead_frac``
+  are lower-better and capped absolutely by ``--obs-overhead-max``.
+  ``BASELINE.json``'s ``published`` map, when populated, bands the same
+  way against the published numbers.
 
 ``--advisory`` prints every violation but exits 0 — the chaos gate runs
 advisory over the full trajectory (the known-dark window shows up loudly)
@@ -54,9 +55,11 @@ BENCH_SCHEMA_CURRENT = 2
 # higher-is-better relative keys banded against the prior-round median
 RELATIVE_KEYS = ("vs_baseline", "agg_speedup", "uploads_per_s",
                  "uploads_per_s_host", "uploads_per_s_pipelined",
-                 "async_flushes_per_s", "async_deltas_per_s")
-# lower-is-better: absolute cap (obs must stay cheap, PR 5 contract)
-OVERHEAD_KEY = "obs_overhead_frac"
+                 "async_flushes_per_s", "async_deltas_per_s",
+                 "telemetry_rounds_per_s")
+# lower-is-better: absolute cap (observability must stay cheap — spans,
+# registry, exposition, and now the telemetry plane all share the budget)
+OVERHEAD_KEYS = ("obs_overhead_frac", "telemetry_overhead_frac")
 
 _MODES = ("full", "degraded", "failed")
 
@@ -173,11 +176,12 @@ def check_trajectory(entries: List[Dict[str, Any]], tolerance: float,
                 f"{floor:g} ({(1.0 - tolerance):.0%} of prior median "
                 f"{med:g})")
     for e in light:
-        frac = e["parsed"].get(OVERHEAD_KEY)
-        if isinstance(frac, (int, float)) and frac > obs_overhead_max:
-            violations.append(
-                f"round {e['round']}: OBS OVERHEAD — {OVERHEAD_KEY}="
-                f"{frac:g} exceeds the {obs_overhead_max:g} budget")
+        for key in OVERHEAD_KEYS:
+            frac = e["parsed"].get(key)
+            if isinstance(frac, (int, float)) and frac > obs_overhead_max:
+                violations.append(
+                    f"round {e['round']}: OBS OVERHEAD — {key}="
+                    f"{frac:g} exceeds the {obs_overhead_max:g} budget")
 
     published = (baseline or {}).get("published") or {}
     if light and isinstance(published, dict):

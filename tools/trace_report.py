@@ -23,6 +23,16 @@ span tree per (run, round) trace:
   close relative to the cycle open) instead of train duration: under
   buffered execution a slow client hurts by *when its delta lands*, not
   by how long its local step ran.
+* **Per-client attribution** (``--clients``) — with the telemetry plane on,
+  remote ``client.train`` sub-spans are grafted into the tree, so each
+  participant gets a compute / network / deferred split: compute is the
+  remote train span, network is the ``upload`` span's SELF time (duration
+  minus nested server-side children), deferred is the async gap between
+  the last report and the cycle open not explained by either.  The
+  dominant phase is the participant's straggler class.
+* **Run diff** (``--diff A B``) — compare two runs' per-phase attribution
+  and critical-path wall time; phases whose mean self-time regressed past
+  ``--diff-tolerance`` are printed and exit code 1.
 
 Durations prefer the end record's monotonic ``duration_s``; adopted ends
 (crash recovery) carry none and fall back to the sink wall-timestamp delta.
@@ -30,8 +40,9 @@ Durations prefer the end record's monotonic ``duration_s``; adopted ends
 Usage::
 
     python tools/trace_report.py run.jsonl
-    python tools/trace_report.py run.jsonl --round 3
+    python tools/trace_report.py run.jsonl --round 3 --clients
     python tools/trace_report.py a.jsonl b.jsonl --assert-closed
+    python tools/trace_report.py --diff before.jsonl after.jsonl
 """
 
 from __future__ import annotations
@@ -280,6 +291,60 @@ class Trace:
                  median > 0 and metric(sn) > slow_factor * median)
                 for sn in ranked]
 
+    def clients(self) -> List[Dict[str, Any]]:
+        """Per-participant compute/network/deferred attribution and the
+        dominant-phase straggler class.  Participants are keyed by the
+        ``client`` attr when present (sp simulation) else the emitting
+        ``node`` (distributed ranks); network is the ``upload`` span's
+        self-time (its duration minus nested children — the server-side
+        receive work parents under the upload context); deferred is, in
+        async traces, the report latency since cycle open that neither
+        compute nor network explains (buffer residency)."""
+        self.link()
+
+        def key_of(sn: SpanNode) -> Any:
+            st = sn.start or {}
+            return st.get("client", st.get("node", "?"))
+
+        per: Dict[Any, Dict[str, float]] = {}
+
+        def slot(k: Any) -> Dict[str, float]:
+            return per.setdefault(k, {"compute_s": 0.0, "network_s": 0.0,
+                                      "deferred_s": 0.0, "_last_end": 0.0})
+
+        for sn in self.spans.values():
+            if sn.start is None:
+                continue
+            if sn.name == "client.train":
+                d = slot(key_of(sn))
+                d["compute_s"] += sn.duration_s()
+            elif sn.name == "upload":
+                d = slot(key_of(sn))
+                child_s = sum(c.duration_s() for c in sn.children)
+                d["network_s"] += max(0.0, sn.duration_s() - child_s)
+            else:
+                continue
+            d["_last_end"] = max(d["_last_end"], sn.end_ts())
+        t0 = self._root_start_ts()
+        is_async = self.is_async()
+        out: List[Dict[str, Any]] = []
+        for k in sorted(per, key=str):
+            d = per[k]
+            if is_async and t0 > 0 and d["_last_end"] > 0:
+                ttr = max(0.0, d["_last_end"] - t0)
+                d["deferred_s"] = max(
+                    0.0, ttr - d["compute_s"] - d["network_s"])
+            del d["_last_end"]
+            phases = {"compute": d["compute_s"], "network": d["network_s"],
+                      "deferred": d["deferred_s"]}
+            cls = max(phases, key=phases.get)  # ties: compute wins (order)
+            out.append({"client": k,
+                        "compute_s": round(d["compute_s"], 6),
+                        "network_s": round(d["network_s"], 6),
+                        "deferred_s": round(d["deferred_s"], 6),
+                        "class": cls})
+        return out
+
 
 def build_traces(records: Iterable[Dict[str, Any]]) -> Dict[str, Trace]:
     traces: Dict[str, Trace] = {}
@@ -333,6 +398,7 @@ def trace_payload(tr: Trace, slow_factor: float) -> Dict[str, Any]:
              if k not in ("topic", "trace_id", "span_id")}
             for sn in tr.spans.values() for ev in sn.events],
         "attribution": tr.attribution(),
+        "clients": tr.clients(),
         "problems": problems,
     }
 
@@ -358,9 +424,63 @@ def report_json(traces: Dict[str, Trace], slow_factor: float,
     return n_problems
 
 
+def phase_profile(traces: Dict[str, Trace]) -> Dict[str, float]:
+    """Mean per-round self-seconds by span name (phases absent in a round
+    count as zero, so the means are comparable across runs with different
+    round counts)."""
+    samples: Dict[str, float] = {}
+    n = 0
+    for tr in _ordered(traces):
+        att = tr.attribution()
+        if not att:
+            continue
+        n += 1
+        for name, secs in att["self_seconds"].items():
+            samples[name] = samples.get(name, 0.0) + float(secs)
+    if n == 0:
+        return {}
+    return {k: v / n for k, v in samples.items()}
+
+
+def _round_seconds(traces: Dict[str, Trace]) -> float:
+    durs = sorted(
+        tr.roots()[0].duration_s() for tr in traces.values() if tr.roots())
+    return durs[len(durs) // 2] if durs else 0.0
+
+
+def diff_report(path_a: str, path_b: str, tolerance: float,
+                out=None) -> int:
+    """Compare run B against baseline run A: median round wall time and
+    mean per-phase self-seconds.  Returns the number of REGRESSED phases
+    (mean self-time grew by more than ``tolerance`` fractionally AND by an
+    absolute floor that ignores sub-millisecond jitter)."""
+    out = out if out is not None else sys.stdout
+    ta = build_traces(load_records(path_a))
+    tb = build_traces(load_records(path_b))
+    prof_a, prof_b = phase_profile(ta), phase_profile(tb)
+    ra, rb = _round_seconds(ta), _round_seconds(tb)
+    print(f"diff: A={path_a} ({len(ta)} traces)  "
+          f"B={path_b} ({len(tb)} traces)", file=out)
+    print(f"  round median: A={ra:.3f}s  B={rb:.3f}s  "
+          f"delta={rb - ra:+.3f}s", file=out)
+    regressed = 0
+    for name in sorted(set(prof_a) | set(prof_b)):
+        a, b = prof_a.get(name, 0.0), prof_b.get(name, 0.0)
+        flag = ""
+        if b > a * (1.0 + tolerance) and b - a > 1e-3:
+            flag = "  << REGRESSED"
+            regressed += 1
+        pct = (100.0 * (b - a) / a) if a > 0 else float("inf") if b > 0 else 0.0
+        print(f"  {name:<20s} A={a:8.4f}s  B={b:8.4f}s  "
+              f"{pct:+7.1f}%{flag}", file=out)
+    if regressed:
+        print(f"trace_report: {regressed} regressed phase(s)", file=out)
+    return regressed
+
+
 def report(traces: Dict[str, Trace], slow_factor: float,
            round_filter: Optional[int] = None, out=None,
-           attribution: bool = False) -> int:
+           attribution: bool = False, clients: bool = False) -> int:
     """Print the per-round report; returns the total problem count."""
     # bind the stream late: a def-time sys.stdout default would dodge any
     # redirection installed after import (test capture, CLI piping)
@@ -411,6 +531,17 @@ def report(traces: Dict[str, Trace], slow_factor: float,
                            if att["round_s"] > 0 else 0.0)
                     print(f"    {name:<20s} {secs:8.3f}s  {pct:5.1f}%",
                           file=out)
+        if clients:
+            rows = tr.clients()
+            if rows:
+                print("  client     compute_s  network_s  deferred_s  class",
+                      file=out)
+                for row in rows:
+                    print(f"  {str(row['client']):<9s}"
+                          f"  {row['compute_s']:9.4f}"
+                          f"  {row['network_s']:9.4f}"
+                          f"  {row['deferred_s']:10.4f}"
+                          f"  {row['class']}", file=out)
         metric_name = "time_to_report" if is_async else "dur"
         for sn, d, slow in tr.stragglers(slow_factor):
             flag = "  << STRAGGLER" if slow else ""
@@ -430,7 +561,7 @@ def report(traces: Dict[str, Trace], slow_factor: float,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("paths", nargs="+", help="mlops JSONL file(s)")
+    ap.add_argument("paths", nargs="*", help="mlops JSONL file(s)")
     ap.add_argument("--round", type=int, default=None,
                     help="report only this round index")
     ap.add_argument("--slow-factor", type=float, default=2.0,
@@ -440,10 +571,25 @@ def main(argv=None) -> int:
     ap.add_argument("--attribution", action="store_true",
                     help="per-round wall-clock attribution: self-time by "
                          "span name + the simulator's compile/execute split")
+    ap.add_argument("--clients", action="store_true",
+                    help="per-participant compute/network/deferred table "
+                         "with the dominant-phase straggler class")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                    help="compare run B against baseline run A: median "
+                         "round time and mean per-phase self-seconds; "
+                         "exit 1 when any phase regressed")
+    ap.add_argument("--diff-tolerance", type=float, default=0.25,
+                    help="fractional growth in a phase's mean self-time "
+                         "counted as a regression (default 0.25)")
     ap.add_argument("--format", choices=("text", "json"), default="text",
                     help="json emits one machine-readable document with the "
                          "same data as the text report")
     args = ap.parse_args(argv)
+    if args.diff is not None:
+        return 1 if diff_report(args.diff[0], args.diff[1],
+                                args.diff_tolerance) else 0
+    if not args.paths:
+        ap.error("at least one JSONL path is required (or use --diff A B)")
 
     records: List[Dict[str, Any]] = []
     for path in args.paths:
@@ -459,7 +605,7 @@ def main(argv=None) -> int:
         n_problems = report_json(traces, args.slow_factor, args.round)
         return 2 if n_problems and args.assert_closed else 0
     n_problems = report(traces, args.slow_factor, args.round,
-                        attribution=args.attribution)
+                        attribution=args.attribution, clients=args.clients)
     if n_problems:
         print(f"trace_report: {n_problems} integrity problem(s)", flush=True)
         if args.assert_closed:
