@@ -90,7 +90,10 @@ class FedMLRunnerSupervisor:
         """Blocking supervise loop; returns the final exit code."""
         meta = self.prepare()
         while not self._stop.is_set():
-            self._proc = self._spawn(meta)
+            # owned-by: run — the supervise loop is the only writer; other
+            # threads read it to signal/terminate the child, racing only
+            # against a handle that stays valid after process exit
+            self._proc = self._spawn(meta)  # owned-by: run
             self._report(self._running_status)
             rc = self._proc.wait()
             if self._stop.is_set():

@@ -77,7 +77,9 @@ class FedMLDaemon:
             except Exception:
                 logger.exception("bad dispatch payload on %s", topic)
 
-        self._client = create_broker_client(
+        # owned-by: main — connected during startup, before the serve loop
+        # spawns; the loop and status publishers only read it
+        self._client = create_broker_client(  # owned-by: main
             host, port, on_message,
             client_id=f"fedml_daemon_{self.role}_{self.account_id}",
         )

@@ -1,0 +1,46 @@
+"""fedlint: the unified AST static-analysis plane.
+
+One framework (shared walker, tokenizer stripping, import-alias resolution,
+pragmas, baseline, reporters) hosting pluggable analyzers:
+
+* the four ported lint contracts (rng / obs / agg / perf);
+* the thread-ownership race detector (``races``);
+* the ack-durability ordering checker (``ack``);
+* the JAX purity/determinism pass (``purity``).
+
+Entry points: ``tools/fedlint.py`` (CLI), or programmatically::
+
+    from fedml_tpu.core.analysis import analyze_tree, build_analyzers
+    result = analyze_tree("fedml_tpu", build_analyzers())
+
+See ``docs/STATIC_ANALYSIS.md`` for the rule catalog, the ownership
+annotation convention, and the pragma/baseline policy.
+"""
+
+from .framework import (
+    AnalysisResult,
+    Analyzer,
+    Baseline,
+    Finding,
+    JSON_SCHEMA_VERSION,
+    NO_BASELINE_PREFIXES,
+    Rule,
+    SourceFile,
+    analyze_file,
+    analyze_tree,
+    iter_python_files,
+    parse_pragma,
+    strip_comments_and_strings,
+)
+from .imports import ImportMap, receiver_of, terminal_name
+from .passes import build_analyzers
+from .report import render_json, render_rule_catalog, render_text
+
+__all__ = [
+    "AnalysisResult", "Analyzer", "Baseline", "Finding",
+    "ImportMap", "JSON_SCHEMA_VERSION", "NO_BASELINE_PREFIXES", "Rule",
+    "SourceFile", "analyze_file", "analyze_tree", "build_analyzers",
+    "iter_python_files", "parse_pragma", "receiver_of", "render_json",
+    "render_rule_catalog", "render_text", "strip_comments_and_strings",
+    "terminal_name",
+]

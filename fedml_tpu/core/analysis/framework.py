@@ -1,0 +1,347 @@
+"""fedlint framework: shared walker, tokenizer stripping, pragmas, baseline.
+
+The four grep-based lint scripts (``tools/lint_{rng,obs,agg,perf}.py``) each
+re-implemented comment/string stripping and file walking, and — being raw
+regexes — could be dodged by a one-line import alias (``from os import fsync
+as f``).  This package replaces all of that with ONE framework:
+
+* :class:`SourceFile` — path + raw lines + tokenize-stripped code lines +
+  parsed AST + import-alias map, computed once and shared by every analyzer;
+* :class:`Analyzer` / :class:`Rule` — the pass plug-in surface.  Rules carry
+  a stable id (``perf-stray-fsync``), may opt into RAW-line scanning
+  (``raw=True`` — string literals stay visible, used by the telemetry wire
+  key rule), and may demand a justification on their pragmas;
+* pragmas — ``# fedlint: allow[rule-id] — why`` suppresses that rule on that
+  line.  Rules with ``requires_justification`` (the race and ack-ordering
+  analyzers) reject a bare pragma: the finding stands until a non-empty
+  justification follows the bracket.  Legacy per-tool pragmas
+  (``# lint_rng: allow`` ...) keep working for the ported passes;
+* baseline — a JSON suppression file for grandfathering pre-existing
+  findings.  Race/ack entries are REJECTED at load (warned and ignored):
+  those two contracts may only be silenced by an inline justified pragma;
+* engine — :func:`analyze_file` / :func:`analyze_tree` walk, run analyzers,
+  and apply suppression, returning an :class:`AnalysisResult`.
+
+Exit-code contract (``tools/fedlint.py``): 0 clean / all suppressed,
+1 findings, 2 usage or internal error.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .imports import ImportMap
+
+#: bumped when the JSON reporter's schema changes shape
+JSON_SCHEMA_VERSION = 1
+
+#: rule-id prefixes that may never be baselined — only justified pragmas
+NO_BASELINE_PREFIXES = ("race-", "ack-")
+
+_PRAGMA_RE = re.compile(r"#\s*fedlint:\s*allow\[([^\]]+)\]\s*(.*)$")
+# leading separators commonly used between the bracket and the justification
+_JUSTIFICATION_STRIP = " \t:—–-"
+
+
+class Rule:
+    """One checkable contract: stable id + human summary + scan options."""
+
+    __slots__ = ("id", "summary", "raw", "requires_justification", "order")
+
+    def __init__(self, id: str, summary: str, *, raw: bool = False,
+                 requires_justification: bool = False, order: int = 0):
+        self.id = id
+        self.summary = summary
+        self.raw = raw
+        self.requires_justification = requires_justification
+        self.order = order
+
+
+class Finding:
+    """One rule violation at one source line."""
+
+    __slots__ = ("analyzer", "rule", "path", "lineno", "message", "source",
+                 "note")
+
+    def __init__(self, analyzer: str, rule: str, path: str, lineno: int,
+                 message: str, source: str, note: str = ""):
+        self.analyzer = analyzer
+        self.rule = rule
+        self.path = path
+        self.lineno = int(lineno)
+        self.message = message
+        self.source = source
+        self.note = note
+
+    def relpath(self, root: str) -> str:
+        try:
+            rel = os.path.relpath(self.path, root)
+        except ValueError:  # pragma: no cover - cross-drive on windows
+            rel = self.path
+        return rel.replace(os.sep, "/")
+
+    def to_dict(self, root: str) -> Dict[str, Any]:
+        d = {
+            "analyzer": self.analyzer,
+            "rule": self.rule,
+            "path": self.relpath(root),
+            "line": self.lineno,
+            "message": self.message,
+            "source": self.source.strip(),
+        }
+        if self.note:
+            d["note"] = self.note
+        return d
+
+    def sort_key(self) -> Tuple:
+        return (self.path, self.lineno, self.analyzer, self.rule)
+
+
+def strip_comments_and_strings(source: str) -> List[str]:
+    """The file's lines with comments and string literals blanked via
+    ``tokenize`` — only actual code can trip a (non-raw) rule.  Unparseable
+    files fall back to the raw lines rather than being skipped (the same
+    behaviour the four legacy linters shared)."""
+    lines = source.splitlines()
+    kept = list(lines)
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return kept
+    for tok in tokens:
+        if tok.type not in (tokenize.COMMENT, tokenize.STRING):
+            continue
+        (srow, scol), (erow, ecol) = tok.start, tok.end
+        for row in range(srow, erow + 1):
+            line = kept[row - 1]
+            lo = scol if row == srow else 0
+            hi = ecol if row == erow else len(line)
+            kept[row - 1] = line[:lo] + " " * (hi - lo) + line[hi:]
+    return kept
+
+
+class SourceFile:
+    """One parsed file, shared by every analyzer: the tokenizer strip and the
+    AST parse happen once per file, not once per pass."""
+
+    __slots__ = ("path", "text", "raw_lines", "_code_lines", "_tree",
+                 "_parsed", "_imports")
+
+    def __init__(self, path: str, text: Optional[str] = None):
+        self.path = os.path.abspath(path)
+        if text is None:
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        self.text = text
+        self.raw_lines = text.splitlines()
+        self._code_lines: Optional[List[str]] = None
+        self._tree: Optional[ast.AST] = None
+        self._parsed = False
+        self._imports: Optional[ImportMap] = None
+
+    @property
+    def code_lines(self) -> List[str]:
+        if self._code_lines is None:
+            self._code_lines = strip_comments_and_strings(self.text)
+        return self._code_lines
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        """The parsed module, or None when the file doesn't parse (passes
+        then fall back to their regex form or skip)."""
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.text)
+            except (SyntaxError, ValueError):
+                self._tree = None
+        return self._tree
+
+    @property
+    def imports(self) -> ImportMap:
+        if self._imports is None:
+            self._imports = ImportMap(self.tree)
+        return self._imports
+
+    def raw_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.raw_lines):
+            return self.raw_lines[lineno - 1]
+        return ""
+
+
+class Analyzer:
+    """Base class for one pass.
+
+    Subclasses set ``name``, ``rules`` and implement :meth:`check`.
+    ``legacy_pragma`` is the old per-tool pragma substring this pass still
+    honors; ``exempt_parts`` / ``exempt_files`` are path fragments whose
+    files the pass skips entirely (the seam owners)."""
+
+    name: str = ""
+    rules: Tuple[Rule, ...] = ()
+    legacy_pragma: Optional[str] = None
+    exempt_parts: Tuple[str, ...] = ()
+    exempt_files: Tuple[str, ...] = ()
+
+    def rule_by_id(self, rule_id: str) -> Rule:
+        for r in self.rules:
+            if r.id == rule_id:
+                return r
+        raise KeyError(rule_id)
+
+    def is_exempt(self, path: str) -> bool:
+        norm = os.path.normpath(os.path.abspath(path))
+        for part in self.exempt_parts:
+            p = part.replace("/", os.sep)
+            if os.sep + p + os.sep in norm or norm.endswith(os.sep + p):
+                return True
+        for part in self.exempt_files:
+            p = part.replace("/", os.sep)
+            if norm.endswith(os.sep + p):
+                return True
+        return False
+
+    def finding(self, rule: Rule, src: SourceFile, lineno: int,
+                message: str) -> Finding:
+        return Finding(self.name, rule.id, src.path, lineno, message,
+                       src.raw_line(lineno).rstrip())
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        raise NotImplementedError
+
+
+def parse_pragma(raw_line: str) -> Optional[Tuple[Set[str], str]]:
+    """``(allowed_rule_ids, justification)`` for a ``# fedlint: allow[...]``
+    pragma on ``raw_line``, or None.  ``*`` allows every rule."""
+    m = _PRAGMA_RE.search(raw_line)
+    if m is None:
+        return None
+    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    justification = m.group(2).strip(_JUSTIFICATION_STRIP).strip()
+    return rules, justification
+
+
+class Baseline:
+    """Suppression file: grandfathered findings keyed on
+    ``(rule, path, stripped source line)`` — line numbers drift, content
+    mostly doesn't.  Race/ack entries are refused at load time."""
+
+    def __init__(self, entries: Optional[Iterable[Dict[str, str]]] = None):
+        self.entries: Set[Tuple[str, str, str]] = set()
+        self.rejected: List[Dict[str, str]] = []
+        for e in entries or ():
+            rule = str(e.get("rule", ""))
+            if rule.startswith(NO_BASELINE_PREFIXES):
+                self.rejected.append(dict(e))
+                continue
+            self.entries.add((rule, str(e.get("path", "")),
+                              str(e.get("source", "")).strip()))
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or "entries" not in doc:
+            raise ValueError(f"baseline {path}: expected {{'entries': [...]}}")
+        return cls(doc["entries"])
+
+    def matches(self, finding: Finding, root: str) -> bool:
+        key = (finding.rule, finding.relpath(root), finding.source.strip())
+        return key in self.entries
+
+    @staticmethod
+    def render(findings: Sequence[Finding], root: str) -> str:
+        entries = []
+        for f in sorted(findings, key=Finding.sort_key):
+            if f.rule.startswith(NO_BASELINE_PREFIXES):
+                continue  # never write race/ack grandfathering
+            entries.append({"rule": f.rule, "path": f.relpath(root),
+                            "source": f.source.strip()})
+        return json.dumps({"version": 1, "entries": entries},
+                          indent=2, sort_keys=True) + "\n"
+
+
+class AnalysisResult:
+    """Findings plus the suppression accounting the reporters render."""
+
+    __slots__ = ("root", "findings", "files_scanned", "suppressed_pragma",
+                 "suppressed_baseline", "baseline_rejected")
+
+    def __init__(self, root: str):
+        self.root = root
+        self.findings: List[Finding] = []
+        self.files_scanned = 0
+        self.suppressed_pragma = 0
+        self.suppressed_baseline = 0
+        self.baseline_rejected: List[Dict[str, str]] = []
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def iter_python_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def analyze_file(src: SourceFile, analyzers: Sequence[Analyzer],
+                 result: Optional[AnalysisResult] = None,
+                 baseline: Optional[Baseline] = None,
+                 root: Optional[str] = None) -> List[Finding]:
+    """Run ``analyzers`` over one file and apply pragma/baseline suppression.
+    Returns the surviving findings (also appended to ``result`` if given)."""
+    kept: List[Finding] = []
+    root = root or os.path.dirname(src.path)
+    for analyzer in analyzers:
+        if analyzer.is_exempt(src.path) and not any(r.raw for r in analyzer.rules):
+            continue
+        for f in sorted(analyzer.check(src), key=Finding.sort_key):
+            raw = src.raw_line(f.lineno)
+            rule = analyzer.rule_by_id(f.rule)
+            if analyzer.legacy_pragma and analyzer.legacy_pragma in raw:
+                if result is not None:
+                    result.suppressed_pragma += 1
+                continue
+            pragma = parse_pragma(raw)
+            if pragma is not None:
+                allowed, justification = pragma
+                if f.rule in allowed or "*" in allowed:
+                    if rule.requires_justification and not justification:
+                        f.note = ("pragma present but missing the required "
+                                  "justification — add one after the bracket")
+                    else:
+                        if result is not None:
+                            result.suppressed_pragma += 1
+                        continue
+            if baseline is not None and baseline.matches(f, root):
+                if result is not None:
+                    result.suppressed_baseline += 1
+                continue
+            kept.append(f)
+    kept.sort(key=Finding.sort_key)
+    if result is not None:
+        result.findings.extend(kept)
+        result.files_scanned += 1
+    return kept
+
+
+def analyze_tree(root: str, analyzers: Sequence[Analyzer],
+                 baseline: Optional[Baseline] = None) -> AnalysisResult:
+    result = AnalysisResult(os.path.abspath(root))
+    if baseline is not None:
+        result.baseline_rejected = list(baseline.rejected)
+    for path in iter_python_files(root):
+        analyze_file(SourceFile(path), analyzers, result=result,
+                     baseline=baseline, root=result.root)
+    result.findings.sort(key=Finding.sort_key)
+    return result

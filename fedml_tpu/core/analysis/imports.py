@@ -1,0 +1,99 @@
+"""Import-alias resolution for fedlint passes.
+
+The legacy grep linters were dodged by a one-line rename::
+
+    from os import fsync as f          # lint_perf never saw "os.fsync("
+    import msgpack as mp               # mp.unpackb(...) sailed through
+
+:class:`ImportMap` closes that gap: it records every ``import`` /
+``from ... import`` binding in a module and resolves a ``Name`` or
+``Attribute`` chain back to its fully qualified dotted name, so rules match
+on what a call IS (``os.fsync``) rather than how it is spelled.
+
+Names that were never imported resolve to themselves (``msgpack_restore``
+stays ``msgpack_restore``) — rules that ban a bare helper name still work —
+with a small fallback table for the conventional scientific aliases
+(``np``/``_np`` → ``numpy``, ``jnp`` → ``jax.numpy``) so fixture snippets
+and REPL-ish code without imports still resolve.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+# conventional aliases assumed even without an import statement; a real
+# import of the same name takes precedence
+_FALLBACK_ALIASES = {
+    "np": "numpy",
+    "_np": "numpy",
+    "jnp": "jax.numpy",
+    "lax": "jax.lax",
+}
+
+
+class ImportMap:
+    """Maps local names to the dotted module/attribute they were bound to."""
+
+    __slots__ = ("aliases",)
+
+    def __init__(self, tree: Optional[ast.AST] = None):
+        self.aliases: Dict[str, str] = {}
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        # "import numpy.random as nr" binds nr -> numpy.random
+                        self.aliases[alias.asname] = alias.name
+                    else:
+                        # "import numpy.random" binds only the root "numpy"
+                        root = alias.name.split(".", 1)[0]
+                        self.aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                module = node.module or ""
+                if node.level:
+                    # relative import: keep the dots so resolution is honest
+                    module = "." * node.level + module
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    full = f"{module}.{alias.name}" if module else alias.name
+                    self.aliases[local] = full
+
+    def resolve_name(self, name: str) -> str:
+        if name in self.aliases:
+            return self.aliases[name]
+        return _FALLBACK_ALIASES.get(name, name)
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Fully qualified dotted name for a Name/Attribute chain, or None
+        when the chain is rooted in something dynamic (a call result, a
+        subscript, ``self.<attr>`` ...)."""
+        parts = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        parts.append(self.resolve_name(cur.id))
+        return ".".join(reversed(parts))
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The last segment of a call target: ``foo`` for ``a.b.foo`` / ``foo``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def receiver_of(node: ast.AST) -> Optional[ast.AST]:
+    """The expression a method is called on: ``a.b`` for ``a.b.foo``."""
+    if isinstance(node, ast.Attribute):
+        return node.value
+    return None

@@ -1,0 +1,31 @@
+"""Built-in fedlint passes: the four ported lint contracts plus the race,
+ack-ordering, and purity analyzers."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..framework import Analyzer
+from .ack_order import AckDurabilityAnalyzer
+from .legacy import AggAnalyzer, ObsAnalyzer, PerfAnalyzer, RngAnalyzer
+from .purity import PurityAnalyzer
+from .races import ThreadOwnershipAnalyzer
+
+__all__ = [
+    "AckDurabilityAnalyzer", "AggAnalyzer", "ObsAnalyzer", "PerfAnalyzer",
+    "PurityAnalyzer", "RngAnalyzer", "ThreadOwnershipAnalyzer",
+    "build_analyzers",
+]
+
+
+def build_analyzers() -> List[Analyzer]:
+    """Fresh instances of every built-in pass, in reporting order."""
+    return [
+        RngAnalyzer(),
+        ObsAnalyzer(),
+        AggAnalyzer(),
+        PerfAnalyzer(),
+        ThreadOwnershipAnalyzer(),
+        AckDurabilityAnalyzer(),
+        PurityAnalyzer(),
+    ]

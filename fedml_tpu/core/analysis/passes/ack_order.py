@@ -1,0 +1,118 @@
+"""Ack-durability ordering checker: "ack implies journaled", statically.
+
+PR 4 established the durability contract (an acked upload is already in the
+journal) and PR 10 stretched it across the staged ingest pipeline
+(``deferred_ack_scope`` tickets + the group-commit journal).  Chaos tests
+exercise the contract dynamically; this pass pins it statically so the
+hierarchical aggregator tier can't silently break the ordering.
+
+Scope is self-selecting: any function whose body calls an ack primitive
+(``_send_ack`` / ``send_ack``).  Within such a function the pass walks
+calls in source order — an optimistic linearization: branches are read
+top-to-bottom and assumed reachable — and requires every ack call to be
+preceded by a durability marker:
+
+* ``deferred_ack_scope(...)`` — the ticketed deferral seam (acks inside the
+  scope are withheld until the journal tickets resolve);
+* a journal append (``<...journal...>.append/append_async/append_blob*``)
+  or a ``_journal_upload(...)`` helper — the write is durable (or ticketed)
+  before the ack;
+* a ``dispatch(...)`` hand-off — ordering responsibility moved to the
+  handler seam, which itself journals before acking (and is checked where
+  it is defined).
+
+Nested functions are separate scopes: a callback that acks must justify its
+own ordering (typically with a pragma explaining which completion event
+implies durability).  Lambdas are not analyzed — keep ack logic out of
+lambdas.  The ``ack-before-journal`` pragma requires a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List
+
+from ..framework import Analyzer, Finding, Rule, SourceFile
+from ..imports import receiver_of, terminal_name
+
+_ACK_NAMES = frozenset({"_send_ack", "send_ack"})
+_SCOPE_MARKERS = frozenset({"deferred_ack_scope"})
+_HANDOFF_MARKERS = frozenset({"dispatch", "_dispatch",
+                              "_journal_upload", "journal_upload"})
+_JOURNAL_APPENDS = frozenset({"append", "append_async", "append_blob",
+                              "append_blob_async"})
+_JOURNALISH = re.compile(r"(?i)journal")
+
+
+def _calls_in_order(stmts, *, skip_nested: bool = True) -> Iterator[ast.Call]:
+    """Calls in source order; nested def/lambda bodies excluded (they run
+    later, on someone else's schedule)."""
+
+    def visit(node: ast.AST) -> Iterator[ast.Call]:
+        if skip_nested and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            yield node
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+
+    for stmt in stmts:
+        yield from visit(stmt)
+
+
+def _receiver_is_journalish(src: SourceFile, call: ast.Call) -> bool:
+    recv = receiver_of(call.func)
+    while recv is not None:
+        name = terminal_name(recv)
+        if name is not None and _JOURNALISH.search(name):
+            return True
+        recv = recv.value if isinstance(recv, ast.Attribute) else None
+    return False
+
+
+def _is_durability_marker(src: SourceFile, call: ast.Call) -> bool:
+    term = terminal_name(call.func)
+    if term is None:
+        return False
+    if term in _SCOPE_MARKERS or term in _HANDOFF_MARKERS:
+        return True
+    if term in _JOURNAL_APPENDS and _receiver_is_journalish(src, call):
+        return True
+    return False
+
+
+class AckDurabilityAnalyzer(Analyzer):
+    """Any path reaching an ack before a journal append / deferral ticket /
+    dispatch hand-off is a finding."""
+
+    name = "ack"
+    rules = (Rule("ack-before-journal",
+                  "ack reachable before a durability marker",
+                  requires_justification=True, order=0),)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if src.tree is None:
+            return []
+        findings: List[Finding] = []
+        rule = self.rules[0]
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls = list(_calls_in_order(node.body))
+            if not any(terminal_name(c.func) in _ACK_NAMES for c in calls):
+                continue
+            marker_seen = False
+            for call in calls:
+                if _is_durability_marker(src, call):
+                    marker_seen = True
+                    continue
+                if terminal_name(call.func) in _ACK_NAMES and not marker_seen:
+                    findings.append(self.finding(
+                        rule, src, call.lineno,
+                        f"{node.name}() acks before any journal append, "
+                        "deferred_ack_scope ticket, or dispatch hand-off — "
+                        "an acked upload must already be durable"))
+        findings.sort(key=Finding.sort_key)
+        return findings

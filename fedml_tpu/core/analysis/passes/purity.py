@@ -1,0 +1,432 @@
+"""JAX purity/determinism pass.
+
+Compiled programs (jit / pmap / vmap / scan bodies, including the agg
+plane's cached executables) trace once and replay: anything impure inside
+the traced body is silently frozen at trace time or breaks bit-exactness
+against the host oracle.  This pass finds the compiled functions in a
+module and flags the classic impurities inside them:
+
+* ``purity-wall-clock`` — ``time.*`` / ``datetime.now`` inside a traced
+  body reads trace-time, not run-time;
+* ``purity-host-rng`` — stdlib ``random.*`` or ``numpy.random.*`` draws
+  (``jax.random`` with explicit keys is the supported path);
+* ``purity-host-numpy`` — host ``numpy`` calls applied to TRACED values
+  (arguments data-dependent on the function's parameters).  Host numpy on
+  static values (shapes, python scalars) is fine and not flagged —
+  ``.shape`` / ``.dtype`` / ``.ndim`` / ``.size`` chains are treated as
+  static and do not propagate taint;
+* ``purity-unsorted-dict`` — iterating a traced dict's ``.items()`` /
+  ``.keys()`` / ``.values()`` without ``sorted(...)`` feeds
+  insertion-order-dependent structure into pytree construction;
+* ``purity-donated-reuse`` — reading a value after it was passed in a
+  donated argument position of a ``jax.jit(..., donate_argnums=...)``
+  wrapper call: the buffer was surrendered to XLA and may alias the
+  output.  Rebinding in the same statement
+  (``x, s = step(x, s)``) un-consumes, matching the canonical pattern.
+
+Compiled-function discovery: ``@jax.jit`` / ``@partial(jax.jit, ...)``
+style decorators, local wrapping (``f2 = jax.jit(f)`` / ``jax.vmap(f)``),
+and function-argument positions of ``lax.scan`` / ``while_loop`` /
+``fori_loop`` / ``cond``.  Nested defs inside a compiled function are part
+of its trace and checked with it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..framework import Analyzer, Finding, Rule, SourceFile
+from ..imports import terminal_name
+
+_JIT_WRAPPERS = frozenset({
+    "jax.jit", "jit", "jax.pmap", "pmap", "jax.vmap", "vmap",
+    "jax.numpy.vectorize",
+})
+_PARTIAL = frozenset({"functools.partial", "partial"})
+#: wrapper -> positional indices whose arguments are traced bodies
+_FN_ARG_WRAPPERS = {
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.map": (0,),
+}
+#: attribute chains that stay static under tracing (no taint propagation)
+_STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size"})
+_WALL_CLOCK_EXACT = frozenset({
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+})
+_DICT_ITERS = frozenset({"items", "keys", "values"})
+
+
+def _iter_statements(body) -> Iterator[ast.stmt]:
+    """Statements in source order, recursing into blocks but not into
+    nested function bodies (separate scopes)."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for _, value in ast.iter_fields(stmt):
+            if isinstance(value, list):
+                stmts = [v for v in value if isinstance(v, ast.stmt)]
+                if stmts:
+                    yield from _iter_statements(stmts)
+                for v in value:
+                    if isinstance(v, ast.excepthandler):
+                        yield from _iter_statements(v.body)
+
+
+def _calls_skip_nested(node: ast.AST) -> Iterator[ast.Call]:
+    def visit(n: ast.AST) -> Iterator[ast.Call]:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(n, ast.Call):
+            yield n
+        for child in ast.iter_child_nodes(n):
+            yield from visit(child)
+    for child in ast.iter_child_nodes(node):
+        yield from visit(child)
+    if isinstance(node, ast.Call):
+        yield node
+
+
+def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return tuple(e.value for e in v.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, int))
+    return ()
+
+
+class PurityAnalyzer(Analyzer):
+    """Flags impure constructs inside compiled (jit/scan/vmap) functions and
+    donated-buffer reuse around jit wrapper calls."""
+
+    name = "purity"
+    rules = (
+        Rule("purity-wall-clock",
+             "wall-clock read inside a traced body", order=0),
+        Rule("purity-host-rng",
+             "host RNG draw inside a traced body", order=1),
+        Rule("purity-host-numpy",
+             "host numpy call on a traced value", order=2),
+        Rule("purity-unsorted-dict",
+             "unsorted dict iteration inside a traced body", order=3),
+        Rule("purity-donated-reuse",
+             "value read after being donated to a jit call", order=4),
+    )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if src.tree is None:
+            return []
+        findings: List[Finding] = []
+        compiled = self._compiled_functions(src)
+        # only the outermost compiled defs: nested defs inside a compiled
+        # body are checked as part of that body's trace
+        outer = [f for f in compiled
+                 if not any(p in compiled for p in self._ancestors(src, f))]
+        for fdef in outer:
+            findings.extend(self._check_compiled(src, fdef))
+        donated_attrs = self._donated_attrs(src)
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(
+                    self._check_donated(src, node, donated_attrs))
+        findings.sort(key=Finding.sort_key)
+        return findings
+
+    # -- compiled-function discovery ----------------------------------------
+
+    def _ancestors(self, src: SourceFile, fdef: ast.AST):
+        return self._parents.get(fdef, ())
+
+    def _compiled_functions(self, src: SourceFile) -> Set[ast.AST]:
+        tree = src.tree
+        defs_by_name: Dict[str, ast.AST] = {}
+        parents: Dict[ast.AST, Tuple[ast.AST, ...]] = {}
+
+        def index(node: ast.AST, chain: Tuple[ast.AST, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs_by_name[child.name] = child
+                    parents[child] = chain
+                    index(child, chain + (child,))
+                else:
+                    index(child, chain)
+
+        index(tree, ())
+        self._parents = parents
+
+        compiled: Set[ast.AST] = set()
+        for fdef in parents:
+            for dec in getattr(fdef, "decorator_list", ()):
+                if self._is_jit_expr(src, dec):
+                    compiled.add(fdef)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = src.imports.resolve(node.func)
+            if q in _JIT_WRAPPERS:
+                if node.args and isinstance(node.args[0], ast.Name):
+                    target = defs_by_name.get(node.args[0].id)
+                    if target is not None:
+                        compiled.add(target)
+            elif q in _FN_ARG_WRAPPERS:
+                for pos in _FN_ARG_WRAPPERS[q]:
+                    if pos < len(node.args) and isinstance(
+                            node.args[pos], ast.Name):
+                        target = defs_by_name.get(node.args[pos].id)
+                        if target is not None:
+                            compiled.add(target)
+        return compiled
+
+    def _is_jit_expr(self, src: SourceFile, expr: ast.AST) -> bool:
+        q = src.imports.resolve(expr)
+        if q in _JIT_WRAPPERS:
+            return True
+        if isinstance(expr, ast.Call):
+            fq = src.imports.resolve(expr.func)
+            if fq in _JIT_WRAPPERS:
+                return True
+            if fq in _PARTIAL and expr.args:
+                return src.imports.resolve(expr.args[0]) in _JIT_WRAPPERS
+        return False
+
+    # -- traced-body checks -------------------------------------------------
+
+    def _check_compiled(self, src: SourceFile,
+                        fdef: ast.AST) -> List[Finding]:
+        findings: List[Finding] = []
+        taint = self._tainted_names(fdef)
+        for node in ast.walk(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            q = src.imports.resolve(node.func)
+            if q is not None:
+                root = q.split(".", 1)[0]
+                if root == "time" and "." in q:
+                    findings.append(self.finding(
+                        self.rule_by_id("purity-wall-clock"), src,
+                        node.lineno,
+                        f"{q} inside traced {fdef.name}() reads trace-time, "
+                        "not run-time"))
+                    continue
+                if q in _WALL_CLOCK_EXACT:
+                    findings.append(self.finding(
+                        self.rule_by_id("purity-wall-clock"), src,
+                        node.lineno,
+                        f"{q} inside traced {fdef.name}()"))
+                    continue
+                if ((root == "random" and "." in q)
+                        or q.startswith("numpy.random.")):
+                    findings.append(self.finding(
+                        self.rule_by_id("purity-host-rng"), src, node.lineno,
+                        f"{q} inside traced {fdef.name}() — draw from "
+                        "jax.random with an explicit key instead"))
+                    continue
+                if (root == "numpy" and "." in q
+                        and not q.startswith("numpy.random.")
+                        and self._any_tainted(node, taint)):
+                    findings.append(self.finding(
+                        self.rule_by_id("purity-host-numpy"), src,
+                        node.lineno,
+                        f"{q} applied to a traced value inside "
+                        f"{fdef.name}() — use jax.numpy"))
+                    continue
+            term = terminal_name(node.func)
+            if (term in _DICT_ITERS
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in taint
+                    and not self._directly_sorted(fdef, node)):
+                findings.append(self.finding(
+                    self.rule_by_id("purity-unsorted-dict"), src,
+                    node.lineno,
+                    f"iteration over {node.func.value.id}.{term}() inside "
+                    f"traced {fdef.name}() is insertion-order dependent — "
+                    "wrap in sorted(...)"))
+        return findings
+
+    def _tainted_names(self, fdef: ast.AST) -> Set[str]:
+        """Names data-dependent on the traced function's parameters,
+        propagated through assignments in source order."""
+        taint: Set[str] = set()
+        for scope in ast.walk(fdef):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                a = scope.args
+                for arg in (list(a.posonlyargs) + list(a.args)
+                            + list(a.kwonlyargs)):
+                    taint.add(arg.arg)
+                if a.vararg:
+                    taint.add(a.vararg.arg)
+                if a.kwarg:
+                    taint.add(a.kwarg.arg)
+        for stmt in _iter_statements(fdef.body):
+            value = getattr(stmt, "value", None)
+            if (isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+                    and value is not None
+                    and self._expr_tainted(value, taint)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for target in targets:
+                    for name in ast.walk(target):
+                        if isinstance(name, ast.Name):
+                            taint.add(name.id)
+            elif isinstance(stmt, ast.For) and self._expr_tainted(
+                    stmt.iter, taint):
+                for name in ast.walk(stmt.target):
+                    if isinstance(name, ast.Name):
+                        taint.add(name.id)
+        return taint
+
+    def _expr_tainted(self, expr: ast.AST, taint: Set[str]) -> bool:
+        if isinstance(expr, ast.Attribute) and expr.attr in _STATIC_ATTRS:
+            return False  # shapes/dtypes are static under trace
+        if isinstance(expr, ast.Name):
+            return expr.id in taint
+        return any(self._expr_tainted(child, taint)
+                   for child in ast.iter_child_nodes(expr))
+
+    def _any_tainted(self, call: ast.Call, taint: Set[str]) -> bool:
+        for arg in call.args:
+            if self._expr_tainted(arg, taint):
+                return True
+        for kw in call.keywords:
+            if self._expr_tainted(kw.value, taint):
+                return True
+        return False
+
+    def _directly_sorted(self, fdef: ast.AST, call: ast.Call) -> bool:
+        """True when the .items()/.keys()/.values() call is the immediate
+        argument of sorted(...)."""
+        for node in ast.walk(fdef):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "sorted"
+                    and any(arg is call for arg in node.args)):
+                return True
+        return False
+
+    # -- donated-buffer reuse -----------------------------------------------
+
+    def _donated_attrs(self, src: SourceFile) -> Dict[str, Tuple[int, ...]]:
+        """self.<attr> -> donated positions, for jit wrappers stored on
+        instances (``self._step = jax.jit(step, donate_argnums=(0, 1))``)."""
+        out: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)
+                    and src.imports.resolve(value.func) in _JIT_WRAPPERS):
+                continue
+            positions = _donate_positions(value)
+            if not positions:
+                continue
+            for target in node.targets:
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    out[target.attr] = positions
+        return out
+
+    def _check_donated(self, src: SourceFile, fdef: ast.AST,
+                       donated_attrs: Dict[str, Tuple[int, ...]]
+                       ) -> List[Finding]:
+        findings: List[Finding] = []
+        donated_locals: Dict[str, Tuple[int, ...]] = {}
+        consumed: Dict[Tuple[str, str], int] = {}
+
+        def key_for(expr: ast.AST) -> Optional[Tuple[str, str]]:
+            if isinstance(expr, ast.Name):
+                return ("name", expr.id)
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"):
+                return ("attr", expr.attr)
+            return None
+
+        for stmt in _iter_statements(fdef.body):
+            # 1) reads of already-consumed values in this statement
+            if consumed:
+                for node in ast.walk(stmt):
+                    k = key_for(node)
+                    if (k in consumed
+                            and isinstance(getattr(node, "ctx", None),
+                                           ast.Load)):
+                        label = (k[1] if k[0] == "name"
+                                 else f"self.{k[1]}")
+                        findings.append(self.finding(
+                            self.rule_by_id("purity-donated-reuse"), src,
+                            node.lineno,
+                            f"{label} is read after being donated at line "
+                            f"{consumed[k]} — the buffer was surrendered "
+                            "to XLA and may alias the output"))
+                        consumed.pop(k)
+            # 2) register local donated wrappers
+            if isinstance(stmt, ast.Assign):
+                value = stmt.value
+                if (isinstance(value, ast.Call)
+                        and src.imports.resolve(value.func)
+                        in _JIT_WRAPPERS):
+                    positions = _donate_positions(value)
+                    if positions:
+                        for target in stmt.targets:
+                            if isinstance(target, ast.Name):
+                                donated_locals[target.id] = positions
+            # 3) new consumption by donated-wrapper calls in this statement
+            for call in _calls_skip_nested(stmt):
+                positions: Tuple[int, ...] = ()
+                if (isinstance(call.func, ast.Name)
+                        and call.func.id in donated_locals):
+                    positions = donated_locals[call.func.id]
+                else:
+                    k = key_for(call.func)
+                    if k is not None and k[0] == "attr" \
+                            and k[1] in donated_attrs:
+                        positions = donated_attrs[k[1]]
+                for pos in positions:
+                    if pos < len(call.args):
+                        ak = key_for(call.args[pos])
+                        if ak is not None:
+                            consumed[ak] = call.lineno
+            # 4) stores un-consume (the canonical x, s = step(x, s))
+            for target in self._store_targets(stmt):
+                consumed.pop(target, None)
+        return findings
+
+    def _store_targets(self, stmt: ast.stmt):
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            targets = [stmt.target]
+        out = []
+
+        def collect(node):
+            if isinstance(node, (ast.Tuple, ast.List)):
+                for elt in node.elts:
+                    collect(elt)
+            elif isinstance(node, ast.Starred):
+                collect(node.value)
+            elif isinstance(node, ast.Name):
+                out.append(("name", node.id))
+            elif (isinstance(node, ast.Attribute)
+                  and isinstance(node.value, ast.Name)
+                  and node.value.id == "self"):
+                out.append(("attr", node.attr))
+
+        for t in targets:
+            collect(t)
+        return out

@@ -1,0 +1,329 @@
+"""Thread-ownership race detector.
+
+Scope is self-selecting: only classes that spawn a thread onto one of their
+own methods (``threading.Thread(target=self._worker)``) are analyzed — a
+class with no threads has no cross-thread state to get wrong.
+
+For each such class the pass:
+
+1. collects the thread ENTRY methods (the ``target=`` of every Thread the
+   class creates) and assigns each a role — the method name by default,
+   overridable with ``# thread-role: <name>`` on the ``def`` line;
+2. walks the intra-class call graph (``self.method()`` edges) from each
+   entry: a method reachable from an entry runs in that entry's thread
+   context; every other method is assumed to run on the caller's ("main")
+   thread;
+3. records every ``self.<attr>`` access with its context set, whether it is
+   a write (assign / augassign / ``del`` / subscript store), whether it
+   happens inside ``with self.<lock>:``, and whether it is ``__init__``
+   publication (writes in ``__init__`` happen-before ``Thread.start`` and
+   are not shared-state writes);
+4. reads ownership annotations: ``# owned-by: <role>`` on any line that
+   touches ``self.<attr>`` declares the attribute's owning context
+   (``main`` for caller-thread state, or a thread role such as
+   ``transport`` / ``dispatch`` / ``committer`` / ``exporter``).
+
+Two rules, both requiring a justification on their pragmas:
+
+* ``race-unannotated-shared`` — an attribute is written outside
+  ``__init__``, is touched from two or more thread contexts, has no
+  ownership annotation, and at least one write holds no lock;
+* ``race-cross-thread-write`` — an annotated attribute is written, without
+  a lock, from a context that is not its owner.
+
+Lock detection: a ``with self.<attr>:`` block where the attribute name
+looks lock-ish (lock/cond/mutex/sem) or was assigned a
+``threading.Lock/RLock/Condition/Semaphore``.  Known limitations, by
+design: mutator METHOD calls (``self.buf.append(x)``) are not writes (too
+many false positives on queues that are themselves thread-safe), and
+reads are not checked for lock discipline — the annotation plus write-side
+checking is the contract this pass pins.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..framework import Analyzer, Finding, Rule, SourceFile
+
+_ROLE_COMMENT = re.compile(r"#\s*owned-by:\s*([A-Za-z_][\w.-]*)")
+_THREAD_ROLE_COMMENT = re.compile(r"#\s*thread-role:\s*([A-Za-z_][\w.-]*)")
+_LOCKISH = re.compile(r"(?i)lock|cond|mutex|sem")
+_LOCK_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+})
+
+MAIN_CONTEXT = "main"
+
+
+class _Access:
+    __slots__ = ("attr", "method", "lineno", "write", "locked", "init")
+
+    def __init__(self, attr: str, method: str, lineno: int, write: bool,
+                 locked: bool, init: bool):
+        self.attr = attr
+        self.method = method
+        self.lineno = lineno
+        self.write = write
+        self.locked = locked
+        self.init = init
+
+
+def _method_defs(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    defs: Dict[str, ast.AST] = {}
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    return defs
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``attr`` when ``node`` is ``self.<attr>``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _AccessCollector(ast.NodeVisitor):
+    """Walks one method body recording self.<attr> accesses with the
+    enclosing-lock state.  Nested defs are included: they execute in the
+    enclosing method's context unless handed to another thread, and the
+    conservative attribution keeps callbacks visible."""
+
+    def __init__(self, method: str, init: bool, lock_attrs: Set[str]):
+        self.method = method
+        self.init = init
+        self.lock_attrs = lock_attrs
+        self.depth = 0  # >0 while inside `with self.<lock>:`
+        self.accesses: List[_Access] = []
+        self._write_targets: Set[int] = set()
+
+    def _record(self, attr: str, lineno: int, write: bool):
+        self.accesses.append(_Access(attr, self.method, lineno, write,
+                                     self.depth > 0, self.init))
+
+    def _mark_targets(self, node: ast.AST):
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record(attr, node.lineno, True)
+            return
+        if isinstance(node, ast.Subscript):
+            inner = _self_attr(node.value)
+            if inner is not None:
+                self._record(inner, node.lineno, True)
+                return
+            self.visit(node.value)
+            self.visit(node.slice)
+            return
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self._mark_targets(elt)
+            return
+        if isinstance(node, ast.Starred):
+            self._mark_targets(node.value)
+            return
+        self.visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)
+        for target in node.targets:
+            self._mark_targets(target)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.visit(node.value)
+        self._mark_targets(node.target)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self.visit(node.value)
+            self._mark_targets(node.target)
+
+    def visit_Delete(self, node: ast.Delete):
+        for target in node.targets:
+            self._mark_targets(target)
+
+    def visit_With(self, node: ast.With):
+        holds = False
+        for item in node.items:
+            ctx = item.context_expr
+            self.visit(ctx)
+            attr = _self_attr(ctx)
+            if attr is not None and attr in self.lock_attrs:
+                holds = True
+            if item.optional_vars is not None:
+                self._mark_targets(item.optional_vars)
+        if holds:
+            self.depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if holds:
+            self.depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record(attr, node.lineno, False)
+        self.generic_visit(node)
+
+
+class ThreadOwnershipAnalyzer(Analyzer):
+    """Flags unannotated shared mutable attributes and cross-thread writes
+    in thread-spawning classes."""
+
+    name = "races"
+    rules = (
+        Rule("race-unannotated-shared",
+             "shared mutable attribute without ownership annotation",
+             requires_justification=True, order=0),
+        Rule("race-cross-thread-write",
+             "write to an owned attribute from a foreign thread context",
+             requires_justification=True, order=1),
+    )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if src.tree is None:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(src, node))
+        return findings
+
+    # -- per-class analysis -------------------------------------------------
+
+    def _check_class(self, src: SourceFile,
+                     cls: ast.ClassDef) -> List[Finding]:
+        methods = _method_defs(cls)
+        if not methods:
+            return []
+        entries = self._thread_entries(src, cls, methods)
+        if not entries:
+            return []  # no threads spawned onto own methods: out of scope
+
+        lock_attrs = self._lock_attrs(src, methods)
+        contexts = self._method_contexts(methods, entries)
+
+        accesses: List[_Access] = []
+        ownership: Dict[str, Tuple[str, int]] = {}
+        for mname, mdef in methods.items():
+            collector = _AccessCollector(mname, mname == "__init__",
+                                         lock_attrs)
+            for stmt in mdef.body:
+                collector.visit(stmt)
+            accesses.extend(collector.accesses)
+        for acc in accesses:
+            m = _ROLE_COMMENT.search(src.raw_line(acc.lineno))
+            if m and acc.attr not in ownership:
+                ownership[acc.attr] = (m.group(1), acc.lineno)
+
+        by_attr: Dict[str, List[_Access]] = {}
+        for acc in accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+
+        findings: List[Finding] = []
+        for attr, accs in sorted(by_attr.items()):
+            if attr in lock_attrs:
+                continue  # the locks themselves are safely shared
+            shared = [a for a in accs if not a.init]
+            ctxs: Set[str] = set()
+            for a in shared:
+                ctxs.update(contexts.get(a.method, {MAIN_CONTEXT}))
+            writes = [a for a in shared if a.write]
+            owner = ownership.get(attr)
+            if owner is None:
+                if len(ctxs) < 2 or not writes:
+                    continue
+                unlocked = [w for w in writes if not w.locked]
+                if unlocked:
+                    w = min(unlocked, key=lambda a: a.lineno)
+                    findings.append(self.finding(
+                        self.rules[0], src, w.lineno,
+                        f"{cls.name}.{attr} is written in {w.method}() and "
+                        f"touched from contexts {sorted(ctxs)} with no lock "
+                        "held and no '# owned-by:' annotation"))
+            else:
+                role = owner[0]
+                for w in writes:
+                    wctx = contexts.get(w.method, {MAIN_CONTEXT})
+                    if role not in wctx and not w.locked:
+                        findings.append(self.finding(
+                            self.rules[1], src, w.lineno,
+                            f"{cls.name}.{attr} is owned by '{role}' but "
+                            f"written from {w.method}() (context "
+                            f"{sorted(wctx)}) without a lock"))
+        findings.sort(key=Finding.sort_key)
+        return findings
+
+    # -- scope discovery ----------------------------------------------------
+
+    def _thread_entries(self, src: SourceFile, cls: ast.ClassDef,
+                        methods: Dict[str, ast.AST]) -> Dict[str, str]:
+        """method name -> role, for every Thread(target=self.<method>)."""
+        entries: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            q = src.imports.resolve(node.func)
+            if q != "threading.Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                attr = _self_attr(kw.value)
+                if attr is not None and attr in methods:
+                    mdef = methods[attr]
+                    m = _THREAD_ROLE_COMMENT.search(
+                        src.raw_line(mdef.lineno))
+                    entries[attr] = m.group(1) if m else attr.lstrip("_")
+        return entries
+
+    def _lock_attrs(self, src: SourceFile,
+                    methods: Dict[str, ast.AST]) -> Set[str]:
+        locks: Set[str] = set()
+        for mdef in methods.values():
+            for node in ast.walk(mdef):
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        attr = _self_attr(target)
+                        if attr is None:
+                            continue
+                        if _LOCKISH.search(attr):
+                            locks.add(attr)
+                        elif (isinstance(node.value, ast.Call)
+                              and src.imports.resolve(node.value.func)
+                              in _LOCK_CTORS):
+                            locks.add(attr)
+        return locks
+
+    def _method_contexts(self, methods: Dict[str, ast.AST],
+                         entries: Dict[str, str]) -> Dict[str, Set[str]]:
+        """Each method's thread-context set: entry roles for methods
+        reachable from an entry, MAIN_CONTEXT otherwise."""
+        edges: Dict[str, Set[str]] = {m: set() for m in methods}
+        for mname, mdef in methods.items():
+            for node in ast.walk(mdef):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee is not None and callee in methods:
+                        edges[mname].add(callee)
+        contexts: Dict[str, Set[str]] = {m: set() for m in methods}
+        for entry, role in entries.items():
+            stack, seen = [entry], {entry}
+            while stack:
+                cur = stack.pop()
+                contexts[cur].add(role)
+                for nxt in edges[cur]:
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+        for m in methods:
+            if not contexts[m]:
+                contexts[m] = {MAIN_CONTEXT}
+        return contexts

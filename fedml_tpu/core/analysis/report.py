@@ -1,0 +1,71 @@
+"""Reporters for fedlint results.
+
+Both renderers RETURN strings — printing is the CLI's job (and library code
+printing metric-shaped JSON would trip the obs pass's own rule).  The JSON
+document is versioned and key-sorted so trace_report-style consumers can
+depend on its shape.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Sequence
+
+from .framework import Analyzer, AnalysisResult, JSON_SCHEMA_VERSION
+
+
+def render_text(result: AnalysisResult) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f"fedlint: {f.relpath(result.root)}:{f.lineno}: "
+                     f"[{f.rule}] {f.message}")
+        if f.note:
+            lines.append(f"fedlint:     note: {f.note}")
+    for entry in result.baseline_rejected:
+        lines.append("fedlint: baseline entry for rule "
+                     f"'{entry.get('rule', '?')}' IGNORED — race/ack "
+                     "contracts may only be suppressed by a justified "
+                     "inline pragma")
+    suppressed = result.suppressed_pragma + result.suppressed_baseline
+    tail = (f"{result.files_scanned} file(s) scanned, "
+            f"{len(result.findings)} finding(s)")
+    if suppressed:
+        tail += (f", {result.suppressed_pragma} pragma-suppressed, "
+                 f"{result.suppressed_baseline} baseline-suppressed")
+    lines.append(f"fedlint: {tail}")
+    if not result.findings:
+        lines.append("fedlint: clean")
+    return "\n".join(lines)
+
+
+def render_json(result: AnalysisResult) -> str:
+    doc = {
+        "version": JSON_SCHEMA_VERSION,
+        "root": result.root,
+        "findings": [f.to_dict(result.root) for f in result.findings],
+        "counts": {
+            "findings": len(result.findings),
+            "files_scanned": result.files_scanned,
+        },
+        "suppressed": {
+            "pragma": result.suppressed_pragma,
+            "baseline": result.suppressed_baseline,
+        },
+        "baseline_rejected": result.baseline_rejected,
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def render_rule_catalog(analyzers: Sequence[Analyzer]) -> str:
+    lines = []
+    for analyzer in analyzers:
+        lines.append(f"{analyzer.name}:")
+        for rule in analyzer.rules:
+            flags = []
+            if rule.raw:
+                flags.append("raw")
+            if rule.requires_justification:
+                flags.append("justified-pragma-only")
+            suffix = f"  [{', '.join(flags)}]" if flags else ""
+            lines.append(f"  {rule.id:<26} {rule.summary}{suffix}")
+    return "\n".join(lines)

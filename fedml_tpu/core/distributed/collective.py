@@ -224,7 +224,9 @@ class ProcessGroup:
             return tree
         gathered = self.gather(tree, dst=0)
         if self.rank == 0:
-            reduced = jax.tree_util.tree_map(
+            # lint_agg: allow — collective allreduce primitive (the comm
+            # layer the aggregators sit ON TOP of), not client aggregation
+            reduced = jax.tree_util.tree_map(  # lint_agg: allow
                 lambda *xs: np.sum(np.stack(xs, 0), axis=0), *gathered
             )
         else:
@@ -243,7 +245,8 @@ class ProcessGroup:
             ws = [w for _, w in gathered]
             den = sum(ws)
             den = den if den > 0 else 1.0
-            reduced = jax.tree_util.tree_map(
+            # lint_agg: allow — weighted allreduce collective primitive
+            reduced = jax.tree_util.tree_map(  # lint_agg: allow
                 lambda *xs: sum(x * w for x, w in zip(xs, ws)) / den, *trees
             )
         else:

@@ -110,9 +110,12 @@ class _ReliableLink:
 
     # -- wiring --------------------------------------------------------------
     def bind(self, send_raw: Callable[[Message], None]) -> None:
-        self._send_raw = send_raw
+        # owned-by: main — both bound/set before the retransmit thread
+        # starts (Thread.start is the happens-before edge); the thread and
+        # the ack path only read them afterwards
+        self._send_raw = send_raw  # owned-by: main
         if self.max_retries > 0 and self._thread is None:
-            self._running = True
+            self._running = True  # owned-by: main
             self._thread = threading.Thread(
                 target=self._retransmit_loop, daemon=True,
                 name=f"comm-retx-rank{self.rank}")
@@ -322,7 +325,8 @@ class _IngestPipeline:
         obs.gauge_set("ingest.queue_depth", self._queue.qsize())
 
     def stop(self) -> None:
-        self._stop_flag = True
+        # owned-by: main — monotonic shutdown latch; the worker only reads
+        self._stop_flag = True  # owned-by: main
         if threading.current_thread() is not self._thread:
             self._thread.join(timeout=10.0)
 
@@ -407,7 +411,10 @@ class _IngestPipeline:
                 self._link.forget(msg)
                 span.end(error=str(error))
                 return
-            self._link._send_ack(msg)
+            # fedlint: allow[ack-before-journal] — runs from JournalTicket
+            # completion callbacks: reaching here means every ticket in the
+            # batch resolved, i.e. the uploads ARE durable before this ack
+            self._link._send_ack(msg)  # fedlint: allow[ack-before-journal] — all batch tickets durable here
             span.end()
 
         for t in tickets:
@@ -421,7 +428,9 @@ class FedMLCommManager(Observer):
         self.rank = int(rank)
         self.backend = backend
         self.comm = comm
-        self.com_manager: Optional[BaseCommunicationManager] = None
+        # owned-by: main — _init_manager() assigns it before run() spawns /
+        # enters the receive loop; the loop thread only reads it
+        self.com_manager: Optional[BaseCommunicationManager] = None  # owned-by: main
         self.message_handler_dict: Dict[str, Callable[[Message], None]] = {}
         self._comm_stats = CommStats(node=self.rank)
         self._link = self._init_link()

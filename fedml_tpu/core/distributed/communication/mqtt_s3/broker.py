@@ -135,12 +135,15 @@ class LocalBroker:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "LocalBroker":
-        self._server_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # owned-by: main — bound/configured before the accept thread starts;
+        # the loops only read (accept on) it afterwards
+        self._server_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # owned-by: main
         self._server_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server_sock.bind((self.host, self.port))
         self.port = self._server_sock.getsockname()[1]
         self._server_sock.listen(128)
-        self._running = True
+        # owned-by: main — start/stop latch; accept/client loops only read
+        self._running = True  # owned-by: main
         self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="broker-accept")
         self._thread.start()
         logger.info("local broker on %s:%s", self.host, self.port)
@@ -380,7 +383,8 @@ class BrokerClient:
         observed losing the tail of a FINISH fan-out, wedging a client
         forever.  shutdown(SHUT_WR) sends FIN instead; the recv thread keeps
         draining until the broker processes our DISCONNECT and closes."""
-        self._running = False
+        # owned-by: main — connect/disconnect latch; the recv loop only reads
+        self._running = False  # owned-by: main
         try:
             with self._lock:
                 # the half-close must be fenced with the sends: a publish

@@ -50,6 +50,10 @@ class TCPCommManager(BaseCommunicationManager):
         self.retry_interval_s = float(retry_interval_s)
         self.bind_host = bind_host
         self.reconnect_count = 0  # connect retries + listener rebinds
+        # reconnect_count has two writer threads (accept loop rebinds,
+        # sender retries) — increments are read-modify-write and must not
+        # lose counts under concurrent senders
+        self._stats_lock = threading.Lock()
         self._observers: List[Observer] = []
         self._inbox: "queue.Queue" = queue.Queue()
         self._running = False
@@ -79,8 +83,12 @@ class TCPCommManager(BaseCommunicationManager):
                 # bounded retry so one socket hiccup doesn't deafen the rank
                 for attempt in range(self.connect_retries):
                     try:
-                        self._server = self._bind_listener()
-                        self.reconnect_count += 1
+                        # owned-by: accept_loop — after __init__ publication
+                        # only the accept loop rebinds the listener; other
+                        # threads just read the handle (close is idempotent)
+                        self._server = self._bind_listener()  # owned-by: accept_loop
+                        with self._stats_lock:
+                            self.reconnect_count += 1
                         logger.warning("tcp rank %s: listener died; rebound "
                                        "after %d attempts", self.rank, attempt + 1)
                         break
@@ -140,7 +148,8 @@ class TCPCommManager(BaseCommunicationManager):
                 with socket.create_connection(addr, timeout=30) as s:
                     s.sendall(struct.pack("<Q", len(payload)) + payload)
                 if attempt > 0:
-                    self.reconnect_count += 1
+                    with self._stats_lock:
+                        self.reconnect_count += 1
                 return
             except (ConnectionRefusedError, socket.timeout, OSError) as e:
                 # peer process may not have bound its port yet (startup race),
@@ -166,7 +175,9 @@ class TCPCommManager(BaseCommunicationManager):
             if item is _STOP:
                 break
             self._notify(item)
-        self._closed = True
+        # owned-by: main — shutdown latch written by the receive/stop path;
+        # the accept loop only reads it to tell stop from socket death
+        self._closed = True  # owned-by: main
         try:
             self._server.close()
         except OSError:

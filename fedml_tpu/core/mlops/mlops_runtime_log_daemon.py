@@ -38,7 +38,8 @@ class MLOpsRuntimeLogDaemon:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "MLOpsRuntimeLogDaemon":
-        self._running = True
+        # owned-by: main — start/stop latch; the shipping loop only reads
+        self._running = True  # owned-by: main
         self._thread = threading.Thread(target=self._loop, daemon=True, name="mlops-log-daemon")
         self._thread.start()
         return self

@@ -85,7 +85,9 @@ class DistributedTrainer:
 
         def step(variables, opt_state, x, y):
             params = variables["params"]
-            other = {k: v for k, v in variables.items() if k != "params"}
+            # sorted: pytree construction inside the traced body must not
+            # depend on the caller's dict insertion order
+            other = {k: v for k, v in sorted(variables.items()) if k != "params"}
 
             def compute(p):
                 logits = module.apply(dict(other, params=p), x, train=True)

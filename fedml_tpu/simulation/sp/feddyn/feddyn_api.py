@@ -71,7 +71,9 @@ class FedDynAPI(FedAvgAPI):
         avg = weighted_mean(w_locals)
         if self.h_clients:
             n_total = float(self.args.client_num_in_total)
-            self.h_mean = jax.tree_util.tree_map(
+            # lint_agg: allow — FedDyn's algorithm-internal h-state fold,
+            # not a client-update aggregation path
+            self.h_mean = jax.tree_util.tree_map(  # lint_agg: allow
                 lambda *xs: sum(xs) / n_total, *self.h_clients.values()
             )
         new_params = jax.tree_util.tree_map(

@@ -1,0 +1,11 @@
+"""Golden fixture: ack before durability (expected: 1 finding).
+
+Line 10 — ack-before-journal: the handler acks the upload before the
+journal append, so a crash between the two loses an acked update.
+"""
+
+
+class Handler:
+    def on_receive(self, msg):
+        self._link._send_ack(msg)
+        self._journal.append(msg.payload)

@@ -1,0 +1,19 @@
+"""Golden fixture: correctly ordered acks (expected: 0 findings) — a
+journal append, a deferred_ack_scope ticket, and a dispatch hand-off each
+count as the durability marker preceding the ack."""
+
+
+class Handler:
+    def journal_first(self, msg):
+        self._journal.append(msg.payload)
+        self._link._send_ack(msg)
+
+    def ticketed(self, msg, ingest):
+        with ingest.deferred_ack_scope() as sink:
+            self.handle(msg)
+        if not sink.tickets:
+            self._link._send_ack(msg)
+
+    def handed_off(self, msg):
+        self.dispatch(msg)
+        self._link._send_ack(msg)

@@ -1,0 +1,21 @@
+"""Regression fixture: import-alias dodges the OLD grep linters missed.
+
+Every call below was invisible to the raw-pattern legacy scripts (the
+text ``os.fsync(`` / ``msgpack.unpackb(`` / ``np.random.shuffle(`` never
+appears), but resolves to the banned target through the import map:
+
+Line 18 — ``f(fd)`` IS ``os.fsync`` (perf-stray-fsync).
+Line 19 — ``mp.unpackb`` IS ``msgpack.unpackb`` (perf-hot-codec).
+Line 20 — ``nr.shuffle`` IS ``numpy.random.shuffle`` (rng-global-rng).
+"""
+
+from os import fsync as f
+import msgpack as mp
+import numpy.random as nr
+
+
+def sneaky(fd, blob, xs):
+    f(fd)
+    data = mp.unpackb(blob)
+    nr.shuffle(xs)
+    return data

@@ -1,0 +1,29 @@
+"""Golden fixture: impure jit body (expected: 5 findings).
+
+Line 19 — purity-wall-clock: time.perf_counter() in a traced body.
+Line 20 — purity-host-rng: stdlib random draw in a traced body.
+Line 21 — purity-host-numpy: host numpy on the traced ``params``.
+Line 22 — purity-unsorted-dict: unsorted .items() on the traced ``batch``.
+Line 29 — purity-donated-reuse: ``params`` read after being donated.
+"""
+
+import time
+import random
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def impure_step(params, batch):
+    t = time.perf_counter()
+    noise = random.random()
+    host = np.sum(params)
+    out = {k: v for k, v in batch.items()}
+    return host + noise + t, out
+
+
+def reuse_after_donation(params, grads):
+    step = jax.jit(lambda p, g: p, donate_argnums=(0,))
+    new = step(params, grads)
+    return params + new

@@ -1,0 +1,31 @@
+"""Golden fixture: the same shape as race_seeded, made clean (expected: 0
+findings) — the latch carries an ownership annotation and the counter is
+written under a lock from both contexts."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.active = False
+        self.last_seen = 0
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        # owned-by: main — start/stop latch; the worker only reads
+        self.active = True  # owned-by: main
+        self._thread = threading.Thread(target=self._worker)
+        self._thread.start()
+
+    def stop(self):
+        self.active = False
+
+    def reset(self):
+        with self._lock:
+            self.last_seen = 0
+
+    def _worker(self):
+        while self.active:
+            with self._lock:
+                self.last_seen = self.last_seen + 1

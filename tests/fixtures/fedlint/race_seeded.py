@@ -1,0 +1,28 @@
+"""Golden fixture: seeded thread-ownership races (expected: 2 findings).
+
+Line 19 — race-unannotated-shared: ``active`` is written from main and
+read by the worker thread, with no lock and no annotation.
+Line 28 — race-cross-thread-write: ``last_seen`` is owned by main but
+written from the worker context without a lock.
+"""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.active = False
+        self.last_seen = 0  # owned-by: main
+        self._thread = None
+
+    def start(self):
+        self.active = True
+        self._thread = threading.Thread(target=self._worker)
+        self._thread.start()
+
+    def stop(self):
+        self.active = False
+
+    def _worker(self):
+        while self.active:
+            self.last_seen = self.last_seen + 1

@@ -1,0 +1,274 @@
+"""tools/fedlint.py wired into tier-1: the unified static-analysis plane.
+
+Golden fixtures under tests/fixtures/fedlint/ pin each analyzer to exact
+(line, rule) findings; the pragma/baseline suppression contract, the JSON
+report schema, and the CLI exit codes are locked here; and the self-lint
+test makes `fedlint` clean on fedml_tpu/ a machine-enforced invariant with
+an EMPTY baseline — race-* and ack-* findings may never be baselined, only
+fixed or carried on a justified inline pragma.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO_ROOT, "tools")
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "fedlint")
+
+sys.path.insert(0, TOOLS)
+
+from _analysis_loader import load_analysis  # noqa: E402
+
+analysis = load_analysis()
+
+
+def _lint_fixture(name, analyzers=None):
+    """All findings for one fixture file, as (lineno, rule_id) pairs."""
+    src = analysis.SourceFile(os.path.join(FIXTURES, name))
+    found = analysis.analyze_file(
+        src, analyzers or analysis.passes.build_analyzers(), root=FIXTURES
+    )
+    return sorted((f.lineno, f.rule) for f in found)
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+def test_race_fixture_exact_findings():
+    assert _lint_fixture("race_seeded.py") == [
+        (19, "race-unannotated-shared"),
+        (28, "race-cross-thread-write"),
+    ]
+
+
+def test_race_clean_fixture_is_clean():
+    """Same shape as the seeded fixture, but every shared write is either
+    lock-guarded or ownership-annotated — zero findings."""
+    assert _lint_fixture("race_clean.py") == []
+
+
+def test_ack_fixture_exact_findings():
+    assert _lint_fixture("ack_early.py") == [(10, "ack-before-journal")]
+
+
+def test_ack_ok_fixture_is_clean():
+    """journal append, deferred_ack_scope ticket, and dispatch hand-off all
+    count as the durability marker preceding the ack."""
+    assert _lint_fixture("ack_ok.py") == []
+
+
+def test_purity_fixture_exact_findings():
+    assert _lint_fixture("purity_impure.py") == [
+        (19, "purity-wall-clock"),
+        (20, "purity-host-rng"),
+        (21, "purity-host-numpy"),
+        (22, "purity-unsorted-dict"),
+        (29, "purity-donated-reuse"),
+    ]
+
+
+def test_alias_dodge_fixture_exact_findings():
+    """The satellite regression: aliased imports (``from os import fsync as
+    f``, ``import msgpack as mp``, ``import numpy.random as nr``) were
+    invisible to the old grep linters; the import map resolves them."""
+    assert _lint_fixture("alias_dodge.py") == [
+        (18, "perf-stray-fsync"),
+        (19, "perf-hot-codec"),
+        (20, "rng-global-rng"),
+    ]
+
+
+def test_legacy_shims_catch_alias_dodges():
+    """The four legacy CLIs ride the same AST passes now, so the alias
+    dodges are caught through the old entry points too."""
+    import lint_perf
+    import lint_rng
+
+    path = os.path.join(FIXTURES, "alias_dodge.py")
+    perf = lint_perf.lint_file(path)
+    assert [(lineno, kind) for _, lineno, kind, _ in perf] == [
+        (18, "per-record fsync outside the durability seam"),
+        (19, "hot-path msgpack codec outside the seams"),
+    ]
+    rng = lint_rng.lint_file(path)
+    assert [lineno for _, lineno, _ in rng] == [20]
+
+
+# ------------------------------------------------------- pragma semantics
+
+
+def _one_file(tmp_path, text):
+    p = tmp_path / "case.py"
+    p.write_text(text)
+    return analysis.SourceFile(str(p))
+
+
+_RACY = (
+    "import threading\n"
+    "class Pump:\n"
+    "    def __init__(self):\n"
+    "        self.active = False\n"
+    "    def start(self):\n"
+    "        self.active = True  {pragma}\n"
+    "        threading.Thread(target=self._worker).start()\n"
+    "    def _worker(self):\n"
+    "        while self.active:\n"
+    "            pass\n"
+)
+
+
+def test_justified_pragma_suppresses_race_rule(tmp_path):
+    src = _one_file(
+        tmp_path,
+        _RACY.format(pragma="# fedlint: allow[race-unannotated-shared] — set-before-start"),
+    )
+    kept = analysis.analyze_file(src, [analysis.passes.ThreadOwnershipAnalyzer()])
+    assert kept == []
+
+
+def test_bare_pragma_does_not_suppress_race_rule(tmp_path):
+    """race-*/ack-* rules require a justification: a bare allow pragma
+    leaves the finding standing and stamps it with a note."""
+    src = _one_file(
+        tmp_path,
+        _RACY.format(pragma="# fedlint: allow[race-unannotated-shared]"),
+    )
+    kept = analysis.analyze_file(src, [analysis.passes.ThreadOwnershipAnalyzer()])
+    assert [f.rule for f in kept] == ["race-unannotated-shared"]
+    assert "justification" in kept[0].note
+
+
+def test_bare_pragma_suppresses_ordinary_rule(tmp_path):
+    src = _one_file(
+        tmp_path,
+        "import os\ndef flush(fd):\n    os.fsync(fd)  # fedlint: allow[perf-stray-fsync]\n",
+    )
+    kept = analysis.analyze_file(src, [analysis.passes.PerfAnalyzer()])
+    assert kept == []
+
+
+def test_legacy_pragma_still_honored(tmp_path):
+    """Existing ``# lint_perf: allow`` pragmas in the tree keep working."""
+    src = _one_file(
+        tmp_path,
+        "import os\ndef flush(fd):\n    os.fsync(fd)  # lint_perf: allow (durability seam)\n",
+    )
+    kept = analysis.analyze_file(src, [analysis.passes.PerfAnalyzer()])
+    assert kept == []
+
+
+# ------------------------------------------------------ baseline contract
+
+
+def test_baseline_suppresses_ordinary_finding(tmp_path):
+    src = _one_file(tmp_path, "import os\ndef flush(fd):\n    os.fsync(fd)\n")
+    entry = {
+        "rule": "perf-stray-fsync",
+        "path": "case.py",
+        "source": "os.fsync(fd)",
+    }
+    baseline = analysis.Baseline([entry])
+    kept = analysis.analyze_file(src, [analysis.passes.PerfAnalyzer()], baseline=baseline)
+    assert kept == []
+    assert baseline.rejected == []
+
+
+def test_baseline_rejects_race_and_ack_entries():
+    """The acceptance gate: race-* and ack-* findings can never hide in the
+    baseline file — entries are rejected at load and never written back."""
+    entries = [
+        {"rule": "race-cross-thread-write", "path": "a.py", "source": "self.x = 1"},
+        {"rule": "ack-before-journal", "path": "b.py", "source": "ack(msg)"},
+        {"rule": "perf-stray-fsync", "path": "c.py", "source": "os.fsync(fd)"},
+    ]
+    baseline = analysis.Baseline(entries)
+    assert sorted(e["rule"] for e in baseline.rejected) == [
+        "ack-before-journal",
+        "race-cross-thread-write",
+    ]
+
+
+def test_baseline_render_never_writes_race_or_ack():
+    """--write-baseline can't smuggle them back in either."""
+    findings = [
+        analysis.Finding("races", "race-cross-thread-write", "/x/a.py", 3, "m", "self.x = 1"),
+        analysis.Finding("perf", "perf-stray-fsync", "/x/c.py", 5, "m", "os.fsync(fd)"),
+    ]
+    rendered = json.loads(analysis.Baseline.render(findings, "/x"))
+    assert [e["rule"] for e in rendered["entries"]] == ["perf-stray-fsync"]
+
+
+def test_shipped_baseline_is_empty():
+    with open(os.path.join(TOOLS, "fedlint_baseline.json")) as f:
+        shipped = json.load(f)
+    assert shipped["entries"] == []
+
+
+# ----------------------------------------------------- CLI + JSON schema
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "fedlint.py"), *argv],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+
+
+def test_cli_findings_exit_1_and_advisory_exit_0():
+    strict = _run_cli("--root", FIXTURES, "--no-baseline")
+    assert strict.returncode == 1
+    advisory = _run_cli("--root", FIXTURES, "--no-baseline", "--advisory")
+    assert advisory.returncode == 0
+
+
+def test_cli_json_schema_is_stable():
+    """chaos_check and CI consume --json; the shape is a contract."""
+    proc = _run_cli("--root", FIXTURES, "--no-baseline", "--json")
+    report = json.loads(proc.stdout)
+    assert report["version"] == 1
+    assert sorted(report.keys()) == [
+        "baseline_rejected",
+        "counts",
+        "findings",
+        "root",
+        "suppressed",
+        "version",
+    ]
+    assert report["counts"]["findings"] == len(report["findings"]) == 11
+    first = report["findings"][0]
+    assert sorted(first.keys()) >= ["analyzer", "line", "message", "path", "rule", "source"]
+    assert {f["rule"] for f in report["findings"]} >= {
+        "race-unannotated-shared",
+        "ack-before-journal",
+        "purity-donated-reuse",
+    }
+
+
+def test_cli_select_and_ignore():
+    """--select/--ignore pick whole analyzers by name."""
+    proc = _run_cli("--root", FIXTURES, "--no-baseline", "--json", "--select", "ack")
+    report = json.loads(proc.stdout)
+    assert [f["rule"] for f in report["findings"]] == ["ack-before-journal"]
+    proc = _run_cli("--root", FIXTURES, "--no-baseline", "--json", "--ignore", "ack")
+    report = json.loads(proc.stdout)
+    assert report["findings"] and "ack-before-journal" not in {
+        f["rule"] for f in report["findings"]
+    }
+    bogus = _run_cli("--root", FIXTURES, "--select", "not-an-analyzer")
+    assert bogus.returncode != 0
+
+
+# ------------------------------------------------------------- self-lint
+
+
+def test_library_tree_is_fedlint_clean():
+    """The machine-enforced contract: the whole plane — all seven
+    analyzers — is clean on fedml_tpu/ with zero baseline entries."""
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,35 @@
+"""Load ``fedml_tpu/core/analysis`` as a standalone package.
+
+The lint tools must stay stdlib-only and fast: importing the package the
+normal way (``import fedml_tpu.core.analysis``) executes
+``fedml_tpu/__init__.py`` and drags in jax/numpy for what is a pure-AST
+tool.  The analysis package only uses intra-package relative imports, so it
+loads cleanly under a private top-level name instead.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYSIS_DIR = os.path.join(REPO_ROOT, "fedml_tpu", "core", "analysis")
+_PKG_NAME = "_fedlint_analysis"
+
+
+def load_analysis():
+    """The analysis package, imported once under a private module name."""
+    if _PKG_NAME in sys.modules:
+        return sys.modules[_PKG_NAME]
+    spec = importlib.util.spec_from_file_location(
+        _PKG_NAME, os.path.join(_ANALYSIS_DIR, "__init__.py"),
+        submodule_search_locations=[_ANALYSIS_DIR])
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[_PKG_NAME] = module
+    try:
+        spec.loader.exec_module(module)
+    except Exception:
+        sys.modules.pop(_PKG_NAME, None)
+        raise
+    return module

@@ -32,6 +32,13 @@ any NEW dark round or regression fails the chaos gate before a single
 pytest process spawns.  ``--skip-perf-gate`` opts out (e.g. a checkout
 without bench artifacts).
 
+It also runs the **fedlint leg** (``tools/fedlint.py``) the same way:
+advisory first (the full report prints, including pragma/baseline
+accounting, so suppressions stay visible), then strict — any finding
+from the race / ack-ordering / purity analyzers or the four ported lint
+contracts fails the gate before a single pytest process spawns.
+``--skip-fedlint`` opts out.
+
 Usage::
 
     python tools/chaos_check.py --runs 5
@@ -43,6 +50,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "ingest"
     python tools/chaos_check.py --runs 3 -k "telemetry"
     python tools/chaos_check.py --runs 3 --skip-perf-gate
+    python tools/chaos_check.py --runs 3 --skip-fedlint
 """
 
 from __future__ import annotations
@@ -83,6 +91,22 @@ def run_perf_gate(timeout: float) -> int:
     return strict.returncode
 
 
+def run_fedlint(timeout: float) -> int:
+    """Advisory pass (full report, suppressions visible), then strict.
+    Returns the strict leg's rc — mirrors run_perf_gate."""
+    fedlint = [sys.executable, os.path.join(REPO_ROOT, "tools", "fedlint.py")]
+    try:
+        print("chaos_check: fedlint (advisory, full report)", flush=True)
+        subprocess.run(fedlint + ["--advisory"], cwd=REPO_ROOT,
+                       timeout=timeout)
+        print("chaos_check: fedlint (strict)", flush=True)
+        strict = subprocess.run(fedlint, cwd=REPO_ROOT, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print("chaos_check: fedlint TIMED OUT", flush=True)
+        return 2
+    return strict.returncode
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--runs", "-n", type=int, default=3,
@@ -98,6 +122,8 @@ def main(argv=None) -> int:
                     help="per-run wall-clock bound in seconds")
     ap.add_argument("--skip-perf-gate", action="store_true",
                     help="skip the bench-trajectory perf gate leg")
+    ap.add_argument("--skip-fedlint", action="store_true",
+                    help="skip the static-analysis (fedlint) leg")
     args = ap.parse_args(argv)
 
     if not args.skip_perf_gate:
@@ -106,6 +132,14 @@ def main(argv=None) -> int:
             print(f"chaos_check: PERF GATE FAILED (rc={gate_rc}) — a new "
                   "dark round or regression in the bench trajectory",
                   flush=True)
+            return 1
+
+    if not args.skip_fedlint:
+        lint_rc = run_fedlint(args.timeout)
+        if lint_rc != 0:
+            print(f"chaos_check: FEDLINT FAILED (rc={lint_rc}) — fix the "
+                  "finding or carry a justified pragma "
+                  "(docs/STATIC_ANALYSIS.md)", flush=True)
             return 1
 
     env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""fedlint: run the unified static-analysis plane over a source tree.
+
+One framework (``fedml_tpu/core/analysis``), seven passes: the four ported
+lint contracts (rng / obs / agg / perf) plus the thread-ownership race
+detector, the ack-durability ordering checker, and the JAX
+purity/determinism pass.  See ``docs/STATIC_ANALYSIS.md`` for the rule
+catalog and the pragma/baseline policy.
+
+Exit codes: 0 clean (or everything suppressed), 1 findings, 2 usage or
+internal error.  ``--advisory`` always exits 0 (the chaos harness runs an
+advisory leg first so new rules can land before the tree is fully clean).
+
+Usage::
+
+    python tools/fedlint.py                    # lint the repo's fedml_tpu/
+    python tools/fedlint.py --root DIR         # lint DIR instead
+    python tools/fedlint.py --json             # machine-readable output
+    python tools/fedlint.py --select races,ack # only these analyzers
+    python tools/fedlint.py --list-rules       # rule catalog
+    python tools/fedlint.py --write-baseline   # grandfather current findings
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from _analysis_loader import REPO_ROOT, load_analysis
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "fedlint_baseline.json")
+
+
+def _pick_analyzers(analysis, select, ignore):
+    analyzers = analysis.build_analyzers()
+    names = {a.name for a in analyzers}
+    for opt, label in ((select, "--select"), (ignore, "--ignore")):
+        unknown = set(opt or ()) - names
+        if unknown:
+            raise SystemExit(
+                f"fedlint: error: unknown analyzer(s) for {label}: "
+                f"{', '.join(sorted(unknown))} (have: "
+                f"{', '.join(sorted(names))})")
+    if select:
+        analyzers = [a for a in analyzers if a.name in select]
+    if ignore:
+        analyzers = [a for a in analyzers if a.name not in ignore]
+    return analyzers
+
+
+def _csv(value):
+    return [part.strip() for part in value.split(",") if part.strip()]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=os.path.join(REPO_ROOT, "fedml_tpu"),
+                    help="directory tree to lint (default: the library)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the versioned JSON report instead of text")
+    ap.add_argument("--select", type=_csv, default=None, metavar="NAMES",
+                    help="comma-separated analyzer names to run")
+    ap.add_argument("--ignore", type=_csv, default=None, metavar="NAMES",
+                    help="comma-separated analyzer names to skip")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE, metavar="PATH",
+                    help="baseline suppression file (default: "
+                         "tools/fedlint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file entirely")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings (minus race/ack rules, "
+                         "which may not be baselined) to --baseline and "
+                         "exit 0")
+    ap.add_argument("--advisory", action="store_true",
+                    help="report findings but always exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    try:
+        analysis = load_analysis()
+        analyzers = _pick_analyzers(analysis, args.select, args.ignore)
+        if args.list_rules:
+            print(analysis.render_rule_catalog(analyzers), flush=True)
+            return 0
+        if not os.path.isdir(args.root):
+            print(f"fedlint: error: --root {args.root} is not a directory",
+                  file=sys.stderr, flush=True)
+            return 2
+        baseline = None
+        if not args.no_baseline and not args.write_baseline \
+                and os.path.exists(args.baseline):
+            baseline = analysis.Baseline.load(args.baseline)
+        result = analysis.analyze_tree(args.root, analyzers,
+                                       baseline=baseline)
+        if args.write_baseline:
+            with open(args.baseline, "w", encoding="utf-8") as f:
+                f.write(analysis.Baseline.render(result.findings,
+                                                 result.root))
+            kept = sum(1 for fi in result.findings if not fi.rule.startswith(
+                analysis.NO_BASELINE_PREFIXES))
+            print(f"fedlint: wrote {kept} baseline entr(y/ies) to "
+                  f"{args.baseline}", flush=True)
+            return 0
+        if args.json:
+            print(analysis.render_json(result), flush=True)
+        else:
+            print(analysis.render_text(result), flush=True)
+        if result.findings and not args.advisory:
+            return 1
+        return 0
+    except SystemExit:
+        raise
+    except Exception as exc:  # internal error -> exit 2, per the contract
+        print(f"fedlint: internal error: {exc!r}", file=sys.stderr,
+              flush=True)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,21 +1,17 @@
 #!/usr/bin/env python
 """Aggregation lint: no new host-side tree_map-loop aggregation.
 
-With ``core/aggregate.py`` (the one host implementation) and
-``parallel/agg_plane.py`` (the compiled GSPMD reduction) in place, there is
-exactly one place client-update math may live.  A module that hand-rolls
-``tree_map(lambda *xs: ...)`` over per-client pytrees reinvents the
-stacking/reduction loop outside both surfaces: it misses the structure
-validation (``flatten_checked``'s clear client/leaf errors), never routes
-through the ``agg_plane`` knob, and emits no ``agg.*`` metrics — precisely
-the drift that made the reference repo grow four per-engine aggregators.
+Thin shim over the unified analysis plane (``fedml_tpu/core/analysis``,
+see ``tools/fedlint.py`` and ``docs/STATIC_ANALYSIS.md``): the contract,
+the ``# lint_agg: allow`` pragma, the ``core/aggregate.py`` exemption, and
+this CLI are unchanged, but matching is now AST-based (a star-lambda as
+``tree_map``'s first argument, wherever tree_map is imported from).
 
-This tool greps ``fedml_tpu/`` for star-lambda ``tree_map`` calls (the
-canonical multi-tree fold/stack construction) with comments/strings
-stripped.  ``core/aggregate.py`` — the layer that IS the host surface — is
-exempt; anything else needing an exception carries a ``# lint_agg: allow``
-pragma on the flagged line.  Wired into tier-1 via
-``tests/test_lint_agg.py``.
+The contract: with ``core/aggregate.py`` (host) and
+``parallel/agg_plane.py`` (compiled GSPMD) in place, there is exactly one
+place client-update math may live — a hand-rolled
+``tree_map(lambda *xs: ...)`` fold misses structure validation, the
+``agg_plane`` knob, and the ``agg.*`` metrics.
 
 Usage::
 
@@ -26,74 +22,28 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import io
 import os
-import re
 import sys
-import tokenize
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import REPO_ROOT, load_analysis
 
-# multi-tree fold: tree_map(lambda *xs, ...) — the construction every
-# hand-rolled host aggregation loop starts from (stack, sum, elementwise
-# combine over a client list).  Single-tree maps (lambda x: ...) are fine.
-_TREEMAP_STAR = re.compile(r"tree_map\s*\(\s*lambda\s*\*")
+_analysis = load_analysis()
+_ANALYZER = _analysis.passes.AggAnalyzer()
 _PRAGMA = "lint_agg: allow"
 
-# the one module that implements the host aggregation surface
-_EXEMPT_FILES = (os.path.join("core", "aggregate.py"),)
-
-
-def _exempt(path: str) -> bool:
-    norm = os.path.normpath(os.path.abspath(path))
-    return any(norm.endswith(os.sep + part) for part in _EXEMPT_FILES)
-
-
-def _code_lines(source: str) -> list:
-    """Lines with comments and string literals blanked via ``tokenize`` —
-    only actual code can trip the pattern (same approach as lint_obs)."""
-    lines = source.splitlines()
-    kept = list(lines)
-    try:
-        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
-    except (tokenize.TokenError, SyntaxError, IndentationError):
-        return kept  # unparseable: lint the raw lines rather than skip
-    for tok in tokens:
-        if tok.type not in (tokenize.COMMENT, tokenize.STRING):
-            continue
-        (srow, scol), (erow, ecol) = tok.start, tok.end
-        for row in range(srow, erow + 1):
-            line = kept[row - 1]
-            lo = scol if row == srow else 0
-            hi = ecol if row == erow else len(line)
-            kept[row - 1] = line[:lo] + " " * (hi - lo) + line[hi:]
-    return kept
+_KINDS = {"agg-host-treemap": "host tree_map aggregation loop"}
 
 
 def lint_file(path: str) -> list:
-    if _exempt(path):
-        return []
-    violations = []
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        source = f.read()
-    raw_lines = source.splitlines()
-    for lineno, code in enumerate(_code_lines(source), 1):
-        raw = raw_lines[lineno - 1]
-        if _PRAGMA in raw:
-            continue
-        if _TREEMAP_STAR.search(code):
-            violations.append(
-                (path, lineno, "host tree_map aggregation loop", raw.rstrip()))
-    return violations
+    src = _analysis.SourceFile(path)
+    findings = _analysis.analyze_file(src, [_ANALYZER])
+    return [(path, f.lineno, _KINDS[f.rule], f.source) for f in findings]
 
 
 def lint_tree(root: str) -> list:
     violations = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                violations.extend(lint_file(os.path.join(dirpath, name)))
+    for path in _analysis.iter_python_files(root):
+        violations.extend(lint_file(path))
     return violations
 
 

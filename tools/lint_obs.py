@@ -1,32 +1,18 @@
 #!/usr/bin/env python
 """Observability lint: no bare counter bags, no direct sink emits.
 
-With ``core/obs`` in place there is exactly one metrics surface
-(``obs.counter_inc`` / ``gauge_set`` / ``histogram_observe`` — labeled,
-capped, exportable) and one emission seam (the mlops sink fan).  Library
-code that grows its own ``defaultdict(int)`` counter bag or calls
-``<sink>.emit(...)`` directly bypasses both: those numbers never reach the
-registry export and never ride the sink fan's JSONL/broker legs.
+Thin shim over the unified analysis plane (``fedml_tpu/core/analysis``,
+see ``tools/fedlint.py`` and ``docs/STATIC_ANALYSIS.md``): the contracts,
+the ``# lint_obs: allow`` pragma, the seam exemptions (``core/obs``,
+``core/mlops``; the telemetry-wire-key rule still pierces them — only
+``core/obs/telemetry.py`` may spell the key), and this CLI are unchanged,
+but matching is now AST-based.  The telemetry-key rule is the framework's
+one ``raw=True`` rule: it scans RAW lines because the key is a string
+literal.
 
-Two more patterns guard the exposition seam: ``print(json.dumps(...))``
-(the bench driver's stdout metric contract — library code printing JSON
-blobs races the exactly-one-metric-line guarantee) and
-``render_openmetrics(...)`` outside ``core/obs`` (exposition belongs to
-the exporter, not ad-hoc render calls).
-
-One pattern guards the telemetry wire seam: the piggybacked telemetry
-blob rides messages under exactly one Message-param key, owned by
-``core/obs/telemetry.py`` (attach/absorb).  Any other module spelling
-that key constructs or reads telemetry params off-seam — it would dodge
-the seq/dedup protocol and the best-effort contract.  Unlike the other
-rules this one scans RAW lines (the key is a string literal) and applies
-even inside ``core/obs``; only ``core/obs/telemetry.py`` is exempt.
-
-This tool greps ``fedml_tpu/`` for these patterns with comments/strings
-stripped.  ``core/obs`` and ``core/mlops`` — the two layers that ARE the
-seam — are exempt; anything else needing an exception carries a
-``# lint_obs: allow`` pragma on the flagged line.  Wired into tier-1 via
-``tests/test_lint_obs.py``.
+The contracts: metrics go through ``obs.counter_inc`` / ``gauge_set`` /
+``histogram_observe``; records ride the mlops sink fan; stdout JSON is the
+bench driver's line alone; exposition belongs to the core/obs exporter.
 
 Usage::
 
@@ -37,120 +23,35 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import io
 import os
-import re
 import sys
-import tokenize
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import REPO_ROOT, load_analysis
 
-# counter bags: defaultdict(int) is the canonical "private metrics dict"
-# constructor (Counter() would be next, but the stdlib Counter has heavy
-# non-metrics use, so only the unambiguous form is banned)
-_COUNTER_BAG = re.compile(r"(?<![\w.])defaultdict\s*\(\s*int\s*\)")
-# direct sink emission: any attribute/variable whose name contains "sink"
-# (or the mlops fan) calling .emit(...) — metrics and spans go through the
-# obs facade; records go through core/mlops helpers
-_SINK_EMIT = re.compile(r"(?i)\w*(?:sink|fan)\w*\s*\.\s*emit\s*\(")
-# stdout metric emission: print(json.dumps(...)) is the bench driver's
-# contract line and NOBODY else's — a library module printing JSON blobs
-# races the bench's exactly-one-metric-line stdout guarantee and is
-# invisible to the registry export
-_PRINTED_JSON = re.compile(r"(?<![\w.])print\s*\(\s*json\s*\.\s*dumps\s*\(")
-# direct exposition: rendering the registry to OpenMetrics text belongs to
-# the exporter inside core/obs — library code calling render_openmetrics
-# (or reaching for the exposition module) forks the export seam
-_DIRECT_RENDER = re.compile(r"(?<![\w.])render_openmetrics\s*\(")
-# the telemetry wire key: one Message-param seam, owned by
-# core/obs/telemetry.py (attach/absorb).  Built by concatenation so this
-# linter's own source never trips the rule if it is ever linted.
-_TELEMETRY_WIRE = re.compile("__obs_" + "telemetry__")
+_analysis = load_analysis()
+_ANALYZER = _analysis.passes.ObsAnalyzer()
 _PRAGMA = "lint_obs: allow"
 
-# the two layers that implement the seam may touch sinks/registries freely
-_EXEMPT_PARTS = (
-    os.path.join("core", "obs"),
-    os.path.join("core", "mlops"),
-)
-
-_TELEMETRY_SEAM = os.path.join("core", "obs", "telemetry.py")
-
-
-def _exempt(path: str) -> bool:
-    norm = os.path.normpath(os.path.abspath(path))
-    return any(os.sep + part + os.sep in norm or
-               norm.endswith(os.sep + part) for part in _EXEMPT_PARTS)
-
-
-def _is_telemetry_seam(path: str) -> bool:
-    norm = os.path.normpath(os.path.abspath(path))
-    return norm.endswith(os.sep + _TELEMETRY_SEAM)
-
-
-def _code_lines(source: str) -> list:
-    """Lines with comments and string literals blanked via ``tokenize`` —
-    only actual code can trip the patterns (same approach as lint_rng)."""
-    lines = source.splitlines()
-    kept = list(lines)
-    try:
-        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
-    except (tokenize.TokenError, SyntaxError, IndentationError):
-        return kept  # unparseable: lint the raw lines rather than skip
-    for tok in tokens:
-        if tok.type not in (tokenize.COMMENT, tokenize.STRING):
-            continue
-        (srow, scol), (erow, ecol) = tok.start, tok.end
-        for row in range(srow, erow + 1):
-            line = kept[row - 1]
-            lo = scol if row == srow else 0
-            hi = ecol if row == erow else len(line)
-            kept[row - 1] = line[:lo] + " " * (hi - lo) + line[hi:]
-    return kept
+_KINDS = {
+    "obs-counter-bag": "bare counter bag",
+    "obs-sink-emit": "direct sink emit",
+    "obs-printed-json": "printed metric json",
+    "obs-direct-render": "direct registry render",
+    "obs-telemetry-key": "telemetry wire key",
+}
 
 
 def lint_file(path: str) -> list:
-    exempt = _exempt(path)
-    seam = _is_telemetry_seam(path)
-    if exempt and seam:
-        return []
-    violations = []
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        source = f.read()
-    raw_lines = source.splitlines()
-    for lineno, code in enumerate(_code_lines(source), 1):
-        raw = raw_lines[lineno - 1]
-        if _PRAGMA in raw:
-            continue
-        if not exempt:
-            if _COUNTER_BAG.search(code):
-                violations.append(
-                    (path, lineno, "bare counter bag", raw.rstrip()))
-            if _SINK_EMIT.search(code):
-                violations.append(
-                    (path, lineno, "direct sink emit", raw.rstrip()))
-            if _PRINTED_JSON.search(code):
-                violations.append(
-                    (path, lineno, "printed metric json", raw.rstrip()))
-            if _DIRECT_RENDER.search(code):
-                violations.append(
-                    (path, lineno, "direct registry render", raw.rstrip()))
-        # the wire key is a string literal, so this rule reads the RAW
-        # line — and pierces the core/obs blanket exemption: only the
-        # telemetry module itself may spell the key
-        if not seam and _TELEMETRY_WIRE.search(raw):
-            violations.append(
-                (path, lineno, "telemetry wire key", raw.rstrip()))
-    return violations
+    src = _analysis.SourceFile(path)
+    findings = _analysis.analyze_file(src, [_ANALYZER])
+    findings.sort(key=lambda f: (f.lineno, _ANALYZER.rule_by_id(f.rule).order))
+    return [(path, f.lineno, _KINDS[f.rule], f.source) for f in findings]
 
 
 def lint_tree(root: str) -> list:
     violations = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                violations.extend(lint_file(os.path.join(dirpath, name)))
+    for path in _analysis.iter_python_files(root):
+        violations.extend(lint_file(path))
     return violations
 
 

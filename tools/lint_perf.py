@@ -1,27 +1,19 @@
 #!/usr/bin/env python
 """Ingest-path perf lint: no stray fsyncs, no hot-path msgpack codecs.
 
-PR 10's staged ingest pipeline moved the two expensive per-upload
-operations behind dedicated seams:
+Thin shim over the unified analysis plane (``fedml_tpu/core/analysis``,
+see ``tools/fedlint.py`` and ``docs/STATIC_ANALYSIS.md``): the contracts,
+the ``# lint_perf: allow`` pragma, the seam exemptions
+(``core/checkpoint.py``, ``core/ingest.py``, ``core/obs``), and this CLI
+are unchanged, but matching is now AST-based with import-alias resolution
+— ``from os import fsync as f`` and ``import msgpack as mp`` no longer
+dodge it, while ``self.msgpack_restore(...)`` lookalike methods no longer
+need special-casing.
 
-* ``os.fsync`` — the durability seam.  ``core/checkpoint.py`` owns every
-  journal/snapshot fsync (group commit amortizes one fsync over a whole
-  batch of acks); ``core/obs`` fsyncs its own export/flight-recorder
-  files.  An fsync anywhere else reintroduces a per-record disk stall on
-  some hot path, silently undoing the ``uploads_per_s_pipelined`` win the
-  perf gate bands.
-* msgpack encode/decode (``msgpack_serialize`` / ``msgpack_restore`` /
-  ``msgpack.packb`` / ``msgpack.unpackb``) — the codec seam.
-  ``core/checkpoint.py`` codes journal frames; ``core/ingest.py`` is the
-  zero-copy decoder.  Library code calling the codec directly puts a
-  blocking (de)serialization back on the dispatcher thread, which is
-  exactly what the pipeline's io/dispatch/commit staging exists to avoid.
-
-This tool greps ``fedml_tpu/`` for these patterns with comments/strings
-stripped.  The seam owners (``core/checkpoint.py``, ``core/ingest.py``,
-``core/obs``) are exempt; anything else needing an exception carries a
-``# lint_perf: allow`` pragma on the flagged line.  Wired into tier-1 via
-``tests/test_lint_perf.py``.
+The contracts (PR 10's staged ingest pipeline): ``os.fsync`` belongs to
+the durability seam (group commit amortizes one fsync over a batch of
+acks); msgpack encode/decode belongs to the journal framer and the
+zero-copy decoder — not to whatever thread happens to be dispatching.
 
 Usage::
 
@@ -32,89 +24,32 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import io
 import os
-import re
 import sys
-import tokenize
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import REPO_ROOT, load_analysis
 
-# per-record disk stall: every fsync outside the durability/obs seams is a
-# hot-path suspect — there is no legitimate third fsync site in the library
-_STRAY_FSYNC = re.compile(r"(?<![\w.])os\s*\.\s*fsync\s*\(")
-# hot-path codec: flax's msgpack entry points and the raw msgpack module —
-# payload (de)serialization belongs to the journal framer and the zero-copy
-# decoder, not to whatever thread happens to be dispatching
-_HOT_CODEC = re.compile(
-    r"(?<![\w.])(?:msgpack_restore|msgpack_serialize)\s*\("
-    r"|(?<![\w.])msgpack\s*\.\s*(?:packb|unpackb)\s*\(")
+_analysis = load_analysis()
+_ANALYZER = _analysis.passes.PerfAnalyzer()
 _PRAGMA = "lint_perf: allow"
 
-# the seam owners may fsync and run the codec freely
-_EXEMPT_PARTS = (
-    os.path.join("core", "obs"),
-    os.path.join("core", "checkpoint.py"),
-    os.path.join("core", "ingest.py"),
-)
-
-
-def _exempt(path: str) -> bool:
-    norm = os.path.normpath(os.path.abspath(path))
-    return any(os.sep + part + os.sep in norm or
-               norm.endswith(os.sep + part) for part in _EXEMPT_PARTS)
-
-
-def _code_lines(source: str) -> list:
-    """Lines with comments and string literals blanked via ``tokenize`` —
-    only actual code can trip the patterns (same approach as lint_obs)."""
-    lines = source.splitlines()
-    kept = list(lines)
-    try:
-        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
-    except (tokenize.TokenError, SyntaxError, IndentationError):
-        return kept  # unparseable: lint the raw lines rather than skip
-    for tok in tokens:
-        if tok.type not in (tokenize.COMMENT, tokenize.STRING):
-            continue
-        (srow, scol), (erow, ecol) = tok.start, tok.end
-        for row in range(srow, erow + 1):
-            line = kept[row - 1]
-            lo = scol if row == srow else 0
-            hi = ecol if row == erow else len(line)
-            kept[row - 1] = line[:lo] + " " * (hi - lo) + line[hi:]
-    return kept
+_KINDS = {
+    "perf-stray-fsync": "per-record fsync outside the durability seam",
+    "perf-hot-codec": "hot-path msgpack codec outside the seams",
+}
 
 
 def lint_file(path: str) -> list:
-    if _exempt(path):
-        return []
-    violations = []
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        source = f.read()
-    raw_lines = source.splitlines()
-    for lineno, code in enumerate(_code_lines(source), 1):
-        raw = raw_lines[lineno - 1]
-        if _PRAGMA in raw:
-            continue
-        if _STRAY_FSYNC.search(code):
-            violations.append(
-                (path, lineno, "per-record fsync outside the durability seam",
-                 raw.rstrip()))
-        if _HOT_CODEC.search(code):
-            violations.append(
-                (path, lineno, "hot-path msgpack codec outside the seams",
-                 raw.rstrip()))
-    return violations
+    src = _analysis.SourceFile(path)
+    findings = _analysis.analyze_file(src, [_ANALYZER])
+    findings.sort(key=lambda f: (f.lineno, _ANALYZER.rule_by_id(f.rule).order))
+    return [(path, f.lineno, _KINDS[f.rule], f.source) for f in findings]
 
 
 def lint_tree(root: str) -> list:
     violations = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                violations.extend(lint_file(os.path.join(dirpath, name)))
+    for path in _analysis.iter_python_files(root):
+        violations.extend(lint_file(path))
     return violations
 
 

@@ -1,19 +1,16 @@
 #!/usr/bin/env python
 """Determinism lint: no global-NumPy-RNG use in library code.
 
-The repo's reproducibility contract is that every schedule-affecting draw
-comes from a LOCAL, explicitly-seeded generator (``np.random.RandomState``,
-``np.random.default_rng``) — seeding or drawing from the process-global
-NumPy RNG makes round schedules depend on import order and on every other
-consumer of the stream (the bug ``core/sampling.py`` historically had).
+Thin shim over the unified analysis plane (``fedml_tpu/core/analysis``,
+see ``tools/fedlint.py`` and ``docs/STATIC_ANALYSIS.md``): the contract,
+the ``# lint_rng: allow`` pragma, and this CLI are unchanged, but matching
+is now AST-based — the pass resolves import aliases, so renamed modules
+can't dodge it, and docstrings/comments can't false-positive.
 
-This tool greps ``fedml_tpu/`` for global-RNG calls (``np.random.seed``,
-bare ``np.random.choice`` / ``.rand`` / ``.shuffle`` / ...), with comments
-stripped so prose mentions don't false-positive and module aliases
-(``_np``, ``numpy``) covered.  The one approved seam — run-entry seeding in
-``fedml_tpu/__init__.py`` — carries a ``# lint_rng: allow`` pragma on the
-flagged line.  Wired into tier-1 via ``tests/test_lint_rng.py`` so the
-contract is machine-enforced, not convention.
+The reproducibility contract: every schedule-affecting draw comes from a
+LOCAL, explicitly-seeded generator (``np.random.RandomState``,
+``np.random.default_rng``) — seeding or drawing from the process-global
+NumPy RNG makes round schedules depend on import order.
 
 Usage::
 
@@ -24,72 +21,26 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import io
 import os
-import re
 import sys
-import tokenize
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import REPO_ROOT, load_analysis
 
-# global-RNG entry points: seeding plus every draw method that reads the
-# global stream.  RandomState(...) / default_rng(...) / Generator are LOCAL
-# constructors and deliberately not listed.
-_DRAWS = (
-    "seed|choice|rand|randn|randint|random_integers|random_sample|random|"
-    "ranf|sample|permutation|shuffle|bytes|normal|standard_normal|uniform|"
-    "binomial|poisson|exponential|laplace|gumbel|beta|gamma|dirichlet|"
-    "multinomial|multivariate_normal|get_state|set_state"
-)
-_PATTERN = re.compile(
-    r"(?<![\w.])(?:np|_np|numpy)\.random\.(?:%s)\s*\(" % _DRAWS
-)
+_analysis = load_analysis()
+_ANALYZER = _analysis.passes.RngAnalyzer()
 _PRAGMA = "lint_rng: allow"
 
 
-def _code_lines(source: str) -> list:
-    """The file's lines with comments and string literals (docstrings,
-    prose mentions, log formats) blanked via ``tokenize`` — only actual
-    code can trip the pattern."""
-    lines = source.splitlines()
-    kept = list(lines)
-    try:
-        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
-    except (tokenize.TokenError, SyntaxError, IndentationError):
-        return kept  # unparseable: lint the raw lines rather than skip
-    for tok in tokens:
-        if tok.type not in (tokenize.COMMENT, tokenize.STRING):
-            continue
-        (srow, scol), (erow, ecol) = tok.start, tok.end
-        for row in range(srow, erow + 1):
-            line = kept[row - 1]
-            lo = scol if row == srow else 0
-            hi = ecol if row == erow else len(line)
-            kept[row - 1] = line[:lo] + " " * (hi - lo) + line[hi:]
-    return kept
-
-
 def lint_file(path: str) -> list:
-    violations = []
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        source = f.read()
-    raw_lines = source.splitlines()
-    for lineno, code in enumerate(_code_lines(source), 1):
-        raw = raw_lines[lineno - 1]
-        if _PRAGMA in raw:
-            continue
-        if _PATTERN.search(code):
-            violations.append((path, lineno, raw.rstrip()))
-    return violations
+    src = _analysis.SourceFile(path)
+    findings = _analysis.analyze_file(src, [_ANALYZER])
+    return [(path, f.lineno, f.source) for f in findings]
 
 
 def lint_tree(root: str) -> list:
     violations = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                violations.extend(lint_file(os.path.join(dirpath, name)))
+    for path in _analysis.iter_python_files(root):
+        violations.extend(lint_file(path))
     return violations
 
 
