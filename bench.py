@@ -407,6 +407,7 @@ def _main() -> int | None:
     out.update(obs_overhead)
     out.update(_measure_telemetry_overhead())
     out.update(_measure_agg_step())
+    out.update(_measure_round_update())
     out.update(_measure_upload_saturation())
     out.update(_measure_async_throughput())
     if os.environ.get("BENCH_SP"):
@@ -479,6 +480,78 @@ def _measure_agg_step() -> dict:
         }
     except Exception as e:
         print(f"agg step measurement failed: {e}", file=sys.stderr)
+        return {}
+
+
+def _measure_round_update() -> dict:
+    """The sharded-server-state relative keys (server_state=sharded): median
+    host-oracle round tail (reduce + FedAdam server step) vs the ONE-program
+    sharded round update over the same seeded synthetic deltas, plus the
+    broadcast wire cost of the full tree vs its largest shard slice.
+    Emitted on BOTH the full-TPU and CPU-degraded metric lines.  Failures
+    degrade to empty keys."""
+    import numpy as np
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from fedml_tpu.core.aggregate import (host_server_round_update,
+                                              make_host_round_step)
+        from fedml_tpu.core.distributed.communication.serialization import (
+            CachedPayload)
+        from fedml_tpu.parallel.agg_plane import (ShardedRoundPlane,
+                                                  _policy_tx,
+                                                  broadcast_shards)
+
+        n = int(os.environ.get("BENCH_AGG_CLIENTS", "32"))
+        reps = int(os.environ.get("BENCH_AGG_REPS", "5"))
+        n_shards = int(os.environ.get("BENCH_BCAST_SHARDS", "4"))
+        updates = _synthetic_updates(n)
+        rng = np.random.default_rng(7)
+        params = {k: jnp.asarray(rng.standard_normal(np.shape(v)), jnp.float32)
+                  for k, v in updates[0][1].items()}
+        policy = ("adam", 0.1, 0.9)  # the FedAdam default server optimizer
+        tx = _policy_tx(policy)
+        opt_state = tx.init([v for v in jax.tree_util.tree_leaves(params)])
+        step = make_host_round_step(tx)
+        host_server_round_update(params, updates, tx, opt_state,
+                                 step=step)  # pay the jit outside the timing
+
+        def timed(fn):
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+
+        host_s = timed(lambda: host_server_round_update(
+            params, updates, tx, opt_state, step=step))
+        plane = ShardedRoundPlane(policy=policy)
+        out_tree = plane.round_update(params, updates)  # compile
+        state = {"tree": out_tree}
+
+        def compiled_once():
+            state["tree"] = plane.round_update(state["tree"], updates)
+            return state["tree"]
+
+        comp_s = timed(compiled_once)
+        bytes_full = len(CachedPayload(state["tree"]).wire_bytes())
+        bytes_sharded = max(
+            len(CachedPayload(s).wire_bytes())
+            for s in broadcast_shards(state["tree"], n_shards))
+        return {
+            "round_update_host_s": round(host_s, 6),
+            "round_update_compiled_s": round(comp_s, 6),
+            "round_update_speedup": round(host_s / max(comp_s, 1e-9), 4),
+            "broadcast_bytes_full": bytes_full,
+            "broadcast_bytes_sharded": bytes_sharded,
+            "broadcast_shrink": round(bytes_full / max(bytes_sharded, 1), 4),
+            "round_update_policy": policy[0],
+        }
+    except Exception as e:
+        print(f"round update measurement failed: {e}", file=sys.stderr)
         return {}
 
 
@@ -703,6 +776,7 @@ def _run_degraded(reason: str) -> int:
     agg = _measure_agg_step()
     out.update(agg)
     out["value"] = agg.get("agg_step_compiled_s", None)
+    out.update(_measure_round_update())
     out.update(_measure_upload_saturation())
     out.update(_measure_async_throughput())
     out.update(_measure_telemetry_overhead())

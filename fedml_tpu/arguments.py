@@ -188,6 +188,20 @@ Aggregation-plane knobs (``train_args``; consumed by
 * ``agg_microbatch_clients`` (int >= 0, default 0 = all at once) — fold
   K clients at a time into the running accumulator so huge cohorts
   aggregate without materializing the full client stack in HBM.
+* ``server_state`` (``replicated`` | ``sharded``, default ``replicated``)
+  — where global params + server-optimizer state live between rounds.
+  ``sharded`` keeps them as model-axis ``NamedSharding`` device arrays on
+  the 2-D (client x model) round mesh and runs the whole round tail
+  (reduce -> FedOpt/FedAdam/FedYogi step -> new-params materialization)
+  as one donated-buffer compiled program; bit-exact vs. the replicated
+  host path in f32 mode.
+* ``server_model_parallel`` (int >= 1, default 0 = all devices) — size of
+  the round mesh's model axis (the XLA simulator splits its device set
+  into client x model with this).
+* ``broadcast_shards`` (int >= 1, default 1) — number of addressable
+  slices the new global params are split into for shard-addressable
+  broadcast; each slice is memoized per round as its own
+  ``CachedPayload``.
 """
 
 from __future__ import annotations
@@ -567,6 +581,26 @@ class Arguments:
             if mv < 0:
                 raise ValueError(
                     f"agg_microbatch_clients must be >= 0 (got {mv})")
+        state = getattr(self, "server_state", None)
+        if state is not None:
+            from .parallel.agg_plane import SERVER_STATES
+
+            if str(state).lower() not in SERVER_STATES:
+                raise ValueError(
+                    f"server_state must be one of {SERVER_STATES} "
+                    f"(got {state!r})")
+        for knob, floor in (("server_model_parallel", 0),
+                            ("broadcast_shards", 1)):
+            v = getattr(self, knob, None)
+            if v is None:
+                continue
+            try:
+                cv = int(v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{knob} must be an integer >= {floor} (got {v!r})")
+            if cv < floor:
+                raise ValueError(f"{knob} must be >= {floor} (got {cv})")
         # a malformed chaos plan should fail at config time, not mid-run when
         # the backend factory first tries to wrap the transport
         plan = getattr(self, "fault_plan", None)

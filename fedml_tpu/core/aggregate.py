@@ -59,6 +59,18 @@ def leaf_paths(treedef) -> Tuple[str, ...]:
     return tuple(names)
 
 
+def opt_leaf_indices(names: Sequence[str], dtypes: Sequence[Any]) -> List[int]:
+    """Leaf indices the server optimizer applies to: floating leaves,
+    restricted to the ``params`` collection when one exists — the sp/fedopt
+    oracle optimizes only ``w_global["params"]`` and plainly averages the
+    other collections (batch_stats etc.)."""
+    floats = [i for i, dt in enumerate(dtypes)
+              if jnp.issubdtype(jnp.dtype(dt), jnp.floating)]
+    in_params = [i for i in floats
+                 if names[i] == "params" or names[i].startswith("params/")]
+    return in_params or floats
+
+
 def flatten_checked(
         trees: Sequence[Pytree]) -> Tuple[List[List[Any]], Any]:
     """Flatten a list of per-client pytrees, validating that every client
@@ -204,3 +216,96 @@ class FedMLAggOperator:
         obs.histogram_observe("agg.step_seconds", time.perf_counter() - t0,
                               labels={"path": "host", "mode": mode})
         return out
+
+    @staticmethod
+    def agg_mode(args) -> str:
+        opt = getattr(args, "federated_optimizer", "FedAvg")
+        return "sum" if opt in FedMLAggOperator._SUM_MODE else "mean"
+
+
+# ---------------------------------------------------------------------------
+# server round update: replicated host oracle + the sharded routing facade
+# ---------------------------------------------------------------------------
+def server_state_mode(args) -> str:
+    """``replicated`` (host pytrees, the default) or ``sharded``
+    (model-sharded device state, :mod:`fedml_tpu.parallel.agg_plane`)."""
+    return str(getattr(args, "server_state", "replicated") or "replicated")
+
+
+def make_host_round_step(tx):
+    """Jitted host server-optimizer tail over (opt params, opt state, avg)
+    leaf lists — the exact op chain of the sp/fedopt ``server_update``:
+    pseudo-gradient = params − avg, one optax update, apply.  Build once
+    and reuse so jit's cache keys on a stable function object."""
+    import optax
+
+    @jax.jit
+    def _step(opt_params, opt_state, opt_avg):
+        pseudo_grad = [p - a for p, a in zip(opt_params, opt_avg)]
+        updates, new_state = tx.update(pseudo_grad, opt_state, opt_params)
+        return optax.apply_updates(opt_params, updates), new_state
+
+    return _step
+
+
+def host_server_round_update(params_tree, updates, tx, opt_state,
+                             mode: str = "mean", step=None):
+    """The replicated host oracle for one round: list-form aggregation plus
+    (when ``tx`` is not None) the server-optimizer tail applied to the
+    ``params`` collection — bit-exact reference for the sharded round
+    plane.  Returns ``(new_global_tree, new_opt_state)``."""
+    avg = unweighted_sum(updates) if mode == "sum" else weighted_mean(updates)
+    if tx is None:
+        return avg, opt_state
+    a_leaves, treedef = jax.tree_util.tree_flatten(avg)
+    p_leaves, p_treedef = jax.tree_util.tree_flatten(params_tree)
+    if p_treedef != treedef:
+        raise ValueError(
+            f"global params structure {p_treedef} differs from the "
+            f"aggregate {treedef}")
+    names = leaf_paths(treedef)
+    idx = opt_leaf_indices(names, [jnp.result_type(l) for l in p_leaves])
+    if step is None:
+        step = make_host_round_step(tx)
+    out_dtypes = [jnp.result_type(l) for l in a_leaves]
+    stepped, new_state = step(
+        [jnp.asarray(p_leaves[i]).astype(out_dtypes[i]) for i in idx],
+        opt_state, [a_leaves[i] for i in idx])
+    out = list(a_leaves)
+    for i, v in zip(idx, stepped):
+        out[i] = v
+    return jax.tree_util.tree_unflatten(treedef, out), new_state
+
+
+class ServerRoundUpdater:
+    """Routing facade for ``server_state=sharded``: owns the per-aggregator
+    :class:`~fedml_tpu.parallel.agg_plane.ShardedRoundPlane` (lazily built
+    so replicated runs never touch the parallel plane) and exposes the
+    snapshot/restore surface the recovery mixin hooks into."""
+
+    def __init__(self, args):
+        self.args = args
+        self._plane = None
+
+    @property
+    def plane(self):
+        if self._plane is None:
+            from ..parallel.agg_plane import make_round_plane
+            self._plane = make_round_plane(self.args)
+        return self._plane
+
+    def round_update(self, params_tree, raw_grad_list, obs_parent=None):
+        return self.plane.round_update(
+            params_tree, raw_grad_list,
+            mode=FedMLAggOperator.agg_mode(self.args), obs_parent=obs_parent)
+
+    def export_state(self):
+        """Numpy snapshot of the sharded server state (None before the
+        first round update)."""
+        return self.plane.export_state() if self._plane is not None else None
+
+    def restore_state(self, params_tree, state):
+        """Install ``params_tree`` then overwrite leaves + optimizer state
+        from a snapshot, bit-identically."""
+        self.plane.install(params_tree)
+        self.plane.load_state(state)

@@ -12,7 +12,7 @@ from __future__ import annotations
 from abc import ABC, abstractmethod
 from typing import Any, List, Tuple
 
-from ..aggregate import FedMLAggOperator
+from ..aggregate import FedMLAggOperator, ServerRoundUpdater, server_state_mode
 
 
 class ServerAggregator(ABC):
@@ -20,6 +20,12 @@ class ServerAggregator(ABC):
         self.model = model
         self.id = 0
         self.args = args
+        # sharded server state: the round updater owns the resident
+        # model-sharded params + optimizer state (built lazily; replicated
+        # runs never construct the plane)
+        self.round_updater = (ServerRoundUpdater(args)
+                              if server_state_mode(args) == "sharded"
+                              else None)
 
     def set_id(self, aggregator_id: int) -> None:
         self.id = aggregator_id
@@ -60,11 +66,17 @@ class ServerAggregator(ABC):
 
         defender = FedMLDefender.get_instance()
         if defender.is_defense_enabled():
+            # defended rounds stay on the replicated path: the defender's
+            # base_aggregation_func contract is plain aggregation, not the
+            # stateful server-optimizer round tail
             return defender.defend_on_aggregation(
                 raw_client_grad_list=raw_client_model_or_grad_list,
                 base_aggregation_func=FedMLAggOperator.agg,
                 extra_auxiliary_info=self.get_model_params(),
             )
+        if self.round_updater is not None:
+            return self.round_updater.round_update(
+                self.get_model_params(), raw_client_model_or_grad_list)
         return FedMLAggOperator.agg(self.args, raw_client_model_or_grad_list)
 
     def on_after_aggregation(self, aggregated_model_or_grad: Any) -> Any:

@@ -182,20 +182,38 @@ class ObsAnalyzer(Analyzer):
 # agg
 
 _TREEMAP_STAR_FALLBACK = re.compile(r"tree_map\s*\(\s*lambda\s*\*")
+_PSEUDOGRAD_FALLBACK = re.compile(
+    r"tree_map\s*\(\s*lambda\s+\w+\s*,\s*\w+\s*:\s*\w+\s*-\s*\w+")
+_APPLY_UPDATES_FALLBACK = re.compile(r"(?<![\w])apply_updates\s*\(")
+
+#: files allowed to spell the host server-optimizer tail: the replicated
+#: oracle (core/aggregate is analyzer-exempt), the sp/fedopt reference
+#: implementation, the compiled round plane, and the in-mesh strategies
+_SERVER_OPT_SEAMS = ("simulation/sp/fedopt", "parallel/agg_plane.py",
+                     "simulation/xla/algorithms.py")
 
 
 class AggAnalyzer(Analyzer):
     """No hand-rolled star-lambda tree_map aggregation loops outside
-    core/aggregate and the compiled agg plane (the lint_agg contract)."""
+    core/aggregate and the compiled agg plane, and no host server-optimizer
+    round tails (pseudo-gradient fold + optax apply) outside the sanctioned
+    seams (the lint_agg contract)."""
 
     name = "agg"
     legacy_pragma = "lint_agg: allow"
     exempt_files = ("core/aggregate.py",)
     rules = (Rule("agg-host-treemap", "host tree_map aggregation loop",
-                  order=0),)
+                  order=0),
+             Rule("agg-server-opt-host", "host server-optimizer round loop",
+                  order=1))
 
     def check(self, src: SourceFile) -> List[Finding]:
-        rule = self.rules[0]
+        findings = self._treemap_findings(src)
+        findings.extend(self._server_opt_findings(src))
+        return findings
+
+    def _treemap_findings(self, src: SourceFile) -> List[Finding]:
+        rule = self.rule_by_id("agg-host-treemap")
         if src.tree is None:
             return [self.finding(rule, src, lineno,
                                  "host tree_map aggregation loop")
@@ -213,6 +231,50 @@ class AggAnalyzer(Analyzer):
                     "host tree_map aggregation loop: star-lambda fold "
                     "belongs to core/aggregate or the agg plane"))
         return findings
+
+    def _server_opt_findings(self, src: SourceFile) -> List[Finding]:
+        """A function that both folds a pseudo-gradient (two-arg lambda
+        subtraction under tree_map) AND applies an optax update is a host
+        server-optimizer round tail — those belong to
+        ``core/aggregate.host_server_round_update`` or the sharded round
+        plane, where the op chain is pinned bit-exact against the compiled
+        program."""
+        rule = self.rule_by_id("agg-server-opt-host")
+        norm = src.path.replace("\\", "/")
+        if any(seam in norm for seam in _SERVER_OPT_SEAMS):
+            return []
+        msg = ("host server-optimizer round loop: the pseudo-gradient tail "
+               "belongs to core/aggregate.host_server_round_update or the "
+               "sharded round plane")
+        if src.tree is None:
+            if not any(_PSEUDOGRAD_FALLBACK.search(c)
+                       for c in src.code_lines):
+                return []
+            return [self.finding(rule, src, lineno, msg)
+                    for lineno, code in enumerate(src.code_lines, 1)
+                    if _APPLY_UPDATES_FALLBACK.search(code)]
+        by_line = {}
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            pseudograd, steps = [], False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                term = terminal_name(node.func)
+                if (term == "tree_map" and node.args
+                        and isinstance(node.args[0], ast.Lambda)):
+                    lam = node.args[0]
+                    if (len(lam.args.args) == 2 and lam.args.vararg is None
+                            and isinstance(lam.body, ast.BinOp)
+                            and isinstance(lam.body.op, ast.Sub)):
+                        pseudograd.append(node.lineno)
+                elif term == "apply_updates":
+                    steps = True
+            if steps:
+                for lineno in pseudograd:
+                    by_line[lineno] = self.finding(rule, src, lineno, msg)
+        return list(by_line.values())
 
 
 # ---------------------------------------------------------------------------

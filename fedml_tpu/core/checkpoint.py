@@ -614,7 +614,9 @@ class ServerRecoveryMixin:
     of the aggregator), ``_round_start_extras`` / ``_restore_round_extras``
     (stack-specific state: silo index map, eval history) — plus
     ``_replay_upload(record)`` to push one journaled upload back into its
-    slot table.  Lifecycle:
+    slot table, and the optional ``_capture_server_opt_state`` /
+    ``_restore_server_opt_state`` pair for the sharded server-optimizer
+    state (``server_state=sharded``).  Lifecycle:
 
     * ``init_server_recovery(args)`` at the end of ``__init__``: loads the
       latest snapshot (if any), bumps the incarnation epoch, replays the
@@ -647,6 +649,10 @@ class ServerRecoveryMixin:
         self.client_id_list_in_this_round = [int(c) for c in state["participants"]]
         self._had_timeout_close = bool(state.get("had_timeout_close", False))
         self._restore_global_params(state["global_params"])
+        if state.get("server_opt") is not None:
+            # sharded server state: params must be installed (the line
+            # above) before the optimizer snapshot loads onto the mesh
+            self._restore_server_opt_state(state["server_opt"])
         self._restore_round_extras(state)
         pop = getattr(self, "population", None)
         if pop is not None:
@@ -701,11 +707,24 @@ class ServerRecoveryMixin:
             "had_timeout_close": bool(getattr(self, "_had_timeout_close", False)),
             "global_params": self._capture_global_params(),
         }
+        opt_state = self._capture_server_opt_state()
+        if opt_state is not None:
+            state["server_opt"] = opt_state
         pop = getattr(self, "population", None)
         if pop is not None:
             state["registry"] = pop.export_registry()
         state.update(self._round_start_extras())
         self._store.save_round_start(int(self.args.round_idx), state)
+
+    def _capture_server_opt_state(self) -> Optional[Any]:
+        """Optional fifth hook pair: hosts running ``server_state=sharded``
+        return the sharded optimizer/params snapshot here (and load it in
+        ``_restore_server_opt_state``) so a server kill restores the
+        server-optimizer state bit-identically.  Default: nothing to save."""
+        return None
+
+    def _restore_server_opt_state(self, state: Any) -> None:
+        pass
 
     def _journal_upload(self, sender: int, **payload: Any) -> bool:
         """Record one accepted upload; False = duplicate for this round (the

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..core import obs
+from ..core.aggregate import ServerRoundUpdater, server_state_mode
 from ..ml.aggregator.default_aggregator import DefaultServerAggregator
 from ..ml.engine.train import init_variables
 from .edge_model import flatten_params, load_edge_model, save_edge_model, unflatten_params
@@ -42,6 +43,16 @@ class FedMLAggregator:
         sample = jnp.asarray(test_global[0][:1])
         self.variables = init_variables(model, sample, seed=int(getattr(args, "random_seed", 0)))
         self._eval = DefaultServerAggregator(model, args)
+        # sharded server state (server_state=sharded): the round updater owns
+        # the model-sharded resident params + server-optimizer state; the
+        # flat name->array dict IS the pytree (names carry the "params/"
+        # prefix the optimizer mask keys on)
+        self.round_updater = (ServerRoundUpdater(args)
+                              if server_state_mode(args) == "sharded"
+                              else None)
+        # last sharded round output (object identity = plane residency key);
+        # any external global replacement must clear it
+        self._round_global: Optional[Dict[str, np.ndarray]] = None
 
         self.model_file_dict: Dict[int, str] = {}
         self.sample_num_dict: Dict[int, float] = {}
@@ -64,6 +75,7 @@ class FedMLAggregator:
 
     def set_global_model_params_from_file(self, path: str) -> None:
         self.variables = unflatten_params(load_edge_model(path))
+        self._round_global = None
 
     # -- crash-recovery persistence (core/checkpoint.ServerRecoveryMixin) ----
     def export_state(self) -> Dict[str, np.ndarray]:
@@ -74,6 +86,7 @@ class FedMLAggregator:
         self.variables = unflatten_params(
             {str(k): np.asarray(v) for k, v in flat.items()}
         )
+        self._round_global = None
 
     # -- collection (reference :44-58) ---------------------------------------
     def add_local_trained_result(self, index: int, model_file: str, sample_num: float) -> None:
@@ -112,6 +125,20 @@ class FedMLAggregator:
         reference's all-received path)."""
         if indices is None:
             indices = list(range(self.worker_num))
+        if self.round_updater is not None:
+            updates = [(self.sample_num_dict[i],
+                        load_edge_model(self.model_file_dict[i]))
+                       for i in indices]
+            merged = self._install_sharded(
+                self.round_updater.round_update(self._sharded_base(), updates))
+            for path in self.model_file_dict.values():
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            self.model_file_dict = {}
+            self.sample_num_dict = {}
+            return merged
         if str(getattr(self.args, "agg_plane", "host") or "host") == "compiled":
             from ..parallel.agg_plane import plane_for
 
@@ -153,6 +180,12 @@ class FedMLAggregator:
         (``model_file_dict`` etc.) are untouched; upload-file cleanup is the
         server manager's ``_async_after_flush`` job, because the files must
         outlive the flush until the successor cycle's snapshot is durable."""
+        if self.round_updater is not None:
+            merged = self._install_sharded(self.round_updater.round_update(
+                self._sharded_base(), list(weighted_updates)))
+            logger.info("buffered aggregate of %d deltas plane=sharded",
+                        len(weighted_updates))
+            return merged
         if str(getattr(self.args, "agg_plane", "host") or "host") == "compiled":
             from ..parallel.agg_plane import plane_for
 
@@ -176,6 +209,38 @@ class FedMLAggregator:
                     len(weighted_updates),
                     getattr(self.args, "agg_plane", "host") or "host")
         return self._install_merged(acc)
+
+    def _sharded_base(self) -> Dict[str, np.ndarray]:
+        """The global-params pytree handed to the round plane: the plane's
+        own last output when the globals haven't been replaced since (object
+        identity keeps the resident device state live — no re-install), the
+        freshly flattened globals otherwise (restore / file-set paths)."""
+        base = getattr(self, "_round_global", None)
+        return base if base is not None else flatten_params(self.variables)
+
+    def _install_sharded(self, merged: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Install the round plane's output as the new global WITHOUT the
+        template recast of :meth:`_install_merged` — the plane's out-dtypes
+        are authoritative (recasting would desync the resident device state
+        and reset the server-optimizer on the next structure check)."""
+        out = {name: np.asarray(v) for name, v in merged.items()}
+        self.variables = unflatten_params(out)
+        self._round_global = merged
+        return out
+
+    def export_server_opt_state(self):
+        """Numpy snapshot of the sharded optimizer/params state for the
+        recovery store (None on the replicated path or before round 1)."""
+        return (self.round_updater.export_state()
+                if self.round_updater is not None else None)
+
+    def restore_server_opt_state(self, state) -> None:
+        """Re-install the restored globals into the round plane and load
+        the optimizer state bit-identically (recovery restore path)."""
+        if self.round_updater is not None and state is not None:
+            self._round_global = None
+            self.round_updater.restore_state(flatten_params(self.variables),
+                                             state)
 
     def _install_merged(self, acc: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Cast an accumulated flat dict back through the current global

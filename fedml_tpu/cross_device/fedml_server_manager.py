@@ -366,6 +366,12 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             dict(r) for r in state.get("eval_history", [])
         ]
 
+    def _capture_server_opt_state(self):
+        return self.aggregator.export_server_opt_state()
+
+    def _restore_server_opt_state(self, state) -> None:
+        self.aggregator.restore_server_opt_state(state)
+
     def _replay_upload(self, record: Dict[str, Any]) -> bool:
         """Re-insert one journaled upload.  The journal holds the upload's
         FILE path, not its tensors — if the file is gone (tmpdir wipe), the

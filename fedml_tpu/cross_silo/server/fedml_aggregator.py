@@ -37,6 +37,20 @@ class FedMLAggregator:
     def set_global_model_params(self, model_parameters):
         self.aggregator.set_model_params(model_parameters)
 
+    # -- sharded server state (server_state=sharded) ------------------------
+    def export_server_opt_state(self):
+        """Numpy snapshot of the sharded optimizer/params state for the
+        recovery store (None on the replicated path or before round 1)."""
+        updater = getattr(self.aggregator, "round_updater", None)
+        return updater.export_state() if updater is not None else None
+
+    def restore_server_opt_state(self, state) -> None:
+        """Re-install the restored globals into the round plane and load
+        the optimizer state bit-identically (recovery restore path)."""
+        updater = getattr(self.aggregator, "round_updater", None)
+        if updater is not None and state is not None:
+            updater.restore_state(self.get_global_model_params(), state)
+
     def add_local_trained_result(self, index: int, model_params, sample_num) -> None:
         logger.info("add_model index=%d n=%s", index, sample_num)
         self.model_dict[int(index)] = model_params

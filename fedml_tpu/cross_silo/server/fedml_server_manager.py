@@ -54,6 +54,9 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
         self.eval_history: List[Dict[str, Any]] = []
         # broadcast-payload cache: one serialized blob per round's fan-out
         self._bcast_cache: tuple = (None, None)
+        # shard-addressable broadcast: per-(round, shard) CachedPayload memo
+        # (server_state=sharded; clients/edge aggregators fetch slices)
+        self._bcast_shard_cache: tuple = (None, {})
         # zero-copy ingest arenas (per-sender), active with the pipeline
         self._zero_copy = (ingest.ZeroCopyDecoder()
                            if ingest.pipeline_enabled(args) else None)
@@ -173,6 +176,28 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
             payload = CachedPayload(self.aggregator.get_global_model_params())
             self._bcast_cache = (key, payload)
         return payload
+
+    def shard_payload(self, shard_idx: int) -> CachedPayload:
+        """Shard-addressable broadcast: one :class:`CachedPayload` per
+        (round, shard) slice of the global model, memoized exactly like the
+        full-tree payload — a client (or a future edge aggregator) that
+        needs only its slice fetches ``broadcast_shards - 1`` fewer bytes.
+        Shard layout comes from ``parallel.agg_plane.broadcast_shards``;
+        ``assemble_shards`` reassembles the tree exactly."""
+        from ...parallel.agg_plane import broadcast_shards
+
+        num = int(getattr(self.args, "broadcast_shards", 1) or 1)
+        key = int(self.args.round_idx)
+        cached_key, payloads = self._bcast_shard_cache
+        if cached_key != key:
+            shards = broadcast_shards(
+                self.aggregator.get_global_model_params(), num)
+            payloads = {s["shard"]: CachedPayload(s) for s in shards}
+            self._bcast_shard_cache = (key, payloads)
+        if int(shard_idx) not in payloads:
+            raise ValueError(
+                f"shard {shard_idx} out of range for broadcast_shards={num}")
+        return payloads[int(shard_idx)]
 
     def send_init_msg(self) -> None:
         """Round-0 kick-off (reference send_message_init_config :182)."""
@@ -397,6 +422,12 @@ class FedMLServerManager(RoundObsMixin, ServerRecoveryMixin,
                                            state["silo_indices"])
         }
         self.eval_history = [dict(r) for r in state.get("eval_history", [])]
+
+    def _capture_server_opt_state(self):
+        return self.aggregator.export_server_opt_state()
+
+    def _restore_server_opt_state(self, state) -> None:
+        self.aggregator.restore_server_opt_state(state)
 
     def _replay_upload(self, record: Dict[str, Any]) -> bool:
         """Push one journaled upload back into the aggregator slot table —

@@ -57,9 +57,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import obs
-from ..core.aggregate import flatten_checked, leaf_paths
+from ..core.aggregate import flatten_checked, leaf_paths, opt_leaf_indices
 from ..core.obs.trace import NULL_SPAN
-from .mesh import create_mesh
+from .mesh import create_mesh, create_round_mesh, mesh_fingerprint
 from .sharding import param_spec
 
 logger = logging.getLogger(__name__)
@@ -68,6 +68,11 @@ Pytree = Any
 
 AGG_PLANES = ("host", "compiled")
 AGG_WIRE_DTYPES = ("f32", "bf16")
+#: where global params + server-optimizer state live between rounds:
+#: ``replicated`` = host pytrees (the pre-sharded-plane behaviour),
+#: ``sharded`` = NamedSharding device arrays on the round mesh with the
+#: whole round tail compiled (:class:`ShardedRoundPlane`).
+SERVER_STATES = ("replicated", "sharded")
 
 _WIRE_JNP = {"f32": jnp.float32, "bf16": jnp.bfloat16}
 
@@ -80,15 +85,27 @@ def default_agg_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return create_mesh((len(devices),), ("tp",), devices)
 
 
+def default_round_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D ``(client, model)`` mesh for the sharded round update.  On the
+    server the client axis is 1 — client deltas arrive over the wire and the
+    fold stays sequential for bit-exactness — while every device owns a
+    model shard of the global params, the optimizer state, and the update
+    step (the XLA simulator widens the client axis for in-mesh cohorts)."""
+    devices = list(devices if devices is not None else jax.devices())
+    return create_round_mesh(clients=1, model=len(devices), devices=devices)
+
+
 def match_partition_rules(rules: Sequence[Tuple[str, Any]], names: Sequence[str],
-                          shapes: Sequence[Tuple[int, ...]], mesh: Mesh) -> List[P]:
+                          shapes: Sequence[Tuple[int, ...]], mesh: Mesh,
+                          axis: str = "tp") -> List[P]:
     """Per-leaf ``PartitionSpec``: first regex in ``rules`` that matches the
     ``/``-joined param path wins; unmatched leaves fall back to the
-    ``param_spec`` largest-divisible-axis heuristic; scalars (and size-1
-    leaves) always replicate.  A rule naming a mesh axis that does not exist
-    (or that does not divide the leaf) degrades to replication rather than
-    failing the round — aggregation must work on any mesh."""
-    tp = int(mesh.shape.get("tp", 1))
+    ``param_spec`` largest-divisible-axis heuristic over ``axis``; scalars
+    (and size-1 leaves) always replicate.  A rule naming a mesh axis that
+    does not exist (or that does not divide the leaf) degrades to
+    replication rather than failing the round — aggregation must work on
+    any mesh."""
+    size = int(mesh.shape.get(axis, 1))
     out: List[P] = []
     for name, shape in zip(names, shapes):
         if len(shape) == 0 or int(np.prod(shape)) <= 1:
@@ -100,7 +117,7 @@ def match_partition_rules(rules: Sequence[Tuple[str, Any]], names: Sequence[str]
                 spec = P(*ps) if not isinstance(ps, P) else ps
                 break
         if spec is None:
-            out.append(param_spec(shape, tp))
+            out.append(param_spec(shape, size, axis=axis))
             continue
         out.append(_sanitize_spec(spec, shape, mesh))
     return out
@@ -183,10 +200,15 @@ class CompiledAggPlane:
     (``mode="sum"``) over ``[(n_samples, pytree), ...]`` but runs as one
     donated-buffer compiled program per microbatch chunk.
 
-    Programs are cached per (treedef, leaf shapes/dtypes, K, mode): the
-    first round at a new signature pays the XLA compile (visible as the
-    ``aggregate.compile`` span); every later round reuses it.
+    Programs are cached per (mesh, treedef, leaf shapes/dtypes, K, mode):
+    the first round at a new signature pays the XLA compile (visible as the
+    ``aggregate.compile`` span); every later round reuses it.  The mesh is
+    part of the key — a program compiled for one device set must never be
+    replayed on another just because the shapes line up.
     """
+
+    #: mesh axis params shard over; the round plane overrides with "model"
+    axis = "tp"
 
     def __init__(self, mesh: Optional[Mesh] = None,
                  rules: Sequence[Tuple[str, Any]] = (),
@@ -199,6 +221,7 @@ class CompiledAggPlane:
             raise ValueError(
                 f"agg_microbatch_clients must be >= 0 (got {microbatch_clients})")
         self.mesh = mesh if mesh is not None else default_agg_mesh()
+        self.mesh_key = mesh_fingerprint(self.mesh)
         self.rules = tuple(rules)
         self.wire_dtype = wire_dtype
         self.microbatch_clients = int(microbatch_clients)
@@ -207,7 +230,8 @@ class CompiledAggPlane:
     # -- program construction ------------------------------------------------
     def _leaf_plan(self, treedef, shapes, dtypes, mode):
         names = leaf_paths(treedef)
-        specs = match_partition_rules(self.rules, names, shapes, self.mesh)
+        specs = match_partition_rules(self.rules, names, shapes, self.mesh,
+                                      axis=self.axis)
         wire = _WIRE_JNP[self.wire_dtype]
         acc_dtypes, wire_dtypes, out_dtypes = [], [], []
         for dt in dtypes:
@@ -279,7 +303,8 @@ class CompiledAggPlane:
 
     def _program_for(self, treedef, shapes, dtypes, k, mode,
                      parent) -> _Program:
-        sig = (treedef, shapes, dtypes, k, mode, self.wire_dtype)
+        sig = (self.mesh_key, treedef, shapes, dtypes, k, mode,
+               self.wire_dtype)
         prog = self._programs.get(sig)
         if prog is None:
             sp = (obs.span("aggregate.compile", parent, k=k, mode=mode,
@@ -377,9 +402,474 @@ class CompiledAggPlane:
         return jax.tree_util.tree_unflatten(treedef, out)
 
 
+# -- the sharded round plane -------------------------------------------------
+
+
+class _RoundProgram:
+    """One compiled round tail: fused fold+optimize+materialize (``fused``)
+    or the finishing tail alone (microbatched folds feed it)."""
+
+    __slots__ = ("fn", "leaf_shardings", "chunk_shardings", "opt_shardings",
+                 "acc_dtypes", "wire_dtypes", "out_dtypes", "wire_bytes",
+                 "fused")
+
+    def __init__(self, fn, leaf_shardings, chunk_shardings, opt_shardings,
+                 acc_dtypes, wire_dtypes, out_dtypes, wire_bytes, fused):
+        self.fn = fn
+        self.leaf_shardings = leaf_shardings
+        self.chunk_shardings = chunk_shardings
+        self.opt_shardings = opt_shardings
+        self.acc_dtypes = acc_dtypes
+        self.wire_dtypes = wire_dtypes
+        self.out_dtypes = out_dtypes
+        self.wire_bytes = wire_bytes
+        self.fused = fused
+
+
+def round_policy(args: Any) -> Tuple:
+    """Server-optimizer policy tuple for the round tail, resolved exactly
+    like the sp/fedopt host oracle: ``("fedavg",)`` when the federated
+    optimizer has no server step, else ``(name, lr, momentum)`` from
+    ``server_optimizer`` / ``server_lr`` / ``server_momentum``."""
+    opt = str(getattr(args, "federated_optimizer", "FedAvg") or "FedAvg")
+    if opt not in ("FedOpt", "FedOpt_seq"):
+        return ("fedavg",)
+    name = str(getattr(args, "server_optimizer", "adam") or "adam").lower()
+    lr = float(getattr(args, "server_lr", 1e-1))
+    momentum = float(getattr(args, "server_momentum", 0.9))
+    return (name, lr, momentum)
+
+
+def _policy_tx(policy: Tuple):
+    """optax transform for a policy tuple, via the sp/fedopt oracle builder
+    (lazy import: fedopt_api imports core.aggregate at module top)."""
+    if policy[0] == "fedavg":
+        return None
+    import types
+
+    from ..simulation.sp.fedopt.fedopt_api import make_server_optimizer
+    name, lr, momentum = policy
+    return make_server_optimizer(types.SimpleNamespace(
+        server_optimizer=name, server_lr=lr, server_momentum=momentum))
+
+
+class ShardedRoundPlane(CompiledAggPlane):
+    """Model-sharded server state + one compiled round update.
+
+    Global params and server-optimizer state live between rounds as
+    ``NamedSharding`` device arrays partitioned along the round mesh's
+    ``model`` axis.  ``round_update(params, updates)`` runs the whole round
+    tail — stacked-delta reduce, FedOpt/FedAdam/FedYogi step (or the FedAvg
+    identity), new-params materialization — as ONE donated-buffer compiled
+    program per (mesh, treedef, shapes, K, mode, policy) signature; with
+    microbatching the chunk fold reuses the inherited step program and only
+    the finishing tail is a second program, so microbatched == full
+    bitwise.
+
+    Bit-exactness: the fold is the inherited left-to-right scan (bitwise
+    the host ``weighted_mean``/``unweighted_sum``), an
+    ``optimization_barrier`` pins the reduce→tail materialization boundary
+    so XLA cannot contract across it, and the tail traces the same optax
+    transform the host oracle jits — so the round update matches
+    :func:`fedml_tpu.core.aggregate.host_server_round_update` bit-for-bit
+    in f32 mode.
+    """
+
+    axis = "model"
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 rules: Sequence[Tuple[str, Any]] = (),
+                 wire_dtype: str = "f32",
+                 microbatch_clients: int = 0,
+                 policy: Tuple = ("fedavg",)):
+        mesh = mesh if mesh is not None else default_round_mesh()
+        super().__init__(mesh=mesh, rules=rules, wire_dtype=wire_dtype,
+                         microbatch_clients=microbatch_clients)
+        self.policy = tuple(policy)
+        self._tx = _policy_tx(self.policy)
+        self._treedef = None
+        self._shapes: Optional[Tuple] = None
+        self._param_dtypes: Optional[Tuple] = None
+        self._leaf_shardings: Optional[List[NamedSharding]] = None
+        self._param_leaves: Optional[List[Any]] = None
+        self._opt_idx: Tuple[int, ...] = ()
+        self._opt_state: Any = ()
+        self._last_out: Any = None
+
+    # -- resident state ------------------------------------------------------
+    def install(self, params_tree: Pytree) -> None:
+        """Place the global params on the mesh (model-axis NamedShardings)
+        and (re)build the server-optimizer state when the structure changed.
+        Optimizer state survives a re-install of same-structure params —
+        the oracle never resets it mid-run either."""
+        leaves, treedef = jax.tree_util.tree_flatten(params_tree)
+        names = leaf_paths(treedef)
+        shapes = tuple(tuple(np.shape(l)) for l in leaves)
+        dtypes = tuple(jnp.dtype(jnp.result_type(l)) for l in leaves)
+        specs = match_partition_rules(self.rules, names, shapes, self.mesh,
+                                      axis=self.axis)
+        changed = (self._treedef is None or treedef != self._treedef
+                   or shapes != self._shapes or dtypes != self._param_dtypes)
+        self._treedef = treedef
+        self._shapes = shapes
+        self._param_dtypes = dtypes
+        self._leaf_shardings = [NamedSharding(self.mesh, s) for s in specs]
+        self._param_leaves = jax.device_put(
+            [np.asarray(l) for l in leaves], self._leaf_shardings)
+        self._opt_idx = tuple(opt_leaf_indices(names, dtypes)
+                              if self._tx is not None else ())
+        if self._tx is not None and (changed or self._opt_state == ()):
+            self._opt_state = self._tx.init(
+                [self._param_leaves[i] for i in self._opt_idx])
+        self._last_out = None
+        param_bytes = sum(int(np.prod(sh) or 1) * jnp.dtype(dt).itemsize
+                          for sh, dt in zip(shapes, dtypes))
+        opt_bytes = sum(
+            int(np.prod(np.shape(l)) or 1) * jnp.dtype(jnp.result_type(l)).itemsize
+            for l in jax.tree_util.tree_leaves(self._opt_state))
+        model = int(self.mesh.shape.get(self.axis, 1))
+        obs.gauge_set("server_state.shard_bytes",
+                      (param_bytes + opt_bytes) / model,
+                      labels={"axis": self.axis})
+
+    @property
+    def installed(self) -> bool:
+        return self._treedef is not None
+
+    # -- round programs ------------------------------------------------------
+    def _build_round_program(self, upd_dtypes, k, mode, fused) -> _RoundProgram:
+        treedef, shapes = self._treedef, self._shapes
+        specs, acc_dtypes, wire_dtypes, out_dtypes = self._leaf_plan(
+            treedef, shapes, upd_dtypes, mode)
+        mesh = self.mesh
+        leaf_sh = [NamedSharding(mesh, s) for s in specs]
+        chunk_sh = [NamedSharding(mesh, P(None, *s)) for s in specs]
+        w_sh = NamedSharding(mesh, P())
+        tx, opt_idx = self._tx, self._opt_idx
+        param_dtypes = self._param_dtypes
+
+        if tx is not None:
+            opt_sds_in = [jax.ShapeDtypeStruct(shapes[i], param_dtypes[i])
+                          for i in opt_idx]
+            opt_template = jax.eval_shape(tx.init, opt_sds_in)
+            model = int(mesh.shape.get(self.axis, 1))
+            opt_sh = jax.tree_util.tree_map(
+                lambda l: NamedSharding(
+                    mesh, param_spec(l.shape, model, axis=self.axis)),
+                opt_template)
+            opt_sds = jax.tree_util.tree_map(
+                lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+                opt_template, opt_sh)
+        else:
+            opt_sh, opt_sds = (), ()
+
+        def fold(acc, chunk, w):
+            if mode == "mean":
+                # scale BEFORE the scan (host-parity rounding; see
+                # _build_program on why in-body scaling breaks bit-exactness)
+                chunk = [c.astype(a.dtype)
+                         * w.reshape((-1,) + (1,) * (c.ndim - 1)).astype(a.dtype)
+                         for a, c in zip(acc, chunk)]
+
+            def body(carry, x):
+                return [a + v.astype(a.dtype)
+                        for a, v in zip(carry, x)], None
+
+            acc, _ = jax.lax.scan(body, acc, chunk)
+            return acc
+
+        def tail(params, opt_state, acc):
+            out = [a.astype(dt) if a.dtype != dt else a
+                   for a, dt in zip(acc, out_dtypes)]
+            if tx is None:
+                return out, opt_state
+            import optax
+            opt_params = [params[i].astype(out_dtypes[i]) for i in opt_idx]
+            pseudo_grad = [p - a for p, a in
+                           zip(opt_params, [out[i] for i in opt_idx])]
+            updates, new_state = tx.update(pseudo_grad, opt_state, opt_params)
+            stepped = optax.apply_updates(opt_params, updates)
+            new = list(out)
+            for i, v in zip(opt_idx, stepped):
+                new[i] = v
+            return new, new_state
+
+        if fused:
+            def fn(params, opt_state, chunk, w):
+                zeros = [jnp.zeros(sh, dt)
+                         for sh, dt in zip(shapes, acc_dtypes)]
+                acc = fold(zeros, chunk, w)
+                # pin the reduce→tail boundary: the accumulator must
+                # materialize here exactly as it does at the two-program
+                # boundary of the host oracle / microbatched path
+                acc = jax.lax.optimization_barrier(acc)
+                return tail(params, opt_state, acc)
+
+            jitted = jax.jit(fn, donate_argnums=(0, 1, 2),
+                             in_shardings=(leaf_sh, opt_sh, chunk_sh, w_sh),
+                             out_shardings=(leaf_sh, opt_sh))
+            param_sds = [jax.ShapeDtypeStruct(sh, dt, sharding=s)
+                         for sh, dt, s in zip(shapes, param_dtypes, leaf_sh)]
+            chunk_sds = [jax.ShapeDtypeStruct((k,) + sh, dt, sharding=s)
+                         for sh, dt, s in zip(shapes, wire_dtypes, chunk_sh)]
+            w_sds = jax.ShapeDtypeStruct((k,), jnp.float32, sharding=w_sh)
+            lowered_args = (param_sds, opt_sds, chunk_sds, w_sds)
+        else:
+            def fn(params, opt_state, acc):
+                return tail(params, opt_state, acc)
+
+            jitted = jax.jit(fn, donate_argnums=(0, 1, 2),
+                             in_shardings=(leaf_sh, opt_sh, leaf_sh),
+                             out_shardings=(leaf_sh, opt_sh))
+            param_sds = [jax.ShapeDtypeStruct(sh, dt, sharding=s)
+                         for sh, dt, s in zip(shapes, param_dtypes, leaf_sh)]
+            acc_sds = [jax.ShapeDtypeStruct(sh, dt, sharding=s)
+                       for sh, dt, s in zip(shapes, acc_dtypes, leaf_sh)]
+            lowered_args = (param_sds, opt_sds, acc_sds)
+        with warnings.catch_warnings():
+            # donation is a no-op on CPU backends; the warning is expected
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            compiled = jitted.lower(*lowered_args).compile()
+        wire_bytes = int(sum(int(np.prod(sh) or 1) * jnp.dtype(dt).itemsize
+                             for sh, dt in zip(shapes, wire_dtypes)))
+        return _RoundProgram(compiled, leaf_sh, chunk_sh, opt_sh, acc_dtypes,
+                             wire_dtypes, out_dtypes, wire_bytes, fused)
+
+    def _round_program_for(self, upd_dtypes, k, mode, fused,
+                           parent) -> _RoundProgram:
+        sig = (self.mesh_key, self._treedef, self._shapes, upd_dtypes,
+               self._param_dtypes, self._opt_idx, k, mode, self.wire_dtype,
+               self.policy, fused)
+        prog = _ROUND_PROGRAMS.get(sig)
+        if prog is None:
+            sp = (obs.span("aggregate.compile", parent, k=k, mode=mode,
+                           policy=self.policy[0], fused=fused,
+                           n_leaves=len(self._shapes))
+                  if parent is not None else NULL_SPAN)
+            with sp:
+                t0 = time.perf_counter()
+                prog = self._build_round_program(upd_dtypes, k, mode, fused)
+                compile_s = time.perf_counter() - t0
+                obs.histogram_observe("agg.compile_seconds", compile_s,
+                                      labels={"mode": mode})
+                sp.end(compile_s=round(compile_s, 6))
+                logger.info(
+                    "round plane compiled policy=%s mode=%s k=%d fused=%s "
+                    "in %.3fs", self.policy[0], mode, k, fused, compile_s)
+            _ROUND_PROGRAMS[sig] = prog
+        return prog
+
+    # -- the round update ----------------------------------------------------
+    def round_update(self, params_tree: Pytree,
+                     updates: Sequence[Tuple[float, Pytree]],
+                     mode: str = "mean",
+                     obs_parent: Any = None) -> Pytree:
+        """One full round tail on the mesh: reduce ``updates``, apply the
+        server-optimizer policy against the resident global params, and
+        materialize the new globals.  Returns the new global pytree (host
+        numpy leaves); the sharded device copy stays resident for the next
+        round, the broadcast shard slices, and recovery snapshots.
+
+        ``params_tree`` is authoritative: unless it IS the tree the last
+        ``round_update`` returned (identity — the aggregate-install round
+        trip through the server manager), it is re-installed first.
+        Optimizer state always survives same-structure re-installs.
+        """
+        if mode not in ("mean", "sum"):
+            raise ValueError(f"agg mode must be mean|sum (got {mode!r})")
+        if not updates:
+            raise ValueError("no updates to aggregate")
+        ns = [float(n) for n, _ in updates]
+        leaves_list, treedef = flatten_checked([t for _, t in updates])
+        n = len(leaves_list)
+        if (params_tree is not self._last_out or self._treedef is None
+                or treedef != self._treedef):
+            self.install(params_tree)
+        if treedef != self._treedef:
+            raise ValueError(
+                "client update pytree structure differs from the installed "
+                "global params")
+        upd_shapes = tuple(tuple(np.shape(l)) for l in leaves_list[0])
+        if upd_shapes != self._shapes:
+            raise ValueError(
+                f"client update leaf shapes {upd_shapes} differ from the "
+                f"installed global params {self._shapes}")
+        if mode == "mean":
+            total = float(sum(ns))
+            if total <= 0:
+                raise ValueError("total sample count must be positive")
+            w_all = np.asarray([x / total for x in ns], np.float32)
+        else:
+            w_all = np.ones(n, np.float32)
+        upd_dtypes = tuple(jnp.dtype(jnp.result_type(l))
+                           for l in leaves_list[0])
+        k = self.microbatch_clients or n
+        parent = obs_parent if obs_parent is not None else obs.active_ctx()
+        sp = (obs.span("round.server_update", parent, n_clients=n, k=k,
+                       mode=mode, policy=self.policy[0])
+              if parent is not None else NULL_SPAN)
+        w_sharding = NamedSharding(self.mesh, P())
+        t0 = time.perf_counter()
+        with sp:
+            params = jax.device_put(self._param_leaves, self._leaf_shardings)
+            if k >= n:
+                prog = self._round_program_for(upd_dtypes, k, mode,
+                                               fused=True, parent=parent)
+                opt_state = (jax.device_put(self._opt_state,
+                                            prog.opt_shardings)
+                             if self._tx is not None else ())
+                chunk = []
+                for j, sh in enumerate(self._shapes):
+                    buf = np.zeros((k,) + sh,
+                                   dtype=np.dtype(prog.wire_dtypes[j]))
+                    for row in range(n):
+                        buf[row] = np.asarray(leaves_list[row][j])
+                    chunk.append(buf)
+                w = np.zeros(k, np.float32)
+                w[:n] = w_all
+                chunk = jax.device_put(chunk, prog.chunk_shardings)
+                new_leaves, new_opt = prog.fn(
+                    params, opt_state, chunk, jax.device_put(w, w_sharding))
+            else:
+                fold_prog = self._program_for(treedef, self._shapes,
+                                              upd_dtypes, k, mode, parent)
+                acc = jax.device_put(
+                    [np.zeros(sh, np.dtype(dt))
+                     for sh, dt in zip(self._shapes, fold_prog.acc_dtypes)],
+                    fold_prog.acc_shardings)
+                for lo in range(0, n, k):
+                    hi = min(lo + k, n)
+                    chunk = []
+                    for j, sh in enumerate(self._shapes):
+                        buf = np.zeros(
+                            (k,) + sh, dtype=np.dtype(fold_prog.wire_dtypes[j]))
+                        for row, c in enumerate(range(lo, hi)):
+                            buf[row] = np.asarray(leaves_list[c][j])
+                        chunk.append(buf)
+                    w = np.zeros(k, np.float32)
+                    w[: hi - lo] = w_all[lo:hi]
+                    chunk = jax.device_put(chunk, fold_prog.chunk_shardings)
+                    acc = fold_prog.step(
+                        acc, chunk, jax.device_put(w, w_sharding))
+                prog = self._round_program_for(upd_dtypes, k, mode,
+                                               fused=False, parent=parent)
+                opt_state = (jax.device_put(self._opt_state,
+                                            prog.opt_shardings)
+                             if self._tx is not None else ())
+                new_leaves, new_opt = prog.fn(params, opt_state, acc)
+            jax.block_until_ready(new_leaves)
+        dt_s = time.perf_counter() - t0
+        obs.histogram_observe("server_opt.step_seconds", dt_s,
+                              labels={"policy": self.policy[0], "mode": mode})
+        obs.histogram_observe("agg.step_seconds", dt_s,
+                              labels={"path": "sharded", "mode": mode})
+        obs.counter_inc("agg.bytes_reduced", n * prog.wire_bytes,
+                        labels={"path": "sharded"})
+        self._param_leaves = list(new_leaves)
+        self._param_dtypes = tuple(jnp.dtype(x.dtype) for x in new_leaves)
+        if self._tx is not None:
+            self._opt_state = new_opt
+        self._last_out = jax.tree_util.tree_unflatten(
+            treedef, [np.asarray(x) for x in new_leaves])
+        return self._last_out
+
+    # -- snapshot / restore --------------------------------------------------
+    def export_state(self) -> Optional[Dict[str, Any]]:
+        """Numpy snapshot of the resident server state (None before
+        install): param leaves in flatten order plus the optimizer state
+        rendered through flax's state-dict codec — msgpack-safe and
+        bit-identical through a save/load round trip."""
+        if not self.installed:
+            return None
+        from flax import serialization
+        return {
+            "policy": list(self.policy),
+            "leaves": [np.asarray(x) for x in self._param_leaves],
+            "opt": serialization.to_state_dict(jax.tree_util.tree_map(
+                np.asarray, self._opt_state)),
+        }
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        """Inverse of :meth:`export_state`: requires ``install`` first (the
+        treedef/shardings come from the installed params), then overwrites
+        the resident leaves and optimizer state bit-identically."""
+        if not self.installed:
+            raise ValueError("install() the global params before load_state")
+        from flax import serialization
+        leaves = [np.asarray(l) for l in state["leaves"]]
+        if len(leaves) != len(self._param_leaves):
+            raise ValueError(
+                f"snapshot has {len(leaves)} leaves, installed params have "
+                f"{len(self._param_leaves)}")
+        self._param_dtypes = tuple(jnp.dtype(l.dtype) for l in leaves)
+        self._param_leaves = jax.device_put(leaves, self._leaf_shardings)
+        if self._tx is not None:
+            restored = serialization.from_state_dict(
+                self._opt_state, state["opt"])
+            self._opt_state = jax.device_put(restored)
+        self._last_out = None
+
+
+# -- shard-addressable broadcast ----------------------------------------------
+
+
+def broadcast_shards(tree: Pytree, num_shards: int) -> List[Dict[str, Any]]:
+    """Split a global-params pytree into ``num_shards`` addressable slices.
+
+    Leaves whose leading dim divides evenly are split along it (the model
+    axis of the round mesh); the rest round-robin whole.  Each shard is a
+    self-describing dict (``shard``, ``num_shards``, ``parts`` =
+    ``[(leaf_index, split_axis_or_-1, ndarray), ...]``) so a client — or a
+    future edge aggregator — can fetch exactly the slices it needs and
+    :func:`assemble_shards` can reassemble the tree exactly."""
+    num_shards = int(num_shards)
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1 (got {num_shards})")
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shards: List[List[Tuple[int, int, np.ndarray]]] = [
+        [] for _ in range(num_shards)]
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        if (num_shards > 1 and arr.ndim >= 1
+                and arr.shape[0] >= num_shards
+                and arr.shape[0] % num_shards == 0):
+            for s, part in enumerate(np.split(arr, num_shards, axis=0)):
+                shards[s].append((i, 0, part))
+        else:
+            shards[i % num_shards].append((i, -1, arr))
+    return [{"shard": s, "num_shards": num_shards, "parts": parts}
+            for s, parts in enumerate(shards)]
+
+
+def assemble_shards(shards: Sequence[Dict[str, Any]], treedef) -> Pytree:
+    """Reassemble :func:`broadcast_shards` output (any order) into the
+    original pytree; raises when a shard is missing or duplicated."""
+    if not shards:
+        raise ValueError("no shards to assemble")
+    num = int(shards[0]["num_shards"])
+    seen = sorted(int(s["shard"]) for s in shards)
+    if seen != list(range(num)):
+        raise ValueError(f"need shards 0..{num - 1}, got {seen}")
+    pieces: Dict[int, List[Tuple[int, int, np.ndarray]]] = {}
+    for sh in shards:
+        for idx, axis, part in sh["parts"]:
+            pieces.setdefault(int(idx), []).append(
+                (int(sh["shard"]), int(axis), part))
+    leaves = []
+    for i in range(treedef.num_leaves):
+        plist = sorted(pieces[i], key=lambda t: t[0])
+        if plist[0][1] == -1:
+            leaves.append(plist[0][2])
+        else:
+            leaves.append(np.concatenate([p for _, _, p in plist], axis=0))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 # -- args-driven construction ------------------------------------------------
 
 _PLANES: Dict[Any, CompiledAggPlane] = {}
+_ROUND_PROGRAMS: Dict[Any, _RoundProgram] = {}
 
 
 def plane_config(args: Any) -> Tuple[str, int]:
@@ -389,18 +879,29 @@ def plane_config(args: Any) -> Tuple[str, int]:
 
 
 def plane_for(args: Any) -> CompiledAggPlane:
-    """Process-cached plane for this config (the mesh — hence the compiled
-    programs — are per-process resources; every aggregator with the same
-    knobs shares one plane and its program cache)."""
-    key = plane_config(args)
+    """Process-cached plane for this config + the CURRENT device topology
+    (the mesh fingerprint is part of the key: after a topology change a
+    fresh plane compiles fresh programs instead of silently replaying ones
+    built for the old device set)."""
+    wire, k = plane_config(args)
+    key = (wire, k, mesh_fingerprint(default_agg_mesh()))
     plane = _PLANES.get(key)
     if plane is None:
-        wire, k = key
         plane = CompiledAggPlane(wire_dtype=wire, microbatch_clients=k)
         _PLANES[key] = plane
     return plane
 
 
+def make_round_plane(args: Any, mesh: Optional[Mesh] = None) -> ShardedRoundPlane:
+    """Per-aggregator sharded round plane (NOT process-cached: it holds the
+    resident server state, which must never bleed across aggregators; the
+    compiled round programs DO share the process-wide cache)."""
+    wire, k = plane_config(args)
+    return ShardedRoundPlane(mesh=mesh, wire_dtype=wire,
+                             microbatch_clients=k, policy=round_policy(args))
+
+
 def reset_planes() -> None:
     """Drop cached planes/programs (tests; device topology changes)."""
     _PLANES.clear()
+    _ROUND_PROGRAMS.clear()

@@ -43,6 +43,31 @@ def create_train_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
     return create_mesh((dp, tp, sp), ("dp", "tp", "sp"), devices)
 
 
+def create_round_mesh(clients: int = 1, model: Optional[int] = None,
+                      devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D ``(client, model)`` mesh for the sharded round update: client
+    deltas reduce along ``client`` while global params and server-optimizer
+    state shard along ``model`` (the cross-replica weight-update sharding of
+    arxiv 2004.13336).  ``model`` defaults to all remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    clients = int(clients)
+    if clients < 1:
+        raise ValueError(f"client axis must be >= 1 (got {clients})")
+    if model is None:
+        model = max(1, len(devices) // clients)
+    return create_mesh((clients, int(model)), ("client", "model"), devices)
+
+
+def mesh_fingerprint(mesh: Mesh) -> Tuple[Tuple[str, int], ...]:
+    """Hashable identity of a mesh: (axis name, size) pairs plus the flat
+    device ids.  Two meshes with the same fingerprint compile to the same
+    program; caching on anything less lets a rebuilt/changed mesh silently
+    reuse programs compiled for the old device set."""
+    axes = tuple((str(a), int(mesh.shape[a])) for a in mesh.axis_names)
+    ids = tuple(int(d.id) for d in np.asarray(mesh.devices).flat)
+    return axes + (("devices",) + ids,)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

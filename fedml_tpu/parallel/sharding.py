@@ -20,15 +20,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 Pytree = Any
 
 
-def param_spec(shape, tp: int) -> P:
-    """PartitionSpec for one parameter under the tp heuristic."""
+def param_spec(shape, tp: int, axis: str = "tp") -> P:
+    """PartitionSpec for one parameter under the largest-divisible-axis
+    heuristic.  ``axis`` names the mesh axis to shard over — ``tp`` for the
+    trainer, ``model`` for the sharded round-update plane."""
     if len(shape) < 2 or tp <= 1:
         return P()
-    axis = int(np.argmax(shape))
-    if shape[axis] % tp != 0:
+    dim = int(np.argmax(shape))
+    if shape[dim] % tp != 0:
         return P()
     spec = [None] * len(shape)
-    spec[axis] = "tp"
+    spec[dim] = axis
     return P(*spec)
 
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 import logging
 import os
 import time
+import warnings
 from functools import partial
 from typing import Any, Dict, List, Tuple
 
@@ -61,7 +62,7 @@ from ...core.schedule import RuntimeEstimator, SeqTrainScheduler
 from ...core.security.fedml_attacker import FedMLAttacker
 from ...core.security.fedml_defender import FedMLDefender
 from ...ml.engine.train import build_local_train, init_variables
-from ...parallel.mesh import create_fl_mesh
+from ...parallel.mesh import create_fl_mesh, create_round_mesh
 from ...utils.metrics import MetricsLogger
 from .algorithms import create_inmesh_algorithm
 
@@ -136,16 +137,29 @@ class XLASimulator:
         if self.agg_plane not in ("host", "compiled"):
             raise ValueError(
                 f"agg_plane must be host|compiled (got {self.agg_plane!r})")
+        from ...core.aggregate import server_state_mode
+
+        self.sharded_state = server_state_mode(args) == "sharded"
         self._model_bytes = int(sum(
             l.size * l.dtype.itemsize
             for l in jax.tree_util.tree_leaves(self.variables)))
         self.packed = bool(getattr(args, "xla_pack", False))
+        if self.sharded_state and (self.packed or self.needs_stack):
+            # the packed streamer and the security tail both carry their own
+            # in-mesh server step on per-client stacks; resharding those onto
+            # the model axis is future work — fail loud over silently
+            # reporting replicated-state results as a sharded run
+            raise NotImplementedError(
+                "server_state=sharded supports the plain in-mesh round only "
+                "(disable xla_pack and security/defense hooks)")
         if self.packed:
             self._build_packed_round_fn()
         else:
             self._build_round_fn()
         if self.needs_stack:
             self._build_security_fn()
+        if self.sharded_state:
+            self._build_server_tail()
 
         self.runtime_estimator = RuntimeEstimator(self.n_dev, uniform_devices=True)
         self.scheduler = SeqTrainScheduler(self.n_dev, estimator=self.runtime_estimator)
@@ -281,6 +295,7 @@ class XLASimulator:
         mesh = self.mesh
         algo = self.algo
         stacked = self.needs_stack
+        sharded = self.sharded_state
         post_train = self._ldp_hook()
         local_train = build_local_train(
             self.module, self.args, self.batch_size, self.padded_n,
@@ -359,13 +374,23 @@ class XLASimulator:
                 # unused acc accumulator — no wasted model-size psum)
                 return mean_loss, outs, ext
             acc = jax.lax.psum(acc, "client")
+            if sharded:
+                # server_state=sharded: the algorithm's server step moves to
+                # the separate model-sharded GSPMD tail program — this
+                # program ends at the reduced accumulator
+                return acc, wsum, ext, mean_loss, outs
             # algorithm server step, replicated — still inside the XLA program
             new_global, new_state = algo.server_update(
                 acc, wsum, ext, variables, server_state
             )
             return new_global, new_state, mean_loss, outs
 
-        out_specs = (P(), P("client"), P()) if stacked else (P(), P(), P(), P("client"))
+        if stacked:
+            out_specs = (P(), P("client"), P())
+        elif sharded:
+            out_specs = (P(), P(), P(), P(), P("client"))
+        else:
+            out_specs = (P(), P(), P(), P("client"))
         self._round_fn = jax.jit(
             shard_map(
                 per_device,
@@ -375,6 +400,54 @@ class XLASimulator:
                 check_vma=False,
             )
         )
+
+    def _build_server_tail(self):
+        """server_state=sharded: the algorithm's server step as its own
+        GSPMD jit program on a ``(client=1, model)`` round mesh.  Global
+        variables and server-optimizer state live between rounds as
+        ``NamedSharding`` arrays partitioned along the ``model`` axis (the
+        :func:`~fedml_tpu.parallel.sharding.param_spec` heuristic picks the
+        largest divisible dim per leaf); the psum'd accumulator is resharded
+        onto the same layout and variables/state/acc buffers are DONATED, so
+        the tail updates the globals in place with no replicated copy.  The
+        training round itself is untouched (client-axis shard_map) — only
+        the memory-bound round tail is model-sharded."""
+        from ...parallel.sharding import param_spec
+
+        devices = list(np.asarray(self.mesh.devices).flat)
+        smp = int(getattr(self.args, "server_model_parallel", 0) or 0)
+        if smp:
+            if smp > len(devices):
+                raise ValueError(
+                    f"server_model_parallel={smp} exceeds the {len(devices)} "
+                    f"mesh devices")
+            devices = devices[:smp]
+        rmesh = create_round_mesh(clients=1, model=len(devices),
+                                  devices=devices)
+        model = int(rmesh.shape["model"])
+        repl = NamedSharding(rmesh, P())
+
+        def shard_of(tree):
+            return jax.tree_util.tree_map(
+                lambda l: NamedSharding(
+                    rmesh, param_spec(tuple(np.shape(l)), model, axis="model")),
+                tree)
+
+        var_sh = shard_of(self.variables)
+        state_sh = shard_of(self.server_state)
+        # the round fn replicates its inputs; when the tail runs on a device
+        # subset its outputs must hop back to the full mesh between rounds
+        self._tail_subset = len(devices) != self.n_dev
+        self._tail_shardings = (var_sh, state_sh, repl)
+        algo = self.algo
+
+        def tail(variables, server_state, acc, wsum, ext):
+            return algo.server_update(acc, wsum, ext, variables, server_state)
+
+        self._server_tail = jax.jit(
+            tail, donate_argnums=(0, 1, 2),
+            in_shardings=(var_sh, state_sh, var_sh, repl, repl),
+            out_shardings=(var_sh, state_sh))
 
     def _build_packed_round_fn(self):
         """Packed ragged round (ml/engine/packed.py): no per-client padding
@@ -856,6 +929,38 @@ class XLASimulator:
                             + tuple(self.x_all.shape[1:]),
                             self.class_num,
                         )
+            elif self.sharded_state:
+                # two programs: the client-axis training round ends at the
+                # psum'd accumulator; the model-sharded GSPMD tail applies
+                # the algorithm's server step on donated resident buffers
+                acc, wsum, ext, mean_loss, outs = self._round_fn(*round_inputs)
+                var_sh, state_sh, repl = self._tail_shardings
+                t_tail = time.time()
+                with obs.span("round.server_update", rsp.ctx,
+                              round_idx=round_idx,
+                              n_clients=int(participated.sum()),
+                              mode="inmesh", policy=type(self.algo).__name__):
+                    with warnings.catch_warnings():
+                        # donation is a no-op on CPU backends; expected there
+                        warnings.filterwarnings(
+                            "ignore",
+                            message="Some donated buffers were not usable")
+                        self.variables, self.server_state = self._server_tail(
+                            jax.device_put(self.variables, var_sh),
+                            jax.device_put(self.server_state, state_sh),
+                            jax.device_put(acc, var_sh),
+                            jax.device_put(wsum, repl),
+                            jax.device_put(ext, repl),
+                        )
+                    jax.block_until_ready(self.variables)
+                obs.histogram_observe(
+                    "server_opt.step_seconds", time.time() - t_tail,
+                    labels={"policy": type(self.algo).__name__,
+                            "mode": "inmesh"})
+                if self._tail_subset:
+                    full = NamedSharding(self.mesh, P())
+                    self.variables = jax.device_put(self.variables, full)
+                    self.server_state = jax.device_put(self.server_state, full)
             else:
                 self.variables, self.server_state, mean_loss, outs = self._round_fn(
                     *round_inputs

@@ -1,0 +1,32 @@
+"""Golden fixture: host server-optimizer round tail (expected: 2 findings).
+
+Line 17 — agg-server-opt-host: pseudo-gradient tree_map in the same
+function as the optax apply.
+Line 24 — agg-server-opt-host: same pattern built inline with optax.adam.
+A pseudo-gradient fold WITHOUT an optax apply (client_delta) is clean —
+plain delta computation is everywhere and not a server-optimizer tail.
+"""
+
+import jax
+import optax
+
+
+def fedopt_round(params, avg, tx, opt_state):
+    # the whole FedOpt tail on the host: exactly what the sharded round
+    # plane (and core/aggregate.host_server_round_update) own now
+    pseudo_grad = jax.tree_util.tree_map(lambda p, a: p - a, params, avg)
+    updates, opt_state = tx.update(pseudo_grad, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state
+
+
+def fedadam_tail(params, avg, opt_state):
+    tx = optax.adam(0.1)
+    grad = jax.tree_util.tree_map(lambda p, a: p - a, params, avg)
+    upd, opt_state = tx.update(grad, opt_state, params)
+    new = optax.apply_updates(params, upd)
+    return new, opt_state
+
+
+def client_delta(new_params, old_params):
+    # clean: a plain delta, no optimizer step in this function
+    return jax.tree_util.tree_map(lambda a, b: a - b, new_params, old_params)

@@ -715,6 +715,38 @@ def test_server_kill_under_client_chaos_bit_identical(fault_free_final_model,
     assert stats[3]["faults_duplicated"] >= 1
 
 
+def test_server_kill_sharded_state_bit_identical(tmp_path):
+    """server_state=sharded crash leg: the server is killed in ROUND 1 —
+    after round 0's FedOpt/adam step, so the round-1 snapshot carries the
+    model-sharded server-optimizer state (first/second moments) — and the
+    restarted incarnation must restore it bit-identically: the final model
+    matches a fault-free sharded run exactly, with exactly-once report
+    accounting.  A round-0 kill would never exercise the optimizer-state
+    restore (the round plane is only built at the first aggregate)."""
+    knobs = {"server_state": "sharded", "federated_optimizer": "FedOpt",
+             "server_optimizer": "adam"}
+    LoopbackHub.reset()
+    history, ref_final, _ = _run_chaos_topology(
+        "sharded-base", knobs={**_CHAOS_KNOBS, **knobs})
+    assert len(history) == 2
+    LoopbackHub.reset()
+    plan = {"seed": 7, "rules": [
+        {"kind": "server_kill", "direction": "recv", "receiver": 0,
+         "msg_type": 3, "round": 1, "after": 1, "times": 1}]}
+    history, final, stats, restarts, killed_stats, server = (
+        _run_server_kill_topology("sharded-kill", tmp_path / "srv",
+                                  fault_plan=plan, knobs=knobs))
+    assert restarts >= 1
+    assert len(history) == 2
+    assert _trees_bit_identical(final, ref_final), \
+        "sharded-state restart diverged from the fault-free sharded run"
+    assert sum(s.get("faults_killed", 0) for s in killed_stats) >= 1
+    assert stats[0]["server_restores"] >= 1
+    # exactly-once accounting across the kill + journal replay
+    reg = server.server_manager.population.registry.snapshot()
+    assert reg["reported_total"] == 3 * 2, reg
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("backend", ["TRPC", "GRPC", "MQTT_S3"])
 def test_server_kill_restart_all_backends(backend, fault_free_final_model,

@@ -71,6 +71,32 @@ def test_purity_fixture_exact_findings():
     ]
 
 
+def test_server_opt_fixture_exact_findings():
+    """The sharded-server-state satellite: a pseudo-gradient tree_map in
+    the same function as an optax apply is a host server-optimizer round
+    tail — those belong to core/aggregate.host_server_round_update or the
+    sharded round plane.  A bare delta fold (client_delta) stays clean."""
+    assert _lint_fixture("agg_server_opt.py") == [
+        (17, "agg-server-opt-host"),
+        (24, "agg-server-opt-host"),
+    ]
+
+
+def test_server_opt_seams_are_exempt():
+    """The rule's seam list: the sp/fedopt reference, the round plane, and
+    the in-mesh strategies may spell the tail; everyone else may not."""
+    from fedml_tpu.core.analysis.passes.legacy import AggAnalyzer
+
+    a = AggAnalyzer()
+    src_path = os.path.join(FIXTURES, "agg_server_opt.py")
+    text = open(src_path).read()
+    for seam in ("fedml_tpu/simulation/sp/fedopt/fedopt_api.py",
+                 "fedml_tpu/parallel/agg_plane.py",
+                 "fedml_tpu/simulation/xla/algorithms.py"):
+        src = analysis.SourceFile(os.path.join(REPO_ROOT, seam), text=text)
+        assert a._server_opt_findings(src) == []
+
+
 def test_alias_dodge_fixture_exact_findings():
     """The satellite regression: aliased imports (``from os import fsync as
     f``, ``import msgpack as mp``, ``import numpy.random as nr``) were
@@ -240,7 +266,7 @@ def test_cli_json_schema_is_stable():
         "suppressed",
         "version",
     ]
-    assert report["counts"]["findings"] == len(report["findings"]) == 11
+    assert report["counts"]["findings"] == len(report["findings"]) == 13
     first = report["findings"][0]
     assert sorted(first.keys()) >= ["analyzer", "line", "message", "path", "rule", "source"]
     assert {f["rule"] for f in report["findings"]} >= {

@@ -17,7 +17,10 @@ every traced round still one closed span tree) AND the telemetry-plane
 chaos tests (``tests/test_telemetry.py`` — drop/dup/delay/server_kill
 with ``obs_telemetry=1`` must converge bit-identical to the
 telemetry-off run, with the remote spans grafted and the seq gap/dup
-accounting exact) N consecutive times in
+accounting exact) AND the sharded-server-state chaos leg
+(``tests/test_fault_tolerance.py -k sharded_state`` — a server kill
+AFTER the first FedOpt round with ``server_state=sharded`` must restore
+the model-sharded optimizer state bit-identically) N consecutive times in
 fresh interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
 operators stop believing red — so new fault kinds / backends must hold up
@@ -49,6 +52,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "async_fl"
     python tools/chaos_check.py --runs 3 -k "ingest"
     python tools/chaos_check.py --runs 3 -k "telemetry"
+    python tools/chaos_check.py --runs 3 -k "sharded_state"
     python tools/chaos_check.py --runs 3 --skip-perf-gate
     python tools/chaos_check.py --runs 3 --skip-fedlint
 """
@@ -114,10 +118,10 @@ def main(argv=None) -> int:
     ap.add_argument(
         "-k", dest="keyword",
         default="chaos or server_kill or trace_integrity or agg_plane "
-                "or async_fl or ingest or telemetry",
+                "or async_fl or ingest or telemetry or sharded_state",
         help='pytest -k selector (default: "chaos or server_kill or '
              'trace_integrity or agg_plane or async_fl or ingest or '
-             'telemetry")')
+             'telemetry or sharded_state")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     ap.add_argument("--skip-perf-gate", action="store_true",

@@ -53,7 +53,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH_SCHEMA_CURRENT = 2
 
 # higher-is-better relative keys banded against the prior-round median
-RELATIVE_KEYS = ("vs_baseline", "agg_speedup", "uploads_per_s",
+RELATIVE_KEYS = ("vs_baseline", "agg_speedup", "round_update_speedup",
+                 "broadcast_shrink", "uploads_per_s",
                  "uploads_per_s_host", "uploads_per_s_pipelined",
                  "async_flushes_per_s", "async_deltas_per_s",
                  "telemetry_rounds_per_s")
