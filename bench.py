@@ -408,6 +408,7 @@ def _main() -> int | None:
     out.update(_measure_telemetry_overhead())
     out.update(_measure_agg_step())
     out.update(_measure_round_update())
+    out.update(_measure_remesh())
     out.update(_measure_upload_saturation())
     out.update(_measure_async_throughput())
     if os.environ.get("BENCH_SP"):
@@ -552,6 +553,49 @@ def _measure_round_update() -> dict:
         }
     except Exception as e:
         print(f"round update measurement failed: {e}", file=sys.stderr)
+        return {}
+
+
+def _measure_remesh() -> dict:
+    """The elastic-resize keys (PR 16): total downtime of an in-place
+    ``ShardedRoundPlane.remesh`` — host-gather the resident params +
+    optimizer state, re-shard onto a mesh with half the model axis, and
+    warm-recompile the round program — plus the recompile slice alone.
+    Lower is better (banded as ceilings in tools/perf_gate.py).  Emitted
+    on BOTH the full-TPU and CPU-degraded metric lines; failures degrade
+    to empty keys."""
+    import numpy as np
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from fedml_tpu.parallel.agg_plane import ShardedRoundPlane
+        from fedml_tpu.parallel.mesh import create_round_mesh
+
+        devs = jax.devices()
+        model = max(2, 1 << (len(devs).bit_length() - 1))  # largest pow2
+        mesh_a = create_round_mesh(clients=1, model=model,
+                                   devices=devs[:model])
+        mesh_b = create_round_mesh(clients=1, model=max(1, model // 2),
+                                   devices=devs[:max(1, model // 2)])
+        n = int(os.environ.get("BENCH_AGG_CLIENTS", "32"))
+        updates = _synthetic_updates(n)
+        rng = np.random.default_rng(7)
+        params = {k: jnp.asarray(rng.standard_normal(np.shape(v)), jnp.float32)
+                  for k, v in updates[0][1].items()}
+        plane = ShardedRoundPlane(policy=("adam", 0.1, 0.9), mesh=mesh_a)
+        plane.round_update(params, updates)  # resident state + program
+        info = plane.remesh(mesh_b)
+        if not (info and info.get("changed")):
+            return {}
+        return {
+            "resize_downtime_s": round(float(info["seconds"]), 6),
+            "remesh_recompile_s": round(float(info["recompile_s"]), 6),
+            "remesh_reshard_bytes": int(info["reshard_bytes"]),
+        }
+    except Exception as e:
+        print(f"remesh measurement failed: {e}", file=sys.stderr)
         return {}
 
 
@@ -777,6 +821,7 @@ def _run_degraded(reason: str) -> int:
     out.update(agg)
     out["value"] = agg.get("agg_step_compiled_s", None)
     out.update(_measure_round_update())
+    out.update(_measure_remesh())
     out.update(_measure_upload_saturation())
     out.update(_measure_async_throughput())
     out.update(_measure_telemetry_overhead())

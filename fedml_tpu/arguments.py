@@ -197,7 +197,15 @@ Aggregation-plane knobs (``train_args``; consumed by
   host path in f32 mode.
 * ``server_model_parallel`` (int >= 1, default 0 = all devices) — size of
   the round mesh's model axis (the XLA simulator splits its device set
-  into client x model with this).
+  into client x model with this).  When the live device count can no
+  longer satisfy the request (device loss, shrunken restart) the mesh
+  degrades to a replicated model=1 layout instead of refusing to serve
+  (docs/ELASTICITY.md).
+* ``remesh_max_retries`` (int >= 1, default 3) / ``remesh_backoff_s``
+  (float >= 0, default 0.05) — retry/backoff for the elastic resume
+  handshake: each attempt re-enumerates the live devices before
+  re-sharding, so a topology change racing the remesh settles instead of
+  failing the round.
 * ``broadcast_shards`` (int >= 1, default 1) — number of addressable
   slices the new global params are split into for shard-addressable
   broadcast; each slice is memoized per round as its own
@@ -590,7 +598,8 @@ class Arguments:
                     f"server_state must be one of {SERVER_STATES} "
                     f"(got {state!r})")
         for knob, floor in (("server_model_parallel", 0),
-                            ("broadcast_shards", 1)):
+                            ("broadcast_shards", 1),
+                            ("remesh_max_retries", 1)):
             v = getattr(self, knob, None)
             if v is None:
                 continue

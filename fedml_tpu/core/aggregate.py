@@ -306,6 +306,37 @@ class ServerRoundUpdater:
 
     def restore_state(self, params_tree, state):
         """Install ``params_tree`` then overwrite leaves + optimizer state
-        from a snapshot, bit-identically."""
+        from a snapshot, bit-identically.  The plane was built over the
+        CURRENT live topology, so a snapshot taken on a different mesh
+        re-shards onto this one through the portable codec."""
         self.plane.install(params_tree)
         self.plane.load_state(state)
+
+    def mesh_key(self):
+        """Fingerprint of the plane's mesh, or None before the plane
+        exists (nothing resident — nothing to re-shard)."""
+        return self._plane.mesh_key if self._plane is not None else None
+
+    def remesh(self, devices=None):
+        """Rebuild the round mesh from the currently-live devices and move
+        the resident state onto it through the portable snapshot codec.
+        Retries with exponential backoff (``remesh_max_retries`` /
+        ``remesh_backoff_s`` knobs, defaults 3 / 0.05s) — device
+        enumeration during an in-progress topology change can be
+        transiently inconsistent, and each retry re-enumerates.  Returns
+        the plane's stats dict, or None when no state is resident yet (the
+        next round lazily builds on the live mesh anyway)."""
+        if self._plane is None:
+            return None
+        from ..parallel.agg_plane import round_mesh_for
+        retries = max(1, int(getattr(self.args, "remesh_max_retries", 3) or 1))
+        backoff = float(getattr(self.args, "remesh_backoff_s", 0.05) or 0.0)
+        last_err = None
+        for attempt in range(retries):
+            try:
+                return self.plane.remesh(round_mesh_for(self.args, devices))
+            except Exception as e:  # noqa: BLE001 — retried, then re-raised
+                last_err = e
+                if attempt + 1 < retries and backoff > 0:
+                    time.sleep(backoff * (2 ** attempt))
+        raise last_err

@@ -1,5 +1,5 @@
 """Built-in fedlint passes: the four ported lint contracts plus the race,
-ack-ordering, and purity analyzers."""
+ack-ordering, purity, and mesh-staleness analyzers."""
 
 from __future__ import annotations
 
@@ -8,13 +8,14 @@ from typing import List
 from ..framework import Analyzer
 from .ack_order import AckDurabilityAnalyzer
 from .legacy import AggAnalyzer, ObsAnalyzer, PerfAnalyzer, RngAnalyzer
+from .meshguard import MeshStaleProgramAnalyzer
 from .purity import PurityAnalyzer
 from .races import ThreadOwnershipAnalyzer
 
 __all__ = [
-    "AckDurabilityAnalyzer", "AggAnalyzer", "ObsAnalyzer", "PerfAnalyzer",
-    "PurityAnalyzer", "RngAnalyzer", "ThreadOwnershipAnalyzer",
-    "build_analyzers",
+    "AckDurabilityAnalyzer", "AggAnalyzer", "MeshStaleProgramAnalyzer",
+    "ObsAnalyzer", "PerfAnalyzer", "PurityAnalyzer", "RngAnalyzer",
+    "ThreadOwnershipAnalyzer", "build_analyzers",
 ]
 
 
@@ -28,4 +29,5 @@ def build_analyzers() -> List[Analyzer]:
         ThreadOwnershipAnalyzer(),
         AckDurabilityAnalyzer(),
         PurityAnalyzer(),
+        MeshStaleProgramAnalyzer(),
     ]

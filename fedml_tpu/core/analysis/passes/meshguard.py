@@ -1,0 +1,137 @@
+"""Mesh-staleness detector for compiled-program caches.
+
+An elastic resize (``ShardedRoundPlane.remesh``) re-shards every resident
+buffer onto a new device mesh.  A compiled XLA program is specialized to
+the shardings it was lowered with — executing a cached program against
+re-sharded buffers is at best a silent full re-layout and at worst a
+wrong-devices crash mid-round.  The aggregation plane's contract is that
+every program-cache key BEGINS with the mesh fingerprint
+(``self.mesh_key`` / ``mesh_fingerprint(...)``), so a resize re-keys
+every lookup and a stale program can never be fetched.
+
+This pass pins that contract statically:
+
+* ``mesh-stale-program`` — a read from a program/plane cache (an
+  ``X.get(...)`` call or an ``X[...]`` subscript load where ``X``'s
+  terminal name looks like a compiled-object cache: ``_programs``,
+  ``_ROUND_PROGRAMS``, ``_PLANES``, ...) inside a scope whose lexical
+  function chain never references ``mesh_key`` or ``mesh_fingerprint``.
+  The fetch site itself need not hash the mesh — building the key from
+  ``self.mesh_key`` anywhere in the enclosing function is what the rule
+  checks for — but a function that fetches compiled state with no mesh
+  identity in sight is exactly the bug class a resize turns into a
+  crash.
+
+Cache-name scope is deliberately narrow (names ending in ``programs`` /
+``planes``, case-insensitive, optional leading underscore): the rule
+exists for compiled-executable caches, not every dict in the tree.
+Writes (``X[k] = v``) and non-fetch methods (``.clear()``, ``.pop()``)
+are not reads and are not flagged.  Pragmas require a justification —
+a cache read that is provably mesh-invariant must say why.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set, Tuple
+
+from ..framework import Analyzer, Finding, Rule, SourceFile
+
+# terminal names that denote a compiled-program / plane cache
+_CACHE_NAME = re.compile(r"(?i)^_?[a-z0-9_]*(program|plane)s$")
+
+# identifiers that carry mesh identity into a cache key
+_MESH_TOKENS = frozenset({"mesh_key", "mesh_fingerprint"})
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost identifier of a Name / Attribute chain, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _ScopeWalker(ast.NodeVisitor):
+    """Collects cache reads with their lexical function chain, and per-scope
+    mesh-identity references.  Scope 0 is the module; each nested function
+    pushes a new scope id so a read inside a closure is cleared by a mesh
+    reference in ANY enclosing function (the key is often built outside the
+    closure that performs the fetch)."""
+
+    def __init__(self):
+        self._stack: List[int] = [0]
+        self._next_id = 1
+        self.mesh_scopes: Set[int] = set()
+        # (lineno, cache_name, scope chain at the read)
+        self.reads: List[Tuple[int, str, Tuple[int, ...]]] = []
+
+    def _enter_function(self, node: ast.AST):
+        sid = self._next_id
+        self._next_id += 1
+        self._stack.append(sid)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _enter_function
+    visit_AsyncFunctionDef = _enter_function
+
+    def _note_mesh(self, name: Optional[str]):
+        if name in _MESH_TOKENS:
+            self.mesh_scopes.add(self._stack[-1])
+
+    def visit_Name(self, node: ast.Name):
+        self._note_mesh(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        self._note_mesh(node.attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if (isinstance(func, ast.Attribute) and func.attr == "get"):
+            cache = _terminal_name(func.value)
+            if cache is not None and _CACHE_NAME.match(cache):
+                self.reads.append(
+                    (node.lineno, cache, tuple(self._stack)))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if isinstance(node.ctx, ast.Load):
+            cache = _terminal_name(node.value)
+            if cache is not None and _CACHE_NAME.match(cache):
+                self.reads.append(
+                    (node.lineno, cache, tuple(self._stack)))
+        self.generic_visit(node)
+
+
+class MeshStaleProgramAnalyzer(Analyzer):
+    """Flags compiled-program cache reads whose enclosing scope never
+    references the mesh fingerprint."""
+
+    name = "meshguard"
+    rules = (
+        Rule("mesh-stale-program",
+             "compiled-program cache read not keyed on the mesh fingerprint",
+             requires_justification=True, order=0),
+    )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if src.tree is None:
+            return []
+        walker = _ScopeWalker()
+        walker.visit(src.tree)
+        findings: List[Finding] = []
+        for lineno, cache, chain in walker.reads:
+            if any(sid in walker.mesh_scopes for sid in chain):
+                continue
+            findings.append(self.finding(
+                self.rules[0], src, lineno,
+                f"read from compiled cache '{cache}' in a scope with no "
+                "mesh_key/mesh_fingerprint reference — a remesh would "
+                "serve a stale program here"))
+        findings.sort(key=Finding.sort_key)
+        return findings

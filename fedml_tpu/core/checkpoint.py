@@ -693,11 +693,62 @@ class ServerRecoveryMixin:
             "bad_tail=%d", self.server_epoch, round_idx,
             self.client_id_list_in_this_round, replayed, bad_tail)
 
+    def _server_round_updater(self) -> Optional[Any]:
+        """The sharded ``ServerRoundUpdater`` behind this manager's
+        aggregator, or None for replicated runs.  Covers both stacks:
+        cross_device's ``FedMLAggregator`` owns ``round_updater`` directly,
+        cross_silo's wraps the ServerAggregator hook object that owns it."""
+        agg = getattr(self, "aggregator", None)
+        for obj in (agg, getattr(agg, "aggregator", None)):
+            upd = getattr(obj, "round_updater", None)
+            if upd is not None:
+                return upd
+        return None
+
+    def maybe_remesh(self) -> bool:
+        """Round-boundary elastic check: when the live device set no longer
+        matches the round plane's mesh (device loss, pod grow, operator
+        resize), re-shard the resident server state onto a mesh rebuilt
+        from the surviving devices and bump the incarnation epoch.
+        In-flight uploads from the old epoch flow through the same
+        journal/dedup machinery as a crash recovery — re-deliveries are
+        discarded by ``_journal_upload``, never double-counted.  Called at
+        every round open (``_save_round_start``); no-op for replicated
+        runs and for an unchanged topology."""
+        updater = self._server_round_updater()
+        if updater is None or updater.mesh_key() is None:
+            return False
+        try:
+            from ..parallel.agg_plane import round_mesh_for
+            from ..parallel.mesh import mesh_fingerprint
+            live = mesh_fingerprint(round_mesh_for(self.args))
+        except Exception:  # a broken probe must not take the round down
+            logger.exception("maybe_remesh: live-mesh probe failed")
+            return False
+        if live == updater.mesh_key():
+            return False
+        info = updater.remesh()
+        if not (info and info.get("changed")):
+            return False
+        self.server_epoch = int(getattr(self, "server_epoch", 0)) + 1
+        node = getattr(self, "rank", 0)
+        self._comm_stats.inc("epoch_bumps")
+        obs.span_event("epoch_bump", round_idx=int(self.args.round_idx),
+                       node=node, epoch=self.server_epoch, reason="remesh")
+        logger.warning(
+            "elastic remesh at round %d: %s -> %s (epoch=%d, %d bytes "
+            "resharded, recompile %.3fs)", int(self.args.round_idx),
+            info["old"], info["new"], self.server_epoch,
+            info["reshard_bytes"], info["recompile_s"])
+        return True
+
     def _save_round_start(self) -> None:
         """Persist the round-open snapshot; also resets the per-round upload
         dedup set (kept even with persistence off — a same-round re-upload
-        must never double-count)."""
+        must never double-count).  The elastic check runs first, so the
+        snapshot captures the post-resize state and epoch."""
         self._uploads_this_round = set()
+        self.maybe_remesh()
         if self._store is None:
             return
         state = {

@@ -21,7 +21,8 @@ Fault-plan schema (dict / YAML ``fault_args`` section)::
       seed: 0                      # seeds per-rule probability draws
       rules:
         - kind: drop               # drop|delay|duplicate|reset|partition|
-                                   #   server_kill
+                                   #   server_kill|mesh_shrink|mesh_grow|
+                                   #   device_loss
           direction: send          # send (default) or recv
           sender: 1                # int or list; omit = any
           receiver: 0              # int or list; omit = any
@@ -33,6 +34,10 @@ Fault-plan schema (dict / YAML ``fault_args`` section)::
                                    #   partition defaults to forever)
           p: 1.0                   # probability, seeded & per-rule
           delay_s: 0.05            # kind=delay only
+          keep: 2                  # mesh_shrink/mesh_grow only: device count
+                                   #   to keep (shrink defaults to half,
+                                   #   grow to full visibility)
+          lose: 1                  # device_loss only: devices lost
 
 Kinds:
 
@@ -52,6 +57,15 @@ Kinds:
   incarnation.  Scope it ``direction: recv, receiver: <server rank>`` to
   kill the server at an exact point mid-round (e.g. between two uploads);
   ``kill_event`` lets a test harness observe the crash.
+* ``mesh_shrink`` / ``mesh_grow`` / ``device_loss`` — *topology* faults:
+  the triggering message is forwarded unchanged, but the deterministic
+  device-visibility shim (:func:`fedml_tpu.parallel.mesh.set_visible_devices`)
+  is mutated — ``mesh_shrink`` keeps the first ``keep`` live devices
+  (default half), ``device_loss`` removes ``lose`` (default 1) from the
+  tail, ``mesh_grow`` restores visibility up to ``keep`` (default all).
+  The server observes the change at its next round boundary
+  (``maybe_remesh``) or when a restarted incarnation rebuilds its mesh;
+  ``device_loss`` also triggers a flight-recorder dump.
 
 Determinism: rules match by *occurrence count within their scope*
 (``after``/``times``), not wall-clock, so the same plan injects the same
@@ -73,7 +87,11 @@ from .communication.message import Message
 
 logger = logging.getLogger(__name__)
 
-FAULT_KINDS = ("drop", "delay", "duplicate", "reset", "partition", "server_kill")
+FAULT_KINDS = ("drop", "delay", "duplicate", "reset", "partition",
+               "server_kill", "mesh_shrink", "mesh_grow", "device_loss")
+
+#: topology fault kinds: they mutate device visibility, never the message
+_TOPOLOGY_KINDS = ("mesh_shrink", "mesh_grow", "device_loss")
 
 # local pseudo-messages a backend synthesizes for itself are never faulted
 _EXEMPT_TYPES = ("connection_ready",)
@@ -93,7 +111,8 @@ class CommStats:
         "messages_sent", "retries", "retransmits", "delivery_failures",
         "acks_sent", "acks_received", "dup_dropped",
         "faults_dropped", "faults_delayed", "faults_duplicated",
-        "faults_reset", "faults_killed", "reconnects", "rejoins",
+        "faults_reset", "faults_killed", "faults_topology",
+        "reconnects", "rejoins",
         # server crash-recovery counters (core/checkpoint.ServerRecoveryMixin)
         "server_restores", "journal_replays", "epoch_bumps",
         "dup_uploads_discarded",
@@ -153,6 +172,9 @@ class FaultRule:
         self.times = None if times is None else int(times)
         self.p = float(spec.get("p", 1.0))
         self.delay_s = float(spec.get("delay_s", 0.05))
+        keep = spec.get("keep")
+        self.keep = None if keep is None else int(keep)
+        self.lose = int(spec.get("lose", 1))
 
     def matches_scope(self, direction: str, msg: Message) -> bool:
         if direction != self.direction:
@@ -283,8 +305,43 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
         except Exception:  # pragma: no cover - observability is non-fatal
             pass
 
+    def _topology_fault(self, kind: str, rule: FaultRule, msg: Message) -> None:
+        """Mutate the deterministic device-visibility shim: break hardware,
+        not traffic.  The server notices at its next round boundary
+        (``maybe_remesh``) or when a restarted incarnation rebuilds its
+        round mesh over the surviving devices."""
+        import jax
+
+        from ...parallel.mesh import set_visible_devices, visible_devices
+        every = list(jax.devices())
+        cur = visible_devices(every)
+        if kind == "mesh_grow":
+            target = every if rule.keep is None else every[:max(1, rule.keep)]
+        elif kind == "mesh_shrink":
+            keep = rule.keep if rule.keep else max(1, len(cur) // 2)
+            target = cur[:max(1, keep)]
+        else:  # device_loss
+            target = cur[:max(1, len(cur) - max(1, rule.lose))]
+        lost = max(0, len(cur) - len(target))
+        set_visible_devices([d.id for d in target])
+        self._stats.inc("faults_topology")
+        if lost:
+            obs.counter_inc("mesh.devices_lost_total", lost)
+        # "device_loss" is a flight-recorder dump trigger (obs.flight)
+        self._fault_event(kind, msg, rule=rule.index,
+                          devices_before=len(cur), devices_after=len(target))
+        logger.warning(
+            "FAULT %s: device visibility %d -> %d (rule %d); triggering "
+            "message %s %s->%s forwarded unchanged", kind, len(cur),
+            len(target), rule.index, msg.get_type(), msg.get_sender_id(),
+            msg.get_receiver_id())
+
     def _apply(self, rule: FaultRule, msg: Message, forward, direction: str) -> None:
         kind = rule.kind
+        if kind in _TOPOLOGY_KINDS:
+            self._topology_fault(kind, rule, msg)
+            forward(msg)
+            return
         if kind == "server_kill":
             self._stats.inc("faults_killed")
             self._fault_event("server_kill", msg, rule=rule.index)

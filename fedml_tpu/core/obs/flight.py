@@ -38,7 +38,9 @@ from typing import Any, Dict, List, Optional, Tuple
 DEFAULT_FLIGHT_CAPACITY = 2048
 
 # span events that trigger an automatic dump when seen on the emit tap
-DUMP_EVENTS = ("server_kill", "server_restore", "slow_round")
+# (device_loss: the elastic topology fault — the ring around a lost chip is
+# exactly the forensic window a remesh post-mortem needs)
+DUMP_EVENTS = ("server_kill", "server_restore", "slow_round", "device_loss")
 
 # hard cap on dumps per recorder: a slow-round storm must not turn the
 # flight recorder into a disk-filling firehose

@@ -59,7 +59,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import obs
 from ..core.aggregate import flatten_checked, leaf_paths, opt_leaf_indices
 from ..core.obs.trace import NULL_SPAN
-from .mesh import create_mesh, create_round_mesh, mesh_fingerprint
+from .mesh import (create_mesh, create_round_mesh, mesh_fingerprint,
+                   visible_devices)
 from .sharding import param_spec
 
 logger = logging.getLogger(__name__)
@@ -81,7 +82,7 @@ def default_agg_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """1-D ``tp`` mesh over all devices: each device owns one shard of every
     (divisible) parameter and reduces only that shard — the weight-update
     analogue of data-parallel replicas splitting the update step."""
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else visible_devices())
     return create_mesh((len(devices),), ("tp",), devices)
 
 
@@ -91,7 +92,7 @@ def default_round_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     fold stays sequential for bit-exactness — while every device owns a
     model shard of the global params, the optimizer state, and the update
     step (the XLA simulator widens the client axis for in-mesh cohorts)."""
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else visible_devices())
     return create_round_mesh(clients=1, model=len(devices), devices=devices)
 
 
@@ -495,6 +496,10 @@ class ShardedRoundPlane(CompiledAggPlane):
         self._opt_idx: Tuple[int, ...] = ()
         self._opt_state: Any = ()
         self._last_out: Any = None
+        # (upd_dtypes, k, mode, fused) of the most recent round — remesh()
+        # pre-warms the same program on the new mesh so the first post-resize
+        # round pays device transfer, not a cold compile
+        self._last_prog_args: Optional[Tuple] = None
 
     # -- resident state ------------------------------------------------------
     def install(self, params_tree: Pytree) -> None:
@@ -705,6 +710,7 @@ class ShardedRoundPlane(CompiledAggPlane):
         upd_dtypes = tuple(jnp.dtype(jnp.result_type(l))
                            for l in leaves_list[0])
         k = self.microbatch_clients or n
+        self._last_prog_args = (upd_dtypes, k, mode, k >= n)
         parent = obs_parent if obs_parent is not None else obs.active_ctx()
         sp = (obs.span("round.server_update", parent, n_clients=n, k=k,
                        mode=mode, policy=self.policy[0])
@@ -776,10 +782,13 @@ class ShardedRoundPlane(CompiledAggPlane):
 
     # -- snapshot / restore --------------------------------------------------
     def export_state(self) -> Optional[Dict[str, Any]]:
-        """Numpy snapshot of the resident server state (None before
-        install): param leaves in flatten order plus the optimizer state
-        rendered through flax's state-dict codec — msgpack-safe and
-        bit-identical through a save/load round trip."""
+        """Mesh-portable numpy snapshot of the resident server state (None
+        before install): param leaves host-gathered in flatten order (the
+        canonical layout — no mesh shape survives into the snapshot), the
+        optimizer state rendered through flax's state-dict codec, and a
+        ``manifest`` (leaf paths / shapes / dtypes plus the source mesh
+        fingerprint, informational) so :meth:`load_state` can validate the
+        snapshot against ANY target mesh before touching devices."""
         if not self.installed:
             return None
         from flax import serialization
@@ -788,15 +797,54 @@ class ShardedRoundPlane(CompiledAggPlane):
             "leaves": [np.asarray(x) for x in self._param_leaves],
             "opt": serialization.to_state_dict(jax.tree_util.tree_map(
                 np.asarray, self._opt_state)),
+            "manifest": {
+                "version": 1,
+                "mesh": [list(part) for part in self.mesh_key],
+                "names": list(leaf_paths(self._treedef)),
+                "shapes": [list(int(d) for d in sh) for sh in self._shapes],
+                "dtypes": [str(jnp.dtype(np.asarray(x).dtype))
+                           for x in self._param_leaves],
+            },
         }
 
+    def _check_manifest(self, manifest: Dict[str, Any]) -> None:
+        """Snapshot/installed-params compatibility: same leaf paths, same
+        shapes.  The manifest's mesh fingerprint is deliberately NOT
+        checked — mesh portability is the point — and dtypes are carried
+        for diagnostics only (``load_state`` adopts the snapshot's)."""
+        names = tuple(leaf_paths(self._treedef))
+        m_names = tuple(str(x) for x in manifest.get("names", ()))
+        if m_names and m_names != names:
+            diff = next((f"{a!r} vs {b!r}" for a, b in zip(m_names, names)
+                         if a != b), f"{len(m_names)} vs {len(names)} leaves")
+            raise ValueError(
+                f"snapshot param tree differs from installed params ({diff})")
+        m_shapes = tuple(tuple(int(d) for d in sh)
+                         for sh in manifest.get("shapes", ()))
+        if m_shapes and m_shapes != tuple(self._shapes):
+            bad = next((i for i, (a, b) in enumerate(
+                zip(m_shapes, self._shapes)) if a != b), None)
+            where = (f"leaf {names[bad]!r}: {m_shapes[bad]} vs "
+                     f"{tuple(self._shapes[bad])}" if bad is not None
+                     else f"{len(m_shapes)} vs {len(self._shapes)} leaves")
+            raise ValueError(
+                f"snapshot leaf shapes differ from installed params ({where})")
+
     def load_state(self, state: Dict[str, Any]) -> None:
-        """Inverse of :meth:`export_state`: requires ``install`` first (the
-        treedef/shardings come from the installed params), then overwrites
-        the resident leaves and optimizer state bit-identically."""
+        """Inverse of :meth:`export_state`, onto ANY mesh: requires
+        ``install`` first (the treedef and the param shardings come from the
+        installed params on the CURRENT mesh), validates the manifest when
+        the snapshot carries one, then overwrites the resident leaves and
+        optimizer state bit-identically — the optimizer state is re-sharded
+        with the same per-leaf model-axis layout the round programs commit
+        to, so a snapshot taken on mesh A resumes on mesh B without a
+        relayout inside the first round."""
         if not self.installed:
             raise ValueError("install() the global params before load_state")
         from flax import serialization
+        manifest = state.get("manifest")
+        if manifest:
+            self._check_manifest(manifest)
         leaves = [np.asarray(l) for l in state["leaves"]]
         if len(leaves) != len(self._param_leaves):
             raise ValueError(
@@ -807,8 +855,75 @@ class ShardedRoundPlane(CompiledAggPlane):
         if self._tx is not None:
             restored = serialization.from_state_dict(
                 self._opt_state, state["opt"])
-            self._opt_state = jax.device_put(restored)
+            restored = jax.tree_util.tree_map(np.asarray, restored)
+            model = int(self.mesh.shape.get(self.axis, 1))
+            opt_sh = jax.tree_util.tree_map(
+                lambda l: NamedSharding(
+                    self.mesh,
+                    param_spec(tuple(np.shape(l)), model, axis=self.axis)),
+                restored)
+            self._opt_state = jax.device_put(restored, opt_sh)
         self._last_out = None
+
+    # -- elastic resize ------------------------------------------------------
+    def remesh(self, new_mesh: Mesh, warm: bool = True) -> Dict[str, Any]:
+        """Move the resident server state onto ``new_mesh`` (grow, shrink,
+        or relayout) through the portable snapshot codec: host-gather,
+        rebuild the shardings on the new mesh, re-place bit-identically.
+        ``mesh_key`` is updated first thing after the gather, so every
+        program-cache signature re-keys and a program compiled for the old
+        topology can never execute against the resharded buffers.  With
+        ``warm`` the most recent round program is recompiled eagerly so the
+        first post-resize round does not pay a cold compile.  Returns a
+        stats dict (``changed``/``old``/``new``/``reshard_bytes``/
+        ``recompile_s``/``seconds``)."""
+        new_key = mesh_fingerprint(new_mesh)
+        if new_key == self.mesh_key:
+            return {"changed": False, "old": self.mesh_key,
+                    "new": new_key, "reshard_bytes": 0,
+                    "recompile_s": 0.0, "seconds": 0.0}
+        old_key = self.mesh_key
+        snap = self.export_state()
+        params_tree = (jax.tree_util.tree_unflatten(
+            self._treedef, [np.asarray(x) for x in self._param_leaves])
+            if self.installed else None)
+        parent = obs.active_ctx()
+        sp = (obs.span("remesh", parent, old_mesh=str(old_key),
+                       new_mesh=str(new_key), policy=self.policy[0])
+              if parent is not None else NULL_SPAN)
+        t0 = time.perf_counter()
+        reshard_bytes = 0
+        recompile_s = 0.0
+        with sp:
+            self.mesh = new_mesh
+            self.mesh_key = new_key
+            self._programs.clear()
+            if snap is not None:
+                self.install(params_tree)
+                self.load_state(snap)
+                reshard_bytes = int(
+                    sum(np.asarray(x).nbytes for x in snap["leaves"])
+                    + sum(np.asarray(l).nbytes for l in
+                          jax.tree_util.tree_leaves(snap["opt"])))
+                if warm and self._last_prog_args is not None:
+                    upd_dtypes, k, mode, fused = self._last_prog_args
+                    t1 = time.perf_counter()
+                    self._round_program_for(upd_dtypes, k, mode, fused,
+                                            parent)
+                    recompile_s = time.perf_counter() - t1
+            seconds = time.perf_counter() - t0
+            sp.end(reshard_bytes=reshard_bytes,
+                   recompile_s=round(recompile_s, 6),
+                   seconds=round(seconds, 6))
+        obs.counter_inc("mesh.resizes_total")
+        obs.histogram_observe("mesh.resize_seconds", seconds)
+        logger.info(
+            "remeshed round plane %s -> %s (%d bytes resharded, "
+            "recompile %.3fs, total %.3fs)", old_key, new_key,
+            reshard_bytes, recompile_s, seconds)
+        return {"changed": True, "old": old_key, "new": new_key,
+                "reshard_bytes": reshard_bytes,
+                "recompile_s": recompile_s, "seconds": seconds}
 
 
 # -- shard-addressable broadcast ----------------------------------------------
@@ -892,11 +1007,39 @@ def plane_for(args: Any) -> CompiledAggPlane:
     return plane
 
 
+def round_mesh_for(args: Any,
+                   devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Round mesh over the currently-LIVE devices, honoring
+    ``server_model_parallel`` with degrade-to-replicate: when the surviving
+    device count cannot satisfy the requested model axis, the mesh falls
+    back to a single-device model axis (fully replicated params) instead of
+    refusing to serve — an elastic server keeps taking rounds on whatever
+    hardware is left and re-shards when capacity returns."""
+    devices = list(devices if devices is not None else visible_devices())
+    smp = int(getattr(args, "server_model_parallel", 0) or 0)
+    if smp <= 0:
+        model = len(devices)
+    elif smp <= len(devices):
+        model = smp
+    else:
+        logger.warning(
+            "server_model_parallel=%d exceeds the %d live device(s); "
+            "degrading to a replicated (model=1) round mesh", smp,
+            len(devices))
+        obs.counter_inc("mesh.degraded_total")
+        model = 1
+    return create_round_mesh(clients=1, model=model, devices=devices)
+
+
 def make_round_plane(args: Any, mesh: Optional[Mesh] = None) -> ShardedRoundPlane:
     """Per-aggregator sharded round plane (NOT process-cached: it holds the
     resident server state, which must never bleed across aggregators; the
-    compiled round programs DO share the process-wide cache)."""
+    compiled round programs DO share the process-wide cache).  Without an
+    explicit mesh the plane is built over the live device set via
+    :func:`round_mesh_for` — a restart after device loss comes up on the
+    shrunken topology and the portable snapshot codec re-shards onto it."""
     wire, k = plane_config(args)
+    mesh = mesh if mesh is not None else round_mesh_for(args)
     return ShardedRoundPlane(mesh=mesh, wire_dtype=wire,
                              microbatch_clients=k, policy=round_policy(args))
 

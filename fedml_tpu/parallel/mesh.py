@@ -19,6 +19,44 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+#: Deterministic device-visibility shim: ``None`` = every device the runtime
+#: reports; otherwise the ids that survive fault injection (``mesh_shrink`` /
+#: ``device_loss``) or precede an elastic restart.  Mesh builders route
+#: through :func:`visible_devices` so a topology change is observed the next
+#: time a mesh is constructed — no process restart required.
+_VISIBLE_IDS: Optional[Tuple[int, ...]] = None
+
+
+def set_visible_devices(ids: Optional[Sequence[int]] = None) -> None:
+    """Restrict (or with ``None`` restore) the device set that
+    :func:`visible_devices` reports.  The shim is process-global and
+    deterministic — fault injection and tests drive elastic topology
+    changes through it instead of needing real chip loss."""
+    global _VISIBLE_IDS
+    if ids is None:
+        _VISIBLE_IDS = None
+        return
+    ids = tuple(sorted({int(i) for i in ids}))
+    if not ids:
+        raise ValueError("visible device set must be non-empty (pass None "
+                         "to restore full visibility)")
+    _VISIBLE_IDS = ids
+
+
+def visible_devices(
+        devices: Optional[Sequence[jax.Device]] = None) -> list:
+    """The currently-live devices: ``devices`` (default ``jax.devices()``)
+    filtered through :func:`set_visible_devices`.  Falls back to the first
+    device when the visible set and the runtime's devices are disjoint —
+    a server with one chip left degrades, it does not crash."""
+    devices = list(devices if devices is not None else jax.devices())
+    if _VISIBLE_IDS is None:
+        return devices
+    allowed = set(_VISIBLE_IDS)
+    vis = [d for d in devices if int(d.id) in allowed]
+    return vis if vis else devices[:1]
+
+
 def create_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())

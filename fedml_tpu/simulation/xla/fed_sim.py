@@ -418,9 +418,15 @@ class XLASimulator:
         smp = int(getattr(self.args, "server_model_parallel", 0) or 0)
         if smp:
             if smp > len(devices):
-                raise ValueError(
-                    f"server_model_parallel={smp} exceeds the {len(devices)} "
-                    f"mesh devices")
+                # degrade-to-replicate, mirroring the message plane's
+                # round_mesh_for: a request the surviving mesh can't satisfy
+                # runs the tail replicated instead of refusing the round
+                logger.warning(
+                    "server_model_parallel=%d exceeds the %d mesh devices; "
+                    "degrading to a replicated (model=1) server tail",
+                    smp, len(devices))
+                obs.counter_inc("mesh.degraded_total")
+                smp = 1
             devices = devices[:smp]
         rmesh = create_round_mesh(clients=1, model=len(devices),
                                   devices=devices)

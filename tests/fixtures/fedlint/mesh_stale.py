@@ -1,0 +1,58 @@
+"""Golden fixture: stale compiled-program cache reads (expected: 3).
+
+Line 20 — mesh-stale-program: ``_PROGRAMS.get`` in a function with no
+mesh identity anywhere in its key.
+Line 27 — mesh-stale-program: subscript load from ``self._planes`` in a
+method that never references mesh_key.
+Line 47 — mesh-stale-program: closure fetches from the cache and neither
+it nor its enclosing function touches the mesh fingerprint.
+
+The ``keyed_*`` and ``enclosing_keyed`` functions are the clean
+counterparts — the fetch is fine as long as the lexical function chain
+builds its key from ``mesh_key`` / ``mesh_fingerprint``.
+"""
+
+_PROGRAMS = {}
+
+
+def stale_lookup(shapes, dtypes):
+    sig = (shapes, dtypes)
+    return _PROGRAMS.get(sig)
+
+
+class Plane:
+    _planes = {}
+
+    def stale_subscript(self, key):
+        return self._planes[key]
+
+    def keyed_method(self, key):
+        sig = (self.mesh_key, key)
+        prog = self._planes.get(sig)
+        if prog is None:
+            prog = object()
+            self._planes[sig] = prog
+        return prog
+
+
+def keyed_lookup(mesh, shapes):
+    from fedml_tpu.parallel.mesh import mesh_fingerprint
+
+    sig = (mesh_fingerprint(mesh), shapes)
+    return _PROGRAMS.get(sig)
+
+
+def stale_closure(shapes):
+    def fetch():
+        return _PROGRAMS.get(shapes)
+
+    return fetch()
+
+
+def enclosing_keyed(mesh, shapes):
+    sig = (mesh_fingerprint(mesh), shapes)
+
+    def fetch():
+        return _PROGRAMS.get(sig)
+
+    return fetch()

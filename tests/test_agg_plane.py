@@ -19,6 +19,10 @@ Four strata:
   chaos topology running ``agg_plane=compiled`` converges bit-identical to
   the fault-free host run (this module is part of the
   ``tools/chaos_check.py`` matrix via the ``agg_plane`` keyword).
+* **Elastic remesh** — the mesh-portable snapshot codec and ``remesh()``:
+  export on mesh A / resume on mesh B (grow, shrink, 1-D, 2-D) bitwise,
+  program-cache re-keying, the device-visibility shim, degrade-to-
+  replicate, and the retry/backoff resume handshake (docs/ELASTICITY.md).
 """
 
 from __future__ import annotations
@@ -63,15 +67,20 @@ from fedml_tpu.parallel.agg_plane import (
     plane_for,
     reset_planes,
 )
-from fedml_tpu.parallel.mesh import create_round_mesh
+from fedml_tpu.parallel.mesh import (
+    create_round_mesh,
+    mesh_fingerprint,
+    set_visible_devices,
+)
 
 
 @pytest.fixture(autouse=True)
 def _plane_hygiene():
-    """Planes (and their compiled programs) are process-cached; obs state is
-    process-global.  Every test leaves both clean."""
+    """Planes (and their compiled programs) are process-cached; obs state
+    and device visibility are process-global.  Every test leaves all clean."""
     yield
     reset_planes()
+    set_visible_devices(None)
     obs.shutdown()
     obs.registry().reset()
 
@@ -397,6 +406,174 @@ class TestShardedRoundPlane:
         _assert_bit_identical(
             upd.round_update(out, _opt_updates(3, seed=10)),
             clone.round_update(out, _opt_updates(3, seed=10)))
+
+
+# ---------------------------------------------------------------------------
+# Elastic remesh (the topology-change robustness claim)
+# ---------------------------------------------------------------------------
+
+def _mesh_variants():
+    """Target meshes for the elastic legs, relative to a (1, 4) source:
+    shrink (model 4→2), grow (model 4→8), 1-D (model collapses to a single
+    device), and 2-D relayout (the client axis widens to 2x2)."""
+    devs = jax.devices()
+    return [
+        ("shrink", lambda: create_round_mesh(clients=1, model=2,
+                                             devices=devs[:2])),
+        ("grow", lambda: create_round_mesh(clients=1, model=len(devs),
+                                           devices=devs)),
+        ("one_d", lambda: create_round_mesh(clients=1, model=1,
+                                            devices=devs[:1])),
+        ("two_d", lambda: create_round_mesh(clients=2, model=2,
+                                            devices=devs[:4])),
+    ]
+
+
+class TestElasticRemesh:
+    """Mesh topology is a recoverable dimension: a snapshot taken on mesh A
+    resumes on ANY mesh B with bitwise-identical params and optimizer
+    moments, live remesh() is equivalent to export/restart/load, and the
+    program caches re-key so nothing compiled for the dead mesh can run."""
+
+    def _mesh_a(self):
+        return create_round_mesh(clients=1, model=4,
+                                 devices=jax.devices()[:4])
+
+    @pytest.mark.parametrize("variant", [v[0] for v in _mesh_variants()])
+    @pytest.mark.parametrize("policy", _POLICIES, ids=lambda p: p[0])
+    def test_export_mesh_a_load_mesh_b_bitwise(self, policy, variant):
+        """The acceptance claim: round 1 on mesh A, snapshot, resume round 2
+        on mesh B (grow / shrink / 1-D / 2-D) — params AND optimizer
+        moments bitwise equal to the uninterrupted fixed-mesh run, for
+        every server policy."""
+        mesh_b = dict(_mesh_variants())[variant]()
+        ref = ShardedRoundPlane(mesh=self._mesh_a(), policy=policy)
+        r1 = ref.round_update(_opt_tree(50), _opt_updates(4, seed=60))
+        r2 = ref.round_update(r1, _opt_updates(4, seed=61))
+
+        src = ShardedRoundPlane(mesh=self._mesh_a(), policy=policy)
+        e1 = src.round_update(_opt_tree(50), _opt_updates(4, seed=60))
+        snap = src.export_state()
+        assert snap["manifest"]["mesh"]  # source fingerprint travels along
+        dst = ShardedRoundPlane(mesh=mesh_b, policy=policy)
+        dst.install(e1)
+        dst.load_state(snap)
+        e2 = dst.round_update(e1, _opt_updates(4, seed=61))
+        _assert_bit_identical(r2, e2)
+        _assert_bit_identical(ref.export_state()["opt"],
+                              dst.export_state()["opt"])
+
+    @pytest.mark.parametrize("variant", [v[0] for v in _mesh_variants()])
+    def test_remesh_in_place_bit_identical(self, variant):
+        """Live remesh() between rounds — host-gather, re-shard, pre-warm —
+        is bitwise invisible to the round math, and the plane's cache
+        identity (mesh_key) re-keys so the old mesh's programs are dead."""
+        mesh_b = dict(_mesh_variants())[variant]()
+        policy = ("adam", 0.1, 0.9)
+        ref = ShardedRoundPlane(mesh=self._mesh_a(), policy=policy)
+        r1 = ref.round_update(_opt_tree(51), _opt_updates(4, seed=70))
+        r2 = ref.round_update(r1, _opt_updates(4, seed=71))
+
+        plane = ShardedRoundPlane(mesh=self._mesh_a(), policy=policy)
+        e1 = plane.round_update(_opt_tree(51), _opt_updates(4, seed=70))
+        old_key = plane.mesh_key
+        info = plane.remesh(mesh_b)
+        assert info["changed"] and info["reshard_bytes"] > 0
+        assert plane.mesh_key == mesh_fingerprint(mesh_b) != old_key
+        _assert_bit_identical(r2, plane.round_update(
+            e1, _opt_updates(4, seed=71)))
+
+    def test_remesh_prewarms_round_program(self):
+        """remesh() recompiles the most recent round program for the new
+        mesh eagerly — the first post-resize round adds NO cache entry —
+        and a same-mesh remesh is a no-op."""
+        from fedml_tpu.parallel import agg_plane as _ap
+
+        plane = ShardedRoundPlane(mesh=self._mesh_a(),
+                                  policy=("adam", 0.1, 0.9))
+        out = plane.round_update(_opt_tree(52), _opt_updates(3, seed=80))
+        assert not plane.remesh(self._mesh_a())["changed"]
+        mesh_b = create_round_mesh(clients=1, model=2,
+                                   devices=jax.devices()[:2])
+        info = plane.remesh(mesh_b)
+        assert info["changed"] and info["recompile_s"] > 0
+        n = len(_ap._ROUND_PROGRAMS)
+        plane.round_update(out, _opt_updates(3, seed=81))
+        assert len(_ap._ROUND_PROGRAMS) == n
+
+    def test_visibility_shim_drives_default_meshes(self):
+        """set_visible_devices() changes what default_round_mesh /
+        round_mesh_for build — the seam fault injection and elastic
+        restarts use to simulate chip loss deterministically."""
+        from fedml_tpu.parallel import agg_plane as _ap
+
+        full = mesh_fingerprint(_ap.default_round_mesh())
+        set_visible_devices([d.id for d in jax.devices()[:2]])
+        shrunk = mesh_fingerprint(_ap.default_round_mesh())
+        assert shrunk != full
+        assert dict(_ap.default_round_mesh().shape)["model"] == 2
+        set_visible_devices(None)
+        assert mesh_fingerprint(_ap.default_round_mesh()) == full
+
+    def test_degrade_to_replicate_when_devices_cannot_satisfy(self):
+        """server_model_parallel beyond the surviving device count degrades
+        to a replicated model=1 mesh (and counts the degradation) instead
+        of refusing to serve."""
+        from fedml_tpu.parallel import agg_plane as _ap
+
+        class _A:
+            server_model_parallel = 4
+
+        set_visible_devices([d.id for d in jax.devices()[:2]])
+        mesh = _ap.round_mesh_for(_A)
+        assert dict(mesh.shape) == {"client": 1, "model": 1}
+        assert obs.registry().get_counter("mesh.degraded_total") >= 1
+
+    def test_manifest_rejects_structurally_foreign_snapshot(self):
+        """The portable codec fails loud, before touching devices, when the
+        snapshot's manifest does not describe the installed params."""
+        plane = ShardedRoundPlane(policy=("adam", 0.1, 0.9))
+        plane.round_update(_opt_tree(53), _opt_updates(3, seed=90))
+        snap = plane.export_state()
+        other = ShardedRoundPlane(policy=("adam", 0.1, 0.9))
+        other.install(_tree(1))  # different leaf paths AND shapes
+        with pytest.raises(ValueError, match="differs from installed"):
+            other.load_state(snap)
+
+    def test_updater_remesh_retries_then_succeeds(self, monkeypatch):
+        """The elastic resume handshake retries with backoff: a transiently
+        failing device enumeration settles on a later attempt instead of
+        failing the round."""
+        from fedml_tpu.parallel import agg_plane as _ap
+
+        class _Args:
+            federated_optimizer = "FedOpt"
+            server_optimizer = "adam"
+            server_lr = 0.1
+            server_momentum = 0.9
+            server_state = "sharded"
+            remesh_max_retries = 3
+            remesh_backoff_s = 0.0
+
+        upd = ServerRoundUpdater(_Args)
+        assert upd.remesh() is None  # nothing resident yet
+        out = upd.round_update(_opt_tree(54), _opt_updates(3, seed=95))
+        mesh_b = create_round_mesh(clients=1, model=2,
+                                   devices=jax.devices()[:2])
+        real, calls = _ap.round_mesh_for, []
+
+        def flaky(args, devices=None):
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("device enumeration raced the resize")
+            return mesh_b
+
+        monkeypatch.setattr(_ap, "round_mesh_for", flaky)
+        info = upd.remesh()
+        monkeypatch.setattr(_ap, "round_mesh_for", real)
+        assert len(calls) == 3 and info["changed"]
+        assert upd.mesh_key() == mesh_fingerprint(mesh_b)
+        upd.round_update(out, _opt_updates(3, seed=96))  # still serves
 
 
 # ---------------------------------------------------------------------------

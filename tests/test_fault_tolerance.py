@@ -585,12 +585,14 @@ def _without_kill(plan):
 
 def _run_server_kill_topology(run_id, ckpt_dir, backend="LOOPBACK", n=3,
                               fault_plan=None, comm_extra=None,
-                              max_restarts=3, knobs=None):
+                              max_restarts=3, knobs=None, on_restart=None):
     """1 server + ``n`` silos; the server is KILLED mid-round by the fault
     seam and a supervisor loop restarts it from its durable state
     (``server_checkpoint_dir``).  Only incarnation 0 carries the kill rule —
     a supervisor restarts the same binary, but a kill that re-fired every
-    incarnation would never let the run end.  Returns
+    incarnation would never let the run end.  ``on_restart(restarts)`` runs
+    between the death and the rebuild (the elastic suite shrinks device
+    visibility there, restarting onto different hardware).  Returns
     ``(history, final, {rank: stats}, restarts, killed_stats, server)``."""
     plan = fault_plan if fault_plan is not None else _server_kill_plan()
     client_plan = _without_kill(plan)
@@ -651,6 +653,8 @@ def _run_server_kill_topology(run_id, ckpt_dir, backend="LOOPBACK", n=3,
             LoopbackHub.sever(run_id, 0)
         restarts += 1
         assert restarts <= max_restarts, "server restart loop did not converge"
+        if on_restart is not None:
+            on_restart(restarts)
         server = None
         for _ in range(40):  # dead incarnation's port may still be freeing
             try:
@@ -743,6 +747,103 @@ def test_server_kill_sharded_state_bit_identical(tmp_path):
     assert sum(s.get("faults_killed", 0) for s in killed_stats) >= 1
     assert stats[0]["server_restores"] >= 1
     # exactly-once accounting across the kill + journal replay
+    reg = server.server_manager.population.registry.snapshot()
+    assert reg["reported_total"] == 3 * 2, reg
+
+
+# ---------------------------------------------------------------------------
+# Elastic suite: topology change (mesh shrink / device loss) mid-run
+# ---------------------------------------------------------------------------
+
+_SHARDED_KNOBS = {"server_state": "sharded", "federated_optimizer": "FedOpt",
+                  "server_optimizer": "adam"}
+
+
+@pytest.fixture
+def _elastic_hygiene():
+    """Device visibility and the plane/program caches are process-global;
+    an elastic test must never leak a shrunken topology into its
+    neighbours."""
+    from fedml_tpu.parallel.agg_plane import reset_planes
+    from fedml_tpu.parallel.mesh import set_visible_devices
+
+    set_visible_devices(None)
+    reset_planes()
+    yield set_visible_devices
+    set_visible_devices(None)
+    reset_planes()
+
+
+def test_elastic_live_remesh_under_client_chaos_bit_identical(
+        _elastic_hygiene):
+    """Mid-run topology change WITHOUT a restart: a ``mesh_shrink`` fault
+    (half the devices vanish during round 1's uploads) rides on top of
+    drop/dup/delay client chaos.  Three rounds, so the plane installs on
+    the full mesh in round 0, loses half its devices mid-round-1, and the
+    round-2 boundary (``maybe_remesh``) re-shards the resident state,
+    bumps the incarnation epoch, and still converges bit-identical to the
+    fixed-mesh fault-free run with exactly-once report accounting."""
+    from fedml_tpu.core import obs
+
+    knobs = {**_CHAOS_KNOBS, **_SHARDED_KNOBS, "comm_round": 3}
+    LoopbackHub.reset()
+    _, ref_final, _ = _run_chaos_topology("elastic-base", knobs=knobs)
+
+    LoopbackHub.reset()
+    plan = {"seed": 7, "rules": _full_chaos_plan()["rules"] + [
+        # half the fleet's devices die on the second round-1 upload the
+        # server receives — after the plane is resident on the full mesh —
+        # so round 2 must open through a live re-shard
+        {"kind": "mesh_shrink", "direction": "recv", "receiver": 0,
+         "msg_type": 3, "round": 1, "after": 1, "times": 1}]}
+    history, final, stats = _run_chaos_topology(
+        "elastic-shrink", fault_plan=plan, knobs=knobs)
+    assert len(history) == 3
+    assert _trees_bit_identical(final, ref_final), \
+        "live remesh diverged from the fixed-mesh run"
+    srv = stats[0]
+    assert srv["faults_topology"] >= 1
+    assert srv["epoch_bumps"] >= 1  # the resize bumped the incarnation epoch
+    assert obs.registry().get_counter("mesh.resizes_total") >= 1
+
+
+def test_elastic_server_kill_mesh_shrink_restart_bit_identical(
+        _elastic_hygiene, tmp_path):
+    """The chaos_check ``elastic`` acceptance leg: the server is killed in
+    round 1 (sharded optimizer state resident) and the supervisor restarts
+    it with the model axis shrunk 4→2 — the restored incarnation rebuilds
+    its round mesh over the surviving devices, re-shards the snapshot
+    through the portable codec, and finishes bit-identical to the
+    uninterrupted 4-device run with exactly-once accounting."""
+    import jax
+
+    from fedml_tpu.parallel.mesh import set_visible_devices
+
+    ids = [d.id for d in jax.devices()]
+    assert len(ids) >= 4, "elastic leg needs >= 4 (virtual) devices"
+    set_visible_devices(ids[:4])  # model axis = 4
+
+    LoopbackHub.reset()
+    history, ref_final, _ = _run_chaos_topology(
+        "elastic-kill-base", knobs={**_CHAOS_KNOBS, **_SHARDED_KNOBS})
+    assert len(history) == 2
+
+    LoopbackHub.reset()
+    plan = {"seed": 7, "rules": [
+        {"kind": "server_kill", "direction": "recv", "receiver": 0,
+         "msg_type": 3, "round": 1, "after": 1, "times": 1}]}
+    history, final, stats, restarts, killed_stats, server = (
+        _run_server_kill_topology(
+            "elastic-kill", tmp_path / "srv", fault_plan=plan,
+            knobs=_SHARDED_KNOBS,
+            on_restart=lambda _n: set_visible_devices(ids[:2])))
+    assert restarts >= 1
+    assert len(history) == 2
+    assert _trees_bit_identical(final, ref_final), \
+        "shrunken-mesh restart diverged from the fixed-mesh run"
+    assert sum(s.get("faults_killed", 0) for s in killed_stats) >= 1
+    assert stats[0]["server_restores"] >= 1
+    # exactly-once accounting across the kill + shrink + journal replay
     reg = server.server_manager.population.registry.snapshot()
     assert reg["reported_total"] == 3 * 2, reg
 

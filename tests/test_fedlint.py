@@ -108,6 +108,19 @@ def test_alias_dodge_fixture_exact_findings():
     ]
 
 
+def test_mesh_stale_fixture_exact_findings():
+    """The elastic-remesh satellite: a compiled-program cache fetched in a
+    scope that never references mesh_key/mesh_fingerprint would execute a
+    stale program against re-sharded buffers after a resize.  The keyed
+    counterparts in the same fixture (key built from the fingerprint in
+    the fetching function or an enclosing one) stay clean."""
+    assert _lint_fixture("mesh_stale.py") == [
+        (20, "mesh-stale-program"),
+        (27, "mesh-stale-program"),
+        (47, "mesh-stale-program"),
+    ]
+
+
 def test_legacy_shims_catch_alias_dodges():
     """The four legacy CLIs ride the same AST passes now, so the alias
     dodges are caught through the old entry points too."""
@@ -266,13 +279,14 @@ def test_cli_json_schema_is_stable():
         "suppressed",
         "version",
     ]
-    assert report["counts"]["findings"] == len(report["findings"]) == 13
+    assert report["counts"]["findings"] == len(report["findings"]) == 16
     first = report["findings"][0]
     assert sorted(first.keys()) >= ["analyzer", "line", "message", "path", "rule", "source"]
     assert {f["rule"] for f in report["findings"]} >= {
         "race-unannotated-shared",
         "ack-before-journal",
         "purity-donated-reuse",
+        "mesh-stale-program",
     }
 
 
@@ -294,7 +308,7 @@ def test_cli_select_and_ignore():
 
 
 def test_library_tree_is_fedlint_clean():
-    """The machine-enforced contract: the whole plane — all seven
+    """The machine-enforced contract: the whole plane — all eight
     analyzers — is clean on fedml_tpu/ with zero baseline entries."""
     proc = _run_cli()
     assert proc.returncode == 0, proc.stdout + proc.stderr

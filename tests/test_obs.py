@@ -722,6 +722,10 @@ class TestFlightRecorder:
         fr = FlightRecorder(capacity=4)
         assert fr.record("span_event", {"event": "server_kill"}) == "server_kill"
         assert fr.record("span_event", {"event": "slow_round"}) == "slow_round"
+        # the elastic topology fault is a dump trigger: the ring around a
+        # lost chip is the forensic window a remesh post-mortem needs
+        assert fr.record("span_event", {"event": "device_loss"}) == "device_loss"
+        assert fr.record("span_event", {"event": "mesh_shrink"}) is None
         assert fr.record("span_event", {"event": "drop"}) is None
         assert fr.record("span_start", {"name": "round"}) is None
 

@@ -20,7 +20,13 @@ telemetry-off run, with the remote spans grafted and the seq gap/dup
 accounting exact) AND the sharded-server-state chaos leg
 (``tests/test_fault_tolerance.py -k sharded_state`` — a server kill
 AFTER the first FedOpt round with ``server_state=sharded`` must restore
-the model-sharded optimizer state bit-identically) N consecutive times in
+the model-sharded optimizer state bit-identically) AND the elastic leg
+(``tests/test_fault_tolerance.py -k elastic`` plus the
+``TestElasticRemesh`` suite in ``tests/test_agg_plane.py`` — a
+``mesh_shrink`` topology fault mid-round, and a server kill restarted
+with the model axis shrunk 4→2, must both re-shard through the portable
+state codec and converge bit-identical to the fixed-mesh run with
+exactly-once accounting) N consecutive times in
 fresh interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
 operators stop believing red — so new fault kinds / backends must hold up
@@ -53,6 +59,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "ingest"
     python tools/chaos_check.py --runs 3 -k "telemetry"
     python tools/chaos_check.py --runs 3 -k "sharded_state"
+    python tools/chaos_check.py --runs 3 -k "elastic or mesh_shrink"
     python tools/chaos_check.py --runs 3 --skip-perf-gate
     python tools/chaos_check.py --runs 3 --skip-fedlint
 """
@@ -118,10 +125,11 @@ def main(argv=None) -> int:
     ap.add_argument(
         "-k", dest="keyword",
         default="chaos or server_kill or trace_integrity or agg_plane "
-                "or async_fl or ingest or telemetry or sharded_state",
+                "or async_fl or ingest or telemetry or sharded_state "
+                "or elastic or mesh_shrink",
         help='pytest -k selector (default: "chaos or server_kill or '
              'trace_integrity or agg_plane or async_fl or ingest or '
-             'telemetry or sharded_state")')
+             'telemetry or sharded_state or elastic or mesh_shrink")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     ap.add_argument("--skip-perf-gate", action="store_true",

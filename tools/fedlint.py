@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """fedlint: run the unified static-analysis plane over a source tree.
 
-One framework (``fedml_tpu/core/analysis``), seven passes: the four ported
+One framework (``fedml_tpu/core/analysis``), eight passes: the four ported
 lint contracts (rng / obs / agg / perf) plus the thread-ownership race
-detector, the ack-durability ordering checker, and the JAX
-purity/determinism pass.  See ``docs/STATIC_ANALYSIS.md`` for the rule
+detector, the ack-durability ordering checker, the JAX
+purity/determinism pass, and the mesh-staleness (compiled-program cache)
+checker.  See ``docs/STATIC_ANALYSIS.md`` for the rule
 catalog and the pragma/baseline policy.
 
 Exit codes: 0 clean (or everything suppressed), 1 findings, 2 usage or

@@ -22,6 +22,9 @@ check.  It parses every ``BENCH_rNN.json`` driver record (``{"n", "cmd",
   that report the key (keys absent in older-schema rounds are simply
   not banded yet).  ``obs_overhead_frac`` and ``telemetry_overhead_frac``
   are lower-better and capped absolutely by ``--obs-overhead-max``.
+  ``resize_downtime_s`` / ``remesh_recompile_s`` (elastic resize) are
+  lower-better and banded RELATIVELY: the latest value must stay under
+  ``(1 + tolerance) x`` the prior-round median.
   ``BASELINE.json``'s ``published`` map, when populated, bands the same
   way against the published numbers.
 
@@ -61,6 +64,10 @@ RELATIVE_KEYS = ("vs_baseline", "agg_speedup", "round_update_speedup",
 # lower-is-better: absolute cap (observability must stay cheap — spans,
 # registry, exposition, and now the telemetry plane all share the budget)
 OVERHEAD_KEYS = ("obs_overhead_frac", "telemetry_overhead_frac")
+# lower-is-better relative keys banded against the prior-round median
+# (elastic resize: downtime of an in-place remesh and its recompile slice
+# must not creep — a topology change should stay a sub-round blip)
+LATENCY_KEYS = ("resize_downtime_s", "remesh_recompile_s")
 
 _MODES = ("full", "degraded", "failed")
 
@@ -175,6 +182,20 @@ def check_trajectory(entries: List[Dict[str, Any]], tolerance: float,
             violations.append(
                 f"round {rnd}: REGRESSION — {key}={latest:g} fell below "
                 f"{floor:g} ({(1.0 - tolerance):.0%} of prior median "
+                f"{med:g})")
+    # lower-is-better bands: latest must stay under the mirrored ceiling
+    for key in LATENCY_KEYS:
+        series = [(e["round"], float(e["parsed"][key])) for e in light
+                  if isinstance(e["parsed"].get(key), (int, float))]
+        if len(series) < 2:
+            continue
+        *prior, (rnd, latest) = series
+        med = _median([v for _, v in prior])
+        ceiling = (1.0 + tolerance) * med
+        if latest > ceiling:
+            violations.append(
+                f"round {rnd}: REGRESSION — {key}={latest:g} rose above "
+                f"{ceiling:g} ({(1.0 + tolerance):.0%} of prior median "
                 f"{med:g})")
     for e in light:
         for key in OVERHEAD_KEYS:
