@@ -408,6 +408,7 @@ def _main() -> int | None:
     out.update(_measure_telemetry_overhead())
     out.update(_measure_agg_step())
     out.update(_measure_round_update())
+    out.update(_measure_defended_round())
     out.update(_measure_remesh())
     out.update(_measure_upload_saturation())
     out.update(_measure_async_throughput())
@@ -554,6 +555,138 @@ def _measure_round_update() -> dict:
     except Exception as e:
         print(f"round update measurement failed: {e}", file=sys.stderr)
         return {}
+
+
+def _measure_defended_round() -> dict:
+    """The defense/privacy-plane keys (PR 17) over the same seeded
+    synthetic deltas —
+
+    * ``defended_round_speedup``: median host-oracle defended round
+      (multi-Krum + Gaussian DP via ``host_secure_round_update``) vs the
+      ONE staged compiled program (``ShardedRoundPlane`` with the fused
+      defense + DP stages).  Higher is better (RELATIVE band).
+    * ``dp_overhead_frac``: the compiled round with the DP stage on vs
+      the identical round without it — what per-client clip + noise
+      costs inside the fused program.  Lower is better (budget cap).
+    * ``secagg_mask_s``: one full SecAgg cycle — quantize + pairwise
+      mask, submit, finite-field fold, unmask — on the compiled field
+      plane.  Lower is better (LATENCY band).
+
+    Emitted on BOTH the full-TPU and CPU-degraded metric lines.
+    Failures degrade to empty keys."""
+    import numpy as np
+
+    out = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from fedml_tpu.parallel.agg_plane import ShardedRoundPlane
+        from fedml_tpu.parallel.sec_plane import host_secure_round_update
+
+        n = int(os.environ.get("BENCH_AGG_CLIENTS", "32"))
+        reps = int(os.environ.get("BENCH_AGG_REPS", "5"))
+        updates = _synthetic_updates(n)
+        rng = np.random.default_rng(7)
+        params = {k: jnp.asarray(rng.standard_normal(np.shape(v)), jnp.float32)
+                  for k, v in updates[0][1].items()}
+        policy = ("adam", 0.1, 0.9)
+        defense = ("krum", 1, max(1, n // 2))  # multi-Krum, half cohort
+        dp = ("gaussian", 1.0, 0)
+        sigma = 0.5
+
+        def timed(fn):
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+
+        host_secure_round_update(params, updates, policy=policy,
+                                 defense=defense, dp=dp,
+                                 dp_sigma=sigma)  # compile outside the timing
+        host_s = timed(lambda: host_secure_round_update(
+            params, updates, policy=policy, defense=defense, dp=dp,
+            dp_sigma=sigma)[0])
+
+        plane = ShardedRoundPlane(policy=policy, defense=defense, dp=dp)
+        state = {"tree": plane.round_update(params, updates,
+                                            dp_sigma=sigma), "round": 1}
+
+        def staged_once():
+            state["tree"] = plane.round_update(
+                state["tree"], updates, round_idx=state["round"],
+                dp_sigma=sigma)
+            state["round"] += 1
+            return state["tree"]
+
+        comp_s = timed(staged_once)
+        out.update({
+            "defended_round_host_s": round(host_s, 6),
+            "defended_round_compiled_s": round(comp_s, 6),
+            "defended_round_speedup": round(host_s / max(comp_s, 1e-9), 4),
+            "defended_round_defense": "multi_krum+gaussian_dp",
+        })
+
+        # DP stage overhead inside the fused program: same plane with and
+        # without the stage
+        plain = ShardedRoundPlane(policy=policy)
+        pstate = {"tree": plain.round_update(params, updates)}
+
+        def plain_once():
+            pstate["tree"] = plain.round_update(pstate["tree"], updates)
+            return pstate["tree"]
+
+        plain_s = timed(plain_once)
+        dp_plane = ShardedRoundPlane(policy=policy, dp=dp)
+        dstate = {"tree": dp_plane.round_update(params, updates,
+                                                dp_sigma=sigma), "round": 1}
+
+        def dp_once():
+            dstate["tree"] = dp_plane.round_update(
+                dstate["tree"], updates, round_idx=dstate["round"],
+                dp_sigma=sigma)
+            dstate["round"] += 1
+            return dstate["tree"]
+
+        dp_s = timed(dp_once)
+        out.update({
+            "dp_round_s": round(dp_s, 6),
+            "dp_overhead_frac": round(
+                max(dp_s - plain_s, 0.0) / max(plain_s, 1e-9), 4),
+        })
+    except Exception as e:
+        print(f"defended round measurement failed: {e}", file=sys.stderr)
+
+    try:
+        from fedml_tpu.core.mpc.dropout import SecAggRound
+
+        reps = int(os.environ.get("BENCH_AGG_REPS", "5"))
+        k = int(os.environ.get("BENCH_SECAGG_CLIENTS", "8"))
+        rng = np.random.default_rng(11)
+        vec = rng.standard_normal(int(
+            os.environ.get("BENCH_SECAGG_DIM", "65536"))).astype(np.float64)
+
+        def secagg_cycle():
+            rnd = SecAggRound(n_clients=k, seed=3, plane="compiled")
+            for i in range(k):
+                rnd.submit(i, rnd.client_payload(i, vec))
+            return rnd.unmask()
+
+        secagg_cycle()  # pay the field-kernel compile outside the timing
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            secagg_cycle()
+            ts.append(time.perf_counter() - t0)
+        out.update({
+            "secagg_mask_s": round(float(np.median(ts)), 6),
+            "secagg_clients": k,
+        })
+    except Exception as e:
+        print(f"secagg measurement failed: {e}", file=sys.stderr)
+    return out
 
 
 def _measure_remesh() -> dict:
@@ -821,6 +954,7 @@ def _run_degraded(reason: str) -> int:
     out.update(agg)
     out["value"] = agg.get("agg_step_compiled_s", None)
     out.update(_measure_round_update())
+    out.update(_measure_defended_round())
     out.update(_measure_remesh())
     out.update(_measure_upload_saturation())
     out.update(_measure_async_throughput())

@@ -210,6 +210,28 @@ Aggregation-plane knobs (``train_args``; consumed by
   slices the new global params are split into for shard-addressable
   broadcast; each slice is memoized per round as its own
   ``CachedPayload``.
+
+Security/privacy plane knobs (``train_args``; consumed by
+``parallel/sec_plane`` and ``core/mpc``, semantics in
+``docs/SECURITY.md``):
+
+* ``defense_plane`` (``host`` | ``compiled``, default ``host``) — where
+  Byzantine-robust filtering runs when ``enable_defense`` is set.
+  ``compiled`` fuses norm-clipping / coordinate-wise trimmed-mean /
+  (multi-)Krum into the sharded round program as a pre-reduce stage
+  (one program per (mesh, treedef, policy, defense) key); bit-exact
+  vs. the retained host defender.
+* ``dp_plane`` (``host`` | ``compiled``, default ``host``) — where
+  per-client clipping + DP noise runs when ``enable_dp`` is set.
+  ``compiled`` draws counter-based noise keyed on (round, client id)
+  inside the round program — seed-deterministic and replay/remesh
+  stable; the ``core/dp`` budget accountant still drives the noise
+  scale (a runtime scalar, never part of the program cache key).
+* ``secagg_plane`` (``host`` | ``compiled``, default ``host``) — where
+  the secure-aggregation finite-field fold runs.  ``compiled`` sums
+  masked residues as sharded uint32 lane ops (``core/mpc/inmesh``);
+  exact field math makes any reduction order bit-identical, so the
+  knob is a pure perf choice.
 """
 
 from __future__ import annotations
@@ -597,6 +619,21 @@ class Arguments:
                 raise ValueError(
                     f"server_state must be one of {SERVER_STATES} "
                     f"(got {state!r})")
+        # security/privacy stage planes (parallel/sec_plane, core/mpc) — same
+        # fail-loud contract: a typo must not silently stay on the host path
+        for knob in ("defense_plane", "dp_plane", "secagg_plane"):
+            sp = getattr(self, knob, None)
+            if sp is not None:
+                from .parallel.sec_plane import SEC_PLANES
+
+                if str(sp).lower() not in SEC_PLANES:
+                    raise ValueError(
+                        f"{knob} must be one of {SEC_PLANES} (got {sp!r})")
+        if (str(getattr(self, "defense_plane", "host") or "host").lower()
+                == "compiled" and getattr(self, "enable_defense", False)):
+            from .parallel.sec_plane import defense_spec
+
+            defense_spec(self)  # raises on defenses the plane can't compile
         for knob, floor in (("server_model_parallel", 0),
                             ("broadcast_shards", 1),
                             ("remesh_max_retries", 1)):

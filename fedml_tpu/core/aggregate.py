@@ -286,6 +286,7 @@ class ServerRoundUpdater:
     def __init__(self, args):
         self.args = args
         self._plane = None
+        self._round_idx = 0
 
     @property
     def plane(self):
@@ -294,10 +295,31 @@ class ServerRoundUpdater:
             self._plane = make_round_plane(self.args)
         return self._plane
 
-    def round_update(self, params_tree, raw_grad_list, obs_parent=None):
-        return self.plane.round_update(
+    def round_update(self, params_tree, raw_grad_list, obs_parent=None,
+                     client_ids=None):
+        """One sharded round update.  When the plane carries compiled
+        security stages the round counter, the participant ids, and this
+        round's accountant-granted noise scale ride along as runtime
+        inputs; the DP budget is spent here (once per participant) exactly
+        like the host mechanism's ``add_noise`` would."""
+        plane = self.plane
+        dp_sigma = 0.0
+        if plane.dp is not None:
+            from ..parallel.sec_plane import dp_runtime_sigma
+            from .dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+            acct = FedMLDifferentialPrivacy.get_instance()
+            if acct.is_dp_enabled:  # attribute, set by init()
+                dp_sigma = acct.noise_scale()
+                acct.spend_budget(len(raw_grad_list))
+            else:
+                dp_sigma = dp_runtime_sigma(self.args)
+        out = plane.round_update(
             params_tree, raw_grad_list,
-            mode=FedMLAggOperator.agg_mode(self.args), obs_parent=obs_parent)
+            mode=FedMLAggOperator.agg_mode(self.args), obs_parent=obs_parent,
+            round_idx=self._round_idx, client_ids=client_ids,
+            dp_sigma=dp_sigma)
+        self._round_idx += 1
+        return out
 
     def export_state(self):
         """Numpy snapshot of the sharded server state (None before the

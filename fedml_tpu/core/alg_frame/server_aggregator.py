@@ -54,21 +54,39 @@ class ServerAggregator(ABC):
                 extra_auxiliary_info=self.get_model_params(),
             )
         defender = FedMLDefender.get_instance()
-        if defender.is_defense_enabled():
+        if defender.is_defense_enabled() and not self._plane_has_defense():
             raw_client_model_or_grad_list = defender.defend_before_aggregation(
                 raw_client_grad_list=raw_client_model_or_grad_list,
                 extra_auxiliary_info=self.get_model_params(),
             )
         return raw_client_model_or_grad_list
 
+    def _plane_has_defense(self) -> bool:
+        """True when the sharded round plane carries the compiled defense
+        stage (``defense_plane=compiled``): the host defender hooks step
+        aside, or the defense would apply twice.  Resolved from args (not
+        the plane object) so the check never forces the lazy plane build."""
+        if self.round_updater is None:
+            return False
+        from ...parallel.sec_plane import defense_spec, stage_plane
+        return (stage_plane(self.args, "defense_plane") == "compiled"
+                and defense_spec(self.args) is not None)
+
+    def _plane_has_dp(self) -> bool:
+        if self.round_updater is None:
+            return False
+        from ...parallel.sec_plane import dp_spec, stage_plane
+        return (stage_plane(self.args, "dp_plane") == "compiled"
+                and dp_spec(self.args) is not None)
+
     def aggregate(self, raw_client_model_or_grad_list: List[Tuple[float, Any]]) -> Any:
         from ..security.fedml_defender import FedMLDefender
 
         defender = FedMLDefender.get_instance()
-        if defender.is_defense_enabled():
-            # defended rounds stay on the replicated path: the defender's
-            # base_aggregation_func contract is plain aggregation, not the
-            # stateful server-optimizer round tail
+        if defender.is_defense_enabled() and not self._plane_has_defense():
+            # host-plane defended rounds stay on the replicated path: the
+            # defender's base_aggregation_func contract is plain
+            # aggregation, not the stateful server-optimizer round tail
             return defender.defend_on_aggregation(
                 raw_client_grad_list=raw_client_model_or_grad_list,
                 base_aggregation_func=FedMLAggOperator.agg,
@@ -84,10 +102,10 @@ class ServerAggregator(ABC):
         from ..security.fedml_defender import FedMLDefender
 
         defender = FedMLDefender.get_instance()
-        if defender.is_defense_enabled():
+        if defender.is_defense_enabled() and not self._plane_has_defense():
             aggregated_model_or_grad = defender.defend_after_aggregation(aggregated_model_or_grad)
         dp = FedMLDifferentialPrivacy.get_instance()
-        if dp.is_global_dp_enabled():
+        if dp.is_global_dp_enabled() and not self._plane_has_dp():
             aggregated_model_or_grad = dp.add_global_noise(aggregated_model_or_grad)
         return aggregated_model_or_grad
 

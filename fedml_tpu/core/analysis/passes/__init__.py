@@ -11,11 +11,12 @@ from .legacy import AggAnalyzer, ObsAnalyzer, PerfAnalyzer, RngAnalyzer
 from .meshguard import MeshStaleProgramAnalyzer
 from .purity import PurityAnalyzer
 from .races import ThreadOwnershipAnalyzer
+from .security import SecHostFallbackAnalyzer
 
 __all__ = [
     "AckDurabilityAnalyzer", "AggAnalyzer", "MeshStaleProgramAnalyzer",
     "ObsAnalyzer", "PerfAnalyzer", "PurityAnalyzer", "RngAnalyzer",
-    "ThreadOwnershipAnalyzer", "build_analyzers",
+    "SecHostFallbackAnalyzer", "ThreadOwnershipAnalyzer", "build_analyzers",
 ]
 
 
@@ -30,4 +31,5 @@ def build_analyzers() -> List[Analyzer]:
         AckDurabilityAnalyzer(),
         PurityAnalyzer(),
         MeshStaleProgramAnalyzer(),
+        SecHostFallbackAnalyzer(),
     ]

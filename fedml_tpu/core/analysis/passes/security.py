@@ -1,0 +1,199 @@
+"""Host-fallback detector for the security/privacy planes.
+
+PR 17 moved Byzantine filtering, DP noise, and the SecAgg finite-field
+fold onto the compiled round path (``parallel/sec_plane``,
+``core/mpc/inmesh``).  The host implementations are retained as bit-exact
+oracles — but NEW host-side aggregation sneaking into ``core/security``,
+``core/dp`` or ``core/mpc`` is exactly how the compiled plane rots: the
+host copy drifts, the parity tests pin the old behavior, and the mesh
+path silently stops being the one that runs.
+
+* ``sec-host-fallback`` — inside the security/privacy modules
+  (``core/security``, ``core/dp``, ``core/mpc``), either
+
+  - a Python ``for`` loop that folds client payloads (iteration over an
+    updates/grads/payloads/shares-shaped name with an accumulation in
+    the body), or
+  - a ``tree_map`` call in a lexical function chain that takes a client
+    payload collection (an ``updates`` / ``raw_grad_list`` -shaped
+    parameter) and carries no JAX-compute marker (``jnp`` / ``lax`` /
+    ``jit`` / ``vmap`` / ``shard_map``) — a host pytree fold over
+    client payloads, not a compiled one.
+
+  Pragmas require a justification: a retained host oracle must say so
+  (``# fedlint: allow[sec-host-fallback] — retained host oracle ...``).
+
+Loops that merely inspect payloads (no accumulation) and ``tree_map``
+calls inside jnp-using functions (compiled defense/attack math) are not
+flagged — the rule targets the host *fold*, not every traversal.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Set, Tuple
+
+from ..framework import Analyzer, Finding, Rule, SourceFile
+
+# path fragments that put a file in the security/privacy plane; fixture
+# files opt in by basename (sec_*.py)
+_SCOPE_PARTS = ("core/security", "core/dp", "core/mpc")
+
+# names that look like per-client payload COLLECTIONS (plural / _list /
+# _dict forms only: a singular `client_update` is one intercepted update,
+# not a fold candidate)
+_PAYLOAD_NAME = re.compile(
+    r"(?i)^((raw_)?(client_)?(grad|update|upload|payload|delta|share|mask)"
+    r"(s|_list|_dict)|stack(ed)?|masked|weighted_updates)$")
+
+# identifiers that mark a function as JAX-compute (its tree_map compiles)
+_JAX_MARKERS = frozenset({"jnp", "lax", "jit", "vmap", "pmap", "shard_map"})
+
+# accumulation carriers inside a fold body
+_ACC_CALLS = frozenset({"mod", "add", "field_add", "_mod_add"})
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost identifier of a Name / Attribute chain, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _iter_base_name(node: ast.AST) -> Optional[str]:
+    """The payload collection a ``for`` iterates, through the common
+    wrappers: ``enumerate(updates)``, ``sorted(payloads)``,
+    ``self.masked.values()``/``.items()``."""
+    while True:
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Name)
+                    and fn.id in ("enumerate", "sorted", "list", "tuple",
+                                  "reversed", "zip")
+                    and node.args):
+                node = node.args[0]
+                continue
+            if isinstance(fn, ast.Attribute) and fn.attr in ("values", "items"):
+                node = fn.value
+                continue
+        return _terminal_name(node)
+
+
+def _accumulates(body: List[ast.stmt]) -> bool:
+    """True when the loop body carries a running fold: an augmented
+    assignment, an additive BinOp, or a modular-add call."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.AugAssign):
+                return True
+            if isinstance(node, ast.BinOp) and isinstance(
+                    node.op, (ast.Add, ast.Sub)):
+                return True
+            if isinstance(node, ast.Call) and _terminal_name(
+                    node.func) in _ACC_CALLS:
+                return True
+    return False
+
+
+class _SecWalker(ast.NodeVisitor):
+    """Collects payload-fold loops, tree_map calls with their lexical
+    function chain, and per-scope JAX-compute references (same scope
+    model as the meshguard pass: a marker in ANY enclosing function
+    clears the call)."""
+
+    def __init__(self):
+        self._stack: List[int] = [0]
+        self._next_id = 1
+        self.jax_scopes: Set[int] = set()
+        # scopes whose function signature takes a payload collection
+        self.payload_scopes: Set[int] = set()
+        self.fold_loops: List[Tuple[int, str]] = []
+        # (lineno, scope chain at the call)
+        self.tree_maps: List[Tuple[int, Tuple[int, ...]]] = []
+
+    def _enter_function(self, node: ast.AST):
+        sid = self._next_id
+        self._next_id += 1
+        a = node.args
+        params = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+        if any(_PAYLOAD_NAME.match(p) for p in params):
+            self.payload_scopes.add(sid)
+        self._stack.append(sid)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _enter_function
+    visit_AsyncFunctionDef = _enter_function
+
+    def _note_jax(self, name: Optional[str]):
+        if name in _JAX_MARKERS:
+            self.jax_scopes.add(self._stack[-1])
+
+    def visit_Name(self, node: ast.Name):
+        self._note_jax(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        self._note_jax(node.attr)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For):
+        base = _iter_base_name(node.iter)
+        if (base is not None and _PAYLOAD_NAME.match(base)
+                and _accumulates(node.body)):
+            self.fold_loops.append((node.lineno, base))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if _terminal_name(node.func) == "tree_map":
+            self.tree_maps.append((node.lineno, tuple(self._stack)))
+        self.generic_visit(node)
+
+
+class SecHostFallbackAnalyzer(Analyzer):
+    """Flags host-side aggregation folds in the security/privacy modules."""
+
+    name = "sec"
+    rules = (
+        Rule("sec-host-fallback",
+             "host-side aggregation fold in a security/privacy module",
+             requires_justification=True, order=0),
+    )
+
+    def _in_scope(self, path: str) -> bool:
+        norm = os.path.normpath(os.path.abspath(path)).replace(os.sep, "/")
+        if os.path.basename(path).startswith("sec_"):
+            return True
+        return any(f"/{part}/" in norm or norm.endswith(f"/{part}")
+                   for part in _SCOPE_PARTS)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if src.tree is None or not self._in_scope(src.path):
+            return []
+        walker = _SecWalker()
+        walker.visit(src.tree)
+        findings: List[Finding] = []
+        for lineno, base in walker.fold_loops:
+            findings.append(self.finding(
+                self.rules[0], src, lineno,
+                f"host aggregation fold over '{base}' in a security/privacy "
+                "module — client folds belong on the compiled plane "
+                "(parallel/sec_plane, core/mpc/inmesh); a retained host "
+                "oracle needs a justified pragma"))
+        for lineno, chain in walker.tree_maps:
+            if not any(sid in walker.payload_scopes for sid in chain):
+                continue
+            if any(sid in walker.jax_scopes for sid in chain):
+                continue
+            findings.append(self.finding(
+                self.rules[0], src, lineno,
+                "tree_map over a client payload collection with no "
+                "JAX-compute marker in scope — a host pytree fold in a "
+                "security/privacy module; move it onto the compiled plane "
+                "or justify the host oracle"))
+        findings.sort(key=Finding.sort_key)
+        return findings

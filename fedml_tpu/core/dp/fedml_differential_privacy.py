@@ -88,6 +88,16 @@ class FedMLDifferentialPrivacy:
     def add_local_noise(self, local_grad: Any) -> Any:
         return self.add_noise(local_grad)
 
+    def noise_scale(self) -> float:
+        """The mechanism's calibrated noise scale (Gaussian sigma / Laplace
+        b) — what the compiled DP stage feeds as its runtime ``dp_sigma``
+        input, so the accountant-driven calibration is the single source of
+        truth on both planes."""
+        if self.mechanism is None:
+            return 0.0
+        return float(getattr(self.mechanism, "sigma",
+                             getattr(self.mechanism, "scale", 0.0)))
+
     def spend_budget(self, times: int = 1) -> None:
         """Account ``times`` mechanism applications WITHOUT noising —
         for paths that apply the (jax-pure) mechanism inside a compiled

@@ -1,0 +1,212 @@
+"""Dropout-resilient SecAgg round coordination.
+
+The seed's SecAgg server stalls unless EVERY client's masked vector arrives
+(``cross_silo/secagg`` waits on ``len(self.masked) < self.client_num``) — a
+single client lost to a chaos drop/reset poisons the round, because its
+pairwise masks never cancel.  This module implements the classic
+Bonawitz-style recovery so a dropout is the common case, not a round-killer:
+
+* **Setup** — every client's DH secret is derived deterministically from the
+  round seed; each secret is ALSO Shamir-shared (:func:`..mpc.secagg.
+  BGW_encoding`, degree ``threshold-1``) so any ``threshold`` survivors can
+  reconstruct a dropped client's key.
+* **Masking** — clients quantize into the M31 field and add the pairwise
+  masks (:func:`..mpc.secagg.mask_model_update`); submissions are journaled
+  exactly-once (duplicate payloads from a chaos retransmit are counted and
+  ignored, never double-folded).
+* **Unmask** — the survivors' payloads field-sum (host loop or the compiled
+  :mod:`.inmesh` scan — exact field math, so bit-identical either way); for
+  each dropped client the coordinator reconstructs its secret from the
+  survivors' shares (``BGW_decoding`` at the survivor alphas), re-derives
+  the agreed keys against each survivor's public key, PRG-expands the
+  uncancelled masks, and applies the sign-correct correction.  The result
+  is bitwise the plain field sum of the survivors' unmasked residues —
+  a mid-round dropout never perturbs a single bit of the aggregate.
+
+The whole round state round-trips through :meth:`SecAggRound.export_state` /
+:meth:`SecAggRound.from_state`, so a server kill between submissions resumes
+and unmasks bit-identically with exactly-once accounting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..obs.trace import NULL_SPAN
+from .field import FIELD_PRIME
+from .secagg import (BGW_decoding, BGW_encoding, mask_model_update,
+                     my_key_agreement, my_pk_gen, pairwise_mask,
+                     transform_finite_to_tensor, transform_tensor_to_finite)
+
+SECAGG_PLANES = ("host", "compiled")
+
+
+class SecAggRound:
+    """One dropout-resilient SecAgg round over ``n_clients`` participants.
+
+    ``threshold`` survivors (default a strict majority) are enough to
+    unmask; fewer raises — below the reconstruction threshold the masks are
+    information-theoretically unrecoverable and the round must abort rather
+    than emit garbage.  ``plane`` picks the field-sum implementation:
+    ``host`` is the per-client numpy loop, ``compiled`` the
+    :mod:`.inmesh` scan; both produce identical residues.
+    """
+
+    def __init__(self, n_clients: int, threshold: Optional[int] = None,
+                 seed: int = 0, q_bits: int = 16, plane: str = "host"):
+        if plane not in SECAGG_PLANES:
+            raise ValueError(
+                f"secagg_plane must be one of {SECAGG_PLANES} (got {plane!r})")
+        n = int(n_clients)
+        if n < 2:
+            raise ValueError(f"SecAgg needs >= 2 clients (got {n})")
+        t = int(threshold) if threshold is not None else n // 2 + 1
+        if not (2 <= t <= n):
+            raise ValueError(
+                f"threshold must be in [2, {n}] (got {t})")
+        self.n = n
+        self.threshold = t
+        self.seed = int(seed)
+        self.q_bits = int(q_bits)
+        self.plane = plane
+        # deterministic per-client DH secrets: the same (seed, n) always
+        # rebuilds the same key material, so a killed-and-restored server
+        # re-derives the setup instead of persisting secrets
+        rng = np.random.default_rng(self.seed)
+        self.sks: List[int] = [int(rng.integers(2, 2 ** 30))
+                               for _ in range(n)]
+        self.pks: List[int] = [my_pk_gen(sk) for sk in self.sks]
+        # sk_shares[i][j] = client j's Shamir share of client i's secret
+        # (degree threshold-1, evaluated at alpha = j + 1)
+        self.sk_shares: List[np.ndarray] = [
+            BGW_encoding(np.asarray([sk], dtype=np.int64), n, t - 1, rng)
+            for sk in self.sks]
+        self.payloads: Dict[int, np.ndarray] = {}
+        self.dup_submissions = 0
+
+    # -- client side ---------------------------------------------------------
+    def quantize(self, vec: np.ndarray) -> np.ndarray:
+        return transform_tensor_to_finite(
+            np.asarray(vec, np.float64), FIELD_PRIME, q_bits=self.q_bits)
+
+    def client_payload(self, client_id: int, vec: np.ndarray) -> np.ndarray:
+        """Quantize ``vec`` into the field and apply client ``client_id``'s
+        pairwise masks against every peer."""
+        i = int(client_id)
+        z = self.quantize(vec)
+        peer_keys = {j: my_key_agreement(self.sks[i], self.pks[j])
+                     for j in range(self.n) if j != i}
+        return mask_model_update(z, i, peer_keys, FIELD_PRIME)
+
+    # -- server side ---------------------------------------------------------
+    def submit(self, client_id: int, payload: np.ndarray) -> bool:
+        """Journal one masked payload exactly-once.  A duplicate (chaos
+        retransmit, replayed upload) is counted and dropped — folding it
+        twice would double that client's contribution."""
+        i = int(client_id)
+        if not (0 <= i < self.n):
+            raise ValueError(f"client_id {i} out of range [0, {self.n})")
+        if i in self.payloads:
+            self.dup_submissions += 1
+            obs.counter_inc("secagg.dup_submissions_total")
+            return False
+        self.payloads[i] = np.asarray(payload, np.int64)
+        return True
+
+    @property
+    def survivors(self) -> List[int]:
+        return sorted(self.payloads)
+
+    @property
+    def dropped(self) -> List[int]:
+        return [d for d in range(self.n) if d not in self.payloads]
+
+    def _field_sum(self, stack: np.ndarray) -> np.ndarray:
+        if self.plane == "compiled":
+            from .inmesh import field_sum
+            return field_sum(stack)
+        # retained host oracle: exact field math, any order — the compiled
+        # scan must match this loop bit-for-bit
+        total = np.zeros(stack.shape[1:], dtype=np.int64)
+        for v in stack:  # fedlint: allow[sec-host-fallback] — retained host oracle for the compiled field fold
+            total = np.mod(total + v, FIELD_PRIME)
+        return total
+
+    def _correct(self, total: np.ndarray, mask: np.ndarray,
+                 add: bool) -> np.ndarray:
+        if self.plane == "compiled":
+            from .inmesh import field_add, field_sub
+            return (field_add if add else field_sub)(total, mask)
+        return np.mod(total + mask if add else total - mask, FIELD_PRIME)
+
+    def unmask(self, obs_parent: Any = None) -> np.ndarray:
+        """Field-sum the survivors' payloads and strip the uncancelled
+        masks of every dropped client.  Returns float64 aggregate (the
+        dequantized residues).  Raises when fewer than ``threshold``
+        payloads arrived."""
+        surv = self.survivors
+        if len(surv) < self.threshold:
+            raise ValueError(
+                f"only {len(surv)} of {self.n} payloads arrived; "
+                f"threshold {self.threshold} survivors required to unmask")
+        parent = obs_parent if obs_parent is not None else obs.active_ctx()
+        sp = (obs.span("round.unmask", parent, n_clients=self.n,
+                       survivors=len(surv), dropped=len(self.dropped),
+                       plane=self.plane)
+              if parent is not None else NULL_SPAN)
+        with sp:
+            stack = np.stack([self.payloads[s] for s in surv])
+            total = self._field_sum(stack)
+            reconstructions = 0
+            for d in self.dropped:
+                # >= threshold survivor shares reconstruct the dropped
+                # secret (Lagrange at 0 over the survivor alphas)
+                idx = surv[: self.threshold]
+                shares = np.stack([self.sk_shares[d][s] for s in idx])
+                alphas = np.asarray([s + 1 for s in idx], dtype=np.int64)
+                sk_d = int(BGW_decoding(shares, alphas)[0])
+                reconstructions += 1
+                for s in surv:
+                    # the agreed key from the RECONSTRUCTED secret equals
+                    # what survivor s derived (DH symmetry), so the PRG
+                    # expands the exact mask s folded in
+                    key = my_key_agreement(sk_d, self.pks[s])
+                    m = pairwise_mask(total.shape, key, FIELD_PRIME)
+                    # s included +m when its peer d ranks above it, -m
+                    # below — apply the inverse
+                    total = self._correct(total, m, add=(d < s))
+            obs.counter_inc("secagg.unmask_reconstructions",
+                            reconstructions)
+            sp.end(reconstructions=reconstructions)
+        return transform_finite_to_tensor(
+            total, FIELD_PRIME, q_bits=self.q_bits)
+
+    # -- crash recovery ------------------------------------------------------
+    def export_state(self) -> Dict[str, Any]:
+        """Snapshot for server-kill recovery: the deterministic setup is
+        re-derived from (seed, n, threshold), so only the journaled
+        payloads and counters persist."""
+        return {
+            "version": 1,
+            "n": self.n,
+            "threshold": self.threshold,
+            "seed": self.seed,
+            "q_bits": self.q_bits,
+            "plane": self.plane,
+            "payloads": {int(i): np.asarray(v, np.int64)
+                         for i, v in self.payloads.items()},
+            "dup_submissions": int(self.dup_submissions),
+        }
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "SecAggRound":
+        round_ = cls(state["n"], threshold=state["threshold"],
+                     seed=state["seed"], q_bits=state["q_bits"],
+                     plane=state.get("plane", "host"))
+        for i, v in state["payloads"].items():
+            round_.payloads[int(i)] = np.asarray(v, np.int64)
+        round_.dup_submissions = int(state["dup_submissions"])
+        return round_

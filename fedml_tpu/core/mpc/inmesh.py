@@ -1,0 +1,100 @@
+"""Compiled finite-field reduction for SecAgg: M31 residue ops on uint32 lanes.
+
+The SecAgg servers fold masked field vectors with a host numpy loop
+(``total = (total + v) % p`` per client) — exact, but O(clients) Python
+iterations over model-size arrays.  Here the same fold is ONE jitted
+``lax.scan`` over the stacked residues in uint32 lanes:
+
+* ``FIELD_PRIME = 2**31 - 1`` fits uint32, and ``a + b <= 2p - 2 < 2**32``,
+  so a single conditional subtract after each add is exact — no widening,
+  no overflow, and the op maps onto integer vector lanes.
+* Field addition is associative and exact, so ANY reduction order gives the
+  same residues: the compiled fold is bit-identical to the host loop by
+  arithmetic, not by tolerance — ``secagg_plane=compiled`` can never drift.
+
+Mask *application* stays element-wise (:func:`field_add` / :func:`field_sub`
+host wrappers over the same jitted kernels) so the dropout-unmask correction
+in :mod:`.dropout` runs through identical code on either plane.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .field import FIELD_PRIME
+
+_P32 = np.uint32(int(FIELD_PRIME))
+
+
+def _mod_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    s = a + b  # residues are < p, so s <= 2p - 2 < 2**32: exact in uint32
+    return jnp.where(s >= _P32, s - _P32, s)
+
+
+def _mod_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # a - b == a + (p - b); b == 0 gives a transient operand of exactly p,
+    # and a + p <= 2p - 1 < 2**32 still holds before the reduce
+    return _mod_add(a, _P32 - b)
+
+
+_KERNELS: Dict[Any, Any] = {}
+
+
+def _kernel(name: str, build):
+    fn = _KERNELS.get(name)
+    if fn is None:
+        fn = jax.jit(build)
+        _KERNELS[name] = fn
+    return fn
+
+
+def _fold(stack):
+    def body(acc, row):
+        return _mod_add(acc, row), None
+
+    acc, _ = jax.lax.scan(
+        body, jnp.zeros(stack.shape[1:], jnp.uint32), stack)
+    return acc
+
+
+def _check_residues(arr: np.ndarray) -> np.ndarray:
+    arr = np.asarray(arr, np.int64)
+    if arr.size and (arr.min() < 0 or arr.max() >= int(FIELD_PRIME)):
+        raise ValueError(
+            "field_sum input must hold residues in [0, p); got range "
+            f"[{arr.min()}, {arr.max()}]")
+    return arr
+
+
+def field_sum(stack: np.ndarray) -> np.ndarray:
+    """Sum ``stack`` ([n, ...] int64 field residues) over the leading axis
+    mod p, as one compiled scan.  Exact integer math — bit-identical to the
+    per-client host loop in any order."""
+    arr = _check_residues(stack)
+    out = _kernel("fold", _fold)(jnp.asarray(arr.astype(np.uint32)))
+    return np.asarray(out).astype(np.int64)
+
+
+def field_add(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(a + b) mod p element-wise through the compiled kernel."""
+    a, b = _check_residues(a), _check_residues(b)
+    out = _kernel("add", _mod_add)(
+        jnp.asarray(a.astype(np.uint32)), jnp.asarray(b.astype(np.uint32)))
+    return np.asarray(out).astype(np.int64)
+
+
+def field_sub(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(a - b) mod p element-wise through the compiled kernel."""
+    a, b = _check_residues(a), _check_residues(b)
+    out = _kernel("sub", _mod_sub)(
+        jnp.asarray(a.astype(np.uint32)), jnp.asarray(b.astype(np.uint32)))
+    return np.asarray(out).astype(np.int64)
+
+
+def reset_kernels() -> None:
+    """Drop the cached jitted kernels (tests)."""
+    _KERNELS.clear()

@@ -93,7 +93,7 @@ def _trimmed_mean_count(updates: Updates, k: int) -> Pytree:
         x = jnp.sort(x, axis=0)
         return jnp.mean(x[k : n - k], axis=0)
 
-    return jax.tree_util.tree_map(_leaf, stacked)
+    return jax.tree_util.tree_map(_leaf, stacked)  # fedlint: allow[sec-host-fallback] — retained host oracle for the compiled trimmed-mean stage
 
 
 # ---------------------------------------------------------------------------
@@ -120,7 +120,7 @@ def norm_diff_clipping(updates: Updates, global_params: Pytree, norm_bound: floa
     (reference norm_diff_clipping_defense.py)."""
     g_vec, unravel = ravel_pytree(global_params)
     out: Updates = []
-    for n, p in updates:
+    for n, p in updates:  # fedlint: allow[sec-host-fallback] — retained host oracle for the compiled norm-clip stage
         v, _ = ravel_pytree(p)
         diff = v - g_vec
         nrm = jnp.linalg.norm(diff)
@@ -196,7 +196,7 @@ def robust_learning_rate(updates: Updates, global_params: Pytree, threshold: int
     g_vec, unravel = ravel_pytree(global_params)
     deltas = []
     nums = []
-    for n, p in updates:
+    for n, p in updates:  # fedlint: allow[sec-host-fallback] — host-only defense, no compiled counterpart yet
         v, _ = ravel_pytree(p)
         deltas.append(v - g_vec)
         nums.append(float(n))

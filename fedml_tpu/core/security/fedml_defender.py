@@ -178,7 +178,7 @@ class FedMLDefender:
         else:
             mask = None
         out = []
-        for n, p in updates:
+        for n, p in updates:  # fedlint: allow[sec-host-fallback] — soteria is probe-driven and host-only by design
             if mask is None:
                 # proxy: per-feature delta magnitude on the defended layer
                 node, gnode = p["params"], global_params["params"]

@@ -130,7 +130,8 @@ class FedMLAggregator:
                         load_edge_model(self.model_file_dict[i]))
                        for i in indices]
             merged = self._install_sharded(
-                self.round_updater.round_update(self._sharded_base(), updates))
+                self.round_updater.round_update(self._sharded_base(), updates,
+                                                client_ids=list(indices)))
             for path in self.model_file_dict.values():
                 try:
                     os.remove(path)

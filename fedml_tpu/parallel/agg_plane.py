@@ -61,6 +61,8 @@ from ..core.aggregate import flatten_checked, leaf_paths, opt_leaf_indices
 from ..core.obs.trace import NULL_SPAN
 from .mesh import (create_mesh, create_round_mesh, mesh_fingerprint,
                    visible_devices)
+from .sec_plane import (make_fold_fn, make_stage_fn, make_tail_fn,
+                        plane_security)
 from .sharding import param_spec
 
 logger = logging.getLogger(__name__)
@@ -412,10 +414,11 @@ class _RoundProgram:
 
     __slots__ = ("fn", "leaf_shardings", "chunk_shardings", "opt_shardings",
                  "acc_dtypes", "wire_dtypes", "out_dtypes", "wire_bytes",
-                 "fused")
+                 "fused", "staged")
 
     def __init__(self, fn, leaf_shardings, chunk_shardings, opt_shardings,
-                 acc_dtypes, wire_dtypes, out_dtypes, wire_bytes, fused):
+                 acc_dtypes, wire_dtypes, out_dtypes, wire_bytes, fused,
+                 staged=False):
         self.fn = fn
         self.leaf_shardings = leaf_shardings
         self.chunk_shardings = chunk_shardings
@@ -425,6 +428,7 @@ class _RoundProgram:
         self.out_dtypes = out_dtypes
         self.wire_bytes = wire_bytes
         self.fused = fused
+        self.staged = staged
 
 
 def round_policy(args: Any) -> Tuple:
@@ -482,11 +486,19 @@ class ShardedRoundPlane(CompiledAggPlane):
                  rules: Sequence[Tuple[str, Any]] = (),
                  wire_dtype: str = "f32",
                  microbatch_clients: int = 0,
-                 policy: Tuple = ("fedavg",)):
+                 policy: Tuple = ("fedavg",),
+                 defense: Optional[Tuple] = None,
+                 dp: Optional[Tuple] = None):
         mesh = mesh if mesh is not None else default_round_mesh()
         super().__init__(mesh=mesh, rules=rules, wire_dtype=wire_dtype,
                          microbatch_clients=microbatch_clients)
         self.policy = tuple(policy)
+        #: hashable sec_plane stage specs; when either is set the round
+        #: program grows a pre-reduce (DP → defense) stage and the plane
+        #: always folds the FULL stack fused (padding/microbatch rows would
+        #: enter a sort/median defense's consensus)
+        self.defense = tuple(defense) if defense is not None else None
+        self.dp = tuple(dp) if dp is not None else None
         self._tx = _policy_tx(self.policy)
         self._treedef = None
         self._shapes: Optional[Tuple] = None
@@ -568,38 +580,65 @@ class ShardedRoundPlane(CompiledAggPlane):
         else:
             opt_sh, opt_sds = (), ()
 
-        def fold(acc, chunk, w):
-            if mode == "mean":
-                # scale BEFORE the scan (host-parity rounding; see
-                # _build_program on why in-body scaling breaks bit-exactness)
-                chunk = [c.astype(a.dtype)
-                         * w.reshape((-1,) + (1,) * (c.ndim - 1)).astype(a.dtype)
-                         for a, c in zip(acc, chunk)]
+        # the fold/tail closures are sec_plane's — the SAME objects the
+        # host oracle jits standalone, so parity is by construction
+        fold = make_fold_fn(mode)
+        tail = make_tail_fn(tx, opt_idx, out_dtypes)
+        staged = fused and (self.defense is not None or self.dp is not None)
 
-            def body(carry, x):
-                return [a + v.astype(a.dtype)
-                        for a, v in zip(carry, x)], None
+        if staged:
+            stage = make_stage_fn(self.defense, self.dp, mode, k)
+            repl = NamedSharding(mesh, P())
 
-            acc, _ = jax.lax.scan(body, acc, chunk)
-            return acc
+            def fn(params, opt_state, chunk, w, round_idx, client_ids,
+                   sigma):
+                # the security stage runs on a REPLICATED copy of the
+                # stack: its cross-coordinate reductions (row norms,
+                # Krum's pairwise matmul) must see whole rows, or GSPMD's
+                # partial-sum order would break the bitwise contract with
+                # the host oracle; the fold below stays model-sharded
+                c_r = [jax.lax.with_sharding_constraint(c, repl)
+                       for c in chunk]
+                p_r = [jax.lax.with_sharding_constraint(p, repl)
+                       for p in params]
+                c2, w2, rejected = stage(c_r, w, p_r, round_idx,
+                                         client_ids, sigma)
+                # anchor the stage EXIT replicated too, so the chunk_sh
+                # re-shard below cannot propagate backward into the
+                # stage's reductions — on meshes where the leaf dims
+                # happen to divide, that propagation splits the row-norm
+                # sums and drifts the stage off the oracle by an ulp —
+                # then pin the stage→fold boundary (where the host oracle
+                # has its program boundary) before re-sharding
+                c2 = [jax.lax.with_sharding_constraint(c, repl)
+                      for c in c2]
+                c2, w2 = jax.lax.optimization_barrier((c2, w2))
+                c2 = [jax.lax.with_sharding_constraint(c, s)
+                      for c, s in zip(c2, chunk_sh)]
+                zeros = [jnp.zeros(sh, dt)
+                         for sh, dt in zip(shapes, acc_dtypes)]
+                acc = fold(zeros, c2, w2)
+                acc = jax.lax.optimization_barrier(acc)
+                new, new_state = tail(params, opt_state, acc)
+                return new, new_state, rejected
 
-        def tail(params, opt_state, acc):
-            out = [a.astype(dt) if a.dtype != dt else a
-                   for a, dt in zip(acc, out_dtypes)]
-            if tx is None:
-                return out, opt_state
-            import optax
-            opt_params = [params[i].astype(out_dtypes[i]) for i in opt_idx]
-            pseudo_grad = [p - a for p, a in
-                           zip(opt_params, [out[i] for i in opt_idx])]
-            updates, new_state = tx.update(pseudo_grad, opt_state, opt_params)
-            stepped = optax.apply_updates(opt_params, updates)
-            new = list(out)
-            for i, v in zip(opt_idx, stepped):
-                new[i] = v
-            return new, new_state
-
-        if fused:
+            jitted = jax.jit(
+                fn, donate_argnums=(0, 1, 2),
+                in_shardings=(leaf_sh, opt_sh, chunk_sh, w_sh, repl, repl,
+                              repl),
+                out_shardings=(leaf_sh, opt_sh, repl))
+            param_sds = [jax.ShapeDtypeStruct(sh, dt, sharding=s)
+                         for sh, dt, s in zip(shapes, param_dtypes, leaf_sh)]
+            chunk_sds = [jax.ShapeDtypeStruct((k,) + sh, dt, sharding=s)
+                         for sh, dt, s in zip(shapes, wire_dtypes, chunk_sh)]
+            w_sds = jax.ShapeDtypeStruct((k,), jnp.float32, sharding=w_sh)
+            lowered_args = (param_sds, opt_sds, chunk_sds, w_sds,
+                            jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
+                            jax.ShapeDtypeStruct((k,), jnp.int32,
+                                                 sharding=repl),
+                            jax.ShapeDtypeStruct((), jnp.float32,
+                                                 sharding=repl))
+        elif fused:
             def fn(params, opt_state, chunk, w):
                 zeros = [jnp.zeros(sh, dt)
                          for sh, dt in zip(shapes, acc_dtypes)]
@@ -639,13 +678,14 @@ class ShardedRoundPlane(CompiledAggPlane):
         wire_bytes = int(sum(int(np.prod(sh) or 1) * jnp.dtype(dt).itemsize
                              for sh, dt in zip(shapes, wire_dtypes)))
         return _RoundProgram(compiled, leaf_sh, chunk_sh, opt_sh, acc_dtypes,
-                             wire_dtypes, out_dtypes, wire_bytes, fused)
+                             wire_dtypes, out_dtypes, wire_bytes, fused,
+                             staged)
 
     def _round_program_for(self, upd_dtypes, k, mode, fused,
                            parent) -> _RoundProgram:
         sig = (self.mesh_key, self._treedef, self._shapes, upd_dtypes,
                self._param_dtypes, self._opt_idx, k, mode, self.wire_dtype,
-               self.policy, fused)
+               self.policy, fused, self.defense, self.dp)
         prog = _ROUND_PROGRAMS.get(sig)
         if prog is None:
             sp = (obs.span("aggregate.compile", parent, k=k, mode=mode,
@@ -669,7 +709,10 @@ class ShardedRoundPlane(CompiledAggPlane):
     def round_update(self, params_tree: Pytree,
                      updates: Sequence[Tuple[float, Pytree]],
                      mode: str = "mean",
-                     obs_parent: Any = None) -> Pytree:
+                     obs_parent: Any = None,
+                     round_idx: int = 0,
+                     client_ids: Optional[Sequence[int]] = None,
+                     dp_sigma: float = 0.0) -> Pytree:
         """One full round tail on the mesh: reduce ``updates``, apply the
         server-optimizer policy against the resident global params, and
         materialize the new globals.  Returns the new global pytree (host
@@ -680,6 +723,13 @@ class ShardedRoundPlane(CompiledAggPlane):
         ``round_update`` returned (identity — the aggregate-install round
         trip through the server manager), it is re-installed first.
         Optimizer state always survives same-structure re-installs.
+
+        With a ``defense``/``dp`` stage configured the program grows a
+        pre-reduce security stage and ``round_idx`` / ``client_ids`` /
+        ``dp_sigma`` feed it as RUNTIME inputs (never cache keys): the DP
+        noise is a counter-based function of (seed, round_idx, client_id)
+        and ``dp_sigma`` is whatever scale the budget accountant granted
+        this round.
         """
         if mode not in ("mean", "sum"):
             raise ValueError(f"agg mode must be mean|sum (got {mode!r})")
@@ -709,13 +759,18 @@ class ShardedRoundPlane(CompiledAggPlane):
             w_all = np.ones(n, np.float32)
         upd_dtypes = tuple(jnp.dtype(jnp.result_type(l))
                            for l in leaves_list[0])
-        k = self.microbatch_clients or n
+        sec_active = self.defense is not None or self.dp is not None
+        # a sort/median defense ranks EVERY row of the stack: zero-padded
+        # or microbatched partial stacks would enter the consensus, so the
+        # staged program always folds the full stack fused at k == n
+        k = n if sec_active else (self.microbatch_clients or n)
         self._last_prog_args = (upd_dtypes, k, mode, k >= n)
         parent = obs_parent if obs_parent is not None else obs.active_ctx()
         sp = (obs.span("round.server_update", parent, n_clients=n, k=k,
                        mode=mode, policy=self.policy[0])
               if parent is not None else NULL_SPAN)
         w_sharding = NamedSharding(self.mesh, P())
+        rejected = 0.0
         t0 = time.perf_counter()
         with sp:
             params = jax.device_put(self._param_leaves, self._leaf_shardings)
@@ -735,8 +790,48 @@ class ShardedRoundPlane(CompiledAggPlane):
                 w = np.zeros(k, np.float32)
                 w[:n] = w_all
                 chunk = jax.device_put(chunk, prog.chunk_shardings)
-                new_leaves, new_opt = prog.fn(
-                    params, opt_state, chunk, jax.device_put(w, w_sharding))
+                if prog.staged:
+                    ids = (np.arange(n, dtype=np.int32) if client_ids is None
+                           else np.asarray(client_ids, np.int32))
+                    if ids.shape != (n,):
+                        raise ValueError(
+                            f"client_ids must have one id per update "
+                            f"({ids.shape} vs {n} updates)")
+                    dsp = (obs.span(
+                        "round.defense", sp if parent is not None else None,
+                        defense=(self.defense[0] if self.defense else "none"),
+                        dp=(self.dp[0] if self.dp else "none"), n_clients=n)
+                        if parent is not None else NULL_SPAN)
+                    with dsp:
+                        t_def = time.perf_counter()
+                        new_leaves, new_opt, rej = prog.fn(
+                            params, opt_state, chunk,
+                            jax.device_put(w, w_sharding),
+                            jax.device_put(np.int32(round_idx), w_sharding),
+                            jax.device_put(ids, w_sharding),
+                            jax.device_put(np.float32(dp_sigma), w_sharding))
+                        jax.block_until_ready(new_leaves)
+                        rejected = float(rej)
+                        def_s = time.perf_counter() - t_def
+                        dsp.end(rejected=int(rejected),
+                                seconds=round(def_s, 6))
+                    # staged-round time: the stage is fused with the fold/
+                    # tail, so this is the whole staged program's latency
+                    obs.histogram_observe(
+                        "agg.defense_seconds", def_s,
+                        labels={"defense": (self.defense[0] if self.defense
+                                            else "none")})
+                    if self.defense is not None:
+                        obs.counter_inc(
+                            "defense.clients_rejected_total", int(rejected),
+                            labels={"defense": self.defense[0]})
+                    if self.dp is not None:
+                        obs.gauge_set("dp.noise_scale", float(dp_sigma),
+                                      labels={"mechanism": self.dp[0]})
+                else:
+                    new_leaves, new_opt = prog.fn(
+                        params, opt_state, chunk,
+                        jax.device_put(w, w_sharding))
             else:
                 fold_prog = self._program_for(treedef, self._shapes,
                                               upd_dtypes, k, mode, parent)
@@ -1040,8 +1135,10 @@ def make_round_plane(args: Any, mesh: Optional[Mesh] = None) -> ShardedRoundPlan
     shrunken topology and the portable snapshot codec re-shards onto it."""
     wire, k = plane_config(args)
     mesh = mesh if mesh is not None else round_mesh_for(args)
+    defense, dp = plane_security(args)
     return ShardedRoundPlane(mesh=mesh, wire_dtype=wire,
-                             microbatch_clients=k, policy=round_policy(args))
+                             microbatch_clients=k, policy=round_policy(args),
+                             defense=defense, dp=dp)
 
 
 def reset_planes() -> None:

@@ -1,0 +1,369 @@
+"""Compiled defense & privacy stages for the sharded round plane.
+
+The seed's threat-model stack (``core/security`` robust aggregation,
+``core/dp`` noise, ``core/mpc`` SecAgg) is host-side Python: per-update
+loops and ``tree_map`` walks whose cost scales with Python object overhead.
+This module restates the subset that belongs on the round path as PURE jnp
+stage functions over the stacked client-delta chunk, shared VERBATIM by
+
+* the fused round program (:class:`~fedml_tpu.parallel.agg_plane.
+  ShardedRoundPlane` inserts them as pre-reduce stages, pinned off the fold
+  by ``optimization_barrier``), and
+* the retained host oracle (:func:`host_secure_round_update` — the same
+  stage/fold/tail functions as three separately-jitted programs),
+
+so "compiled == host" is a bitwise contract, not a tolerance.
+
+Stage order is DP first (per-client clip + counter-keyed noise — local DP
+happens before anyone aggregates), then the defense filter.  Inside the
+fused program the stage runs on a REPLICATED copy of the chunk
+(``with_sharding_constraint``): the cross-coordinate reductions (row norms,
+Krum's pairwise-distance matmul) must not be split across the model axis,
+where GSPMD's partial-sum order would break bit-exactness against the
+oracle.  The elementwise fold that follows stays model-sharded.
+
+DP noise is a COUNTER-BASED stream: ``fold_in(fold_in(key(seed), round),
+client_id)`` — a pure function of (seed, round, client), so replaying a
+round, resuming from a checkpoint, or shrinking the mesh 4→2 regenerates
+identical noise.  The split-threaded stream in
+:mod:`fedml_tpu.core.dp.fedml_differential_privacy` stays for the host
+mechanisms; the accountant still drives the scale (``sigma`` is a RUNTIME
+scalar input, never part of the program cache key).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+#: per-stage placement knobs on the agg plane
+SEC_PLANES = ("host", "compiled")
+
+#: defenses with an in-mesh (stacked, pre-reduce) form on the round plane
+PLANE_DEFENSES = ("krum", "multi_krum", "norm_diff_clipping",
+                  "coordinate_wise_trimmed_mean")
+
+
+# ---------------------------------------------------------------------------
+# knob + spec resolution (specs are hashable — they key the program cache)
+# ---------------------------------------------------------------------------
+def stage_plane(args: Any, knob: str) -> str:
+    v = str(getattr(args, knob, "host") or "host").lower()
+    if v not in SEC_PLANES:
+        raise ValueError(f"{knob} must be one of {SEC_PLANES} (got {v!r})")
+    return v
+
+
+def defense_spec(args: Any) -> Optional[Tuple]:
+    """Hashable defense-stage spec for the enabled defense, or None when no
+    defense is enabled.  Raises when the enabled defense has no in-mesh
+    form — the caller asked for ``defense_plane=compiled`` and silently
+    running undefended would be a security hole, not a degrade."""
+    if not bool(getattr(args, "enable_defense", False)):
+        return None
+    t = str(getattr(args, "defense_type", "") or "")
+    if t == "norm_diff_clipping":
+        return ("norm_clip", float(getattr(args, "norm_bound", 5.0)))
+    if t == "coordinate_wise_trimmed_mean":
+        return ("trimmed_mean", float(getattr(args, "beta", 0.1)))
+    if t in ("krum", "multi_krum"):
+        byz = int(getattr(args, "byzantine_client_num", 1))
+        m = (max(int(getattr(args, "krum_param_m", 1)), 1)
+             if t == "multi_krum" else 1)
+        return ("krum", byz, m)
+    raise ValueError(
+        f"defense_type {t!r} has no compiled (in-mesh) stage; supported: "
+        f"{PLANE_DEFENSES} — set defense_plane=host for the others")
+
+
+def dp_spec(args: Any) -> Optional[Tuple]:
+    """Hashable DP-stage spec (mechanism, clip, seed), or None when DP is
+    off.  The noise SCALE is deliberately absent: sigma is a runtime scalar
+    the budget accountant drives per round, so budget decay never forces a
+    recompile."""
+    if not bool(getattr(args, "enable_dp", False)):
+        return None
+    mech = str(getattr(args, "mechanism_type", "gaussian") or "gaussian").lower()
+    if mech not in ("gaussian", "laplace"):
+        raise ValueError(f"unknown DP mechanism: {mech!r}")
+    clip = float(getattr(args, "sensitivity", 1.0))
+    seed = int(getattr(args, "random_seed", 0))
+    return (mech, clip, seed)
+
+
+def plane_security(args: Any) -> Tuple[Optional[Tuple], Optional[Tuple]]:
+    """(defense, dp) stage specs for the round plane — each stage rides the
+    compiled path only when its knob opts in; ``host`` keeps the existing
+    host hooks authoritative."""
+    d = defense_spec(args) if stage_plane(args, "defense_plane") == "compiled" else None
+    p = dp_spec(args) if stage_plane(args, "dp_plane") == "compiled" else None
+    return d, p
+
+
+def dp_runtime_sigma(args: Any) -> float:
+    """This round's noise scale from the mechanism formulas (the budget
+    accountant gates whether the round may spend at all; the scale itself
+    is the classic calibration)."""
+    spec = dp_spec(args)
+    if spec is None:
+        return 0.0
+    mech, clip, _ = spec
+    eps = float(getattr(args, "epsilon", 1.0))
+    if mech == "gaussian":
+        from ..core.dp.mechanisms import Gaussian
+        return Gaussian.compute_sigma(eps, float(getattr(args, "delta", 1e-5)), clip)
+    return clip / eps  # laplace scale
+
+
+# ---------------------------------------------------------------------------
+# the shared fold / tail (agg_plane builds its fused program from THESE, the
+# host oracle jits the same closures standalone — one definition, two paths)
+# ---------------------------------------------------------------------------
+def make_fold_fn(mode: str):
+    """Left-to-right scan fold of the (k, ...) chunk into the accumulator.
+    ``mean`` scales the whole chunk BEFORE the scan: the product must
+    materialize at the while-loop boundary so it rounds to f32 exactly like
+    the host path's ``tree_scale`` — inside the loop body LLVM would
+    contract ``a + v*w`` into an fma and break bit-exactness."""
+
+    def fold(acc, chunk, w):
+        if mode == "mean":
+            chunk = [c.astype(a.dtype)
+                     * w.reshape((-1,) + (1,) * (c.ndim - 1)).astype(a.dtype)
+                     for a, c in zip(acc, chunk)]
+
+        def body(carry, x):
+            return [a + v.astype(a.dtype)
+                    for a, v in zip(carry, x)], None
+
+        acc, _ = jax.lax.scan(body, acc, chunk)
+        return acc
+
+    return fold
+
+
+def make_tail_fn(tx, opt_idx: Sequence[int], out_dtypes: Sequence[Any]):
+    """Server-optimizer tail over the reduced accumulator: cast to the host
+    output dtypes, pseudo-gradient = params − aggregate over the optimizer
+    leaves, one optax update, scatter back."""
+
+    def tail(params, opt_state, acc):
+        out = [a.astype(dt) if a.dtype != dt else a
+               for a, dt in zip(acc, out_dtypes)]
+        if tx is None:
+            return out, opt_state
+        import optax
+        opt_params = [params[i].astype(out_dtypes[i]) for i in opt_idx]
+        pseudo_grad = [p - a for p, a in
+                       zip(opt_params, [out[i] for i in opt_idx])]
+        updates, new_state = tx.update(pseudo_grad, opt_state, opt_params)
+        stepped = optax.apply_updates(opt_params, updates)
+        new = list(out)
+        for i, v in zip(opt_idx, stepped):
+            new[i] = v
+        return new, new_state
+
+    return tail
+
+
+# ---------------------------------------------------------------------------
+# the pre-reduce stage: DP then defense, over the stacked chunk
+# ---------------------------------------------------------------------------
+def make_stage_fn(defense: Optional[Tuple], dp: Optional[Tuple], mode: str,
+                  n: int):
+    """-> ``stage(chunk, w, params, round_idx, client_ids, sigma) ->
+    (chunk', w', rejected)``, pure jnp over the per-leaf chunk lists.
+
+    ``n`` is the STATIC number of client rows and must equal the chunk's
+    leading dim: the stage forbids zero-padded rows (a sort/median defense
+    would rank padding as the consensus), so the plane always runs the
+    staged program fused at ``k == n``.
+
+    Selection semantics per aggregation mode: ``mean`` rejects clients
+    through the weight vector (zero + renormalize — exactly the surviving
+    clients' ``n_i / N_surviving``); ``sum`` zeroes the rejected rows
+    (sum-mode folds never read weights).  Aggregate-replacing defenses
+    (trimmed mean) broadcast their consensus into row 0 with a one-hot
+    weight, which the fold reproduces exactly (``t * 1.0 == t``).
+    """
+    from ..core.security.defense_funcs import krum_scores
+
+    def stage(chunk, w, params, round_idx, client_ids, sigma):
+        k = chunk[0].shape[0]
+        G = jnp.concatenate(
+            [c.reshape(k, -1).astype(jnp.float32) for c in chunk], axis=1)
+        p_vec = jnp.concatenate(
+            [p.reshape(-1).astype(jnp.float32) for p in params])
+        rejected = jnp.zeros((), jnp.float32)
+        if dp is not None:
+            mech, clip, seed = dp
+            delta = G - p_vec[None, :]
+            nrm = jnp.linalg.norm(delta, axis=1, keepdims=True)
+            delta = delta * jnp.minimum(1.0, clip / jnp.maximum(nrm, 1e-12))
+            # counter-based keys: a pure function of (seed, round, client) —
+            # seed-deterministic and replay/remesh-stable by construction
+            base = jax.random.fold_in(
+                jax.random.PRNGKey(seed), round_idx.astype(jnp.uint32))
+            keys = jax.vmap(
+                lambda c: jax.random.fold_in(base, c.astype(jnp.uint32))
+            )(client_ids)
+            sample = (jax.random.normal if mech == "gaussian"
+                      else jax.random.laplace)
+            noise = jax.vmap(
+                lambda key: sample(key, (G.shape[1],), jnp.float32))(keys)
+            G = p_vec[None, :] + delta + sigma.astype(jnp.float32) * noise
+        if defense is not None:
+            kind = defense[0]
+            if kind == "norm_clip":
+                bound = defense[1]
+                diff = G - p_vec[None, :]
+                nrm = jnp.linalg.norm(diff, axis=1, keepdims=True)
+                G = p_vec[None, :] + diff * jnp.minimum(
+                    1.0, bound / jnp.maximum(nrm, 1e-12))
+            elif kind == "krum":
+                byz, m = defense[1], defense[2]
+                # pairwise distances over the clients axis are ONE matmul
+                # (krum_scores: ||xi||^2 + ||xj||^2 - 2 xi.xj)
+                scores = krum_scores(G, byz)
+                chosen = jnp.argsort(scores)[:m]
+                sel = jnp.zeros((k,), jnp.float32).at[chosen].set(1.0)
+                rejected = jnp.asarray(k, jnp.float32) - jnp.sum(sel)
+                if mode == "mean":
+                    ws = w * sel
+                    w = ws / jnp.sum(ws)
+                else:
+                    G = G * sel[:, None]
+            elif kind == "trimmed_mean":
+                beta = defense[1]
+                kk = max(0, min(int(n * float(beta)), (n - 1) // 2))
+                srt = jnp.sort(G, axis=0)
+                t = jnp.mean(srt[kk: n - kk], axis=0)
+                G = jnp.zeros_like(G).at[0].set(t)
+                w = jnp.zeros((k,), jnp.float32).at[0].set(1.0)
+                rejected = jnp.asarray(2 * kk, jnp.float32)
+            else:
+                raise ValueError(f"unknown defense stage {kind!r}")
+        out, off = [], 0
+        for c in chunk:
+            size = int(np.prod(c.shape[1:]) or 1)
+            out.append(G[:, off:off + size].reshape(c.shape).astype(c.dtype))
+            off += size
+        return out, w, rejected
+
+    return stage
+
+
+# ---------------------------------------------------------------------------
+# the retained host oracle
+# ---------------------------------------------------------------------------
+_HOST_PROGRAMS: Dict[Any, Any] = {}
+
+
+def host_secure_round_update(params_tree: Pytree,
+                             updates: Sequence[Tuple[float, Pytree]],
+                             mode: str = "mean",
+                             policy: Tuple = ("fedavg",),
+                             opt_state: Any = None,
+                             defense: Optional[Tuple] = None,
+                             dp: Optional[Tuple] = None,
+                             round_idx: int = 0,
+                             client_ids: Optional[np.ndarray] = None,
+                             dp_sigma: float = 0.0):
+    """Host-path round update with the security stages applied: the SAME
+    stage/fold/tail closures the fused round program traces, run as three
+    separately-jitted host programs (stage → materialize → fold →
+    materialize → tail — the boundaries the plane pins with
+    ``optimization_barrier``).  Bit-exact reference for
+    :meth:`~fedml_tpu.parallel.agg_plane.ShardedRoundPlane.round_update`
+    with stages active; with ``defense=dp=None`` it reduces to the plain
+    stage-free fold + tail.
+
+    Returns ``(new_global_tree, new_opt_state, rejected_clients)``.
+    """
+    from ..core.aggregate import flatten_checked, leaf_paths, opt_leaf_indices
+    from ..parallel.agg_plane import _policy_tx
+
+    if mode not in ("mean", "sum"):
+        raise ValueError(f"agg mode must be mean|sum (got {mode!r})")
+    if not updates:
+        raise ValueError("no updates to aggregate")
+    ns = [float(x) for x, _ in updates]
+    leaves_list, treedef = flatten_checked([t for _, t in updates])
+    n = len(leaves_list)
+    p_leaves, p_treedef = jax.tree_util.tree_flatten(params_tree)
+    if p_treedef != treedef:
+        raise ValueError(
+            f"global params structure {p_treedef} differs from the client "
+            f"updates {treedef}")
+    if mode == "mean":
+        total = float(sum(ns))
+        if total <= 0:
+            raise ValueError("total sample count must be positive")
+        w_all = np.asarray([x / total for x in ns], np.float32)
+    else:
+        w_all = np.ones(n, np.float32)
+    shapes = tuple(tuple(np.shape(l)) for l in leaves_list[0])
+    upd_dtypes = tuple(jnp.dtype(jnp.result_type(l)) for l in leaves_list[0])
+    param_dtypes = tuple(jnp.dtype(jnp.result_type(l)) for l in p_leaves)
+    names = leaf_paths(treedef)
+    tx = _policy_tx(tuple(policy))
+    opt_idx = tuple(opt_leaf_indices(names, param_dtypes)) if tx is not None else ()
+    # the plane's _leaf_plan dtype policy, host-side: floats accumulate f32
+    # and keep their dtype; ints accumulate/stay integer under sum and
+    # promote to f32 under mean
+    acc_dtypes, out_dtypes = [], []
+    for dt in upd_dtypes:
+        if jnp.issubdtype(dt, jnp.floating):
+            acc_dtypes.append(jnp.dtype(jnp.float32))
+            out_dtypes.append(dt)
+        elif mode == "sum":
+            acc_dtypes.append(dt)
+            out_dtypes.append(dt)
+        else:
+            acc_dtypes.append(jnp.dtype(jnp.float32))
+            out_dtypes.append(jnp.dtype(jnp.float32))
+
+    key = (treedef, shapes, upd_dtypes, param_dtypes, opt_idx, n, mode,
+           tuple(policy), defense, dp)
+    progs = _HOST_PROGRAMS.get(key)  # fedlint: allow[mesh-stale-program] — host oracle programs are unsharded plain jit; there is no mesh identity to key on
+    if progs is None:
+        stage = (jax.jit(make_stage_fn(defense, dp, mode, n))
+                 if (defense is not None or dp is not None) else None)
+        fold = jax.jit(make_fold_fn(mode))
+        tail = jax.jit(make_tail_fn(tx, opt_idx, out_dtypes))
+        progs = (stage, fold, tail)
+        _HOST_PROGRAMS[key] = progs
+    stage, fold, tail = progs
+
+    chunk = [np.stack([np.asarray(leaves_list[c][j]) for c in range(n)])
+             for j in range(len(shapes))]
+    w = jnp.asarray(w_all)
+    rejected = 0.0
+    if stage is not None:
+        ids = (np.arange(n, dtype=np.int32) if client_ids is None
+               else np.asarray(client_ids, np.int32))
+        chunk, w, rej = stage(
+            [jnp.asarray(c) for c in chunk], w,
+            [jnp.asarray(np.asarray(l)) for l in p_leaves],
+            jnp.asarray(int(round_idx), jnp.int32), jnp.asarray(ids),
+            jnp.asarray(float(dp_sigma), jnp.float32))
+        rejected = float(rej)
+    zeros = [jnp.zeros(sh, dt) for sh, dt in zip(shapes, acc_dtypes)]
+    acc = fold(zeros, [jnp.asarray(c) for c in chunk], w)
+    if tx is not None and opt_state is None:
+        opt_state = tx.init([jnp.asarray(np.asarray(p_leaves[i]))
+                             .astype(out_dtypes[i]) for i in opt_idx])
+    new_leaves, new_opt = tail(
+        [jnp.asarray(np.asarray(l)) for l in p_leaves], opt_state, acc)
+    return (jax.tree_util.tree_unflatten(
+        treedef, [np.asarray(x) for x in new_leaves]), new_opt, rejected)
+
+
+def reset_host_programs() -> None:
+    """Drop the cached host-oracle programs (tests)."""
+    _HOST_PROGRAMS.clear()

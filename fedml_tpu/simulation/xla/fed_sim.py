@@ -144,14 +144,10 @@ class XLASimulator:
             l.size * l.dtype.itemsize
             for l in jax.tree_util.tree_leaves(self.variables)))
         self.packed = bool(getattr(args, "xla_pack", False))
-        if self.sharded_state and (self.packed or self.needs_stack):
-            # the packed streamer and the security tail both carry their own
-            # in-mesh server step on per-client stacks; resharding those onto
-            # the model axis is future work — fail loud over silently
-            # reporting replicated-state results as a sharded run
-            raise NotImplementedError(
-                "server_state=sharded supports the plain in-mesh round only "
-                "(disable xla_pack and security/defense hooks)")
+        # sharded_state composes with BOTH the packed streamer and the
+        # security tail now: each of those programs ends at the psum'd
+        # accumulator and the model-sharded GSPMD tail applies the server
+        # step — defended + model-sharded rounds run, they don't degrade
         if self.packed:
             self._build_packed_round_fn()
         else:
@@ -470,6 +466,7 @@ class XLASimulator:
             int(getattr(self.args, "epochs", 1)),
         )
         stacked = self.needs_stack
+        sharded = self.sharded_state
         device_fn = build_packed_device_fn(
             self.module, self.args, algo, self.batch_size, self.slots,
             loss=self.loss_kind,
@@ -494,12 +491,21 @@ class XLASimulator:
                 return mean_loss, outs, ext
             acc = jax.lax.psum(acc, "client")
             wsum = jax.lax.psum(wsum, "client")
+            if sharded:
+                # program ends at the reduced accumulator; the model-sharded
+                # tail applies the server step (same split as _build_round_fn)
+                return acc, wsum, ext, mean_loss, outs
             new_global, new_state = algo.server_update(
                 acc, wsum, ext, variables, server_state
             )
             return new_global, new_state, mean_loss, outs
 
-        out_specs = (P(), P("client"), P()) if stacked else (P(), P(), P(), P("client"))
+        if stacked:
+            out_specs = (P(), P("client"), P())
+        elif sharded:
+            out_specs = (P(), P(), P(), P(), P("client"))
+        else:
+            out_specs = (P(), P(), P(), P("client"))
         self._round_fn = jax.jit(
             shard_map(
                 per_device,
@@ -531,6 +537,7 @@ class XLASimulator:
 
         algo = self.algo
         via_acc = algo.aggregates_via_acc
+        sharded = self.sharded_state
         use_plane = self.agg_plane == "compiled"
         attacker = FedMLAttacker.get_instance()
         defender = FedMLDefender.get_instance()
@@ -587,6 +594,11 @@ class XLASimulator:
                 # a weighted sum (every acc strategy divides by wsum)
                 wsum = jnp.sum(w)
                 acc = jax.tree_util.tree_map(lambda t: t * wsum, agg)
+                if sharded:
+                    # model-sharded state: the defended reduce stops at the
+                    # accumulator and the GSPMD server tail applies the step
+                    # (same two-program split as the undefended sharded round)
+                    return acc, wsum, ext, dstate
                 new_global, new_server_state = algo.server_update(
                     acc, wsum, ext, prev_global, server_state
                 )
@@ -608,6 +620,8 @@ class XLASimulator:
             # contract-complete acc (the defended weighted sum); strategies
             # that only read ext leave it to XLA's dead-code elimination
             acc = unravel(w2 @ mat)
+            if sharded:
+                return acc, jnp.sum(w2), ext2, dstate
             new_global, new_server_state = algo.server_update(
                 acc, jnp.sum(w2), ext2, prev_global, server_state
             )
@@ -897,25 +911,58 @@ class XLASimulator:
                     # (one split per round is the replayable invariant)
                     skey = jax.random.fold_in(sub, 999331)
                     meta = self.algo.security_meta(taus, cex, jnp.asarray(real_sel))
+                    sec_inputs = (
+                        stack,
+                        jnp.asarray(counts[real_sel], jnp.float32),
+                        jnp.asarray(real_sel),
+                        jnp.asarray(mal),
+                        meta,
+                        self.variables,
+                        self.server_state,
+                        ext,
+                        skey,
+                        dstate,
+                    )
                     with obs.span("aggregate.reduce", rsp.ctx,
                                   round_idx=round_idx,
                                   n_clients=int(real_sel.size),
                                   mode="inmesh"):
-                        self.variables, self.server_state, self._defense_state = (
-                            self._security_fn(
-                                stack,
-                                jnp.asarray(counts[real_sel], jnp.float32),
-                                jnp.asarray(real_sel),
-                                jnp.asarray(mal),
-                                meta,
-                                self.variables,
-                                self.server_state,
-                                ext,
-                                skey,
-                                dstate,
-                            )
-                        )
-                        jax.block_until_ready(self.variables)
+                        if self.sharded_state:
+                            # defended + model-sharded: the security program
+                            # stops at the robust accumulator; the GSPMD
+                            # server tail applies the step on donated
+                            # resident buffers (the same two-program split
+                            # the undefended sharded round uses)
+                            acc_d, wsum_d, ext_d, self._defense_state = (
+                                self._security_fn(*sec_inputs))
+                            var_sh, state_sh, repl = self._tail_shardings
+                            t_tail = time.time()
+                            with warnings.catch_warnings():
+                                warnings.filterwarnings(
+                                    "ignore",
+                                    message="Some donated buffers were not usable")
+                                self.variables, self.server_state = self._server_tail(
+                                    jax.device_put(self.variables, var_sh),
+                                    jax.device_put(self.server_state, state_sh),
+                                    jax.device_put(acc_d, var_sh),
+                                    jax.device_put(wsum_d, repl),
+                                    jax.device_put(ext_d, repl),
+                                )
+                            jax.block_until_ready(self.variables)
+                            obs.histogram_observe(
+                                "server_opt.step_seconds", time.time() - t_tail,
+                                labels={"policy": type(self.algo).__name__,
+                                        "mode": "inmesh"})
+                            if self._tail_subset:
+                                full = NamedSharding(self.mesh, P())
+                                self.variables = jax.device_put(
+                                    self.variables, full)
+                                self.server_state = jax.device_put(
+                                    self.server_state, full)
+                        else:
+                            self.variables, self.server_state, self._defense_state = (
+                                self._security_fn(*sec_inputs))
+                            jax.block_until_ready(self.variables)
                     if self.analysis_attacked and round_idx % max(
                         1, int(getattr(self.args, "dlg_frequency", 1))
                     ) == 0:

@@ -1,0 +1,59 @@
+"""Golden fixture: host aggregation folds in the security plane
+(expected: 3).  The ``sec_`` basename opts this file into the
+``sec-host-fallback`` scope (the rule otherwise keys on the
+``core/security`` / ``core/dp`` / ``core/mpc`` path fragments).
+
+Line 25 — sec-host-fallback: a Python loop folding client ``updates``
+into a running accumulator (the host-fallback aggregation pattern).
+Line 32 — sec-host-fallback: a modular fold over masked payloads
+through ``.values()`` — the SecAgg host field sum.
+Line 40 — sec-host-fallback: ``tree_map`` over a client payload
+collection in a function with no JAX-compute marker — a host pytree
+fold.
+
+The clean counterparts: ``inspect_updates`` iterates payloads without
+accumulating (no fold), ``compiled_fold`` uses ``tree_map`` next to
+``jnp`` compute (a compiled stage, not a host fallback), and
+``oracle_fold`` carries a justified pragma (the retained-oracle seam).
+"""
+
+import numpy as np
+
+
+def host_fold(updates):
+    total = np.zeros(4)
+    for _, p in updates:
+        total = total + p
+    return total
+
+
+def masked_field_sum(masked, prime):
+    total = np.zeros(4, np.int64)
+    for v in masked.values():
+        total = np.mod(total + v, prime)
+    return total
+
+
+def host_tree_fold(raw_grad_list, tree_map):
+    acc = raw_grad_list[0]
+    for g in raw_grad_list[1:]:
+        acc = tree_map(lambda a, b: a + b, acc, g)
+    return acc
+
+
+def inspect_updates(updates):
+    names = []
+    for n, _ in updates:
+        names.append(n)
+    return names
+
+
+def compiled_fold(updates, jnp, tree_map):
+    return tree_map(lambda s: jnp.sum(s, axis=0), updates)
+
+
+def oracle_fold(updates):
+    total = np.zeros(4)
+    for _, p in updates:  # fedlint: allow[sec-host-fallback] — retained host oracle for the fixture
+        total = total + p
+    return total

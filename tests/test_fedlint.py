@@ -121,6 +121,19 @@ def test_mesh_stale_fixture_exact_findings():
     ]
 
 
+def test_sec_fallback_fixture_exact_findings():
+    """The security-plane satellite: host aggregation folds over client
+    payloads in core/security|core/dp|core/mpc must either move onto the
+    compiled plane (parallel/sec_plane, core/mpc/inmesh) or carry a
+    justified retained-oracle pragma.  The payload-inspection loop, the
+    jnp-marked tree_map, and the pragma'd oracle stay clean."""
+    assert _lint_fixture("sec_fallback.py") == [
+        (25, "sec-host-fallback"),
+        (32, "sec-host-fallback"),
+        (40, "sec-host-fallback"),
+    ]
+
+
 def test_legacy_shims_catch_alias_dodges():
     """The four legacy CLIs ride the same AST passes now, so the alias
     dodges are caught through the old entry points too."""
@@ -279,7 +292,7 @@ def test_cli_json_schema_is_stable():
         "suppressed",
         "version",
     ]
-    assert report["counts"]["findings"] == len(report["findings"]) == 16
+    assert report["counts"]["findings"] == len(report["findings"]) == 19
     first = report["findings"][0]
     assert sorted(first.keys()) >= ["analyzer", "line", "message", "path", "rule", "source"]
     assert {f["rule"] for f in report["findings"]} >= {
@@ -287,6 +300,7 @@ def test_cli_json_schema_is_stable():
         "ack-before-journal",
         "purity-donated-reuse",
         "mesh-stale-program",
+        "sec-host-fallback",
     }
 
 

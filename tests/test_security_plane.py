@@ -1,0 +1,348 @@
+"""The compiled defense & privacy plane (``parallel/sec_plane``,
+``core/mpc/inmesh``, ``core/mpc/dropout``).
+
+Four strata:
+
+* **Compiled == host, bitwise** — the parity matrix: every in-mesh defense
+  crossed with every server policy, modes checkerboarded so each defense
+  and each policy is exercised under both ``mean`` and ``sum``; the fused
+  staged round program on the 8-device mesh must agree BIT-FOR-BIT with
+  :func:`~fedml_tpu.parallel.sec_plane.host_secure_round_update` (the same
+  stage/fold/tail closures as three separately-jitted host programs).
+* **DP determinism** — the counter-based noise stream is a pure function of
+  (seed, round, client): identical inputs replay identical noise, the
+  round/client counters actually move the stream, sigma is a RUNTIME
+  scalar (no recompile between sigma values), and a 4→2 device remesh
+  regenerates bitwise-identical noise.
+* **Finite-field properties** — M31 residue ops: the compiled scan equals
+  the host loop in ANY summation order (exact integer math), add/sub
+  round-trip, boundary residues, and out-of-range rejection.
+* **SecAgg dropout chaos** (the ``secagg_dropout`` leg of
+  ``tools/chaos_check.py``) — a client dropped mid-upload plus a server
+  kill mid-round: the restored round unmasks BIT-IDENTICALLY to the
+  uninterrupted one with exactly-once duplicate accounting, and below the
+  reconstruction threshold the round aborts instead of emitting garbage.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.core.mpc.dropout import SecAggRound
+from fedml_tpu.core.mpc.field import FIELD_PRIME
+from fedml_tpu.core.mpc.inmesh import (
+    field_add,
+    field_sub,
+    field_sum,
+    reset_kernels,
+)
+from fedml_tpu.parallel.agg_plane import (
+    _ROUND_PROGRAMS,
+    ShardedRoundPlane,
+    reset_planes,
+)
+from fedml_tpu.parallel.mesh import create_round_mesh, set_visible_devices
+from fedml_tpu.parallel.sec_plane import (
+    PLANE_DEFENSES,
+    host_secure_round_update,
+    reset_host_programs,
+)
+
+
+@pytest.fixture(autouse=True)
+def _plane_hygiene():
+    """Planes, round programs, host-oracle programs, and field kernels are
+    process-cached; device visibility is process-global.  Leave all clean."""
+    set_visible_devices(None)
+    reset_planes()
+    reset_host_programs()
+    reset_kernels()
+    yield
+    set_visible_devices(None)
+    reset_planes()
+    reset_host_programs()
+    reset_kernels()
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.normal(size=(6, 4)).astype(np.float32),
+            "b": rng.normal(size=(4,)).astype(np.float32)}
+
+
+def _updates(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return [(float(i + 1),
+             {"w": rng.normal(size=(6, 4)).astype(np.float32),
+              "b": rng.normal(size=(4,)).astype(np.float32)})
+            for i in range(n)]
+
+
+def _assert_bit_identical(a, b):
+    fa, ta = jax.tree_util.tree_flatten(a)
+    fb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(fa, fb):
+        assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Compiled == host: the defense x policy parity matrix
+# ---------------------------------------------------------------------------
+
+_DEFENSES = [
+    ("norm_clip", 1.5),
+    ("krum", 1, 1),
+    ("krum", 1, 3),       # multi-Krum, m survivors
+    ("trimmed_mean", 0.2),
+]
+_POLICIES = [
+    ("fedavg",),
+    ("sgd", 0.9, 0.0),
+    ("adam", 0.1, 0.9),
+    ("yogi", 0.1, 0.9),
+    ("adagrad", 0.1, 0.0),
+]
+_DP = ("gaussian", 1.0, 0)
+
+# every (defense, policy) pair, modes checkerboarded: each defense and each
+# policy sees both mean and sum without doubling the compile bill
+_MATRIX = [(d, p, ("mean", "sum")[(i + j) % 2])
+           for i, d in enumerate(_DEFENSES)
+           for j, p in enumerate(_POLICIES)]
+
+
+class TestCompiledHostParity:
+    """The tentpole acceptance claim: with the security stages active the
+    fused round program agrees bitwise with the retained host oracle."""
+
+    @pytest.mark.parametrize(
+        "defense,policy,mode", _MATRIX,
+        ids=[f"{d[0]}-{p[0]}-{m}" for d, p, m in _MATRIX])
+    def test_defense_policy_parity_bitwise(self, defense, policy, mode):
+        params, updates = _tree(10), _updates(6, seed=11)
+        plane = ShardedRoundPlane(policy=policy, defense=defense, dp=_DP)
+        got = plane.round_update(params, updates, mode=mode, round_idx=3,
+                                 client_ids=list(range(6)), dp_sigma=0.7)
+        want, _, _ = host_secure_round_update(
+            params, updates, mode=mode, policy=policy, defense=defense,
+            dp=_DP, round_idx=3, client_ids=np.arange(6), dp_sigma=0.7)
+        _assert_bit_identical(got, want)
+
+    def test_dp_only_stage_parity_bitwise(self):
+        """DP without a defense filter still stages bitwise."""
+        params, updates = _tree(12), _updates(5, seed=13)
+        plane = ShardedRoundPlane(policy=("fedavg",), dp=("laplace", 2.0, 9))
+        got = plane.round_update(params, updates, round_idx=1,
+                                 client_ids=[3, 1, 4, 1, 5], dp_sigma=0.3)
+        want, _, _ = host_secure_round_update(
+            params, updates, dp=("laplace", 2.0, 9), round_idx=1,
+            client_ids=np.asarray([3, 1, 4, 1, 5]), dp_sigma=0.3)
+        _assert_bit_identical(got, want)
+
+    def test_every_plane_defense_has_a_matrix_row(self):
+        """_DEFENSES tracks PLANE_DEFENSES — growing the plane without
+        growing the parity matrix is a silent coverage hole."""
+        kinds = {d[0] for d in _DEFENSES}
+        assert kinds == {"norm_clip", "krum", "trimmed_mean"}
+        assert len(PLANE_DEFENSES) == 4  # krum + multi_krum share a stage
+
+
+# ---------------------------------------------------------------------------
+# DP determinism: counter-based noise, runtime sigma, remesh stability
+# ---------------------------------------------------------------------------
+
+class TestDPDeterminism:
+    def test_dp_noise_counter_deterministic(self):
+        """Same (seed, round, client) -> same noise, bitwise; moving either
+        counter moves the stream."""
+        params, updates = _tree(20), _updates(4, seed=21)
+        kw = dict(dp=("gaussian", 1.0, 7), dp_sigma=0.5,
+                  client_ids=np.asarray([2, 5, 8, 11]))
+        a, _, _ = host_secure_round_update(params, updates, round_idx=4, **kw)
+        b, _, _ = host_secure_round_update(params, updates, round_idx=4, **kw)
+        _assert_bit_identical(a, b)
+        c, _, _ = host_secure_round_update(params, updates, round_idx=5, **kw)
+        assert np.asarray(a["w"]).tobytes() != np.asarray(c["w"]).tobytes()
+        kw["client_ids"] = np.asarray([2, 5, 8, 12])
+        d, _, _ = host_secure_round_update(params, updates, round_idx=4, **kw)
+        assert np.asarray(a["w"]).tobytes() != np.asarray(d["w"]).tobytes()
+
+    def test_dp_sigma_is_runtime_not_a_cache_key(self):
+        """Budget decay (the accountant shrinking sigma round over round)
+        must never force a recompile: two sigmas, one program."""
+        params, updates = _tree(22), _updates(4, seed=23)
+        plane = ShardedRoundPlane(policy=("fedavg",), dp=_DP)
+        out1 = plane.round_update(params, updates, round_idx=0,
+                                  client_ids=[0, 1, 2, 3], dp_sigma=0.5)
+        n_progs = len(_ROUND_PROGRAMS)
+        out2 = plane.round_update(out1, updates, round_idx=1,
+                                  client_ids=[0, 1, 2, 3], dp_sigma=0.125)
+        assert len(_ROUND_PROGRAMS) == n_progs
+        assert np.asarray(out2["w"]).dtype == np.float32
+
+    def test_dp_determinism_under_remesh_4_to_2(self):
+        """The remesh-stability claim: shrinking the mesh 4 -> 2 devices
+        regenerates bitwise-identical DP noise (the counter-based stream
+        depends on (seed, round, client), never on topology) — and both
+        topologies match the unsharded host oracle."""
+        params, updates = _tree(24), _updates(4, seed=25)
+        kw = dict(round_idx=6, client_ids=[1, 3, 5, 7], dp_sigma=0.9)
+        mesh4 = create_round_mesh(clients=1, model=4,
+                                  devices=jax.devices()[:4])
+        mesh2 = create_round_mesh(clients=1, model=2,
+                                  devices=jax.devices()[:2])
+        p4 = ShardedRoundPlane(mesh=mesh4, policy=("adam", 0.1, 0.9),
+                               defense=("norm_clip", 2.0), dp=_DP)
+        p2 = ShardedRoundPlane(mesh=mesh2, policy=("adam", 0.1, 0.9),
+                               defense=("norm_clip", 2.0), dp=_DP)
+        out4 = p4.round_update(params, updates, **kw)
+        out2 = p2.round_update(params, updates, **kw)
+        _assert_bit_identical(out4, out2)
+        want, _, _ = host_secure_round_update(
+            params, updates, policy=("adam", 0.1, 0.9),
+            defense=("norm_clip", 2.0), dp=_DP, round_idx=6,
+            client_ids=np.asarray([1, 3, 5, 7]), dp_sigma=0.9)
+        _assert_bit_identical(out4, want)
+
+
+# ---------------------------------------------------------------------------
+# Finite-field properties (core/mpc/inmesh vs the host loop)
+# ---------------------------------------------------------------------------
+
+class TestFiniteField:
+    def _residues(self, n, shape, seed):
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, int(FIELD_PRIME), size=(n,) + shape,
+                            dtype=np.int64)
+
+    def test_field_sum_matches_host_loop_any_order(self):
+        """Exact integer math: the compiled scan equals the per-client host
+        fold under every permutation of the stack."""
+        stack = self._residues(7, (5,), seed=30)
+        host = np.zeros((5,), np.int64)
+        for v in stack:
+            host = np.mod(host + v, FIELD_PRIME)
+        rng = np.random.default_rng(31)
+        for _ in range(4):
+            perm = rng.permutation(len(stack))
+            assert np.array_equal(field_sum(stack[perm]), host)
+
+    def test_field_add_sub_round_trip_and_boundaries(self):
+        a = self._residues(1, (9,), seed=32)[0]
+        b = self._residues(1, (9,), seed=33)[0]
+        assert np.array_equal(field_sub(field_add(a, b), b), a)
+        # boundary residues: p-1 + p-1 wraps, x - 0 is identity, 0 - x wraps
+        top = np.full((3,), int(FIELD_PRIME) - 1, np.int64)
+        zero = np.zeros((3,), np.int64)
+        assert np.array_equal(field_add(top, top),
+                              np.mod(top + top, FIELD_PRIME))
+        assert np.array_equal(field_sub(a, np.zeros_like(a)), a)
+        assert np.array_equal(field_sub(zero, top),
+                              np.mod(-top, FIELD_PRIME))
+
+    def test_field_ops_reject_non_residues(self):
+        bad_hi = np.asarray([int(FIELD_PRIME)], np.int64)
+        bad_lo = np.asarray([-1], np.int64)
+        for bad in (bad_hi, bad_lo):
+            with pytest.raises(ValueError, match="residues"):
+                field_sum(bad[None, :])
+            with pytest.raises(ValueError, match="residues"):
+                field_add(bad, np.zeros_like(bad))
+
+
+# ---------------------------------------------------------------------------
+# SecAgg dropout chaos (the chaos_check `secagg_dropout` leg)
+# ---------------------------------------------------------------------------
+
+def _client_vecs(n, dim=32, seed=40):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=(dim,)) for _ in range(n)]
+
+
+def _expected_aggregate(rnd, vecs, survivors):
+    """The ground truth: the plain field sum of the SURVIVORS' quantized
+    vectors, dequantized — what a fault-free round over exactly the
+    survivors would produce."""
+    total = np.zeros_like(rnd.quantize(vecs[0]))
+    for s in survivors:
+        total = np.mod(total + rnd.quantize(vecs[s]), FIELD_PRIME)
+    from fedml_tpu.core.mpc.secagg import transform_finite_to_tensor
+    return transform_finite_to_tensor(total, FIELD_PRIME, q_bits=rnd.q_bits)
+
+
+@pytest.mark.parametrize("plane", ["host", "compiled"])
+def test_secagg_dropout_unmask_bit_identical(plane):
+    """Two clients dropped mid-upload: the survivor shares reconstruct the
+    dropped DH secrets, the uncancelled masks strip, and the aggregate is
+    BITWISE the plain field sum of the survivors' unmasked residues."""
+    n, vecs = 6, _client_vecs(6)
+    rnd = SecAggRound(n_clients=n, threshold=4, seed=5, plane=plane)
+    for i in range(n):
+        if i in (2, 5):
+            continue  # dropped: their payloads never arrive
+        rnd.submit(i, rnd.client_payload(i, vecs[i]))
+    assert rnd.dropped == [2, 5]
+    got = rnd.unmask()
+    want = _expected_aggregate(rnd, vecs, rnd.survivors)
+    assert got.tobytes() == want.tobytes()
+
+
+def test_secagg_dropout_server_kill_mid_round_bit_identical():
+    """The chaos leg: a duplicate retransmit, then a server kill between
+    submissions, then a dropout — the restored round unmasks bit-identical
+    to an uninterrupted one, with exactly-once duplicate accounting."""
+    n, vecs = 5, _client_vecs(5, seed=41)
+    payloads = None
+
+    def play(rnd, kill=False):
+        nonlocal payloads
+        if payloads is None:
+            payloads = [rnd.client_payload(i, vecs[i]) for i in range(n)]
+        rnd.submit(0, payloads[0])
+        rnd.submit(1, payloads[1])
+        assert not rnd.submit(1, payloads[1])  # chaos retransmit: dropped
+        if kill:
+            rnd = SecAggRound.from_state(rnd.export_state())  # server kill
+        rnd.submit(3, payloads[3])
+        assert not rnd.submit(3, payloads[3])  # post-restore retransmit
+        rnd.submit(4, payloads[4])
+        # client 2 dropped mid-upload: its payload never lands
+        assert rnd.dropped == [2]
+        assert rnd.dup_submissions == 2  # exactly-once across the kill
+        return rnd.unmask()
+
+    ref = play(SecAggRound(n_clients=n, threshold=3, seed=9))
+    got = play(SecAggRound(n_clients=n, threshold=3, seed=9), kill=True)
+    assert got.tobytes() == ref.tobytes()
+    want = _expected_aggregate(
+        SecAggRound(n_clients=n, threshold=3, seed=9), vecs, [0, 1, 3, 4])
+    assert got.tobytes() == want.tobytes()
+
+
+def test_secagg_dropout_host_and_compiled_planes_agree():
+    """Field math is exact on both planes, so the unmasked aggregates are
+    bitwise equal — secagg_plane=compiled can never drift."""
+    n, vecs = 4, _client_vecs(4, seed=42)
+
+    def run(plane):
+        rnd = SecAggRound(n_clients=n, threshold=3, seed=2, plane=plane)
+        for i in range(n):
+            if i != 1:
+                rnd.submit(i, rnd.client_payload(i, vecs[i]))
+        return rnd.unmask()
+
+    assert run("host").tobytes() == run("compiled").tobytes()
+
+
+def test_secagg_dropout_below_threshold_aborts():
+    """Fewer than ``threshold`` survivors: the masks are information-
+    theoretically unrecoverable — the round must raise, not emit garbage."""
+    rnd = SecAggRound(n_clients=5, threshold=4, seed=1)
+    vecs = _client_vecs(5, seed=43)
+    for i in (0, 2, 4):
+        rnd.submit(i, rnd.client_payload(i, vecs[i]))
+    with pytest.raises(ValueError, match="threshold"):
+        rnd.unmask()

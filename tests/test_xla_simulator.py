@@ -267,6 +267,28 @@ class TestInMeshDefense:
         )
         assert metrics["test_acc"] > 0.5, (optimizer, defense, metrics)
 
+    @pytest.mark.parametrize("optimizer,defense,extra", [
+        ("FedOpt", "norm_diff_clipping", {"norm_bound": 5.0}),
+        ("FedNova", "krum", {"byzantine_client_num": 1}),
+    ])
+    def test_sharded_state_composes_with_defense_bitwise(
+            self, optimizer, defense, extra):
+        """The defended + model-sharded composition (the old fed_sim gate
+        silently degraded sharded_state to replicated whenever the security
+        tail was active): the security program now ends at the psum'd
+        accumulator and the model-sharded GSPMD tail applies the server
+        step — and the run is BITWISE the replicated defended run, for
+        both the via-acc and the rows (ext2) security branches."""
+        knobs = dict(defense=defense, federated_optimizer=optimizer,
+                     server_optimizer="adam", **extra)
+        sim_r, m_r = _run_security(**knobs)
+        sim_s, m_s = _run_security(server_state="sharded", **knobs)
+        assert sim_s.sharded_state and not sim_r.sharded_state
+        for a, b in zip(jax.tree_util.tree_leaves(sim_r.variables),
+                        jax.tree_util.tree_leaves(sim_s.variables)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        assert m_r["test_acc"] == m_s["test_acc"]
+
     def test_fednova_byzantine_degrades_and_krum_recovers(self):
         _, clean = _run_security(comm_round=3, federated_optimizer="FedNova")
         _, attacked = _run_security(

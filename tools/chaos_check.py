@@ -26,7 +26,12 @@ the model-sharded optimizer state bit-identically) AND the elastic leg
 ``mesh_shrink`` topology fault mid-round, and a server kill restarted
 with the model axis shrunk 4→2, must both re-shard through the portable
 state codec and converge bit-identical to the fixed-mesh run with
-exactly-once accounting) N consecutive times in
+exactly-once accounting) AND the defense leg
+(``tests/test_security_plane.py -k secagg_dropout`` — a SecAgg round
+with a client dropped mid-upload plus a server kill mid-round must
+unmask BIT-IDENTICALLY to the uninterrupted round, with exactly-once
+duplicate accounting, and abort below the reconstruction threshold)
+N consecutive times in
 fresh interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
 operators stop believing red — so new fault kinds / backends must hold up
@@ -60,6 +65,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "telemetry"
     python tools/chaos_check.py --runs 3 -k "sharded_state"
     python tools/chaos_check.py --runs 3 -k "elastic or mesh_shrink"
+    python tools/chaos_check.py --runs 3 -k "secagg_dropout"
     python tools/chaos_check.py --runs 3 --skip-perf-gate
     python tools/chaos_check.py --runs 3 --skip-fedlint
 """
@@ -126,10 +132,11 @@ def main(argv=None) -> int:
         "-k", dest="keyword",
         default="chaos or server_kill or trace_integrity or agg_plane "
                 "or async_fl or ingest or telemetry or sharded_state "
-                "or elastic or mesh_shrink",
+                "or elastic or mesh_shrink or secagg_dropout",
         help='pytest -k selector (default: "chaos or server_kill or '
              'trace_integrity or agg_plane or async_fl or ingest or '
-             'telemetry or sharded_state or elastic or mesh_shrink")')
+             'telemetry or sharded_state or elastic or mesh_shrink or '
+             'secagg_dropout")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     ap.add_argument("--skip-perf-gate", action="store_true",
@@ -158,7 +165,7 @@ def main(argv=None) -> int:
     cmd = [sys.executable, "-m", "pytest", "tests/test_fault_tolerance.py",
            "tests/test_obs.py", "tests/test_agg_plane.py",
            "tests/test_async_fl.py", "tests/test_ingest.py",
-           "tests/test_telemetry.py",
+           "tests/test_telemetry.py", "tests/test_security_plane.py",
            "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
     for i in range(1, args.runs + 1):
         t0 = time.time()
