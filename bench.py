@@ -411,6 +411,7 @@ def _main() -> int | None:
     out.update(_measure_defended_round())
     out.update(_measure_remesh())
     out.update(_measure_upload_saturation())
+    out.update(_measure_fanin())
     out.update(_measure_async_throughput())
     if os.environ.get("BENCH_SP"):
         out["sp_samples_per_sec"] = round(_measure_sp(args, dataset), 2)
@@ -881,6 +882,130 @@ def _measure_upload_saturation() -> dict:
         return {}
 
 
+def _measure_fanin() -> dict:
+    """Hierarchical fan-in relative keys (PR 18): the same 512-leaf round
+    ingested two ways, both evaluating the SAME
+    :class:`~fedml_tpu.core.hierarchy.plan.HierarchyPlan` so the
+    arithmetic is identical and only the topology moves —
+
+    * **flat leg** (``fanin_uploads_per_s_flat``): one root serially
+      journals every leaf upload (decode + length/crc32-framed append,
+      the PR 4 durability contract) then folds the whole plan in-process.
+    * **edge leg** (``fanin_uploads_per_s_edge``): the plan's leaf-edge
+      blocks run concurrently — each edge thread journals ITS block's
+      uploads into its own journal and folds its block partial; the clock
+      stops after the root combines the edge partials in block order.
+
+    ``edge_forward_bytes`` is the wire size of one edge's fused forward
+    delta (the O(model) payload an edge sends regardless of fanout) —
+    the number that makes "edge memory/egress is O(model), not
+    O(clients)" a banded fact.  Pure host work (journals + host fold),
+    reported on both the full and CPU-degraded lines.  Failures degrade
+    to empty keys."""
+    import concurrent.futures
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    try:
+        from flax import serialization
+
+        from fedml_tpu.core.checkpoint import UpdateJournal
+        from fedml_tpu.core.compression import wire_bytes
+        from fedml_tpu.core.hierarchy.plan import HierarchyPlan
+
+        n_leaves = int(os.environ.get("BENCH_FANIN_LEAVES", "512"))
+        fanout = int(os.environ.get("BENCH_FANIN_FANOUT", "64"))
+        fsync = os.environ.get("BENCH_JOURNAL_FSYNC", "always")
+        plan = HierarchyPlan(n_leaves=n_leaves, levels=2, edge_fanout=fanout)
+        rng = np.random.default_rng(7)
+        # a handful of distinct payload templates; each leaf's wire blob is
+        # pre-encoded so both legs pay decode + journal + fold, nothing
+        # else.  ~4KB frames: million-client leaves ship compressed deltas
+        # (docs/COMPRESSION.md), and at this size the per-upload cost is the
+        # durability round-trip itself — exactly what the edge tier shards.
+        templates = [
+            {"w/kernel": rng.standard_normal((32, 32)).astype(np.float32),
+             "w/bias": rng.standard_normal(32).astype(np.float32),
+             "head/kernel": rng.standard_normal((32, 10)).astype(np.float32)}
+            for _ in range(16)
+        ]
+        blobs = [serialization.msgpack_serialize(
+            {"sender": i, "n_samples": 16 + (i % 48), "version": 0,
+             "model_params": templates[i % len(templates)]})
+            for i in range(n_leaves)]
+
+        def ingest(journal, leaf_indices):
+            """Decode + journal each upload; return the block's updates in
+            leaf-index order (the plan's fold order)."""
+            updates = []
+            for i in leaf_indices:
+                rec = serialization.msgpack_restore(blobs[i])
+                journal.append(0, rec)
+                updates.append((float(rec["n_samples"]),
+                                rec["model_params"]))
+            return updates
+
+        def flat_leg():
+            tmp = tempfile.mkdtemp(prefix="bench_fanin_flat_")
+            try:
+                journal = UpdateJournal(tmp, fsync=fsync)
+                t0 = time.perf_counter()
+                updates = ingest(journal, range(n_leaves))
+                plan.aggregate(updates, mode="mean")
+                dt = time.perf_counter() - t0
+                journal.close()
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            return n_leaves / max(dt, 1e-9)
+
+        def edge_leg():
+            tmp = tempfile.mkdtemp(prefix="bench_fanin_edge_")
+            total = float(sum(16 + (i % 48) for i in range(n_leaves)))
+
+            def run_edge(e):
+                journal = UpdateJournal(os.path.join(tmp, f"edge_{e}"),
+                                        fsync=fsync)
+                updates = ingest(journal, plan.blocks[e])
+                partial = plan.block_partial(updates, total, mode="mean")
+                journal.close()
+                return partial
+
+            try:
+                with concurrent.futures.ThreadPoolExecutor(
+                        max_workers=plan.n_edges) as pool:
+                    t0 = time.perf_counter()
+                    partials = list(pool.map(run_edge,
+                                             range(plan.n_edges)))
+                    plan.combine(partials)
+                    dt = time.perf_counter() - t0
+                fwd_bytes = wire_bytes(partials[0])
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            return n_leaves / max(dt, 1e-9), fwd_bytes
+
+        # median of reps: fsync latency on shared storage is noisy and the
+        # first rep pays cold-start (page cache, allocator) — the median
+        # drops it without a separate warmup pass
+        reps = int(os.environ.get("BENCH_FANIN_REPS", "3"))
+        flat_rate = float(np.median([flat_leg() for _ in range(reps)]))
+        edge_runs = [edge_leg() for _ in range(reps)]
+        edge_rate = float(np.median([r for r, _ in edge_runs]))
+        fwd_bytes = edge_runs[0][1]
+        return {
+            "fanin_uploads_per_s_flat": round(flat_rate, 2),
+            "fanin_uploads_per_s_edge": round(edge_rate, 2),
+            "fanin_edge_speedup": round(edge_rate / max(flat_rate, 1e-9), 3),
+            "edge_forward_bytes": fwd_bytes,
+            "fanin_leaves": n_leaves,
+            "fanin_edges": plan.n_edges,
+        }
+    except Exception as e:
+        print(f"fan-in measurement failed: {e}", file=sys.stderr)
+        return {}
+
+
 def _measure_async_throughput() -> dict:
     """Buffered-async round-throughput keys: a small sp FedBuff run
     (synthetic data, lr model) timed end-to-end — flushes (the async
@@ -957,6 +1082,7 @@ def _run_degraded(reason: str) -> int:
     out.update(_measure_defended_round())
     out.update(_measure_remesh())
     out.update(_measure_upload_saturation())
+    out.update(_measure_fanin())
     out.update(_measure_async_throughput())
     out.update(_measure_telemetry_overhead())
 

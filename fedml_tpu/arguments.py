@@ -109,6 +109,34 @@ anatomy in ``docs/INGEST.md``):
   dispatch queue; a full queue backpressures the transport thread
   instead of growing an unbounded handler backlog.
 
+Hierarchical fan-in knobs (``train_args`` or ``comm_args``; consumed by
+``core/hierarchy``, topology contract in ``docs/HIERARCHY.md``):
+
+* ``fan_in_tree`` (1 | 2 | 3, default 1 = flat) — aggregation tree
+  depth: 2 inserts an edge-aggregator tier between leaf clients and the
+  root, 3 adds a mid tier above the edges.  The BLOCKED fold the tree
+  evaluates is the canonical arithmetic — a flat deployment of the same
+  plan computes the identical bits at the root.
+* ``edge_fanout`` (int >= 0, default 0 = one block of everything) —
+  children per tree node: leaves per edge block, and edges per mid in a
+  3-level tree.
+* ``edge_flush`` (``all`` | seconds > 0, default ``all``) — when an edge
+  flushes its block upward.  ``all`` is the bit-exactness barrier (wait
+  for every child); a number flushes whatever arrived after that many
+  seconds, trading bit-identity against the full-cohort plan for
+  liveness under lost leaves.
+* ``edge_checkpoint_dir`` (path, default unset; falls back to
+  ``server_checkpoint_dir``) — root for per-edge update journals.  With
+  neither set, edges keep no durable state and a killed edge's uploads
+  must be retransmitted by its leaves.
+* ``edge_codec_offers`` / ``edge_codec_accept`` (comma-separated scheme
+  lists from ``none|topk|eftopk|quantize|qsgd``, default ``none``) — the
+  per-link codec negotiation inputs: a child offers what it can encode
+  (with honest byte estimates), a parent picks the cheapest scheme it
+  accepts.  Lossy schemes trade the bit-identity contract for bytes.
+* ``edge_codec_ratio`` / ``edge_codec_bits`` (defaults 0.05 / 8) —
+  parameters for the negotiated scheme, when one applies.
+
 Observability knobs (``tracking_args`` or ``obs_args``; consumed by
 ``core/obs``, semantics in ``docs/OBSERVABILITY.md``):
 
@@ -459,6 +487,47 @@ class Arguments:
                     f"{knob} must be an integer >= 1 (got {v!r})")
             if iv < 1:
                 raise ValueError(f"{knob} must be >= 1 (got {iv})")
+        # hierarchical fan-in knobs (core/hierarchy) — the plan derives the
+        # tree shape from these, so a bad value must fail before any node
+        # is built with a different grouping than its peers
+        tree = getattr(self, "fan_in_tree", None)
+        if tree is not None:
+            from .core.hierarchy.plan import FAN_IN_TREE_LEVELS
+
+            try:
+                tv = int(tree)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"fan_in_tree must be one of {FAN_IN_TREE_LEVELS} "
+                    f"(got {tree!r})")
+            if tv not in FAN_IN_TREE_LEVELS:
+                raise ValueError(
+                    f"fan_in_tree must be one of {FAN_IN_TREE_LEVELS} "
+                    f"(got {tv})")
+        fanout = getattr(self, "edge_fanout", None)
+        if fanout is not None:
+            try:
+                fo = int(fanout)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "edge_fanout must be an integer >= 0 "
+                    f"(got {fanout!r})")
+            if fo < 0:
+                raise ValueError(f"edge_fanout must be >= 0 (got {fo})")
+        flush_k = getattr(self, "edge_flush", None)
+        if flush_k is not None:
+            ok = (isinstance(flush_k, str)
+                  and flush_k.strip().lower() == "all")
+            if not ok:
+                try:
+                    fs = float(flush_k)
+                    ok = fs > 0
+                except (TypeError, ValueError):
+                    ok = False
+            if not ok:
+                raise ValueError(
+                    "edge_flush must be 'all' or a positive number of "
+                    f"seconds (got {flush_k!r})")
         # observability knobs (core/obs) — bad values fail here so a typo'd
         # interval doesn't silently disable the periodic metrics export
         interval = getattr(self, "obs_metrics_export_interval", None)

@@ -141,6 +141,42 @@ def unweighted_sum(updates: Sequence[Tuple[float, Pytree]]) -> Pytree:
     return tree_sum([p for _, p in updates])
 
 
+def partial_fold(updates: Sequence[Tuple[float, Pytree]],
+                 total_weight: float, mode: str = "mean") -> Pytree:
+    """One hierarchy block's share of the round fold (host leg).
+
+    The edge-aggregator tier splits the flat reduction into per-block
+    partials; this is a block's contribution with the arithmetic of the
+    flat path preserved exactly: ``mean`` scales each update by
+    ``n_i / total_weight`` (the GLOBAL total, so the per-leaf multiply is
+    the same operand :func:`weighted_mean` would use) and sums
+    left-to-right; ``sum`` is the plain left-to-right sum.  Combining
+    block partials with :func:`combine_partials` therefore reproduces the
+    blocked canonical fold bit-for-bit wherever it runs — the deployment
+    topology decides WHERE each block folds, never WHAT is computed.
+    """
+    if mode not in ("mean", "sum"):
+        raise ValueError(f"agg mode must be mean|sum (got {mode!r})")
+    if not updates:
+        raise ValueError("no updates to fold")
+    if mode == "sum":
+        return tree_sum([p for _, p in updates])
+    total = float(total_weight)
+    if total <= 0:
+        raise ValueError("total sample count must be positive")
+    return tree_sum([tree_scale(p, float(n) / total) for n, p in updates])
+
+
+def combine_partials(partials: Sequence[Pytree]) -> Pytree:
+    """Fold block partials into the round aggregate (host leg): a plain
+    left-to-right sum, i.e. exactly the ``sum``-mode fold — partials are
+    already scaled (``mean``) or raw sums (``sum``), so no tail math
+    remains here."""
+    if not partials:
+        raise ValueError("no partials to combine")
+    return tree_sum(list(partials))
+
+
 def tree_stack(trees: Sequence[Pytree]) -> Pytree:
     """Stack a list of identically-shaped pytrees on a new leading axis.
 

@@ -7,6 +7,7 @@ from typing import List
 
 from ..framework import Analyzer
 from .ack_order import AckDurabilityAnalyzer
+from .hierarchy import HierarchyReduceSeamAnalyzer
 from .legacy import AggAnalyzer, ObsAnalyzer, PerfAnalyzer, RngAnalyzer
 from .meshguard import MeshStaleProgramAnalyzer
 from .purity import PurityAnalyzer
@@ -14,9 +15,10 @@ from .races import ThreadOwnershipAnalyzer
 from .security import SecHostFallbackAnalyzer
 
 __all__ = [
-    "AckDurabilityAnalyzer", "AggAnalyzer", "MeshStaleProgramAnalyzer",
-    "ObsAnalyzer", "PerfAnalyzer", "PurityAnalyzer", "RngAnalyzer",
-    "SecHostFallbackAnalyzer", "ThreadOwnershipAnalyzer", "build_analyzers",
+    "AckDurabilityAnalyzer", "AggAnalyzer", "HierarchyReduceSeamAnalyzer",
+    "MeshStaleProgramAnalyzer", "ObsAnalyzer", "PerfAnalyzer",
+    "PurityAnalyzer", "RngAnalyzer", "SecHostFallbackAnalyzer",
+    "ThreadOwnershipAnalyzer", "build_analyzers",
 ]
 
 
@@ -32,4 +34,5 @@ def build_analyzers() -> List[Analyzer]:
         PurityAnalyzer(),
         MeshStaleProgramAnalyzer(),
         SecHostFallbackAnalyzer(),
+        HierarchyReduceSeamAnalyzer(),
     ]

@@ -1,0 +1,81 @@
+"""Partial-reduction seam guard for the hierarchical fan-in tier.
+
+The hierarchy's bit-identity contract (``docs/HIERARCHY.md``) holds
+because every partial reduction is evaluated by ONE arithmetic seam: the
+:class:`~fedml_tpu.core.hierarchy.plan.HierarchyPlan` routing into the
+host fold (``core/aggregate.py``) or the compiled plane
+(``parallel/agg_plane.py``).  A ``partial_fold`` / ``partial_reduce`` /
+``combine_partials`` call ANYWHERE else is how the contract rots: a
+second call site picks its own block order or its own total, and the
+tree deployment silently stops matching the flat one.
+
+* ``hierarchy-reduce-seam`` — a partial-reduction entry point invoked
+  outside ``core/hierarchy/``, ``core/aggregate.py`` and
+  ``parallel/agg_plane.py``.  Pragmas require a justification
+  (``# fedlint: allow[hierarchy-reduce-seam] — ...``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from ..framework import Analyzer, Finding, Rule, SourceFile
+
+# the seam: the only modules that may invoke a partial reduction
+_SEAM_PARTS = ("core/hierarchy",)
+_SEAM_FILES = ("core/aggregate.py", "parallel/agg_plane.py")
+
+# the partial-reduction entry points the seam owns
+_SEAM_CALLS = frozenset(
+    {"partial_fold", "combine_partials", "partial_reduce", "block_partial"})
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class HierarchyReduceSeamAnalyzer(Analyzer):
+    """Flags partial-reduction calls outside the hierarchy seam."""
+
+    name = "hierarchy"
+    rules = (
+        Rule("hierarchy-reduce-seam",
+             "partial reduction invoked outside the hierarchy seam",
+             requires_justification=True, order=0),
+    )
+
+    def _exempt(self, path: str) -> bool:
+        # fixtures opt IN by basename, overriding the path exemption
+        if os.path.basename(path).startswith("hier_"):
+            return False
+        norm = os.path.normpath(os.path.abspath(path)).replace(os.sep, "/")
+        if any(f"/{part}/" in norm or norm.endswith(f"/{part}")
+               for part in _SEAM_PARTS):
+            return True
+        return any(norm.endswith(f"/{f}") for f in _SEAM_FILES)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if src.tree is None or self._exempt(src.path):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            if name not in _SEAM_CALLS:
+                continue
+            findings.append(self.finding(
+                self.rules[0], src, node.lineno,
+                f"'{name}' called outside the hierarchy seam "
+                "(core/hierarchy, core/aggregate.py, parallel/agg_plane.py) "
+                "— a second partial-reduction site can pick its own block "
+                "order or total and break the tree/flat bit-identity "
+                "contract; route through HierarchyPlan or justify"))
+        findings.sort(key=Finding.sort_key)
+        return findings

@@ -605,6 +605,37 @@ def maybe_server_store(args: Any) -> Optional[ServerStateStore]:
     )
 
 
+def edge_journal_dir(base: str, edge_id: int) -> str:
+    """Per-edge journal directory under a deployment's checkpoint root.
+
+    Deterministic in ``edge_id`` so a REPLACEMENT incarnation of a killed
+    edge finds its predecessor's journal and can replay the round."""
+    return os.path.join(str(base), f"edge_{int(edge_id)}", "journal")
+
+
+def make_edge_journal(args: Any, edge_id: int) -> Optional[UpdateJournal]:
+    """Build an edge aggregator's :class:`UpdateJournal`, or None when
+    durability is disabled.
+
+    Edges reuse the server journal knobs (``server_journal_fsync``,
+    ``journal_group_commit_ms`` / ``_max``) — the journal-before-ack
+    contract is tier-independent — rooted at ``edge_checkpoint_dir`` when
+    set, else ``server_checkpoint_dir``.  Edges keep no model snapshot:
+    their only durable state is the round's accepted uploads, which is
+    exactly what replay needs to re-fold and re-forward the same fused
+    delta under the same forward id."""
+    base = (getattr(args, "edge_checkpoint_dir", None)
+            or getattr(args, "server_checkpoint_dir", None))
+    if not base:
+        return None
+    return UpdateJournal(
+        edge_journal_dir(base, edge_id),
+        fsync=str(getattr(args, "server_journal_fsync", "always")),
+        group_commit_ms=float(getattr(args, "journal_group_commit_ms", 0.0)),
+        group_commit_max=int(getattr(args, "journal_group_commit_max", 32)),
+    )
+
+
 class ServerRecoveryMixin:
     """Crash-resumable rounds for the message-plane server managers.
 

@@ -29,18 +29,35 @@ Pytree = Any
 
 _MARKER = "__fedml_compressed__"
 
+# largest flat index an int32 can address; beyond it top-k indices are int64
+_INT32_MAX = 2**31 - 1
+
 
 # ---------------------------------------------------------------------------
 # leaf kernels
 # ---------------------------------------------------------------------------
 
+def topk_k(ratio: float, n: int) -> int:
+    """Deterministic k for a top-``ratio`` selection over ``n`` entries.
+
+    ``int(round(...))`` is banker's rounding: ``round(0.5) == 0`` but
+    ``round(1.5) == 2``, so the kept fraction of a .5-boundary leaf
+    drifts with its size (and with any platform that rounds half away
+    from zero).  Half-up (``+ 0.5`` then truncate) is monotone in both
+    arguments and identical everywhere."""
+    return max(1, int(float(ratio) * int(n) + 0.5))
+
+
 def topk_leaf(x: jnp.ndarray, ratio: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Keep the top ``ratio`` fraction of entries by |value|; returns
     (values [k], flat indices [k])."""
     flat = x.reshape(-1)
-    k = max(1, int(round(ratio * flat.shape[0])))
+    k = topk_k(ratio, flat.shape[0])
     _, idx = jax.lax.top_k(jnp.abs(flat), k)
-    return flat[idx], idx.astype(jnp.int32)
+    # int32 flat indices silently wrap past 2^31-1 elements; huge embedding
+    # leaves need the wide dtype (the wire cost is honest via wire_bytes)
+    idx_dtype = jnp.int64 if flat.shape[0] > _INT32_MAX else jnp.int32
+    return flat[idx], idx.astype(idx_dtype)
 
 
 def quantize_leaf(x: jnp.ndarray, bits: int) -> jnp.ndarray:
@@ -151,6 +168,34 @@ def decompress_update(payload: Dict[str, Any]) -> Pytree:
         return jax.tree_util.tree_unflatten(
             treedef, [jnp.asarray(l) for l in payload["leaves"]]
         )
+    raise ValueError(f"unknown compression method {method!r}")
+
+
+def wire_bytes(payload: Any) -> int:
+    """Honest payload size in bytes for a (possibly compressed) update.
+
+    Counts the array bytes that actually ride the wire — dense leaves for
+    ``none``/``quantize``/``qsgd``, (values + indices) pairs for
+    ``topk``/``eftopk`` — and ignores framing/treedef overhead (shared by
+    every scheme, so it cancels out of a comparison).  Accepts a raw
+    pytree too, so codec negotiation can compare "as is" against each
+    candidate scheme with one estimator.
+    """
+    def _nbytes(a: Any) -> int:
+        arr = np.asarray(a)
+        return int(arr.size) * int(arr.dtype.itemsize)
+
+    if not is_compressed(payload):
+        return int(sum(_nbytes(l) for l in jax.tree_util.tree_leaves(payload)))
+    method = payload[_MARKER]
+    if method == "none":
+        return int(sum(_nbytes(l)
+                       for l in jax.tree_util.tree_leaves(payload["tree"])))
+    if method in ("topk", "eftopk"):
+        return int(sum(_nbytes(values) + _nbytes(idx)
+                       for values, idx, _shape, _dtype in payload["leaves"]))
+    if method in ("quantize", "qsgd"):
+        return int(sum(_nbytes(l) for l in payload["leaves"]))
     raise ValueError(f"unknown compression method {method!r}")
 
 

@@ -422,6 +422,10 @@ class _IngestPipeline:
 
 
 class FedMLCommManager(Observer):
+    #: subclasses that fan in uploads without being rank 0 (the hierarchy's
+    #: edge aggregators) set this True to opt in to the staged ingest path
+    wants_ingest_pipeline = False
+
     def __init__(self, args, comm=None, rank: int = 0, size: int = 0, backend: str = "LOOPBACK"):
         self.args = args
         self.size = int(size)
@@ -454,10 +458,13 @@ class FedMLCommManager(Observer):
         )
 
     def _init_pipeline(self) -> Optional[_IngestPipeline]:
-        """The staged ingest path is a SERVER feature (rank 0 fans in the
-        whole cohort's uploads); clients keep the synchronous receive loop."""
+        """The staged ingest path is a FAN-IN feature: rank 0 absorbs the
+        whole cohort's uploads, and hierarchy edge aggregators
+        (``wants_ingest_pipeline``) absorb a block's worth; ordinary
+        clients keep the synchronous receive loop."""
         a = self.args
-        if (self._link is None or a is None or self.rank != 0
+        if (self._link is None or a is None
+                or (self.rank != 0 and not self.wants_ingest_pipeline)
                 or not ingest.pipeline_enabled(a)):
             return None
         depth = int(getattr(a, "ingest_queue_depth", 64))

@@ -1,0 +1,53 @@
+"""Hierarchical fan-in: the streaming edge-aggregator tier.
+
+One flat server cannot absorb million-client rounds even with the staged
+ingest pipeline and the fused round tail — this package stands an
+intermediate aggregation tier between leaf clients and the root server:
+
+* :mod:`plan` — the *logical* tree: which leaves fold in which block, in
+  which order, independent of where each block runs.  The canonical
+  arithmetic is the blocked fold; deployment topology decides WHERE each
+  block folds, never WHAT is computed, which is what makes a tree
+  deployment provably bit-identical to the flat deployment of the same
+  plan.
+* :mod:`protocol` — the four-message wire vocabulary
+  (upload / counts / total / partial) and the fused
+  ``(partial_sum, total_weight, n_clients, leaf_epoch)`` delta format.
+* :mod:`edge` — :class:`~fedml_tpu.core.hierarchy.edge.EdgeAggregator`,
+  a comm-manager node that accepts leaf uploads through the existing
+  ingest-pipeline + update-journal machinery (journal-before-ack, msg-id
+  dedup), partial-reduces K-at-a-time via the agg plane's fold, and
+  forwards ONE fused delta to its parent; a killed edge replays its
+  journal and re-forwards under the same forward id.
+* :mod:`root` — :class:`~fedml_tpu.core.hierarchy.root.HierarchyRoot`,
+  the root-side fan-in that attaches to ANY existing manager
+  (cross-silo / cross-device), dedups re-forwards for exactly-once
+  accounting, and closes the round with the combined aggregate.
+* :mod:`router` — :class:`~fedml_tpu.core.hierarchy.router.HierarchyRouter`,
+  rank layout + node construction for 2- and 3-level trees from the
+  validated ``fan_in_tree`` / ``edge_fanout`` / ``edge_flush`` knobs,
+  plus per-link codec negotiation over honest
+  :func:`~fedml_tpu.core.compression.wire_bytes` estimates.
+
+Contract details and the runbook live in ``docs/HIERARCHY.md``.
+"""
+
+from __future__ import annotations
+
+from .plan import HierarchyPlan
+from .protocol import (
+    HIER_COUNTS,
+    HIER_PARTIAL,
+    HIER_TOTAL,
+    HIER_UPLOAD,
+    PartialDelta,
+)
+from .edge import EdgeAggregator
+from .root import HierarchyRoot
+from .router import HierarchyRouter, estimate_scheme_bytes, negotiate_codec
+
+__all__ = [
+    "EdgeAggregator", "HierarchyPlan", "HierarchyRoot", "HierarchyRouter",
+    "HIER_COUNTS", "HIER_PARTIAL", "HIER_TOTAL", "HIER_UPLOAD",
+    "PartialDelta", "estimate_scheme_bytes", "negotiate_codec",
+]

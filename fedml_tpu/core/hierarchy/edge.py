@@ -1,0 +1,515 @@
+"""The edge aggregator: one tree node's streaming partial fold.
+
+An :class:`EdgeAggregator` is a :class:`~fedml_tpu.core.distributed
+.comm_manager.FedMLCommManager` node that absorbs its block's leaf
+uploads through the SAME machinery the root server uses — the reliable
+link's msg-id dedup, the staged ingest pipeline
+(``wants_ingest_pipeline``), and an :class:`~fedml_tpu.core.checkpoint
+.UpdateJournal` with the journal-before-ack contract — so the PR 4/10
+exactly-once guarantees hold one tier up, unchanged.
+
+Round lifecycle (the two-phase count-then-reduce flush):
+
+1. leaves send ``hier_upload``; each is journaled before its ack, its
+   telemetry blob relayed, and (``sum`` mode, host leg, all-children
+   barrier) stream-folded in leaf-index order through the ingest
+   :class:`~fedml_tpu.core.ingest.ReorderWindow` so edge memory stays
+   O(model) plus the out-of-order tail, not O(block).
+2. when the block is complete (or ``edge_flush`` seconds elapsed), the
+   edge sends ``hier_counts`` up: its block weight, client count, and
+   codec offer.  No ``mean`` float math has happened yet — those scales
+   need the GLOBAL total.
+3. ``hier_total`` comes down with the global total and the negotiated
+   codec; the edge folds its block (host ``partial_fold`` or the agg
+   plane's ``partial_reduce``) and forwards ONE fused
+   :class:`~fedml_tpu.core.hierarchy.protocol.PartialDelta` under a
+   deterministic forward id, leaf telemetry grafted on.
+
+A killed edge's replacement replays the journal, restages the same
+uploads, re-offers the same telemetry bytes, re-sends counts, and — on
+the parent's idempotent ``hier_total`` re-reply — re-forwards the same
+delta under the SAME forward id; the parent's dedup makes the replay
+exactly-once.
+
+A *mid* edge (3-level trees) runs the same lifecycle over child EDGES
+instead of leaves: child ``hier_counts`` roll up into one, ``hier_total``
+relays down with a per-child negotiated codec, child ``hier_partial``
+deltas combine (the plain sum fold — children arrive pre-scaled) into
+one fused forward.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from .. import ingest, obs
+from ..aggregate import FedMLAggOperator
+from ..checkpoint import make_edge_journal
+from ..compression import compress_update, maybe_decompress_update, wire_bytes
+from ..distributed.comm_manager import FedMLCommManager
+from ..distributed.communication.message import Message
+from ..obs.telemetry import TelemetryRelay
+from . import protocol
+from .plan import HierarchyPlan
+from .protocol import PartialDelta
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+
+def _zero_plus(tree: Pytree) -> Pytree:
+    """``0 + x`` per leaf — the exact first term of the host ``tree_sum``
+    left fold, so a streamed accumulator starts on the same operand."""
+    return jax.tree_util.tree_map(lambda x: 0 + x, tree)
+
+
+class EdgeAggregator(FedMLCommManager):
+    """One tree node: leaf-edge (folds a block of leaf uploads) or mid
+    (combines child edges' fused deltas)."""
+
+    wants_ingest_pipeline = True
+
+    def __init__(self, args, plan: HierarchyPlan, edge_id: int,
+                 parent_rank: int, children: Sequence[int],
+                 child_ranks: Optional[Dict[int, int]] = None,
+                 is_mid: bool = False, comm=None, rank: int = 0,
+                 size: int = 0, backend: str = "LOOPBACK",
+                 mode: Optional[str] = None, plane: Any = None):
+        self.plan = plan
+        self.edge_id = int(edge_id)
+        self.parent_rank = int(parent_rank)
+        self.children = list(children)       # leaf indices, or child edge ids
+        self.child_ranks = dict(child_ranks or {})
+        self.is_mid = bool(is_mid)
+        self.mode = mode or FedMLAggOperator.agg_mode(args)
+        self._plane = plane
+        self._plane_checked = plane is not None
+        self._lock = threading.RLock()
+        # per-round state, keyed by round index
+        self._seen: Dict[int, set] = {}               # child keys landed
+        self._seen_fwd: Dict[int, set] = {}           # mid: forward ids seen
+        self._staged: Dict[int, Dict[int, Tuple[float, Any, int]]] = {}
+        self._stream_acc: Dict[int, Pytree] = {}      # sum-mode stream fold
+        self._stream_win: Dict[int, ingest.ReorderWindow] = {}
+        self._counts_sent: Dict[int, Tuple[float, int]] = {}
+        self._members: Dict[int, List[int]] = {}      # frozen at counts time
+        self._child_counts: Dict[int, Dict[int, Tuple[float, int, Any]]] = {}
+        self._totals: Dict[int, Tuple[float, str]] = {}
+        self._forwarded: Dict[int, Message] = {}
+        self._flush_timers: Dict[int, threading.Timer] = {}
+        self.relay = TelemetryRelay()
+        self.dup_uploads = 0
+        self.dup_forwards = 0
+        self._journal = make_edge_journal(args, edge_id)
+        super().__init__(args, comm=comm, rank=rank, size=size,
+                         backend=backend)
+        self._recover()
+
+    # -- wiring --------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        if self.is_mid:
+            self.register_message_receive_handler(
+                protocol.HIER_COUNTS, self._handle_child_counts)
+            self.register_message_receive_handler(
+                protocol.HIER_PARTIAL, self._handle_child_partial)
+        else:
+            self.register_message_receive_handler(
+                protocol.HIER_UPLOAD, self._handle_upload)
+        self.register_message_receive_handler(
+            protocol.HIER_TOTAL, self._handle_total)
+
+    @property
+    def plane(self):
+        if not self._plane_checked:
+            self._plane_checked = True
+            if str(getattr(self.args, "agg_plane", "host")
+                   or "host") == "compiled":
+                from ...parallel.agg_plane import plane_for
+
+                self._plane = plane_for(self.args)
+        return self._plane
+
+    def _streaming(self) -> bool:
+        """The stream fold needs the all-children barrier: a timeout flush
+        may fold a non-contiguous subset, which only the staged path can
+        do in plan order."""
+        return (self.mode == "sum" and not self.is_mid
+                and self.plane is None and self.flush_deadline() is None)
+
+    def flush_deadline(self) -> Optional[float]:
+        return self.plan.flush_timeout()
+
+    # -- journal-before-ack (the _journal_upload idiom, one tier up) ---------
+    def _journal_record(self, round_idx: int, record: Dict[str, Any]) -> None:
+        journal = self._journal
+        if journal is None:
+            return
+        sink = (ingest.current_sink()
+                if journal.group_commit_enabled else None)
+        if sink is not None:
+            sink.add(journal.append_async(round_idx, record))
+        else:
+            journal.append(round_idx, record)
+
+    # -- leaf-edge: uploads --------------------------------------------------
+    def _handle_upload(self, msg: Message) -> None:
+        r = int(msg.get(protocol.KEY_ROUND))
+        leaf = int(msg.get(protocol.KEY_LEAF))
+        n = float(msg.get(protocol.KEY_N_SAMPLES, 0.0))
+        epoch = int(msg.get(protocol.KEY_EPOCH, 0) or 0)
+        with self._lock:
+            if leaf in self._seen.get(r, ()):
+                self.dup_uploads += 1
+                obs.counter_inc("hierarchy.dup_uploads")
+                return
+            if r in self._counts_sent:
+                # a straggler past a timeout flush: its weight is not in
+                # the counts the parent already totaled — count and drop
+                # (journal untouched, so nothing double-folds on replay)
+                obs.counter_inc("hierarchy.late_uploads")
+                return
+        if leaf not in self.children:
+            logger.warning("edge %d: leaf %d is not in this block",
+                           self.edge_id, leaf)
+            return
+        # decompress BEFORE journaling: the leaf->edge codec is transport-
+        # only, and the journal's msgpack framing can't carry treedefs
+        tree = maybe_decompress_update(msg.get(protocol.KEY_PAYLOAD))
+        blob = self.relay.collect(msg)
+        self._journal_record(r, {
+            "round_idx": r, "sender": leaf, "n_samples": n, "epoch": epoch,
+            "model_params": tree, "telemetry": blob,
+        })
+        self._stage_upload(r, leaf, n, tree, epoch)
+
+    def _stage_upload(self, r: int, leaf: int, n: float, tree: Pytree,
+                      epoch: int) -> None:
+        deadline = self.flush_deadline()
+        with self._lock:
+            self._seen.setdefault(r, set()).add(leaf)
+            staged = self._staged.setdefault(r, {})
+            if self._streaming():
+                # stream the host sum fold in leaf-index order: each payload
+                # is dropped the moment the window releases it into the
+                # accumulator (the journal keeps the durable copy)
+                win = self._stream_win.get(r)
+                if win is None:
+                    win = ingest.ReorderWindow(list(self.children))
+                    self._stream_win[r] = win
+                staged[leaf] = (n, None, epoch)
+                for _, item in win.stage(leaf, tree):
+                    acc = self._stream_acc.get(r)
+                    self._stream_acc[r] = (
+                        _zero_plus(item) if acc is None
+                        else jax.tree_util.tree_map(lambda a, b: a + b,
+                                                    acc, item))
+            else:
+                staged[leaf] = (n, tree, epoch)
+            if (deadline is not None and r not in self._flush_timers
+                    and r not in self._counts_sent):
+                t = threading.Timer(deadline, self._maybe_send_counts,
+                                    args=(r, True))
+                t.daemon = True
+                self._flush_timers[r] = t
+                t.start()
+        self._maybe_send_counts(r)
+
+    # -- phase A: counts up --------------------------------------------------
+    def _maybe_send_counts(self, r: int, force: bool = False) -> None:
+        with self._lock:
+            if r in self._counts_sent:
+                return
+            if not force and len(self._seen.get(r, ())) < len(self.children):
+                return
+            staged = self._staged.get(r, {})
+            if not staged:
+                return
+            members = sorted(staged)
+            if self.is_mid:
+                counts = self._child_counts.get(r, {})
+                weight = float(sum(counts[c][0] for c in members))
+                n_clients = int(sum(counts[c][1] for c in members))
+            else:
+                weight = float(sum(staged[c][0] for c in members))
+                n_clients = len(members)
+            self._counts_sent[r] = (weight, n_clients)
+            self._members[r] = members
+            timer = self._flush_timers.pop(r, None)
+        if timer is not None:
+            timer.cancel()
+        msg = Message(protocol.HIER_COUNTS, self.rank, self.parent_rank)
+        msg.add_params(protocol.KEY_ROUND, r)
+        msg.add_params(protocol.KEY_EDGE, self.edge_id)
+        msg.add_params(protocol.KEY_TOTAL_WEIGHT, weight)
+        msg.add_params(protocol.KEY_N_CLIENTS, n_clients)
+        msg.add_params(protocol.KEY_OFFERS, self._codec_offers(r))
+        self.send_message(msg)
+        obs.counter_inc("hierarchy.counts_sent")
+
+    def _codec_offers(self, r: int) -> Dict[str, Any]:
+        """This edge's codec offer: the schemes it can speak plus honest
+        byte estimates for the fused forward, measured on a staged tree
+        (same shapes as the partial)."""
+        from .router import estimate_scheme_bytes
+
+        schemes = [s.strip().lower() for s in str(
+            getattr(self.args, "edge_codec_offers", "none") or "none"
+        ).split(",") if s.strip()]
+        sample: Optional[Pytree] = None
+        with self._lock:
+            if r in self._stream_acc:
+                sample = self._stream_acc[r]
+            else:
+                for _n, t_, _e in self._staged.get(r, {}).values():
+                    if t_ is not None and not isinstance(t_, PartialDelta):
+                        sample = t_
+                        break
+        estimates: Dict[str, int] = {}
+        if sample is not None:
+            ratio = float(getattr(self.args, "edge_codec_ratio", 0.05) or 0.05)
+            for s in schemes:
+                try:
+                    estimates[s] = estimate_scheme_bytes(sample, s, ratio)
+                except Exception:
+                    pass
+        return {"schemes": schemes, "bytes": estimates}
+
+    # -- mid: child counts / partials ---------------------------------------
+    def _handle_child_counts(self, msg: Message) -> None:
+        r = int(msg.get(protocol.KEY_ROUND))
+        child = int(msg.get(protocol.KEY_EDGE))
+        with self._lock:
+            counts = self._child_counts.setdefault(r, {})
+            fresh = child not in counts
+            counts[child] = (float(msg.get(protocol.KEY_TOTAL_WEIGHT, 0.0)),
+                             int(msg.get(protocol.KEY_N_CLIENTS, 0)),
+                             msg.get(protocol.KEY_OFFERS))
+            self._seen.setdefault(r, set()).add(child)
+            # a mid "stages" a placeholder per counted child so the counts
+            # barrier sees progress before any partial arrives
+            self._staged.setdefault(r, {}).setdefault(child, (0.0, None, 0))
+            already_total = r in self._totals
+        if not fresh and already_total:
+            # a replayed child re-sent counts after this mid already has
+            # the global total: re-relay it down idempotently so the
+            # replayed incarnation can re-fold and re-forward
+            self._relay_total_down(r, self._totals[r][0], only_child=child)
+            return
+        self._maybe_send_counts(r)
+
+    def _handle_child_partial(self, msg: Message) -> None:
+        r = int(msg.get(protocol.KEY_ROUND))
+        child = int(msg.get(protocol.KEY_EDGE))
+        fwd = str(msg.get(protocol.KEY_FORWARD_ID))
+        with self._lock:
+            seen = self._seen_fwd.setdefault(r, set())
+            if fwd in seen:
+                self.dup_forwards += 1
+                obs.counter_inc("hierarchy.dup_forwards")
+                return
+            seen.add(fwd)
+        wire = dict(msg.get(protocol.KEY_PAYLOAD))
+        wire["partial_sum"] = maybe_decompress_update(wire["partial_sum"])
+        delta = PartialDelta.from_wire(wire)
+        collected = self.relay.collect_many(msg)
+        self._journal_record(r, {
+            "round_idx": r, "sender": child, "forward_id": fwd,
+            "delta": delta.to_wire(), "telemetry": collected,
+        })
+        with self._lock:
+            self._staged.setdefault(r, {})[child] = (
+                delta.total_weight, delta, delta.leaf_epoch)
+        self._maybe_forward(r)
+
+    # -- phase B: total down, fused delta up ---------------------------------
+    def _handle_total(self, msg: Message) -> None:
+        r = int(msg.get(protocol.KEY_ROUND))
+        total = float(msg.get(protocol.KEY_TOTAL_WEIGHT))
+        codec = str(msg.get(protocol.KEY_CODEC, "none") or "none")
+        with self._lock:
+            self._totals[r] = (total, codec)
+        if self.is_mid:
+            self._relay_total_down(r, total)
+        self._maybe_forward(r)
+
+    def _relay_total_down(self, r: int, total: float,
+                          only_child: Optional[int] = None) -> None:
+        from .router import negotiate_codec
+
+        accepted = [s.strip().lower() for s in str(
+            getattr(self.args, "edge_codec_accept", "none") or "none"
+        ).split(",") if s.strip()]
+        with self._lock:
+            counts = dict(self._child_counts.get(r, {}))
+        for child in sorted(counts):
+            if only_child is not None and child != only_child:
+                continue
+            child_rank = self.child_ranks.get(child)
+            if child_rank is None:
+                continue
+            m = Message(protocol.HIER_TOTAL, self.rank, child_rank)
+            m.add_params(protocol.KEY_ROUND, r)
+            m.add_params(protocol.KEY_TOTAL_WEIGHT, total)
+            m.add_params(protocol.KEY_CODEC,
+                         negotiate_codec(counts[child][2], accepted))
+            self.send_message(m)
+
+    def _maybe_forward(self, r: int) -> None:
+        with self._lock:
+            if r in self._forwarded:
+                # duplicate hier_total (the parent's idempotent re-reply to
+                # a replayed sibling, or a retransmit): re-forward the SAME
+                # message — same forward id, same blobs; the parent dedups
+                msg = self._forwarded[r]
+                obs.counter_inc("hierarchy.reforwards")
+            else:
+                if r not in self._totals or r not in self._counts_sent:
+                    return
+                staged = self._staged.get(r, {})
+                members = self._members.get(r, [])
+                if self.is_mid:
+                    ready = [c for c in members
+                             if isinstance(staged.get(c, (0, None, 0))[1],
+                                           PartialDelta)]
+                else:
+                    ready = [c for c in members
+                             if c in staged
+                             and (self._streaming()
+                                  or staged[c][1] is not None)]
+                if len(ready) < len(members):
+                    return
+                msg = self._build_forward(r)
+                self._forwarded[r] = msg
+        self.send_message(msg)
+        obs.counter_inc("hierarchy.forwards")
+
+    def _build_forward(self, r: int) -> Message:
+        total, codec = self._totals[r]
+        weight, n_clients = self._counts_sent[r]
+        staged = self._staged[r]
+        order = [c for c in self.children if c in self._members[r]]
+        if self.is_mid:
+            deltas = [staged[c][1] for c in order]
+            partial = self.plan.combine([d.partial_sum for d in deltas],
+                                        self.mode, self.plane)
+            epoch = min((d.leaf_epoch for d in deltas), default=0)
+        elif self._streaming():
+            partial = self._stream_acc.pop(r)
+            epoch = min((staged[c][2] for c in order), default=0)
+        else:
+            updates = [(staged[c][0], staged[c][1]) for c in order]
+            partial = self.plan.block_partial(updates, total, self.mode,
+                                              self.plane)
+            epoch = min((staged[c][2] for c in order), default=0)
+        delta = PartialDelta(partial_sum=partial, total_weight=weight,
+                             n_clients=n_clients, leaf_epoch=epoch)
+        wire = delta.to_wire()
+        if codec != "none":
+            ratio = float(getattr(self.args, "edge_codec_ratio", 0.05) or 0.05)
+            bits = int(getattr(self.args, "edge_codec_bits", 8) or 8)
+            payload, _ = compress_update(partial, method=codec, ratio=ratio,
+                                         bits=bits)
+            wire["partial_sum"] = payload
+            obs.counter_inc("hierarchy.codec_compressed")
+        try:
+            obs.histogram_observe("hierarchy.forward_bytes",
+                                  float(wire_bytes(wire["partial_sum"])))
+        except Exception:
+            pass
+        msg = Message(protocol.HIER_PARTIAL, self.rank, self.parent_rank)
+        msg.add_params(protocol.KEY_ROUND, r)
+        msg.add_params(protocol.KEY_EDGE, self.edge_id)
+        msg.add_params(protocol.KEY_FORWARD_ID,
+                       protocol.forward_id(self.edge_id, r))
+        msg.add_params(protocol.KEY_PAYLOAD, wire)
+        self.relay.graft(msg)
+        if not self.is_mid:
+            # free the round's staged payloads; the journal keeps the
+            # durable copy a replacement incarnation would replay
+            self._staged[r] = {c: (staged[c][0], None, staged[c][2])
+                               for c in staged}
+        return msg
+
+    # -- crash recovery ------------------------------------------------------
+    def _recover(self) -> None:
+        """Replay the predecessor incarnation's journal: restage every
+        accepted upload (or child delta), re-offer its telemetry, and
+        re-send counts — the parent's idempotent ``hier_total`` re-reply
+        then drives a re-forward under the SAME forward id."""
+        journal = self._journal
+        if journal is None:
+            return
+        for r in journal.rounds():
+            records, bad_tail = journal.replay(r)
+            if bad_tail:
+                obs.counter_inc("hierarchy.replay_bad_tail")
+            restaged = 0
+            for rec in records:
+                blob_field = rec.get("telemetry")
+                blobs = (blob_field if isinstance(blob_field, (list, tuple))
+                         else [blob_field])
+                for b in blobs:
+                    if isinstance(b, (bytes, bytearray)):
+                        self.relay.offer(bytes(b))
+                if "delta" in rec:
+                    fwd = str(rec.get("forward_id"))
+                    child = int(rec["sender"])
+                    with self._lock:
+                        seen = self._seen_fwd.setdefault(r, set())
+                        if fwd in seen:
+                            continue
+                        seen.add(fwd)
+                        self._seen.setdefault(r, set()).add(child)
+                        delta = PartialDelta.from_wire(rec["delta"])
+                        self._staged.setdefault(r, {})[child] = (
+                            delta.total_weight, delta, delta.leaf_epoch)
+                        self._child_counts.setdefault(r, {})[child] = (
+                            delta.total_weight, delta.n_clients, None)
+                else:
+                    leaf = int(rec["sender"])
+                    with self._lock:
+                        if leaf in self._seen.get(r, set()):
+                            continue
+                    self._stage_upload(r, leaf, float(rec["n_samples"]),
+                                       rec["model_params"],
+                                       int(rec.get("epoch", 0)))
+                restaged += 1
+            if restaged:
+                obs.counter_inc("hierarchy.replayed_records", restaged)
+                logger.info("edge %d: replayed %d journaled records for "
+                            "round %d", self.edge_id, restaged, r)
+                self._maybe_send_counts(r)
+
+    # -- housekeeping --------------------------------------------------------
+    def prune_round(self, r: int) -> None:
+        """Drop a finished round's state (the parent has combined it)."""
+        with self._lock:
+            for d in (self._seen, self._seen_fwd, self._staged,
+                      self._stream_acc, self._stream_win, self._counts_sent,
+                      self._members, self._child_counts, self._totals,
+                      self._forwarded):
+                d.pop(r, None)
+            timer = self._flush_timers.pop(r, None)
+        if timer is not None:
+            timer.cancel()
+        if self._journal is not None:
+            self._journal.prune_before(r + 1)
+
+    def finish(self) -> None:
+        with self._lock:
+            timers = list(self._flush_timers.values())
+            self._flush_timers.clear()
+        for t in timers:
+            t.cancel()
+        if self._journal is not None:
+            try:
+                self._journal.flush(timeout=10.0)
+                self._journal.close()
+            except Exception:
+                pass
+        super().finish()

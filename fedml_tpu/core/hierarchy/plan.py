@@ -1,0 +1,164 @@
+"""The logical fan-in tree and its canonical blocked fold.
+
+Floating-point addition does not reassociate, so a tree of partial
+reductions can never be bit-identical to a *differently grouped* flat
+fold — the only way a hierarchy can be "provably bit-identical to the
+flat topology" is for the grouping itself to be part of the round's
+arithmetic contract.  That is what a :class:`HierarchyPlan` is: the
+blocks (contiguous leaf-index ranges), their fold order, and the tree
+shape above them, derived purely from config
+(``fan_in_tree`` / ``edge_fanout``) and the leaf count.  A flat
+deployment evaluates the whole plan at the root
+(:meth:`HierarchyPlan.aggregate`); a tree deployment evaluates each
+block on its edge aggregator and combines up the tree — same operands,
+same order, same bits.  Topology decides WHERE each block folds, never
+WHAT is computed (the same move the compiled agg plane made to match the
+host fold bit-for-bit).
+
+``mean`` blocks scale every update by ``n_i / total`` with the GLOBAL
+total (see :func:`~fedml_tpu.core.aggregate.partial_fold`), which is why
+the wire protocol's flush is two-phase (counts up, total down) — no
+float math happens at an edge until the global total is known.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ..aggregate import combine_partials, partial_fold
+
+Pytree = Any
+
+#: accepted ``fan_in_tree`` depths: 1 = flat, 2 = leaf->edge->root,
+#: 3 = leaf->edge->mid->root
+FAN_IN_TREE_LEVELS = (1, 2, 3)
+
+
+def _blocks(n_items: int, fanout: int) -> List[List[int]]:
+    """Contiguous index blocks of at most ``fanout`` items (one block of
+    everything when ``fanout`` is 0)."""
+    if fanout <= 0 or fanout >= n_items:
+        return [list(range(n_items))]
+    return [list(range(lo, min(lo + fanout, n_items)))
+            for lo in range(0, n_items, fanout)]
+
+
+@dataclass
+class HierarchyPlan:
+    """The logical tree: blocks of leaves, groups of blocks, fold order."""
+
+    n_leaves: int
+    levels: int = 1
+    edge_fanout: int = 0
+    edge_flush: Any = "all"
+    #: leaf-edge blocks: leaf indices folded by each edge, in fold order
+    blocks: List[List[int]] = field(init=False)
+    #: mid groups (3-level only): edge indices combined by each mid
+    mid_groups: List[List[int]] = field(init=False)
+
+    def __post_init__(self):
+        if int(self.levels) not in FAN_IN_TREE_LEVELS:
+            raise ValueError(
+                f"fan_in_tree must be one of {FAN_IN_TREE_LEVELS} "
+                f"(got {self.levels!r})")
+        if int(self.n_leaves) < 1:
+            raise ValueError(f"n_leaves must be >= 1 (got {self.n_leaves})")
+        self.n_leaves = int(self.n_leaves)
+        self.levels = int(self.levels)
+        self.edge_fanout = int(self.edge_fanout)
+        fanout = self.edge_fanout if self.levels > 1 else 0
+        self.blocks = _blocks(self.n_leaves, fanout)
+        self.mid_groups = (_blocks(len(self.blocks), fanout)
+                           if self.levels == 3 else [])
+
+    @classmethod
+    def from_args(cls, args: Any, n_leaves: int) -> "HierarchyPlan":
+        return cls(
+            n_leaves=n_leaves,
+            levels=int(getattr(args, "fan_in_tree", 1) or 1),
+            edge_fanout=int(getattr(args, "edge_fanout", 0) or 0),
+            edge_flush=getattr(args, "edge_flush", "all") or "all",
+        )
+
+    # -- topology queries ----------------------------------------------------
+    @property
+    def n_edges(self) -> int:
+        """Leaf-edge count (0 when the plan is flat)."""
+        return len(self.blocks) if self.levels > 1 else 0
+
+    @property
+    def n_mids(self) -> int:
+        return len(self.mid_groups)
+
+    def edge_of(self, leaf_idx: int) -> int:
+        """The leaf edge folding ``leaf_idx``'s block."""
+        for e, block in enumerate(self.blocks):
+            if leaf_idx in block:
+                return e
+        raise ValueError(f"leaf {leaf_idx} not in any block")
+
+    def mid_of(self, edge_idx: int) -> Optional[int]:
+        """The mid combining leaf-edge ``edge_idx`` (None for 2-level)."""
+        if self.levels != 3:
+            return None
+        for m, group in enumerate(self.mid_groups):
+            if edge_idx in group:
+                return m
+        raise ValueError(f"edge {edge_idx} not in any mid group")
+
+    def flush_timeout(self) -> Optional[float]:
+        """Seconds after which an edge flushes a partial block, or None
+        for the default all-children barrier (``edge_flush="all"`` — the
+        bit-exactness mode; a timeout flush trades bit-identity against
+        the full-cohort plan for liveness under lost leaves)."""
+        if isinstance(self.edge_flush, str) \
+                and self.edge_flush.strip().lower() == "all":
+            return None
+        return float(self.edge_flush)
+
+    # -- the canonical blocked fold ------------------------------------------
+    def aggregate(self, updates: Sequence[Tuple[float, Pytree]],
+                  mode: str = "mean", plane: Any = None) -> Pytree:
+        """Evaluate the WHOLE plan at one node (the flat deployment).
+
+        ``updates`` is indexed by leaf (0..n_leaves-1).  With ``plane``
+        set (a :class:`~fedml_tpu.parallel.agg_plane.CompiledAggPlane`),
+        block folds run ``plane.partial_reduce`` and combines run the
+        plane's ``sum`` fold; otherwise both legs are the host fold.
+        A tree deployment of the same plan computes the identical value
+        bit-for-bit — each edge evaluates one block term, each mid/root
+        one combine term.
+        """
+        if len(updates) != self.n_leaves:
+            raise ValueError(
+                f"plan expects {self.n_leaves} leaf updates "
+                f"(got {len(updates)})")
+        total = float(sum(float(n) for n, _ in updates))
+        partials = [self.block_partial([updates[i] for i in block],
+                                       total, mode, plane)
+                    for block in self.blocks]
+        if self.levels == 3:
+            partials = [self.combine([partials[e] for e in group], mode,
+                                     plane)
+                        for group in self.mid_groups]
+        return self.combine(partials, mode, plane)
+
+    def block_partial(self, block_updates: Sequence[Tuple[float, Pytree]],
+                      total_weight: float, mode: str = "mean",
+                      plane: Any = None) -> Pytree:
+        """One block's partial fold (the edge-aggregator term)."""
+        if plane is not None:
+            return plane.partial_reduce(list(block_updates),
+                                        total_weight=total_weight, mode=mode)
+        return partial_fold(block_updates, total_weight, mode=mode)
+
+    def combine(self, partials: Sequence[Pytree], mode: str = "mean",
+                plane: Any = None) -> Pytree:
+        """Fold child partials (the mid/root term): the plain ``sum``
+        fold in child order — partials are already scaled (``mean``) or
+        raw sums (``sum``), so no tail math remains."""
+        del mode  # same combine either way; kept for call-site symmetry
+        if plane is not None:
+            return plane.aggregate([(1.0, p) for p in partials], mode="sum")
+        return combine_partials(partials)

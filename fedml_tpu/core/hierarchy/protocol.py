@@ -1,0 +1,99 @@
+"""Hierarchy wire vocabulary: message types, param keys, fused deltas.
+
+The tier speaks four message types below the cross-silo application
+vocabulary (like ``comm_ack``, they are invisible to flat deployments):
+
+* ``hier_upload`` — leaf -> edge: one client update (the same payload a
+  flat client would send the server, addressed to its edge instead).
+* ``hier_counts`` — edge -> parent: the count-then-reduce flush's phase
+  A.  Carries the block's ``(total_weight, n_clients)`` plus the edge's
+  codec offer with honest byte estimates; ``mean`` folds cannot start
+  until the GLOBAL total is known, so counts flow up before any float
+  math happens.
+* ``hier_total`` — parent -> edge: phase B release.  Carries the global
+  total weight and the negotiated per-link codec; mids relay it down.
+* ``hier_partial`` — edge -> parent: ONE fused
+  ``(partial_sum, total_weight, n_clients, leaf_epoch)`` delta for the
+  whole block, stamped with a deterministic ``forward_id`` that a
+  replayed incarnation reuses — the parent dedups on it, which is what
+  makes edge-kill replay exactly-once.
+
+Transport-level reliability (msg-id ack/dedup/retransmit) rides the
+ordinary :class:`~fedml_tpu.core.distributed.comm_manager._ReliableLink`
+stamping; the ``forward_id`` here is one layer up — application identity
+that survives process death, where a fresh incarnation's msg-id nonce
+deliberately does not.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+Pytree = Any
+
+# message types (below the MyMessage application vocabulary)
+HIER_UPLOAD = "hier_upload"
+HIER_COUNTS = "hier_counts"
+HIER_TOTAL = "hier_total"
+HIER_PARTIAL = "hier_partial"
+
+# param keys
+KEY_ROUND = "hier_round"
+KEY_LEAF = "hier_leaf"
+KEY_N_SAMPLES = "hier_n_samples"
+KEY_EPOCH = "hier_epoch"
+KEY_EDGE = "hier_edge"
+KEY_FORWARD_ID = "hier_forward_id"
+KEY_PAYLOAD = "hier_payload"
+KEY_TOTAL_WEIGHT = "hier_total_weight"
+KEY_N_CLIENTS = "hier_n_clients"
+KEY_CODEC = "hier_codec"
+KEY_OFFERS = "hier_offers"
+
+# fused-delta wire marker (a self-describing dict, like the compression
+# payloads, so every comm backend can carry it opaquely)
+PARTIAL_MARKER = "__fedml_partial_delta__"
+
+
+def forward_id(edge_id: int, round_idx: int) -> str:
+    """The deterministic application-level identity of an edge's fused
+    forward for one round: a function of (edge, round) alone, so a
+    replayed incarnation re-forwards under the SAME id and the parent's
+    dedup makes the replay exactly-once."""
+    return f"e{int(edge_id)}:r{int(round_idx)}"
+
+
+@dataclass
+class PartialDelta:
+    """One block's fused contribution: the partial fold plus the
+    accounting the parent needs to close its own books."""
+
+    partial_sum: Pytree
+    total_weight: float
+    n_clients: int
+    leaf_epoch: int = 0
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            PARTIAL_MARKER: 1,
+            "partial_sum": self.partial_sum,
+            "total_weight": float(self.total_weight),
+            "n_clients": int(self.n_clients),
+            "leaf_epoch": int(self.leaf_epoch),
+        }
+
+    @staticmethod
+    def is_wire(obj: Any) -> bool:
+        return isinstance(obj, dict) and PARTIAL_MARKER in obj
+
+    @staticmethod
+    def from_wire(payload: Dict[str, Any]) -> "PartialDelta":
+        if not PartialDelta.is_wire(payload):
+            raise ValueError("not a partial-delta payload")
+        return PartialDelta(
+            partial_sum=payload["partial_sum"],
+            total_weight=float(payload["total_weight"]),
+            n_clients=int(payload["n_clients"]),
+            leaf_epoch=int(payload.get("leaf_epoch", 0)),
+        )

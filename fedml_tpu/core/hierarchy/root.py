@@ -1,0 +1,207 @@
+"""Root-side fan-in: totals, codec negotiation, exactly-once combine.
+
+:class:`HierarchyRoot` is deliberately NOT a comm manager — it attaches
+to any existing :class:`~fedml_tpu.core.distributed.comm_manager
+.FedMLCommManager` (the cross-silo server, a bare test manager) by
+registering the two upward hierarchy handlers, so the flat server keeps
+its whole vocabulary and grows the tree's on the side:
+
+* ``hier_counts`` — stage each top-level child's ``(weight, clients,
+  codec offer)``.  When the cohort is complete, total the weights in
+  child-id order (one deterministic float sum — the same total every
+  deployment of the plan computes), negotiate a per-link codec from each
+  child's offer, and send ``hier_total`` down.  A child's counts arriving
+  AFTER the total exists (a replayed edge incarnation) get an idempotent
+  ``hier_total`` re-reply — that re-reply is what drives the replayed
+  edge to re-forward.
+* ``hier_partial`` — dedup on the deterministic forward id (a replayed
+  edge re-forwards under the same id; the duplicate is counted and
+  dropped — exactly-once accounting), absorb the grafted leaf telemetry
+  into the merger, stage the delta, and when every child has landed,
+  combine in child-id order and close the round through ``on_round``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import obs
+from ..aggregate import FedMLAggOperator
+from ..compression import maybe_decompress_update
+from ..distributed.communication.message import Message
+from . import protocol
+from .plan import HierarchyPlan
+from .protocol import PartialDelta
+
+logger = logging.getLogger(__name__)
+
+Pytree = Any
+
+
+class HierarchyRoot:
+    """The tree's apex: counts -> total -> combine, attached to a manager."""
+
+    def __init__(self, manager, plan: HierarchyPlan,
+                 child_ranks: Dict[int, int], mode: Optional[str] = None,
+                 plane: Any = None, merger: Any = None,
+                 on_round: Optional[Callable[[int, Pytree, float, int],
+                                             None]] = None):
+        self.manager = manager
+        self.plan = plan
+        self.child_ranks = dict(child_ranks)
+        self.mode = mode or FedMLAggOperator.agg_mode(manager.args)
+        self._plane = plane
+        self._plane_checked = plane is not None
+        self.merger = merger
+        self.on_round = on_round
+        self._lock = threading.RLock()
+        self._counts: Dict[int, Dict[int, Tuple[float, int, Any]]] = {}
+        self._codecs: Dict[int, Dict[int, str]] = {}
+        self._totals: Dict[int, float] = {}
+        self._seen_fwd: Dict[int, set] = {}
+        self._deltas: Dict[int, Dict[int, PartialDelta]] = {}
+        self._results: Dict[int, Tuple[Pytree, float, int]] = {}
+        self._closed: Dict[int, threading.Event] = {}
+        self.dup_forwards = 0
+        self.rounds_closed = 0
+        manager.register_message_receive_handler(
+            protocol.HIER_COUNTS, self._handle_counts)
+        manager.register_message_receive_handler(
+            protocol.HIER_PARTIAL, self._handle_partial)
+
+    @property
+    def plane(self):
+        if not self._plane_checked:
+            self._plane_checked = True
+            if str(getattr(self.manager.args, "agg_plane", "host")
+                   or "host") == "compiled":
+                from ...parallel.agg_plane import plane_for
+
+                self._plane = plane_for(self.manager.args)
+        return self._plane
+
+    def _accepted(self) -> List[str]:
+        return [s.strip().lower() for s in str(
+            getattr(self.manager.args, "edge_codec_accept", "none") or "none"
+        ).split(",") if s.strip()]
+
+    # -- phase A: counts up, total down --------------------------------------
+    def _handle_counts(self, msg: Message) -> None:
+        r = int(msg.get(protocol.KEY_ROUND))
+        child = int(msg.get(protocol.KEY_EDGE))
+        with self._lock:
+            counts = self._counts.setdefault(r, {})
+            counts[child] = (float(msg.get(protocol.KEY_TOTAL_WEIGHT, 0.0)),
+                             int(msg.get(protocol.KEY_N_CLIENTS, 0)),
+                             msg.get(protocol.KEY_OFFERS))
+            total_known = r in self._totals
+            complete = len(counts) >= len(self.child_ranks)
+        if total_known:
+            # a replayed child re-sent counts: idempotent hier_total
+            # re-reply so the replacement incarnation can re-forward
+            self._send_total(r, only_child=child)
+            obs.counter_inc("hierarchy.total_rereplies")
+            return
+        if complete:
+            self._close_counts(r)
+
+    def _close_counts(self, r: int) -> None:
+        from .router import negotiate_codec
+
+        accepted = self._accepted()
+        with self._lock:
+            if r in self._totals:
+                return
+            counts = self._counts[r]
+            # one deterministic float sum in child-id order — every
+            # deployment of the plan totals the same operands the same way
+            total = float(sum(counts[c][0] for c in sorted(counts)))
+            self._totals[r] = total
+            self._codecs[r] = {c: negotiate_codec(counts[c][2], accepted)
+                               for c in counts}
+        self._send_total(r)
+
+    def _send_total(self, r: int, only_child: Optional[int] = None) -> None:
+        with self._lock:
+            total = self._totals[r]
+            codecs = dict(self._codecs.get(r, {}))
+        for child, rank in sorted(self.child_ranks.items()):
+            if only_child is not None and child != only_child:
+                continue
+            m = Message(protocol.HIER_TOTAL, self.manager.get_sender_id(),
+                        rank)
+            m.add_params(protocol.KEY_ROUND, r)
+            m.add_params(protocol.KEY_TOTAL_WEIGHT, total)
+            m.add_params(protocol.KEY_CODEC, codecs.get(child, "none"))
+            self.manager.send_message(m)
+
+    # -- phase B: fused deltas up, combine, close ----------------------------
+    def _handle_partial(self, msg: Message) -> None:
+        r = int(msg.get(protocol.KEY_ROUND))
+        child = int(msg.get(protocol.KEY_EDGE))
+        fwd = str(msg.get(protocol.KEY_FORWARD_ID))
+        with self._lock:
+            seen = self._seen_fwd.setdefault(r, set())
+            if fwd in seen:
+                # a replayed edge's re-forward: the SAME forward id, so this
+                # is the same contribution — drop it.  Exactly-once is this
+                # line plus the deterministic id.
+                self.dup_forwards += 1
+                obs.counter_inc("hierarchy.root_dup_forwards")
+                return
+            seen.add(fwd)
+        wire = dict(msg.get(protocol.KEY_PAYLOAD))
+        wire["partial_sum"] = maybe_decompress_update(wire["partial_sum"])
+        delta = PartialDelta.from_wire(wire)
+        if self.merger is not None:
+            try:
+                self.merger.absorb(msg)
+            except Exception:  # telemetry never raises into the round path
+                pass
+        with self._lock:
+            deltas = self._deltas.setdefault(r, {})
+            deltas[child] = delta
+            if len(deltas) < len(self.child_ranks):
+                return
+        self._close_round(r)
+
+    def _close_round(self, r: int) -> None:
+        with self._lock:
+            if r in self._results:
+                return
+            deltas = self._deltas[r]
+            order = sorted(deltas)
+            tree = self.plan.combine([deltas[c].partial_sum for c in order],
+                                     self.mode, self.plane)
+            weight = float(sum(deltas[c].total_weight for c in order))
+            n_clients = int(sum(deltas[c].n_clients for c in order))
+            self._results[r] = (tree, weight, n_clients)
+            self.rounds_closed += 1
+            ev = self._closed.setdefault(r, threading.Event())
+        obs.counter_inc("hierarchy.rounds_closed")
+        if self.on_round is not None:
+            try:
+                self.on_round(r, tree, weight, n_clients)
+            except Exception:
+                logger.exception("hierarchy on_round callback failed for "
+                                 "round %d", r)
+        ev.set()
+
+    # -- results -------------------------------------------------------------
+    def result(self, r: int) -> Optional[Tuple[Pytree, float, int]]:
+        with self._lock:
+            return self._results.get(r)
+
+    def wait_round(self, r: int, timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            ev = self._closed.setdefault(r, threading.Event())
+        return ev.wait(timeout)
+
+    def prune_round(self, r: int) -> None:
+        with self._lock:
+            for d in (self._counts, self._codecs, self._totals,
+                      self._seen_fwd, self._deltas, self._results,
+                      self._closed):
+                d.pop(r, None)

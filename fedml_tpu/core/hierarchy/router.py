@@ -1,0 +1,193 @@
+"""Tree wiring: rank layout, node construction, per-link codec choice.
+
+:class:`HierarchyRouter` turns a validated
+:class:`~fedml_tpu.core.hierarchy.plan.HierarchyPlan` into a deployed
+tree over one comm backend.  The rank layout is deterministic in the
+plan alone::
+
+    rank 0                      the root (any existing server manager)
+    1 .. E                      leaf-edge aggregators, block order
+    E+1 .. E+M                  mids (3-level only), group order
+    E+M+1 .. E+M+L              leaf senders, leaf-index order
+
+Mid node ids live in the same namespace as edge ids, offset by the edge
+count, so every node's deterministic forward id is globally unique.
+
+Codec negotiation is per parent<->child link: the child OFFERS the
+schemes it can encode plus honest byte estimates
+(:func:`estimate_scheme_bytes` — measured shapes, the real top-k ``k``,
+the real index dtype); the parent picks the cheapest offered scheme it
+accepts, preferring its own accept-list order on ties, and always falls
+back to ``none``.  Lossy codecs trade the bit-identity contract for
+bytes — bit-exact deployments negotiate ``none`` (the default).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..compression import _INT32_MAX, topk_k, wire_bytes
+from ..distributed.communication.message import Message
+from . import protocol
+from .edge import EdgeAggregator
+from .plan import HierarchyPlan
+from .root import HierarchyRoot
+
+Pytree = Any
+
+#: schemes a hierarchy link may negotiate, in default preference order
+LINK_SCHEMES = ("none", "topk", "eftopk", "quantize", "qsgd")
+
+
+def estimate_scheme_bytes(tree: Pytree, method: str,
+                          ratio: float = 0.05) -> int:
+    """Honest wire-byte estimate for encoding ``tree`` under ``method``,
+    WITHOUT running the codec: dense leaf bytes for ``none`` and the
+    quantizers (they ship dense float arrays), per-leaf
+    ``k * (value + index)`` bytes for top-k — the same ``k`` rule and
+    index dtype the real :func:`~fedml_tpu.core.compression.topk_leaf`
+    uses, so the estimate and :func:`~fedml_tpu.core.compression
+    .wire_bytes` of the actual payload agree."""
+    import jax
+
+    method = str(method).lower()
+    if method not in LINK_SCHEMES:
+        raise ValueError(f"unknown compression method {method!r}")
+    if method in ("none", "quantize", "qsgd"):
+        return wire_bytes(tree)
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf)
+        n = int(arr.size)
+        if n == 0:
+            continue
+        k = topk_k(ratio, n)
+        idx_itemsize = 8 if n > _INT32_MAX else 4
+        total += k * int(arr.dtype.itemsize) + k * idx_itemsize
+    return int(total)
+
+
+def negotiate_codec(offers: Any, accepted: List[str]) -> str:
+    """Pick the link codec from a child's offer and a parent's accept
+    list: cheapest offered-and-accepted scheme by the child's byte
+    estimates; schemes with no estimate lose to estimated ones; ties (and
+    the no-estimates case) resolve by the PARENT's accept-list order.
+    Anything malformed degrades to ``none`` — the link always works."""
+    accepted = [str(s).lower() for s in (accepted or []) if s]
+    if not isinstance(offers, dict):
+        return "none"
+    schemes = [str(s).lower() for s in offers.get("schemes", []) or []]
+    estimates = offers.get("bytes") or {}
+    candidates = [s for s in schemes if s in accepted and s in LINK_SCHEMES]
+    if not candidates:
+        return "none"
+    def _key(s: str):
+        est = estimates.get(s)
+        has = isinstance(est, (int, float))
+        return (0 if has else 1, est if has else 0, accepted.index(s))
+    return sorted(candidates, key=_key)[0]
+
+
+class HierarchyRouter:
+    """Deterministic rank layout + node construction for one plan."""
+
+    def __init__(self, args, plan: Optional[HierarchyPlan] = None,
+                 n_leaves: Optional[int] = None, backend: str = "LOOPBACK",
+                 mode: Optional[str] = None):
+        if plan is None:
+            if n_leaves is None:
+                raise ValueError("router needs a plan or a leaf count")
+            plan = HierarchyPlan.from_args(args, n_leaves)
+        if plan.levels < 2:
+            raise ValueError(
+                "a hierarchy router needs fan_in_tree >= 2 "
+                f"(got {plan.levels}); flat deployments evaluate the plan "
+                "at the root directly")
+        self.args = args
+        self.plan = plan
+        self.backend = backend
+        self.mode = mode
+
+    # -- rank layout ---------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return 1 + self.plan.n_edges + self.plan.n_mids + self.plan.n_leaves
+
+    def edge_rank(self, edge_idx: int) -> int:
+        return 1 + int(edge_idx)
+
+    def mid_rank(self, mid_idx: int) -> int:
+        return 1 + self.plan.n_edges + int(mid_idx)
+
+    def leaf_rank(self, leaf_idx: int) -> int:
+        return 1 + self.plan.n_edges + self.plan.n_mids + int(leaf_idx)
+
+    def leaf_target_rank(self, leaf_idx: int) -> int:
+        """The rank a leaf addresses its upload to: its block's edge."""
+        return self.edge_rank(self.plan.edge_of(leaf_idx))
+
+    def mid_id(self, mid_idx: int) -> int:
+        """Mid node id in the shared edge-id namespace (forward ids stay
+        globally unique)."""
+        return self.plan.n_edges + int(mid_idx)
+
+    def root_child_ranks(self) -> Dict[int, int]:
+        """The root's direct children: mids when 3-level, else the edges."""
+        if self.plan.levels == 3:
+            return {self.mid_id(m): self.mid_rank(m)
+                    for m in range(self.plan.n_mids)}
+        return {e: self.edge_rank(e) for e in range(self.plan.n_edges)}
+
+    # -- node construction ---------------------------------------------------
+    def build_edges(self, comm=None, plane: Any = None
+                    ) -> List[EdgeAggregator]:
+        """Construct every edge (and mid) manager for this plan, leaf-edge
+        blocks first, mids after — callers start each with ``run_async()``."""
+        nodes: List[EdgeAggregator] = []
+        for e, block in enumerate(self.plan.blocks):
+            mid = self.plan.mid_of(e)
+            parent = 0 if mid is None else self.mid_rank(mid)
+            nodes.append(EdgeAggregator(
+                self.args, self.plan, edge_id=e, parent_rank=parent,
+                children=block, comm=comm, rank=self.edge_rank(e),
+                size=self.size, backend=self.backend, mode=self.mode,
+                plane=plane))
+        for m, group in enumerate(self.plan.mid_groups):
+            nodes.append(EdgeAggregator(
+                self.args, self.plan, edge_id=self.mid_id(m), parent_rank=0,
+                children=list(group),
+                child_ranks={e: self.edge_rank(e) for e in group},
+                is_mid=True, comm=comm, rank=self.mid_rank(m),
+                size=self.size, backend=self.backend, mode=self.mode,
+                plane=plane))
+        return nodes
+
+    def attach_root(self, manager, merger: Any = None,
+                    on_round: Optional[Callable] = None,
+                    plane: Any = None) -> HierarchyRoot:
+        """Graft the tree's apex onto an existing rank-0 manager."""
+        return HierarchyRoot(manager, self.plan,
+                             child_ranks=self.root_child_ranks(),
+                             mode=self.mode, plane=plane, merger=merger,
+                             on_round=on_round)
+
+    # -- leaf-side helper ----------------------------------------------------
+    def leaf_upload_message(self, sender_rank: int, leaf_idx: int,
+                            round_idx: int, n_samples: float, tree: Pytree,
+                            epoch: int = 0,
+                            telemetry: Any = None) -> Message:
+        """Build one leaf upload addressed to its edge; ``telemetry`` is an
+        optional :class:`~fedml_tpu.core.obs.telemetry.ClientTelemetry`
+        whose pending ring rides along (and through the edge's graft)."""
+        msg = Message(protocol.HIER_UPLOAD, sender_rank,
+                      self.leaf_target_rank(leaf_idx))
+        msg.add_params(protocol.KEY_ROUND, int(round_idx))
+        msg.add_params(protocol.KEY_LEAF, int(leaf_idx))
+        msg.add_params(protocol.KEY_N_SAMPLES, float(n_samples))
+        msg.add_params(protocol.KEY_EPOCH, int(epoch))
+        msg.add_params(protocol.KEY_PAYLOAD, tree)
+        if telemetry is not None:
+            telemetry.attach(msg)
+        return msg

@@ -25,6 +25,12 @@ transport nor the durability layer may own directly (circular import):
   attributes most ingest time to.  Arena reuse is safe for the same reason
   the async flush path is: a slot's previous tree is always consumed
   (aggregated) before the same slot accepts the next round's upload.
+* a **reorder window** — the edge-aggregator tier's streaming fold must
+  consume uploads in leaf-index order (the fold order is part of the
+  round's bit-exactness contract) while the wire delivers them in
+  arrival order; :class:`ReorderWindow` releases items in index order,
+  holding only the out-of-order tail, so in-order traffic streams
+  straight into the accumulator with O(1) staging.
 """
 
 from __future__ import annotations
@@ -278,3 +284,62 @@ class ZeroCopyDecoder:
         with self._lock:
             self._arenas.pop(slot, None)
             self._blob_arenas.pop(slot, None)
+
+
+# ---------------------------------------------------------------------------
+# in-order release window (streaming edge fold)
+# ---------------------------------------------------------------------------
+class ReorderWindow:
+    """Release staged items in a fixed index order regardless of arrival.
+
+    The edge aggregator's streaming fold (``core/hierarchy``) consumes
+    one leaf upload at a time in the block's leaf-index order — the fold
+    order IS the bit-exactness contract — but transports deliver in
+    arrival order.  ``stage(key, item)`` parks an item; ``release()``
+    yields every ``(key, item)`` that is now contiguous with the release
+    cursor, dropping staged references as it goes, so the common in-order
+    case stages nothing and the out-of-order tail is all that is ever
+    held.  Not thread-safe by design: the single dispatch worker (or the
+    transport thread on the sync path) is the only caller, the same
+    single-threaded-handler invariant every manager assumes.
+    """
+
+    def __init__(self, order: List[Any]):
+        self._order = list(order)
+        self._cursor = 0
+        self._staged: Dict[Any, Any] = {}
+
+    @property
+    def expected(self) -> Optional[Any]:
+        """The next key the window will release, or None when done."""
+        if self._cursor >= len(self._order):
+            return None
+        return self._order[self._cursor]
+
+    def pending(self) -> int:
+        """Items parked out of order (the memory the window is holding)."""
+        return len(self._staged)
+
+    def done(self) -> bool:
+        return self._cursor >= len(self._order)
+
+    def stage(self, key: Any, item: Any) -> List[Tuple[Any, Any]]:
+        """Park ``item`` and return the (possibly empty) newly contiguous
+        run, in order.  Unknown keys raise; re-staging a key that was
+        already released or parked is the caller's dedup bug."""
+        if key not in self._order:
+            raise KeyError(f"key {key!r} not in this window's order")
+        if key in self._staged or self._order.index(key) < self._cursor:
+            raise ValueError(f"key {key!r} staged twice")
+        self._staged[key] = item
+        return self.release()
+
+    def release(self) -> List[Tuple[Any, Any]]:
+        out: List[Tuple[Any, Any]] = []
+        while self._cursor < len(self._order):
+            key = self._order[self._cursor]
+            if key not in self._staged:
+                break
+            out.append((key, self._staged.pop(key)))
+            self._cursor += 1
+        return out

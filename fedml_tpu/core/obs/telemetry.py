@@ -270,6 +270,86 @@ class ClientTelemetry:
         return m
 
 
+class TelemetryRelay:
+    """Edge-aggregator telemetry graft: collect leaf blobs, re-carry them
+    upstream.
+
+    An edge tier must not become a telemetry black hole — the root's
+    :class:`TelemetryMerger` still wants per-LEAF span attribution, so an
+    edge pops each leaf upload's blob off the message (undecoded: the
+    blob is opaque bytes with its own node id and seq space) and grafts
+    the collected batch onto its fused forward as a list under
+    :data:`TELEMETRY_KEY`.  The merger's list-aware
+    :meth:`TelemetryMerger.absorb` merges each as if the leaf had
+    uploaded directly; a replayed forward re-carries the same blobs and
+    the per-node seq dedup collapses them.  Bounded and best-effort like
+    everything else on this plane.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._blobs: List[bytes] = []
+        self.blobs_dropped = 0
+
+    def collect(self, message: Any) -> Optional[bytes]:
+        """Pop the blob riding a leaf upload (if any) into the relay
+        buffer; returns it so the caller can journal it alongside the
+        upload (a replayed edge then re-grafts the same bytes)."""
+        try:
+            blob = message.get(TELEMETRY_KEY)
+        except Exception:
+            return None
+        if not isinstance(blob, (bytes, bytearray)):
+            return None
+        return self.offer(bytes(blob))
+
+    def collect_many(self, message: Any) -> List[bytes]:
+        """Pop the blob OR blob-list riding ``message`` (a mid absorbing a
+        child edge's graft sees a list) into the relay buffer; returns the
+        collected blobs for journaling."""
+        try:
+            blob = message.get(TELEMETRY_KEY)
+        except Exception:
+            return []
+        blobs = blob if isinstance(blob, (list, tuple)) else [blob]
+        out: List[bytes] = []
+        for b in blobs:
+            got = self.offer(b) if isinstance(b, (bytes, bytearray)) else None
+            if got is not None:
+                out.append(got)
+        return out
+
+    def offer(self, blob: Optional[bytes]) -> Optional[bytes]:
+        """Buffer one raw blob (journal-replay re-entry point)."""
+        if not isinstance(blob, (bytes, bytearray)):
+            return None
+        blob = bytes(blob)
+        with self._lock:
+            if len(self._blobs) >= self.capacity:
+                self.blobs_dropped += 1
+                return blob
+            self._blobs.append(blob)
+        return blob
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._blobs)
+
+    def graft(self, message: Any, own: Optional[bytes] = None) -> int:
+        """Attach the collected leaf blobs (plus the edge's ``own`` blob,
+        if given) to the fused forward; returns the blob count.  The
+        buffer drains — a later flush carries only newer leaf blobs."""
+        with self._lock:
+            blobs = list(self._blobs)
+            self._blobs.clear()
+        if isinstance(own, (bytes, bytearray)):
+            blobs.append(bytes(own))
+        if blobs:
+            message.add_params(TELEMETRY_KEY, blobs)
+        return len(blobs)
+
+
 class TelemetryMerger:
     """Server-side blob fan-in: seq dedup/gap accounting, remote-span
     re-emission, ``client``-labeled metric merge.
@@ -297,11 +377,22 @@ class TelemetryMerger:
     # -- ingestion -----------------------------------------------------------
     def absorb(self, message: Any) -> int:
         """Merge the blob riding ``message`` (if any); returns the number
-        of FRESH records applied.  Never raises."""
+        of FRESH records applied.  Never raises.  The param may be one
+        blob (a direct client upload) or a list of blobs (an edge
+        aggregator's graft: the leaf blobs it collected, re-carried on
+        its fused forward) — each blob keeps its own node id and seq
+        window, so per-leaf attribution survives the intermediate hop and
+        a replayed forward's re-carried blobs collapse as duplicates."""
         try:
             blob = message.get(TELEMETRY_KEY)
         except Exception:
             return 0
+        if isinstance(blob, (list, tuple)):
+            fresh = 0
+            for b in blob:
+                if isinstance(b, (bytes, bytearray)):
+                    fresh += self.merge(bytes(b))
+            return fresh
         if not isinstance(blob, (bytes, bytearray)):
             return 0
         return self.merge(bytes(blob))

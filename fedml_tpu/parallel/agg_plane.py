@@ -404,6 +404,76 @@ class CompiledAggPlane:
                         labels={"path": "compiled"})
         return jax.tree_util.tree_unflatten(treedef, out)
 
+    def partial_reduce(self, updates: Sequence[Tuple[float, Pytree]],
+                       total_weight: Optional[float] = None,
+                       mode: str = "mean",
+                       obs_parent: Any = None) -> Pytree:
+        """One hierarchy block's fold — the reduction WITHOUT a server
+        tail (the edge-aggregator tier's compiled leg).
+
+        Identical to :meth:`aggregate` except the ``mean`` weights divide
+        by the caller-supplied GLOBAL ``total_weight`` instead of the
+        block-local sum, so every per-leaf multiply uses the same f32
+        operand the flat fold would — block partials then combine (a
+        ``sum``-mode fold over the partial pytrees) into the flat result
+        bit-for-bit.  ``sum`` mode ignores ``total_weight``.
+        """
+        if mode not in ("mean", "sum"):
+            raise ValueError(f"agg mode must be mean|sum (got {mode!r})")
+        if not updates:
+            raise ValueError("no updates to fold")
+        if mode == "sum":
+            return self.aggregate(updates, mode="sum", obs_parent=obs_parent)
+        if total_weight is None:
+            total_weight = float(sum(float(n) for n, _ in updates))
+        total = float(total_weight)
+        if total <= 0:
+            raise ValueError("total sample count must be positive")
+        ns = [float(n) for n, _ in updates]
+        leaves_list, treedef = flatten_checked([t for _, t in updates])
+        n = len(leaves_list)
+        # the same f64 divide the host partial_fold feeds tree_scale,
+        # rounded to f32 once — matching the flat plane's weight path
+        w_all = np.asarray([x / total for x in ns], np.float32)
+
+        shapes = tuple(tuple(np.shape(l)) for l in leaves_list[0])
+        dtypes = tuple(jnp.dtype(jnp.result_type(l)) for l in leaves_list[0])
+        k = self.microbatch_clients or n
+        parent = obs_parent if obs_parent is not None else obs.active_ctx()
+        prog = self._program_for(treedef, shapes, dtypes, k, "mean", parent)
+
+        t0 = time.perf_counter()
+        sp = (obs.span("aggregate.partial", parent, n_clients=n, k=k,
+                       mode=mode)
+              if parent is not None else NULL_SPAN)
+        w_sharding = NamedSharding(self.mesh, P())
+        with sp:
+            acc = jax.device_put(
+                [np.zeros(sh, np.dtype(dt))
+                 for sh, dt in zip(shapes, prog.acc_dtypes)],
+                prog.acc_shardings)
+            for lo in range(0, n, k):
+                hi = min(lo + k, n)
+                chunk = []
+                for j, sh in enumerate(shapes):
+                    buf = np.zeros((k,) + sh, dtype=np.dtype(prog.wire_dtypes[j]))
+                    for row, c in enumerate(range(lo, hi)):
+                        buf[row] = np.asarray(leaves_list[c][j])
+                    chunk.append(buf)
+                w = np.zeros(k, np.float32)
+                w[: hi - lo] = w_all[lo:hi]
+                chunk = jax.device_put(chunk, prog.chunk_shardings)
+                acc = prog.step(acc, chunk, jax.device_put(w, w_sharding))
+            out = [a.astype(dt) if a.dtype != dt else a
+                   for a, dt in zip(acc, prog.out_dtypes)]
+            jax.block_until_ready(out)
+        dt_s = time.perf_counter() - t0
+        obs.histogram_observe("agg.step_seconds", dt_s,
+                              labels={"path": "compiled", "mode": "partial"})
+        obs.counter_inc("agg.bytes_reduced", n * prog.wire_bytes,
+                        labels={"path": "compiled"})
+        return jax.tree_util.tree_unflatten(treedef, out)
+
 
 # -- the sharded round plane -------------------------------------------------
 

@@ -1,0 +1,42 @@
+"""Golden fixture: partial reductions outside the hierarchy seam
+(expected: 3).  The ``hier_`` basename opts this file into the
+``hierarchy-reduce-seam`` scope (real seam files — ``core/hierarchy/``,
+``core/aggregate.py``, ``parallel/agg_plane.py`` — are exempt by path).
+
+Line 22 — hierarchy-reduce-seam: a direct ``partial_fold`` call in
+application code picks its own total.
+Line 26 — hierarchy-reduce-seam: ``plane.partial_reduce`` invoked
+outside the plan's routing.
+Line 32 — hierarchy-reduce-seam: ``combine_partials`` outside the seam
+re-folds child partials in an order the plan never blessed.
+
+The clean counterparts: ``via_plan`` delegates to the plan (topology
+decides WHERE, the plan decides WHAT), and ``justified`` carries the
+pragma a deliberate out-of-seam oracle needs.
+"""
+
+
+def rogue_block(updates):
+    from fedml_tpu.core.aggregate import partial_fold
+
+    return partial_fold(updates, 10.0, mode="mean")
+
+
+def rogue_compiled(plane, updates):
+    return plane.partial_reduce(updates, total_weight=10.0)
+
+
+def rogue_combine(partials):
+    from fedml_tpu.core.aggregate import combine_partials
+
+    return combine_partials(partials)
+
+
+def via_plan(plan, updates, mode):
+    return plan.aggregate(updates, mode=mode)
+
+
+def justified(partials):
+    from fedml_tpu.core.aggregate import combine_partials
+
+    return combine_partials(partials)  # fedlint: allow[hierarchy-reduce-seam] — parity oracle for the seam test itself

@@ -9,13 +9,16 @@ import jax
 import jax.numpy as jnp
 
 from fedml_tpu.core.compression import (
+    _MARKER,
     compress_update,
     decompress_update,
     is_compressed,
     maybe_decompress_update,
     qsgd_leaf,
     quantize_leaf,
+    topk_k,
     topk_leaf,
+    wire_bytes,
 )
 
 
@@ -84,6 +87,148 @@ class TestPytreeAPI:
         assert res is None
         out = decompress_update(payload)
         assert float(out["w"].sum()) == 10.0 + 9.0  # only the top 2 survive
+
+
+class TestTopkKBoundaries:
+    """Pins for the half-up k rule ``max(1, int(ratio*n + 0.5))``.
+
+    The edge tier's codec negotiation prices a top-k forward from this
+    exact k, so the rule is part of the wire contract: banker's rounding
+    (``int(round(...))``) would keep a DIFFERENT fraction of .5-boundary
+    leaves depending on parity and platform."""
+
+    @pytest.mark.parametrize("ratio,n,expected", [
+        (0.5, 1, 1),
+        (0.5, 3, 2),
+        (0.5, 5, 3),      # round(2.5) == 2 under banker's — the pin
+        (0.05, 50, 3),    # round(2.5) again, at the default ratio
+        (0.05, 10, 1),
+        (0.1, 100, 10),
+        (0.001, 100, 1),  # never below one entry
+        (1.0, 7, 7),
+    ])
+    def test_half_up_boundary_pins(self, ratio, n, expected):
+        assert topk_k(ratio, n) == expected
+
+    def test_monotone_in_both_arguments(self):
+        ks = [topk_k(0.3, n) for n in range(1, 200)]
+        assert ks == sorted(ks)
+        ks = [topk_k(r, 97) for r in np.linspace(0.01, 1.0, 50)]
+        assert ks == sorted(ks)
+
+    def test_topk_leaf_keeps_exactly_k(self):
+        for n in (1, 3, 5, 17, 64):
+            x = jnp.asarray(np.random.RandomState(n).randn(n), jnp.float32)
+            values, idx = topk_leaf(x, ratio=0.5)
+            assert values.shape[0] == idx.shape[0] == topk_k(0.5, n)
+
+    def test_indices_stay_int32_below_the_guard(self):
+        """The int64 top-k index guard: normal leaves ship the narrow
+        dtype (half the index bytes); only leaves past 2^31-1 entries
+        widen — and ``wire_bytes`` prices whichever dtype actually rode."""
+        _, idx = topk_leaf(jnp.arange(100, dtype=jnp.float32), ratio=0.1)
+        assert np.asarray(idx).dtype == np.int32
+        # a hand-built wide-index payload is billed at 8 bytes per index
+        narrow = {_MARKER: "topk", "treedef": None, "leaves": [
+            (np.ones(4, np.float32), np.arange(4, dtype=np.int32), (8,),
+             "float32")]}
+        wide = {_MARKER: "topk", "treedef": None, "leaves": [
+            (np.ones(4, np.float32), np.arange(4, dtype=np.int64), (8,),
+             "float32")]}
+        assert wire_bytes(narrow) == 4 * 4 + 4 * 4
+        assert wire_bytes(wide) == 4 * 4 + 4 * 8
+
+
+class TestRoundTripProperties:
+    """Scheme-by-scheme round-trip laws plus the ``wire_bytes`` honesty
+    contract the hierarchy's codec negotiation depends on."""
+
+    def _tree(self, seed=0):
+        rng = np.random.RandomState(seed)
+        return {"layer": {"kernel": jnp.asarray(rng.randn(32, 16), jnp.float32),
+                          "bias": jnp.asarray(rng.randn(16), jnp.float32)}}
+
+    def _dense_bytes(self, tree):
+        return sum(np.asarray(l).size * np.asarray(l).dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(tree))
+
+    def test_none_is_lossless_and_full_price(self):
+        tree = self._tree()
+        payload, res = compress_update(tree, "none")
+        assert res is None
+        out = decompress_update(payload)
+        for a, b in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert wire_bytes(payload) == self._dense_bytes(tree)
+        # raw pytrees price the same as their 'none' wrapping
+        assert wire_bytes(tree) == wire_bytes(payload)
+
+    @pytest.mark.parametrize("method", ["topk", "eftopk"])
+    @pytest.mark.parametrize("ratio", [0.05, 0.25, 0.5, 1.0])
+    def test_topk_exact_on_survivors_zero_elsewhere(self, method, ratio):
+        tree = self._tree(1)
+        payload, _ = compress_update(tree, method, ratio=ratio)
+        out = decompress_update(payload)
+        for a, b in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(tree)):
+            a, b = np.asarray(a), np.asarray(b)
+            kept = a != 0
+            # survivors are bit-exact, everything else is exactly zero
+            np.testing.assert_array_equal(a[kept], b[kept])
+            k = topk_k(ratio, b.size)
+            assert kept.sum() == k
+            # and the survivors really are the top-k magnitudes
+            if (~kept).any():
+                assert np.abs(b[kept]).min() >= np.abs(b[~kept]).max()
+
+    @pytest.mark.parametrize("ratio", [0.05, 0.25, 0.5])
+    def test_topk_wire_bytes_scale_with_k(self, ratio):
+        tree = self._tree(2)
+        payload, _ = compress_update(tree, "topk", ratio=ratio)
+        expected = sum(
+            topk_k(ratio, np.asarray(l).size) * (4 + 4)  # f32 value + i32 idx
+            for l in jax.tree_util.tree_leaves(tree))
+        assert wire_bytes(payload) == expected
+        # at ratio 0.5 the 4-byte index per 4-byte value exactly ties the
+        # dense price — the break-even the codec negotiation must see
+        if ratio < 0.5:
+            assert wire_bytes(payload) < self._dense_bytes(tree)
+        else:
+            assert wire_bytes(payload) == self._dense_bytes(tree)
+
+    @pytest.mark.parametrize("method", ["quantize", "qsgd"])
+    def test_quantized_bounded_error_dense_price(self, method):
+        tree = self._tree(3)
+        payload, res = compress_update(tree, method, bits=8,
+                                       key=jax.random.PRNGKey(7))
+        assert res is None
+        out = decompress_update(payload)
+        for a, b in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(tree)):
+            a, b = np.asarray(a), np.asarray(b)
+            norm = np.linalg.norm(b.reshape(-1))
+            # one quantization level of error, norm-scaled (qsgd's biased
+            # scale only shrinks magnitudes, never grows the error bound)
+            assert np.abs(a - b).max() <= norm / 255 + norm + 1e-6
+            assert np.all(np.sign(a) * np.sign(b) >= 0)
+        assert wire_bytes(payload) == self._dense_bytes(tree)
+
+    def test_qsgd_reproducible_under_same_key(self):
+        tree = self._tree(4)
+        p1, _ = compress_update(tree, "qsgd", key=jax.random.PRNGKey(11))
+        p2, _ = compress_update(tree, "qsgd", key=jax.random.PRNGKey(11))
+        for a, b in zip(p1["leaves"], p2["leaves"]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_decompress_rejects_unknown_scheme(self):
+        with pytest.raises(ValueError, match="unknown compression"):
+            compress_update(self._tree(), "gzip")
+        with pytest.raises(ValueError, match="unknown compression"):
+            decompress_update({_MARKER: "gzip", "treedef": None,
+                               "leaves": []})
+        with pytest.raises(ValueError, match="unknown compression"):
+            wire_bytes({_MARKER: "gzip", "leaves": []})
 
 
 @pytest.mark.heavy

@@ -134,6 +134,20 @@ def test_sec_fallback_fixture_exact_findings():
     ]
 
 
+def test_hierarchy_seam_fixture_exact_findings():
+    """The hierarchy satellite: partial-reduction entry points
+    (partial_fold / partial_reduce / combine_partials / block_partial)
+    outside core/hierarchy + core/aggregate.py + parallel/agg_plane.py
+    are findings — a second reduction site can pick its own block order
+    or total and break the tree/flat bit-identity contract.  The
+    plan-delegating call and the pragma'd oracle stay clean."""
+    assert _lint_fixture("hier_partial.py") == [
+        (22, "hierarchy-reduce-seam"),
+        (26, "hierarchy-reduce-seam"),
+        (32, "hierarchy-reduce-seam"),
+    ]
+
+
 def test_legacy_shims_catch_alias_dodges():
     """The four legacy CLIs ride the same AST passes now, so the alias
     dodges are caught through the old entry points too."""
@@ -292,7 +306,7 @@ def test_cli_json_schema_is_stable():
         "suppressed",
         "version",
     ]
-    assert report["counts"]["findings"] == len(report["findings"]) == 19
+    assert report["counts"]["findings"] == len(report["findings"]) == 22
     first = report["findings"][0]
     assert sorted(first.keys()) >= ["analyzer", "line", "message", "path", "rule", "source"]
     assert {f["rule"] for f in report["findings"]} >= {
@@ -301,6 +315,7 @@ def test_cli_json_schema_is_stable():
         "purity-donated-reuse",
         "mesh-stale-program",
         "sec-host-fallback",
+        "hierarchy-reduce-seam",
     }
 
 

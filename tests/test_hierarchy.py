@@ -1,0 +1,705 @@
+"""The hierarchical fan-in tier (``fedml_tpu.core.hierarchy``).
+
+Four strata, mirroring the tier's contract:
+
+* **Plan** — the blocked canonical fold: a degenerate single-block plan
+  anchors bitwise to the classic host aggregators, the blocked fold is
+  deterministic, and the compiled agg plane's ``partial_reduce`` leg
+  evaluates the SAME plan bit-identically to the host leg.
+* **Deployment** — live loopback trees: a 2-level and a 3-level tree
+  (mean AND sum, shuffled arrival order, host and compiled legs) close
+  rounds BIT-IDENTICAL to the flat evaluation of the same plan, because
+  topology decides WHERE each block folds, never WHAT is computed.
+* **Chaos** — the acceptance claim, wired into ``tools/chaos_check.py``'s
+  ``hierarchy`` leg: the full drop + duplicate + delay + reset plan over
+  the hierarchy vocabulary still converges bit-identically with
+  exactly-once accounting, and a killed edge's replacement incarnation
+  replays its journal and re-forwards under the SAME forward id — the
+  root's dedup makes the replay invisible (2-level and 3-level).
+* **Observability** — leaf telemetry blobs ride the edge hop
+  (collect -> journal -> graft), so ``trace_report --clients`` still
+  attributes per-leaf time and ``--assert-closed`` stays green.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import types
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import trace_report
+
+from fedml_tpu.core import obs
+from fedml_tpu.core.aggregate import unweighted_sum, weighted_mean
+from fedml_tpu.core.compression import compress_update, wire_bytes
+from fedml_tpu.core.distributed.comm_manager import FedMLCommManager
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+from fedml_tpu.core.hierarchy import (
+    HierarchyPlan,
+    HierarchyRouter,
+    PartialDelta,
+    estimate_scheme_bytes,
+    negotiate_codec,
+)
+from fedml_tpu.core.hierarchy.edge import EdgeAggregator
+from fedml_tpu.core.ingest import ReorderWindow
+from fedml_tpu.core.obs.telemetry import ClientTelemetry, TelemetryMerger
+from fedml_tpu.core.obs.trace import round_root_ctx
+
+
+@pytest.fixture(autouse=True)
+def _obs_hygiene():
+    yield
+    obs.shutdown()
+    obs.registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _bit_identical(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _updates(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(float(rng.integers(1, 50)),
+             {"w": rng.standard_normal((4, 3)).astype(np.float32),
+              "b": rng.standard_normal((3,)).astype(np.float32)})
+            for _ in range(n)]
+
+
+def _mkargs(run_id, optimizer="FedAvg", **kw):
+    return types.SimpleNamespace(run_id=run_id, federated_optimizer=optimizer,
+                                 comm_max_retries=3, **kw)
+
+
+class _Mgr(FedMLCommManager):
+    """A bare manager: the root host and the leaf senders."""
+
+    def register_message_receive_handlers(self) -> None:
+        pass
+
+
+class _Tree:
+    """One deployed loopback tree + its teardown."""
+
+    def __init__(self, args, plan, plane=None, merger=None):
+        self.router = HierarchyRouter(args, plan=plan)
+        self.root_mgr = _Mgr(args, rank=0, size=self.router.size)
+        self.done = threading.Event()
+        self.out = {}
+
+        def on_round(r, tree, w, k):
+            self.out["res"] = (tree, w, k)
+            self.done.set()
+
+        self.root = self.router.attach_root(self.root_mgr, merger=merger,
+                                            on_round=on_round, plane=plane)
+        self.edges = self.router.build_edges(plane=plane)
+        self.leaves = [_Mgr(args, rank=self.router.leaf_rank(i),
+                            size=self.router.size)
+                       for i in range(plan.n_leaves)]
+        self.extra = []
+        for m in [self.root_mgr] + self.edges + self.leaves:
+            m.run_async()
+        time.sleep(0.2)
+
+    def send(self, ups, round_idx=0, order=None, telemetry=None):
+        idxs = list(order) if order is not None else range(len(self.leaves))
+        for i in idxs:
+            m = self.leaves[i]
+            cap = telemetry[i] if telemetry is not None else None
+            m.send_message(self.router.leaf_upload_message(
+                m.rank, i, round_idx, ups[i][0], ups[i][1], telemetry=cap))
+
+    def close(self):
+        for m in [self.root_mgr] + self.edges + self.extra + self.leaves:
+            try:
+                m.finish()
+            except Exception:
+                pass
+
+    def result(self, timeout=60):
+        assert self.done.wait(timeout), "hierarchy round never closed"
+        return self.out["res"]
+
+
+# ---------------------------------------------------------------------------
+# Plan: the blocked canonical fold
+# ---------------------------------------------------------------------------
+
+class TestPlan:
+    def test_knob_validation(self):
+        with pytest.raises(ValueError):
+            HierarchyPlan(n_leaves=4, levels=4)
+        with pytest.raises(ValueError):
+            HierarchyPlan(n_leaves=0, levels=2)
+
+    def test_block_shapes(self):
+        plan = HierarchyPlan(n_leaves=10, levels=2, edge_fanout=4)
+        assert plan.blocks == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+        assert plan.n_edges == 3 and plan.n_mids == 0
+        plan3 = HierarchyPlan(n_leaves=12, levels=3, edge_fanout=3)
+        assert plan3.n_edges == 4 and plan3.mid_groups == [[0, 1, 2], [3]]
+        assert plan3.edge_of(7) == 2 and plan3.mid_of(3) == 1
+
+    def test_flush_timeout_parsing(self):
+        assert HierarchyPlan(n_leaves=2, levels=2,
+                             edge_flush="all").flush_timeout() is None
+        assert HierarchyPlan(n_leaves=2, levels=2,
+                             edge_flush=0.5).flush_timeout() == 0.5
+
+    def test_degenerate_plan_anchors_to_classic_aggregators(self):
+        """A single-block plan IS the classic fold — bit for bit.  This is
+        the anchor that makes 'tree == flat' mean 'tree == what the flat
+        server always computed'."""
+        ups = _updates(10, seed=0)
+        plan = HierarchyPlan(n_leaves=10, levels=1)
+        assert _bit_identical(plan.aggregate(ups, mode="mean"),
+                              weighted_mean(ups))
+        assert _bit_identical(plan.aggregate(ups, mode="sum"),
+                              unweighted_sum(ups))
+
+    def test_blocked_fold_is_deterministic(self):
+        ups = _updates(10, seed=1)
+        for levels, fanout in ((2, 3), (3, 3)):
+            plan = HierarchyPlan(n_leaves=10, levels=levels,
+                                 edge_fanout=fanout)
+            for mode in ("mean", "sum"):
+                assert _bit_identical(plan.aggregate(ups, mode=mode),
+                                      plan.aggregate(ups, mode=mode))
+
+    def test_host_vs_compiled_partial_parity(self):
+        """The compiled leg evaluates the SAME plan bit-identically: block
+        folds via ``partial_reduce``, combines via the plane's sum fold."""
+        from fedml_tpu.parallel.agg_plane import CompiledAggPlane
+
+        ups = _updates(8, seed=2)
+        plane = CompiledAggPlane()
+        for levels, fanout in ((2, 3), (3, 2)):
+            plan = HierarchyPlan(n_leaves=8, levels=levels,
+                                 edge_fanout=fanout)
+            for mode in ("mean", "sum"):
+                host = plan.aggregate(ups, mode=mode)
+                compiled = plan.aggregate(ups, mode=mode, plane=plane)
+                assert _bit_identical(host, compiled), \
+                    f"compiled leg diverged (levels={levels}, mode={mode})"
+
+
+# ---------------------------------------------------------------------------
+# ReorderWindow: the streaming fold's ordering seam
+# ---------------------------------------------------------------------------
+
+class TestReorderWindow:
+    def test_in_order_releases_immediately(self):
+        win = ReorderWindow([5, 7, 9])
+        assert win.expected == 5
+        assert win.stage(5, "a") == [(5, "a")]
+        assert win.stage(7, "b") == [(7, "b")]
+        assert not win.done()
+        assert win.stage(9, "c") == [(9, "c")]
+        assert win.done() and win.pending() == 0
+
+    def test_out_of_order_holds_then_flushes_contiguous_run(self):
+        win = ReorderWindow([0, 1, 2, 3])
+        assert win.stage(2, "c") == []
+        assert win.stage(1, "b") == []
+        assert win.pending() == 2
+        # 0 lands: the whole contiguous run releases in plan order
+        assert win.stage(0, "a") == [(0, "a"), (1, "b"), (2, "c")]
+        assert win.stage(3, "d") == [(3, "d")]
+
+    def test_double_stage_and_unknown_key_raise(self):
+        win = ReorderWindow([0, 1])
+        win.stage(0, "a")
+        with pytest.raises(ValueError):
+            win.stage(0, "again")
+        with pytest.raises(KeyError):
+            win.stage(42, "who")
+
+
+# ---------------------------------------------------------------------------
+# Router: rank layout + codec negotiation
+# ---------------------------------------------------------------------------
+
+class TestRouter:
+    def test_rank_layout_two_level(self):
+        args = _mkargs("hier-layout2")
+        plan = HierarchyPlan(n_leaves=10, levels=2, edge_fanout=4)
+        router = HierarchyRouter(args, plan=plan)
+        assert router.size == 1 + 3 + 10
+        assert [router.edge_rank(e) for e in range(3)] == [1, 2, 3]
+        assert router.leaf_rank(0) == 4
+        assert router.leaf_target_rank(5) == router.edge_rank(1)
+        assert router.root_child_ranks() == {0: 1, 1: 2, 2: 3}
+
+    def test_rank_layout_three_level(self):
+        args = _mkargs("hier-layout3")
+        plan = HierarchyPlan(n_leaves=12, levels=3, edge_fanout=3)
+        router = HierarchyRouter(args, plan=plan)
+        # root, 4 edges, 2 mids, 12 leaves
+        assert router.size == 19
+        assert router.mid_rank(0) == 5 and router.mid_rank(1) == 6
+        # mid ids live in the shared edge-id namespace
+        assert router.mid_id(0) == 4 and router.mid_id(1) == 5
+        assert router.root_child_ranks() == {4: 5, 5: 6}
+
+    def test_router_rejects_flat_plan(self):
+        with pytest.raises(ValueError):
+            HierarchyRouter(_mkargs("hier-flat"),
+                            plan=HierarchyPlan(n_leaves=4, levels=1))
+
+    def test_negotiate_picks_cheapest_estimated(self):
+        offers = {"schemes": ["none", "topk"],
+                  "bytes": {"none": 1000, "topk": 120}}
+        assert negotiate_codec(offers, ["none", "topk"]) == "topk"
+        # the parent's accept list is a hard filter
+        assert negotiate_codec(offers, ["none"]) == "none"
+        assert negotiate_codec(offers, []) == "none"
+
+    def test_negotiate_estimate_less_schemes_lose(self):
+        offers = {"schemes": ["qsgd", "topk"], "bytes": {"topk": 500}}
+        assert negotiate_codec(offers, ["qsgd", "topk"]) == "topk"
+
+    def test_negotiate_ties_resolve_by_parent_order(self):
+        offers = {"schemes": ["quantize", "qsgd"], "bytes": {}}
+        assert negotiate_codec(offers, ["qsgd", "quantize"]) == "qsgd"
+
+    def test_negotiate_malformed_degrades_to_none(self):
+        assert negotiate_codec(None, ["topk"]) == "none"
+        assert negotiate_codec("junk", ["topk"]) == "none"
+        assert negotiate_codec({"schemes": ["evil"]}, ["topk"]) == "none"
+
+    def test_estimates_are_honest(self):
+        """The dense estimate IS the wire size; the top-k estimate agrees
+        with ``wire_bytes`` of a real encoded payload."""
+        rng = np.random.default_rng(3)
+        tree = {"w": rng.standard_normal((64, 32)).astype(np.float32),
+                "b": rng.standard_normal((32,)).astype(np.float32)}
+        dense = estimate_scheme_bytes(tree, "none")
+        assert dense == wire_bytes(tree)
+        est = estimate_scheme_bytes(tree, "topk", ratio=0.1)
+        assert 0 < est < dense
+        payload, _ = compress_update(tree, method="topk", ratio=0.1)
+        assert est == wire_bytes(payload)
+
+
+class TestArgumentKnobs:
+    def _args(self, **extra):
+        import test_fault_tolerance as _ft
+
+        return _ft._args("hier-knobs", 2, **extra)
+
+    def test_valid_knobs_pass(self):
+        args = self._args(fan_in_tree=3, edge_fanout=8, edge_flush="all")
+        assert args.fan_in_tree == 3
+
+    def test_bad_fan_in_tree_rejected(self):
+        with pytest.raises(ValueError, match="fan_in_tree"):
+            self._args(fan_in_tree=5)
+
+    def test_bad_edge_fanout_rejected(self):
+        with pytest.raises(ValueError, match="edge_fanout"):
+            self._args(edge_fanout=-1)
+
+    def test_bad_edge_flush_rejected(self):
+        with pytest.raises(ValueError, match="edge_flush"):
+            self._args(edge_flush="sometimes")
+        with pytest.raises(ValueError, match="edge_flush"):
+            self._args(edge_flush=0)
+
+
+# ---------------------------------------------------------------------------
+# Deployment: live trees vs the flat evaluation of the same plan
+# ---------------------------------------------------------------------------
+
+_MODES = (("mean", "FedAvg"), ("sum", "FedAvg_seq"))
+
+
+class TestTreeVsFlat:
+    @pytest.mark.parametrize("levels", (2, 3))
+    @pytest.mark.parametrize("mode,opt", _MODES)
+    def test_tree_round_bit_identical_to_flat(self, levels, mode, opt):
+        n = 12
+        ups = _updates(n, seed=10 + levels)
+        plan = HierarchyPlan(n_leaves=n, levels=levels, edge_fanout=3)
+        flat = plan.aggregate(ups, mode=mode)
+        args = _mkargs(f"hier-tvf-{levels}-{mode}", optimizer=opt)
+        tree = _Tree(args, plan)
+        try:
+            rng = np.random.default_rng(levels)
+            order = list(range(n))
+            rng.shuffle(order)  # the reorder window restores plan order
+            tree.send(ups, order=order)
+            got, weight, k = tree.result()
+            assert _bit_identical(got, flat), \
+                f"{levels}-level {mode} tree diverged from the flat fold"
+            assert weight == sum(u[0] for u in ups)
+            assert k == n
+            assert tree.root.dup_forwards == 0
+            assert tree.root.rounds_closed == 1
+        finally:
+            tree.close()
+
+    def test_compiled_leg_tree_matches_flat_and_host(self):
+        """The acceptance matrix's compiled column: edges and root fold
+        through the agg plane, and the closed round still matches BOTH the
+        compiled flat evaluation and the host one."""
+        from fedml_tpu.parallel.agg_plane import CompiledAggPlane
+
+        n = 10
+        ups = _updates(n, seed=20)
+        plan = HierarchyPlan(n_leaves=n, levels=2, edge_fanout=4)
+        plane = CompiledAggPlane()
+        args = _mkargs("hier-compiled")
+        tree = _Tree(args, plan, plane=plane)
+        try:
+            tree.send(ups)
+            got, _, _ = tree.result()
+            assert _bit_identical(got, plan.aggregate(ups, "mean", plane))
+            assert _bit_identical(got, plan.aggregate(ups, "mean"))
+        finally:
+            tree.close()
+
+    def test_streaming_sum_fold_drops_payloads(self):
+        """The O(model) claim: in sum mode the edge stream-folds each
+        release and stages only ``(weight, None, epoch)`` — no per-leaf
+        payload survives in memory, the journal keeps the durable copy."""
+        n = 8
+        ups = _updates(n, seed=21)
+        plan = HierarchyPlan(n_leaves=n, levels=2, edge_fanout=4)
+        args = _mkargs("hier-stream", optimizer="FedAvg_seq")
+        tree = _Tree(args, plan)
+        try:
+            order = [3, 0, 2, 1, 7, 5, 4, 6]  # out-of-order arrival
+            tree.send(ups, order=order)
+            got, _, _ = tree.result()
+            assert _bit_identical(got, plan.aggregate(ups, mode="sum"))
+            for edge in tree.edges:
+                staged = edge._staged.get(0, {})
+                assert staged and all(t is None for _, t, _ in
+                                      staged.values())
+        finally:
+            tree.close()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: faults on the hierarchy vocabulary + edge kill replay
+# ---------------------------------------------------------------------------
+
+def _hier_chaos_plan():
+    """Every fault kind aimed at the tier's own vocabulary.  Rules are
+    per-endpoint occurrence counters, so EVERY leaf loses its first
+    upload send, EVERY edge's counts send is RST and its forward
+    duplicated — much denser than one fault per round."""
+    return {"seed": 11, "rules": [
+        {"kind": "drop", "direction": "send", "msg_type": "hier_upload",
+         "times": 1},
+        {"kind": "reset", "direction": "send", "msg_type": "hier_counts",
+         "times": 1},
+        {"kind": "duplicate", "direction": "send",
+         "msg_type": "hier_partial", "times": 1},
+        {"kind": "delay", "direction": "send", "msg_type": "hier_total",
+         "times": 1, "delay_s": 0.05},
+    ]}
+
+
+class TestHierarchyChaos:
+    @pytest.mark.parametrize("levels", (2, 3))
+    @pytest.mark.parametrize("mode,opt", _MODES)
+    def test_full_chaos_plan_converges_bit_identical(self, levels, mode,
+                                                     opt):
+        n = 12
+        ups = _updates(n, seed=30 + levels)
+        plan = HierarchyPlan(n_leaves=n, levels=levels, edge_fanout=3)
+        flat = plan.aggregate(ups, mode=mode)
+        args = _mkargs(f"hier-chaos-{levels}-{mode}", optimizer=opt,
+                       fault_plan=_hier_chaos_plan())
+        tree = _Tree(args, plan)
+        try:
+            tree.send(ups)
+            got, weight, k = tree.result(timeout=90)
+            assert _bit_identical(got, flat), \
+                "chaos run diverged from the flat fold"
+            assert weight == sum(u[0] for u in ups) and k == n
+            # exactly-once: faults cost retries, never double counting
+            assert tree.root.dup_forwards == 0
+            assert tree.root.rounds_closed == 1
+        finally:
+            tree.close()
+
+    def test_edge_kill_mid_round_replays_exactly_once(self, tmp_path):
+        """Kill an edge after it journaled its block but before the global
+        total exists; the replacement incarnation replays the journal,
+        re-sends counts, and the round closes bit-identical.  A THIRD
+        incarnation after the close re-forwards under the same forward id
+        — the root counts the dup and the result never changes."""
+        n = 8
+        ups = _updates(n, seed=40)
+        plan = HierarchyPlan(n_leaves=n, levels=2, edge_fanout=4)
+        flat = plan.aggregate(ups, mode="mean")
+        run = "hier-kill2"
+        args = _mkargs(run, edge_checkpoint_dir=str(tmp_path))
+        tree = _Tree(args, plan)
+        try:
+            # phase 1: only edge 0's block lands, then the edge dies
+            tree.send(ups, order=plan.blocks[0])
+            deadline = time.time() + 30
+            while (len(tree.edges[0]._seen.get(0, ())) < len(plan.blocks[0])
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            assert len(tree.edges[0]._seen.get(0, ())) == len(plan.blocks[0])
+            LoopbackHub.sever(run, tree.edges[0].rank)
+            tree.edges[0].com_manager.stop_receive_message()
+
+            # phase 2: the replacement replays the journal; edge 1's block
+            # arrives; the round closes bit-identical with no dup at root
+            edge0b = EdgeAggregator(args, plan, edge_id=0, parent_rank=0,
+                                    children=plan.blocks[0],
+                                    rank=tree.router.edge_rank(0),
+                                    size=tree.router.size)
+            tree.extra.append(edge0b)
+            edge0b.run_async()
+            tree.send(ups, order=plan.blocks[1])
+            got, weight, k = tree.result()
+            assert _bit_identical(got, flat)
+            assert weight == sum(u[0] for u in ups) and k == n
+            assert tree.root.dup_forwards == 0
+
+            # phase 3: a post-close incarnation re-forwards the SAME id
+            edge0c = EdgeAggregator(args, plan, edge_id=0, parent_rank=0,
+                                    children=plan.blocks[0],
+                                    rank=tree.router.edge_rank(0),
+                                    size=tree.router.size)
+            tree.extra.append(edge0c)
+            edge0c.run_async()
+            deadline = time.time() + 30
+            while tree.root.dup_forwards < 1 and time.time() < deadline:
+                time.sleep(0.05)
+            assert tree.root.dup_forwards >= 1
+            assert tree.root.rounds_closed == 1
+            assert _bit_identical(tree.root.result(0)[0], flat), \
+                "a replayed forward changed the closed round"
+        finally:
+            tree.close()
+
+    def test_three_level_edge_kill_replays_through_mid(self, tmp_path):
+        """Same replay contract one level down: the killed LEAF edge's
+        replacement re-sends counts to its MID, which relays the total
+        down idempotently, and the root still closes exactly-once."""
+        n = 12
+        ups = _updates(n, seed=41)
+        plan = HierarchyPlan(n_leaves=n, levels=3, edge_fanout=3)
+        flat = plan.aggregate(ups, mode="mean")
+        run = "hier-kill3"
+        args = _mkargs(run, edge_checkpoint_dir=str(tmp_path))
+        tree = _Tree(args, plan)
+        try:
+            tree.send(ups, order=plan.blocks[0])
+            deadline = time.time() + 30
+            while (len(tree.edges[0]._seen.get(0, ())) < len(plan.blocks[0])
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            LoopbackHub.sever(run, tree.edges[0].rank)
+            tree.edges[0].com_manager.stop_receive_message()
+
+            mid0 = tree.router.mid_rank(plan.mid_of(0))
+            edge0b = EdgeAggregator(args, plan, edge_id=0, parent_rank=mid0,
+                                    children=plan.blocks[0],
+                                    rank=tree.router.edge_rank(0),
+                                    size=tree.router.size)
+            tree.extra.append(edge0b)
+            edge0b.run_async()
+            rest = [i for i in range(n) if i not in plan.blocks[0]]
+            tree.send(ups, order=rest)
+            got, weight, k = tree.result()
+            assert _bit_identical(got, flat)
+            assert weight == sum(u[0] for u in ups) and k == n
+            assert tree.root.dup_forwards == 0
+            assert tree.root.rounds_closed == 1
+        finally:
+            tree.close()
+
+
+# ---------------------------------------------------------------------------
+# Knob-driven behavior: timeout flush, live codec negotiation
+# ---------------------------------------------------------------------------
+
+class TestKnobs:
+    def test_timeout_flush_closes_without_the_missing_leaf(self):
+        """``edge_flush`` trades the full-cohort bit-identity contract for
+        liveness: a silent leaf must not wedge the round."""
+        n = 4
+        ups = _updates(n, seed=50)
+        plan = HierarchyPlan(n_leaves=n, levels=2, edge_fanout=2,
+                             edge_flush=0.3)
+        args = _mkargs("hier-flush")
+        tree = _Tree(args, plan)
+        try:
+            tree.send(ups, order=[0, 1, 2])  # leaf 3 never reports
+            got, weight, k = tree.result()
+            assert k == 3
+            assert weight == sum(ups[i][0] for i in (0, 1, 2))
+            # the arithmetic contract: same blocked fold over the cohort
+            # that made the counts, with the root's global total
+            total = weight
+            expected = plan.combine([
+                plan.block_partial([ups[0], ups[1]], total, "mean"),
+                plan.block_partial([ups[2]], total, "mean"),
+            ], "mean")
+            assert _bit_identical(got, expected)
+            # the straggler past the flush is counted and dropped
+            tree.send(ups, order=[3])
+            time.sleep(0.4)
+            assert 3 not in tree.edges[1]._seen.get(0, set())
+            assert _bit_identical(tree.root.result(0)[0], expected)
+        finally:
+            tree.close()
+
+    def test_live_codec_negotiation_compresses_the_forward(self):
+        """Edges offer top-k, the root accepts it: every link negotiates
+        ``topk`` and the fused forwards ship compressed (lossy — the
+        bit-identity contract is explicitly traded away here).  Trees are
+        big enough that the honest estimate makes top-k actually win."""
+        n = 6
+        rng = np.random.default_rng(51)
+        ups = [(float(rng.integers(1, 50)),
+                {"w": rng.standard_normal((64, 32)).astype(np.float32)})
+               for _ in range(n)]
+        plan = HierarchyPlan(n_leaves=n, levels=2, edge_fanout=3)
+        args = _mkargs("hier-codec", optimizer="FedAvg_seq",
+                       edge_codec_offers="topk,none",
+                       edge_codec_accept="topk,none",
+                       edge_codec_ratio=0.1)
+        tree = _Tree(args, plan)
+        try:
+            tree.send(ups)
+            got, weight, k = tree.result()
+            assert k == n and weight == sum(u[0] for u in ups)
+            assert tree.root._codecs[0] == {0: "topk", 1: "topk"}
+            # lossy, but structurally intact and in the right ballpark
+            ref = plan.aggregate(ups, mode="sum")
+            got_l = jax.tree_util.tree_leaves(got)
+            ref_l = jax.tree_util.tree_leaves(ref)
+            assert [np.asarray(x).shape for x in got_l] == \
+                   [np.asarray(x).shape for x in ref_l]
+        finally:
+            tree.close()
+
+
+# ---------------------------------------------------------------------------
+# Observability: telemetry rides the edge hop
+# ---------------------------------------------------------------------------
+
+class TestTelemetryThroughTheTree:
+    def test_leaf_spans_graft_and_trace_report_attributes(self, tmp_path):
+        """Leaf telemetry blobs collected at the edge and grafted onto the
+        fused forward reach the root merger intact: ``trace_report
+        --clients`` attributes every leaf's train time through the edge
+        hop and ``--assert-closed`` stays green."""
+        n = 6
+        run = "hier-tel"
+        ups = _updates(n, seed=60)
+        plan = HierarchyPlan(n_leaves=n, levels=2, edge_fanout=3)
+        collected = []
+        merger = TelemetryMerger(
+            emit=lambda t, r: collected.append(dict(r, topic=t)))
+        args = _mkargs(run)
+        tree = _Tree(args, plan, merger=merger)
+        try:
+            caps = []
+            for i in range(n):
+                cap = ClientTelemetry(i, run)
+                cap.record_span("client.train", 0.1 * (i + 1), round_idx=0,
+                                seq=0, client=i)
+                caps.append(cap)
+            tree.send(ups, telemetry=caps)
+            tree.result()
+            # every leaf's span made it through the hop, attributed
+            trains = [r for r in collected if r.get("topic") == "span_start"
+                      and r.get("name") == "client.train"]
+            assert {r["client"] for r in trains} == set(range(n))
+            assert all(r["remote"] is True for r in trains)
+            for i in range(n):
+                assert merger.train_seconds(i) == pytest.approx(
+                    0.1 * (i + 1))
+            # the merged tree closes: local round root + grafted leaf spans
+            root_ctx = round_root_ctx(run, 0)
+            local = [
+                {"topic": "span_start", "trace_id": root_ctx.trace_id,
+                 "span_id": root_ctx.span_id, "name": "round", "node": 0,
+                 "round_idx": 0, "ts": 10.0},
+                {"topic": "span_end", "trace_id": root_ctx.trace_id,
+                 "span_id": root_ctx.span_id, "name": "round",
+                 "duration_s": 2.0, "ts": 12.0},
+            ]
+            recs = local + collected
+            tr = trace_report.build_traces(recs)[root_ctx.trace_id]
+            assert tr.problems() == []
+            rows = {row["client"]: row for row in tr.clients()}
+            assert set(rows) == set(range(n))
+            assert rows[n - 1]["compute_s"] == pytest.approx(0.1 * n)
+            # and the CLI contract the runbook points operators at
+            p = tmp_path / "hier.jsonl"
+            p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+            assert trace_report.main(
+                [str(p), "--clients", "--assert-closed"]) == 0
+        finally:
+            tree.close()
+
+    def test_replayed_edge_recarries_journaled_telemetry(self, tmp_path):
+        """A killed edge's replacement re-grafts the journaled blobs, and
+        the merger's per-node seq dedup keeps the accounting exact."""
+        n = 4
+        run = "hier-tel-replay"
+        ups = _updates(n, seed=61)
+        plan = HierarchyPlan(n_leaves=n, levels=2, edge_fanout=2)
+        collected = []
+        merger = TelemetryMerger(
+            emit=lambda t, r: collected.append(dict(r, topic=t)))
+        args = _mkargs(run, edge_checkpoint_dir=str(tmp_path))
+        tree = _Tree(args, plan, merger=merger)
+        try:
+            caps = []
+            for i in range(n):
+                cap = ClientTelemetry(i, run)
+                cap.record_span("client.train", 0.2, round_idx=0, seq=0,
+                                client=i)
+                caps.append(cap)
+            tree.send(ups, order=plan.blocks[0], telemetry=caps)
+            deadline = time.time() + 30
+            while (len(tree.edges[0]._seen.get(0, ())) < 2
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            LoopbackHub.sever(run, tree.edges[0].rank)
+            tree.edges[0].com_manager.stop_receive_message()
+            edge0b = EdgeAggregator(args, plan, edge_id=0, parent_rank=0,
+                                    children=plan.blocks[0],
+                                    rank=tree.router.edge_rank(0),
+                                    size=tree.router.size)
+            tree.extra.append(edge0b)
+            edge0b.run_async()
+            tree.send(ups, order=plan.blocks[1], telemetry=caps)
+            tree.result()
+            trains = [r for r in collected if r.get("topic") == "span_start"
+                      and r.get("name") == "client.train"]
+            # every leaf attributed exactly once, replay notwithstanding
+            assert sorted(r["client"] for r in trains) == list(range(n))
+        finally:
+            tree.close()

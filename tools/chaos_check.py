@@ -31,6 +31,10 @@ exactly-once accounting) AND the defense leg
 with a client dropped mid-upload plus a server kill mid-round must
 unmask BIT-IDENTICALLY to the uninterrupted round, with exactly-once
 duplicate accounting, and abort below the reconstruction threshold)
+AND the hierarchy leg (``tests/test_hierarchy.py -k hierarchy`` — 2- and
+3-level edge-aggregator trees under the full drop/dup/delay/reset chaos
+plan, plus an edge kill mid-round, must close the round BIT-IDENTICALLY
+to the flat topology with exactly-once forward accounting at the root)
 N consecutive times in
 fresh interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
@@ -66,6 +70,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "sharded_state"
     python tools/chaos_check.py --runs 3 -k "elastic or mesh_shrink"
     python tools/chaos_check.py --runs 3 -k "secagg_dropout"
+    python tools/chaos_check.py --runs 3 -k "hierarchy"
     python tools/chaos_check.py --runs 3 --skip-perf-gate
     python tools/chaos_check.py --runs 3 --skip-fedlint
 """
@@ -132,11 +137,11 @@ def main(argv=None) -> int:
         "-k", dest="keyword",
         default="chaos or server_kill or trace_integrity or agg_plane "
                 "or async_fl or ingest or telemetry or sharded_state "
-                "or elastic or mesh_shrink or secagg_dropout",
+                "or elastic or mesh_shrink or secagg_dropout or hierarchy",
         help='pytest -k selector (default: "chaos or server_kill or '
              'trace_integrity or agg_plane or async_fl or ingest or '
              'telemetry or sharded_state or elastic or mesh_shrink or '
-             'secagg_dropout")')
+             'secagg_dropout or hierarchy")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     ap.add_argument("--skip-perf-gate", action="store_true",
@@ -166,6 +171,7 @@ def main(argv=None) -> int:
            "tests/test_obs.py", "tests/test_agg_plane.py",
            "tests/test_async_fl.py", "tests/test_ingest.py",
            "tests/test_telemetry.py", "tests/test_security_plane.py",
+           "tests/test_hierarchy.py",
            "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
     for i in range(1, args.runs + 1):
         t0 = time.time()

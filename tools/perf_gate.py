@@ -17,7 +17,8 @@ check.  It parses every ``BENCH_rNN.json`` driver record (``{"n", "cmd",
   ``metric``/numeric ``value``.
 * **regressions** — for each relative key (``vs_baseline``,
   ``agg_speedup``, ``uploads_per_s``, ``async_flushes_per_s``,
-  ``async_deltas_per_s``, ``telemetry_rounds_per_s``) the LATEST value
+  ``async_deltas_per_s``, ``telemetry_rounds_per_s``,
+  ``fanin_uploads_per_s_flat`` / ``fanin_uploads_per_s_edge``) the LATEST value
   must stay within ``--tolerance`` of the median of the prior rounds
   that report the key (keys absent in older-schema rounds are simply
   not banded yet).  ``obs_overhead_frac`` and ``telemetry_overhead_frac``
@@ -60,7 +61,8 @@ RELATIVE_KEYS = ("vs_baseline", "agg_speedup", "round_update_speedup",
                  "broadcast_shrink", "uploads_per_s",
                  "uploads_per_s_host", "uploads_per_s_pipelined",
                  "async_flushes_per_s", "async_deltas_per_s",
-                 "telemetry_rounds_per_s", "defended_round_speedup")
+                 "telemetry_rounds_per_s", "defended_round_speedup",
+                 "fanin_uploads_per_s_flat", "fanin_uploads_per_s_edge")
 # lower-is-better: absolute cap (observability must stay cheap — spans,
 # registry, exposition, and now the telemetry plane all share the budget)
 OVERHEAD_KEYS = ("obs_overhead_frac", "telemetry_overhead_frac",
