@@ -39,9 +39,41 @@ Transport-reliability knobs (``train_args`` or ``comm_args``; consumed by
   ``min(base * 2^attempt, max) * (1 + jitter * U[0,1))``.
 * ``comm_dedup_window`` (default 8192) — LRU size of the receive-side
   message-id dedup window.
+* ``comm_backoff_seed`` (int, default = ``random_seed``, unset = legacy
+  per-incarnation nonce) — seeds the retransmit jitter stream per
+  ``(seed, rank)`` so schedules are deterministic ACROSS incarnations
+  (a restarted cohort must not re-draw identical fresh-nonce schedules
+  and synchronize its retry storm) yet distinct per rank.
 * ``fault_plan`` (default None; ``fault_args`` section) — a deterministic
   chaos plan injected at the transport seam; schema in
   ``core/distributed/faults.py``.
+
+Chunked resumable-upload knobs (``train_args`` or ``comm_args``; consumed
+by ``core/distributed/chunking.py``, wire format + resume protocol in
+``docs/INGEST.md``):
+
+* ``upload_chunk_bytes`` (int >= 0, default 0 = whole-message sends) —
+  split payload-bearing messages larger than this into crc32-framed
+  chunks, each acked/deduped/retransmitted individually by the
+  reliability layer, so a reconnecting sender resumes from its last
+  acked chunk instead of restarting the upload.  Requires
+  ``comm_max_retries > 0`` for the resume semantics to engage.
+* ``chunk_window`` (int >= 1, default 8) — max unacked chunks in flight
+  per stream; bounds both sender memory and the bytes a mid-stream link
+  cut can waste.
+* ``chunk_resume`` (bool, default True) — journal each accepted chunk
+  before its transport ack (journal-before-ack one level down) so a
+  server/edge kill mid-upload replays partial streams from disk; off
+  keeps reassembly memory-only (a receiver crash re-collects from
+  retransmits).
+* ``chunk_buffer_bytes`` (int >= 1, default 64 MiB) — receiver-side
+  reassembly budget; over it the OLDEST incomplete stream is shed (its
+  sender told to restart via ``comm_chunk_reset``, the over-budget
+  chunk's ack withheld).
+* ``chunk_receive`` (bool, default True) — advertise chunk-receive
+  capability on outbound messages.  Chunking negotiates DOWN per link:
+  senders only chunk toward peers seen advertising, so legacy peers keep
+  whole-message uploads in both directions.
 
 Backend-specific resilience knobs: ``trpc_connect_retries`` /
 ``trpc_retry_interval_s`` (TCP), ``grpc_send_retries`` /
@@ -477,6 +509,29 @@ class Arguments:
                 raise ValueError(
                     f"journal_group_commit_ms must be >= 0 (got {gv})")
         for knob in ("journal_group_commit_max", "ingest_queue_depth"):
+            v = getattr(self, knob, None)
+            if v is None:
+                continue
+            try:
+                iv = int(v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{knob} must be an integer >= 1 (got {v!r})")
+            if iv < 1:
+                raise ValueError(f"{knob} must be >= 1 (got {iv})")
+        # chunked resumable-upload knobs (core/distributed/chunking)
+        chunk_bytes = getattr(self, "upload_chunk_bytes", None)
+        if chunk_bytes is not None:
+            try:
+                cb = int(chunk_bytes)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "upload_chunk_bytes must be an integer >= 0 "
+                    f"(got {chunk_bytes!r})")
+            if cb < 0:
+                raise ValueError(
+                    f"upload_chunk_bytes must be >= 0 (got {cb})")
+        for knob in ("chunk_window", "chunk_buffer_bytes"):
             v = getattr(self, knob, None)
             if v is None:
                 continue

@@ -7,6 +7,7 @@ from typing import List
 
 from ..framework import Analyzer
 from .ack_order import AckDurabilityAnalyzer
+from .chunking import ChunkReassemblySeamAnalyzer
 from .hierarchy import HierarchyReduceSeamAnalyzer
 from .legacy import AggAnalyzer, ObsAnalyzer, PerfAnalyzer, RngAnalyzer
 from .meshguard import MeshStaleProgramAnalyzer
@@ -15,7 +16,8 @@ from .races import ThreadOwnershipAnalyzer
 from .security import SecHostFallbackAnalyzer
 
 __all__ = [
-    "AckDurabilityAnalyzer", "AggAnalyzer", "HierarchyReduceSeamAnalyzer",
+    "AckDurabilityAnalyzer", "AggAnalyzer", "ChunkReassemblySeamAnalyzer",
+    "HierarchyReduceSeamAnalyzer",
     "MeshStaleProgramAnalyzer", "ObsAnalyzer", "PerfAnalyzer",
     "PurityAnalyzer", "RngAnalyzer", "SecHostFallbackAnalyzer",
     "ThreadOwnershipAnalyzer", "build_analyzers",
@@ -35,4 +37,5 @@ def build_analyzers() -> List[Analyzer]:
         MeshStaleProgramAnalyzer(),
         SecHostFallbackAnalyzer(),
         HierarchyReduceSeamAnalyzer(),
+        ChunkReassemblySeamAnalyzer(),
     ]

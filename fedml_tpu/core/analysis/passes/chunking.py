@@ -1,0 +1,118 @@
+"""Chunk-header / reassembly seam guard for resumable chunked uploads.
+
+Chunked uploads stay resumable and exactly-once because ONE seam owns the
+wire vocabulary: ``core/distributed/chunking.py`` builds and parses every
+``comm_chunk`` header and mutates every reassembly buffer, and
+``core/ingest.py`` re-exports the reassembler as the pipeline-facing
+stage.  A second site that reads ``chunk_idx`` out of a message, or that
+constructs chunk frames itself, forks the resume protocol: its idea of
+stream identity, crc framing, or journal record shape drifts from the
+reassembler's and the replay/dedup accounting silently stops being
+exactly-once.
+
+* ``chunk-reassembly-seam`` — a chunk wire-vocabulary literal used as a
+  call argument / subscript key / comparison operand, or a chunk framing
+  entry point (``ChunkReassembler`` / ``build_chunks`` /
+  ``split_payload``) invoked, outside ``core/distributed/chunking.py``
+  and ``core/ingest.py``.  Pragmas require a justification
+  (``# fedlint: allow[chunk-reassembly-seam] — ...``).
+  (:func:`~fedml_tpu.core.distributed.chunking.truncate_for_fault` is
+  deliberately NOT guarded — it exists so the fault seam can tear frames
+  WITHOUT parsing headers itself.)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from ..framework import Analyzer, Finding, Rule, SourceFile
+
+# the seam: the only modules that may parse chunk headers or touch
+# reassembly buffers
+_SEAM_FILES = ("core/distributed/chunking.py", "core/ingest.py")
+
+# the chunk wire vocabulary (param keys + message types); literals only —
+# every legitimate caller imports the constants from the seam instead
+_CHUNK_KEYS = frozenset({
+    "chunk_stream", "chunk_idx", "chunk_n", "chunk_data", "chunk_crc",
+    "chunk_total", "chunk_inner_type", "comm_chunk", "comm_chunk_reset",
+})
+
+# framing/reassembly entry points the seam owns
+_SEAM_CALLS = frozenset({"ChunkReassembler", "build_chunks", "split_payload"})
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _chunk_literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _CHUNK_KEYS:
+        return node.value
+    return None
+
+
+class ChunkReassemblySeamAnalyzer(Analyzer):
+    """Flags chunk-header parsing / framing outside the chunking seam."""
+
+    name = "chunking"
+    rules = (
+        Rule("chunk-reassembly-seam",
+             "chunk header parsed or reassembly invoked outside the "
+             "chunking seam",
+             requires_justification=True, order=0),
+    )
+
+    def _exempt(self, path: str) -> bool:
+        # fixtures opt IN by basename, overriding the path exemption
+        if os.path.basename(path).startswith("chunk_"):
+            return False
+        norm = os.path.normpath(os.path.abspath(path)).replace(os.sep, "/")
+        return any(norm.endswith(f"/{f}") for f in _SEAM_FILES)
+
+    def _flag(self, findings: List[Finding], src: SourceFile, lineno: int,
+              what: str) -> None:
+        findings.append(self.finding(
+            self.rules[0], src, lineno,
+            f"{what} outside the chunking seam "
+            "(core/distributed/chunking.py, core/ingest.py) — a second "
+            "chunk-parsing site forks the resume protocol and breaks the "
+            "replay/dedup exactly-once accounting; import the seam's API "
+            "or justify"))
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if src.tree is None or self._exempt(src.path):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                name = _terminal_name(node.func)
+                if name in _SEAM_CALLS:
+                    self._flag(findings, src, node.lineno,
+                               f"'{name}' called")
+                    continue
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    lit = _chunk_literal(arg)
+                    if lit is not None:
+                        self._flag(findings, src, node.lineno,
+                                   f"chunk wire key '{lit}' passed")
+            elif isinstance(node, ast.Subscript):
+                lit = _chunk_literal(node.slice)
+                if lit is not None:
+                    self._flag(findings, src, node.lineno,
+                               f"chunk wire key '{lit}' subscripted")
+            elif isinstance(node, ast.Compare):
+                for operand in [node.left] + list(node.comparators):
+                    lit = _chunk_literal(operand)
+                    if lit is not None:
+                        self._flag(findings, src, node.lineno,
+                                   f"chunk wire key '{lit}' compared")
+        findings.sort(key=Finding.sort_key)
+        return findings

@@ -667,6 +667,13 @@ class ServerRecoveryMixin:
         self.server_epoch = 0
         self._uploads_this_round: set = set()
         self._recovered_pending_close = False
+        if self._store is not None:
+            # chunked uploads journal each accepted chunk before its ack
+            # (sub-message granularity of the same contract _journal_upload
+            # implements at message granularity)
+            chunking = getattr(self, "_chunking", None)
+            if chunking is not None:
+                chunking.bind_journal(self._journal_chunk)
         if self._store is None:
             return
         loaded = self._store.load_latest()
@@ -692,7 +699,15 @@ class ServerRecoveryMixin:
                              self.client_id_list_in_this_round)
         records, bad_tail = self._store.journal.replay(round_idx)
         replayed = 0
+        # chunk records (journal-before-ack one level DOWN: each accepted
+        # chunk of a partial upload) route to the reassembler, never the
+        # slot table — a complete-but-unacked stream re-dispatches when its
+        # sender retransmits, and _journal_upload's sender dedup below keeps
+        # the finished upload exactly-once either way
+        chunk_recs = [r for r in records if r.get("kind") == "chunk"]
         for rec in records:
+            if rec.get("kind") == "chunk":
+                continue
             sender = int(rec["sender"])
             if sender in self._uploads_this_round:
                 self._comm_stats.inc("dup_uploads_discarded")
@@ -700,6 +715,10 @@ class ServerRecoveryMixin:
             if self._replay_upload(rec):
                 self._uploads_this_round.add(sender)
                 replayed += 1
+        if chunk_recs:
+            chunking = getattr(self, "_chunking", None)
+            if chunking is not None:
+                chunking.restore(chunk_recs)
         # already-initialized: the ONLINE handshake must NOT restart round 0.
         # _client_epochs is deliberately NOT restored — every client's next
         # ONLINE therefore reads as a rejoin and flows through the existing
@@ -836,6 +855,19 @@ class ServerRecoveryMixin:
                 journal.append(self.args.round_idx, record)
         self._uploads_this_round.add(sender)
         return True
+
+    def _journal_chunk(self, round_idx: int, record: Dict[str, Any]) -> None:
+        """Journal hook for the chunk reassembler: one record per accepted
+        chunk, durable before that chunk's transport ack (same sink-or-
+        blocking idiom as ``_journal_upload``, one level down)."""
+        if self._store is None:
+            return
+        journal = self._store.journal
+        sink = ingest.current_sink() if journal.group_commit_enabled else None
+        if sink is not None:
+            sink.add(journal.append_async(int(round_idx), record))
+        else:
+            journal.append(int(round_idx), record)
 
     def finish(self) -> None:
         """Flush any pending group-commit batch (releasing its held acks)

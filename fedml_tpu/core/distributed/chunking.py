@@ -1,0 +1,730 @@
+"""Chunked, resumable upload streaming (sub-message fault granularity).
+
+A client that disconnects at 90% of a large delta upload re-sends the
+WHOLE message today — at million-client scale over flaky edge links that
+is the dominant wasted-bytes and tail-latency source (the regime Prime
+CCL's fault-tolerant collectives target, arXiv:2505.14065).  This module
+splits a serialized payload-bearing message into crc32-framed chunks and
+rides each chunk on the PR 1 reliability machinery (per-chunk msg-id,
+ack, dedup, retransmit), so after a link cut only the unacked tail of
+the stream is re-sent: the acked prefix IS the resume state, no extra
+protocol round trips.
+
+Wire format — one ``comm_chunk`` message per slice, below the
+application vocabulary like ``comm_ack``::
+
+    chunk_stream : "c<rank>:<nonce>:<seq>"  sender-unique stream id
+    chunk_idx    : 0-based slice index
+    chunk_n      : total slices in the stream
+    chunk_data   : the slice bytes
+    chunk_crc    : crc32 of the slice (torn-frame detection)
+    chunk_total  : total payload bytes
+    chunk_inner_type : the inner message's msg_type (fault-plan scoping)
+    round_idx    : copied from the inner message (fault-plan scoping)
+
+plus a ``comm_chunk_reset`` control message (receiver -> sender) that
+aborts a shed stream so the sender restarts it from scratch.
+
+Capability negotiates DOWN per link, like the PR 18 codec negotiation:
+every stamped outbound message additively advertises ``chunk_ok``; a
+sender only chunks toward peers it has seen advertise.  Legacy peers
+never advertise and keep whole-message uploads — wire-compatible in both
+directions, zero extra round trips (the server's handshake/sync messages
+precede any upload, so capability is known in time).
+
+Durability composes with the PR 4/10/18 journal-before-ack contract one
+level down: the receiving tier journals each accepted chunk BEFORE its
+transport ack is released (via the ambient
+:func:`~fedml_tpu.core.ingest.deferred_ack_scope` sink under the staged
+pipeline, blocking append on the host path), so a server/edge kill
+mid-upload replays its partial streams and resumes from the journal —
+an acked chunk is never re-sent, a never-acked chunk is retransmitted
+into the restored reassembler, and the application-level per-sender
+dedup (``_journal_upload`` / edge ``_seen``) keeps the completed upload
+exactly-once.
+
+This file and ``core/ingest.py`` are the ONLY modules that may parse
+chunk headers or mutate reassembly buffers (fedlint
+``chunk-reassembly-seam``): a second parsing site is how resume
+semantics and the exactly-once accounting silently fork.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+import uuid
+import zlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import obs
+from .communication.message import Message
+
+logger = logging.getLogger(__name__)
+
+#: transport-level chunk vocabulary (below MyMessage, like ``comm_ack``)
+CHUNK_TYPE = "comm_chunk"
+CHUNK_RESET_TYPE = "comm_chunk_reset"
+
+#: additive capability advertisement on stamped messages
+CHUNK_OK_KEY = "chunk_ok"
+
+_KEY_STREAM = "chunk_stream"
+_KEY_IDX = "chunk_idx"
+_KEY_N = "chunk_n"
+_KEY_DATA = "chunk_data"
+_KEY_CRC = "chunk_crc"
+_KEY_TOTAL = "chunk_total"
+_KEY_INNER_TYPE = "chunk_inner_type"
+
+#: params keys whose presence marks a message as payload-bearing (worth
+#: serializing to measure); everything else is control traffic
+_PAYLOAD_KEYS = (Message.MSG_ARG_KEY_MODEL_PARAMS, "hier_payload")
+
+DEFAULT_CHUNK_WINDOW = 8
+DEFAULT_BUFFER_BYTES = 64 << 20
+_COMPLETED_LRU = 64
+_MAX_STREAM_RESTARTS = 3
+
+
+class ChunkError(RuntimeError):
+    """A chunk failed integrity/admission checks: raised out of dispatch so
+    the transport withholds the ack and forgets the msg-id — the sender's
+    retransmitter redelivers the frame intact / later."""
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def split_payload(payload: bytes, chunk_bytes: int) -> List[bytes]:
+    """Slice ``payload`` into ``chunk_bytes``-sized pieces (last may be
+    short; an empty payload still yields one empty slice)."""
+    chunk_bytes = max(1, int(chunk_bytes))
+    if not payload:
+        return [b""]
+    return [payload[i:i + chunk_bytes]
+            for i in range(0, len(payload), chunk_bytes)]
+
+
+def is_chunk(msg: Message) -> bool:
+    return msg.get_type() == CHUNK_TYPE
+
+
+def is_chunk_reset(msg: Message) -> bool:
+    return msg.get_type() == CHUNK_RESET_TYPE
+
+
+def truncate_for_fault(msg: Message) -> Optional[Message]:
+    """The ``truncated_frame`` fault's mangler: a shallow-COPIED chunk
+    message whose slice bytes are torn in half (stale crc kept, so the
+    receiver's integrity check rejects it).  Copying matters: the sender's
+    retransmitter holds the ORIGINAL object and must re-send it intact.
+    Returns None for non-chunk messages (nothing to tear)."""
+    if not is_chunk(msg):
+        return None
+    params = dict(msg.get_params())
+    data = params.get(_KEY_DATA) or b""
+    params[_KEY_DATA] = bytes(data)[: len(data) // 2]
+    torn = Message()
+    torn.init(params)
+    return torn
+
+
+def build_chunks(stream_id: str, inner: Message, payload: bytes,
+                 chunk_bytes: int) -> List[Message]:
+    """Frame ``payload`` (the pickled inner params dict) as a list of
+    ``comm_chunk`` messages carrying deterministic
+    ``(stream, chunk_idx, chunk_n)`` headers and per-slice crc32."""
+    slices = split_payload(payload, chunk_bytes)
+    n = len(slices)
+    rnd = inner.get("round_idx")
+    out: List[Message] = []
+    for idx, data in enumerate(slices):
+        m = Message(CHUNK_TYPE, inner.get_sender_id(), inner.get_receiver_id())
+        m.add_params(_KEY_STREAM, stream_id)
+        m.add_params(_KEY_IDX, idx)
+        m.add_params(_KEY_N, n)
+        m.add_params(_KEY_DATA, data)
+        m.add_params(_KEY_CRC, _crc(data))
+        m.add_params(_KEY_TOTAL, len(payload))
+        m.add_params(_KEY_INNER_TYPE, str(inner.get_type()))
+        if rnd is not None:
+            m.add_params("round_idx", rnd)
+        tp = inner.get(Message.MSG_ARG_KEY_TRACEPARENT)
+        if tp is not None:
+            m.add_params(Message.MSG_ARG_KEY_TRACEPARENT, tp)
+        out.append(m)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sender: windowed stream send over the reliable link
+# ---------------------------------------------------------------------------
+class _StreamState:
+    __slots__ = ("stream_id", "total", "n", "acked", "resent_bytes",
+                 "aborted", "failed", "all_sent", "inner", "restarts")
+
+    def __init__(self, stream_id: str, total: int, n: int, inner: Message):
+        self.stream_id = stream_id
+        self.total = int(total)
+        self.n = int(n)
+        self.acked = 0
+        self.resent_bytes = 0
+        self.aborted = False
+        self.failed = False
+        self.all_sent = False
+        self.inner = inner
+        self.restarts = 0
+
+
+class ChunkedSender:
+    """Split-and-stream side: at most ``window`` unacked chunks in flight,
+    resume accounting per stream, restart on a receiver's shed reset.
+
+    Delivery ownership matches whole-message semantics: ``send`` returns
+    once the stream is registered and handed to a pump thread (the
+    retransmitter owns each unacked chunk); the window only throttles how
+    far ahead of the acks the stream runs, which is exactly what bounds
+    the bytes a mid-stream link cut can cost.  The pump MUST be
+    off-thread: ``send`` is normally called from the manager's dispatch
+    thread, and the acks the window waits on arrive on that same thread —
+    pumping inline would deadlock the node against itself."""
+
+    def __init__(self, manager: Any, *, chunk_bytes: int, window: int):
+        self._manager = manager
+        self._stats = manager._comm_stats
+        self.chunk_bytes = max(1, int(chunk_bytes))
+        self.window = max(1, int(window))
+        self._cond = threading.Condition()
+        self._inflight: Dict[str, Tuple[str, int]] = {}  # msg_id -> (stream, nbytes)
+        self._streams: Dict[str, _StreamState] = {}
+        self._seq = 0
+        self._nonce = uuid.uuid4().hex[:8]
+        self._closed = False
+        link = manager._link
+        patience = (link.max_retries + 1) * link.backoff_max_s + 2.0
+        self._patience_s = max(5.0, patience)
+        link.add_ack_listener(self._on_ack)
+
+    def _new_stream_id(self) -> str:
+        with self._cond:
+            self._seq += 1
+            return f"c{self._manager.rank}:{self._nonce}:{self._seq}"
+
+    # -- link callback -------------------------------------------------------
+    def _on_ack(self, msg_id: str, attempts: int, delivered: bool) -> None:
+        finished: Optional[_StreamState] = None
+        with self._cond:
+            entry = self._inflight.pop(msg_id, None)
+            self._cond.notify_all()
+            if entry is None:
+                return
+            stream_id, nbytes = entry
+            st = self._streams.get(stream_id)
+            if st is None:
+                return
+            if not delivered:
+                st.failed = True
+            else:
+                st.acked += 1
+                if attempts > 0:
+                    resent = attempts * nbytes
+                    st.resent_bytes += resent
+                    self._stats.inc("chunk_bytes_resent", resent)
+            if st.all_sent and st.acked >= st.n and not st.failed:
+                finished = self._streams.pop(stream_id)
+        if finished is not None:
+            self._finish_stream(finished)
+
+    def _finish_stream(self, st: _StreamState) -> None:
+        self._stats.inc("streams_completed")
+        obs.counter_inc("ingest.streams_completed")
+        if st.resent_bytes > 0:
+            # the resumability win, in bytes: a whole-message restart would
+            # have re-sent the full payload; chunking re-sent only the
+            # retransmitted slices
+            saved = max(0, st.total - st.resent_bytes)
+            self._stats.inc("resume_bytes_saved", saved)
+            obs.counter_inc("ingest.resume_bytes_saved", saved)
+        obs.span_event("chunk_stream_complete", obs.extract(st.inner),
+                       node=self._manager.rank, stream=st.stream_id,
+                       n_chunks=st.n, total_bytes=st.total,
+                       resent_bytes=st.resent_bytes)
+
+    def on_reset(self, msg: Message) -> None:
+        """Receiver shed this stream: abort the in-flight window and replay
+        the whole stream from scratch under a FRESH stream id + fresh msg
+        ids (the receiver's dedup window would re-ack the old ones without
+        delivering).  Restarted off-thread: this runs on the receive path,
+        which must stay free to consume the restart's acks."""
+        stream_id = str(msg.get(_KEY_STREAM))
+        with self._cond:
+            st = self._streams.get(stream_id)
+            if st is None:
+                return
+            st.aborted = True
+            self._streams.pop(stream_id, None)
+            stale = [mid for mid, (sid, _) in self._inflight.items()
+                     if sid == stream_id]
+            for mid in stale:
+                self._inflight.pop(mid, None)
+            self._cond.notify_all()
+            inner, restarts = st.inner, st.restarts
+        if restarts >= _MAX_STREAM_RESTARTS:
+            logger.warning("rank %s: stream %s shed %d times; giving up "
+                           "(application-level retry owns it now)",
+                           self._manager.rank, stream_id, restarts)
+            return
+        self._stats.inc("streams_restarted")
+        t = threading.Thread(
+            target=lambda: self.send(inner, restarts=restarts + 1),
+            daemon=True, name=f"chunk-restart-rank{self._manager.rank}")
+        t.start()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._inflight.clear()
+            self._streams.clear()
+            self._cond.notify_all()
+
+    # -- stream send ---------------------------------------------------------
+    def serialize(self, message: Message) -> bytes:
+        """The stream payload: the pickled params dict — the same bytes a
+        binary transport would have put on the wire for the whole message
+        (``CachedPayload`` substitutes its cached blob via ``__reduce__``)."""
+        return pickle.dumps(message.get_params(),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+
+    def send(self, message: Message, restarts: int = 0,
+             payload: Optional[bytes] = None) -> bool:
+        """Chunk-stream ``message``; False when it fits one chunk (the
+        caller sends it whole)."""
+        if payload is None:
+            payload = self.serialize(message)
+        if len(payload) <= self.chunk_bytes:
+            return False
+        stream_id = self._new_stream_id()
+        chunks = build_chunks(stream_id, message, payload, self.chunk_bytes)
+        st = _StreamState(stream_id, len(payload), len(chunks), message)
+        st.restarts = restarts
+        with self._cond:
+            if self._closed:
+                return True
+            self._streams[stream_id] = st
+        obs.span_event("chunk_stream_start", obs.extract(message),
+                       node=self._manager.rank, stream=stream_id,
+                       n_chunks=len(chunks), total_bytes=len(payload),
+                       inner_type=str(message.get_type()), restart=restarts)
+        threading.Thread(
+            target=self._pump, args=(st, chunks), daemon=True,
+            name=f"chunk-pump-rank{self._manager.rank}").start()
+        return True
+
+    def _pump(self, st: _StreamState, chunks: List[Message]) -> None:
+        """The windowed loop, on a dedicated thread per stream."""
+        link = self._manager._link
+        stream_id = st.stream_id
+        deadline = time.monotonic() + self._patience_s
+        for chunk in chunks:
+            with self._cond:
+                while (len([1 for sid, _ in self._inflight.values()
+                            if sid == stream_id]) >= self.window
+                       and not st.aborted and not self._closed):
+                    if time.monotonic() > deadline:
+                        # a wedged window (dead peer past retransmit
+                        # give-up) must not wedge the round thread forever
+                        logger.warning(
+                            "rank %s: stream %s window stalled %.0fs; "
+                            "draining without acks", self._manager.rank,
+                            stream_id, self._patience_s)
+                        for mid in [m for m, (sid, _) in
+                                    self._inflight.items()
+                                    if sid == stream_id]:
+                            self._inflight.pop(mid, None)
+                        st.failed = True
+                        break
+                    self._cond.wait(timeout=0.05)
+                if st.aborted or self._closed:
+                    return
+                # pre-register under the lock BEFORE the send: the ack can
+                # race back on the receive thread the moment the frame is out
+                msg_id = link.stamp(chunk)
+                self._inflight[msg_id] = (
+                    stream_id, len(chunk.get(_KEY_DATA) or b""))
+                deadline = time.monotonic() + self._patience_s
+            self._stats.inc("chunks_sent")
+            obs.counter_inc("ingest.chunks_sent")
+            self._manager._send_one(chunk, msg_id=msg_id)
+        with self._cond:
+            st.all_sent = True
+            finished = (st.acked >= st.n and not st.failed
+                        and self._streams.pop(stream_id, None) is not None)
+        if finished:
+            self._finish_stream(st)
+
+
+# ---------------------------------------------------------------------------
+# receiver: journaled reassembly with pressure shedding
+# ---------------------------------------------------------------------------
+class _Reassembly:
+    __slots__ = ("stream_id", "sender", "n", "total", "chunks", "nbytes",
+                 "round_idx", "inner_type", "born")
+
+    def __init__(self, stream_id: str, sender: int, n: int, total: int,
+                 round_idx: Any, inner_type: str, born: int):
+        self.stream_id = stream_id
+        self.sender = int(sender)
+        self.n = int(n)
+        self.total = int(total)
+        self.chunks: Dict[int, bytes] = {}
+        self.nbytes = 0
+        self.round_idx = round_idx
+        self.inner_type = inner_type
+        self.born = born  # admission order: shed-oldest victim selection
+
+
+class ChunkReassembler:
+    """Collect chunks per stream, journal each accepted chunk before its
+    ack, dispatch ONLY completed inner messages, and shed the oldest
+    incomplete stream under buffer pressure (withholding the over-budget
+    chunk's ack so its sender retransmits after the shed reset lands)."""
+
+    def __init__(self, manager: Any, *, buffer_bytes: int = DEFAULT_BUFFER_BYTES,
+                 resume: bool = True):
+        self._manager = manager
+        self._stats = manager._comm_stats
+        self.buffer_bytes = max(1, int(buffer_bytes))
+        self.resume = bool(resume)
+        self._lock = threading.RLock()
+        self._streams: "OrderedDict[str, _Reassembly]" = OrderedDict()
+        # stream_id -> retained payload (None once dispatched); bounds the
+        # replay-resume memory and dedups re-deliveries of finished streams
+        self._completed: "OrderedDict[str, Optional[bytes]]" = OrderedDict()
+        self._born = 0
+        self._buffered = 0
+        # bound by the recovery owner (ServerRecoveryMixin / EdgeAggregator):
+        # fn(round_idx, record) journals one chunk record with the same
+        # sink-or-blocking idiom as _journal_upload
+        self._journal: Optional[Callable[[int, Dict[str, Any]], None]] = None
+
+    def bind_journal(self, fn: Callable[[int, Dict[str, Any]], None]) -> None:
+        self._journal = fn
+
+    # -- admission -----------------------------------------------------------
+    def accept(self, msg: Message, dispatch: Callable[[Message], None]) -> None:
+        stream_id = str(msg.get(_KEY_STREAM))
+        idx = int(msg.get(_KEY_IDX))
+        n = int(msg.get(_KEY_N))
+        total = int(msg.get(_KEY_TOTAL))
+        data = msg.get(_KEY_DATA)
+        data = bytes(data) if data is not None else b""
+        want_crc = int(msg.get(_KEY_CRC, -1))
+        if _crc(data) != want_crc:
+            self._stats.inc("chunks_crc_bad")
+            obs.counter_inc("ingest.chunks_crc_bad")
+            raise ChunkError(
+                f"chunk {stream_id}[{idx}] crc mismatch "
+                f"({_crc(data):08x} != {want_crc & 0xFFFFFFFF:08x}); "
+                "withholding ack for retransmit")
+        with self._lock:
+            if stream_id in self._completed:
+                payload = self._completed[stream_id]
+                if payload is None:
+                    # finished and dispatched: a late duplicate, re-acked
+                    self._stats.inc("chunks_dup")
+                    obs.counter_inc("ingest.chunks_dup")
+                    return
+                # journal-restored stream whose final ack was lost with the
+                # dead incarnation: the sender's retransmit is the signal to
+                # dispatch it now, exactly once (app-level dedup downstream
+                # drops it if the upload record also survived)
+                self._completed[stream_id] = None
+                inner = self._build_inner(payload)
+            else:
+                st = self._streams.get(stream_id)
+                if st is None:
+                    st = self._admit(msg, stream_id, n, total)
+                if idx in st.chunks:
+                    self._stats.inc("chunks_dup")
+                    obs.counter_inc("ingest.chunks_dup")
+                    return
+                self._shed_for(len(data), keep=stream_id)
+                st.chunks[idx] = data
+                st.nbytes += len(data)
+                self._buffered += len(data)
+                self._stats.inc("chunks_received")
+                obs.counter_inc("ingest.chunks_received")
+                if len(st.chunks) == 1:
+                    obs.span_event("chunk_stream_start", obs.extract(msg),
+                                   node=self._manager.rank, side="recv",
+                                   stream=stream_id, n_chunks=n,
+                                   total_bytes=total)
+                self._journal_chunk(msg, st, idx, data)
+                if len(st.chunks) < st.n:
+                    return
+                payload = b"".join(st.chunks[i] for i in range(st.n))
+                if len(payload) != st.total:
+                    # a torn stream header slipped through per-slice crc:
+                    # drop the stream, withhold this ack — full restart
+                    self._drop_stream(stream_id)
+                    self._stats.inc("chunks_crc_bad")
+                    obs.counter_inc("ingest.chunks_crc_bad")
+                    raise ChunkError(
+                        f"stream {stream_id} reassembled {len(payload)} "
+                        f"bytes, header said {st.total}")
+                inner = self._build_inner(payload)
+        # dispatch OUTSIDE the reassembly lock (handlers take round locks);
+        # a raise here propagates so the transport withholds the final
+        # chunk's ack — on the retransmit the stream is still complete
+        try:
+            dispatch(inner)
+        except BaseException:
+            with self._lock:
+                st = self._streams.get(stream_id)
+                if st is not None and idx in st.chunks:
+                    self._buffered -= len(st.chunks.pop(idx))
+                    st.nbytes -= len(data)
+            raise
+        with self._lock:
+            self._drop_stream(stream_id)
+            self._remember_completed(stream_id, None)
+            self._stats.inc("streams_completed")
+        obs.span_event("chunk_stream_complete", obs.extract(msg),
+                       node=self._manager.rank, side="recv",
+                       stream=stream_id, total_bytes=total)
+
+    def _admit(self, msg: Message, stream_id: str, n: int,
+               total: int) -> _Reassembly:
+        self._born += 1
+        st = _Reassembly(stream_id, int(msg.get_sender_id()), n, total,
+                         msg.get("round_idx"),
+                         str(msg.get(_KEY_INNER_TYPE, "")), self._born)
+        self._streams[stream_id] = st
+        return st
+
+    def _shed_for(self, incoming: int, keep: str) -> None:
+        """Make room for ``incoming`` bytes by dropping oldest-incomplete
+        streams (never ``keep``), telling each victim's sender to restart."""
+        while (self._buffered + incoming > self.buffer_bytes
+               and any(sid != keep for sid in self._streams)):
+            victim = min(
+                (st for sid, st in self._streams.items() if sid != keep),
+                key=lambda st: st.born)
+            sender = victim.sender
+            self._drop_stream(victim.stream_id)
+            self._stats.inc("streams_shed")
+            obs.counter_inc("ingest.streams_shed")
+            logger.warning(
+                "rank %s: reassembly pressure (%d buffered, cap %d); shed "
+                "stream %s from %s", self._manager.rank, self._buffered,
+                self.buffer_bytes, victim.stream_id, sender)
+            reset = Message(CHUNK_RESET_TYPE, self._manager.rank, sender)
+            reset.add_params(_KEY_STREAM, victim.stream_id)
+            try:
+                self._manager._send_one(reset)
+            except Exception:
+                # best-effort: without the reset the victim's retransmits
+                # re-admit the stream chunk by chunk (slower, still correct)
+                logger.info("rank %s: shed reset send failed",
+                            self._manager.rank, exc_info=True)
+
+    def _drop_stream(self, stream_id: str) -> None:
+        st = self._streams.pop(stream_id, None)
+        if st is not None:
+            self._buffered -= st.nbytes
+
+    def _remember_completed(self, stream_id: str,
+                            payload: Optional[bytes]) -> None:
+        self._completed[stream_id] = payload
+        self._completed.move_to_end(stream_id)
+        while len(self._completed) > _COMPLETED_LRU:
+            self._completed.popitem(last=False)
+
+    def _build_inner(self, payload: bytes) -> Message:
+        inner = Message()
+        inner.init(pickle.loads(payload))
+        return inner
+
+    # -- durability ----------------------------------------------------------
+    def _journal_chunk(self, msg: Message, st: _Reassembly, idx: int,
+                       data: bytes) -> None:
+        if self._journal is None or not self.resume:
+            return
+        rnd = st.round_idx
+        try:
+            rnd = int(rnd) if rnd is not None else 0
+        except (TypeError, ValueError):
+            rnd = 0
+        self._journal(rnd, {
+            "kind": "chunk",
+            "round_idx": rnd,
+            "sender": st.sender,
+            _KEY_STREAM: st.stream_id,
+            _KEY_IDX: int(idx),
+            _KEY_N: st.n,
+            _KEY_TOTAL: st.total,
+            _KEY_INNER_TYPE: st.inner_type,
+            _KEY_DATA: data,
+        })
+
+    def restore(self, records: List[Dict[str, Any]]) -> int:
+        """Rebuild reassembly state from replayed journal chunk records.
+        Completed streams retain their payload but are NOT dispatched — a
+        live retransmit of any of their chunks (guaranteed whenever the
+        final ack died with the old incarnation) triggers the dispatch,
+        and the application-level sender dedup keeps it exactly-once."""
+        restored = 0
+        with self._lock:
+            for rec in records:
+                if rec.get("kind") != "chunk":
+                    continue
+                stream_id = str(rec[_KEY_STREAM])
+                if stream_id in self._completed:
+                    continue
+                st = self._streams.get(stream_id)
+                if st is None:
+                    self._born += 1
+                    st = _Reassembly(
+                        stream_id, int(rec.get("sender", 0)),
+                        int(rec[_KEY_N]), int(rec[_KEY_TOTAL]),
+                        rec.get("round_idx"),
+                        str(rec.get(_KEY_INNER_TYPE, "")), self._born)
+                    self._streams[stream_id] = st
+                idx = int(rec[_KEY_IDX])
+                if idx in st.chunks:
+                    continue
+                data = bytes(rec[_KEY_DATA])
+                st.chunks[idx] = data
+                st.nbytes += len(data)
+                self._buffered += len(data)
+                restored += 1
+                if len(st.chunks) == st.n:
+                    payload = b"".join(st.chunks[i] for i in range(st.n))
+                    self._drop_stream(stream_id)
+                    if len(payload) == st.total:
+                        self._remember_completed(stream_id, payload)
+        if restored:
+            obs.counter_inc("ingest.chunks_restored", restored)
+            logger.info("rank %s: restored %d journaled chunks "
+                        "(%d open streams, %d completed-held)",
+                        self._manager.rank, restored, len(self._streams),
+                        len(self._completed))
+        return restored
+
+    def stats_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"open_streams": len(self._streams),
+                    "buffered_bytes": self._buffered,
+                    "completed_held": len(self._completed)}
+
+
+# ---------------------------------------------------------------------------
+# per-manager facade
+# ---------------------------------------------------------------------------
+class ChunkingState:
+    """One node runtime's chunking plane: capability map + sender +
+    reassembler, wired into the comm manager's send/dispatch seams."""
+
+    def __init__(self, manager: Any):
+        a = manager.args
+        g = (lambda k, d: getattr(a, k, d) if a is not None else d)
+        self.chunk_bytes = int(g("upload_chunk_bytes", 0) or 0)
+        self.window = int(g("chunk_window", DEFAULT_CHUNK_WINDOW)
+                          or DEFAULT_CHUNK_WINDOW)
+        self.resume = bool(g("chunk_resume", True))
+        self.receive_ok = bool(g("chunk_receive", True))
+        buffer_bytes = int(g("chunk_buffer_bytes", DEFAULT_BUFFER_BYTES)
+                           or DEFAULT_BUFFER_BYTES)
+        self._manager = manager
+        self._peer_ok: set = set()
+        self._peer_lock = threading.Lock()
+        self.sender = (ChunkedSender(manager, chunk_bytes=self.chunk_bytes,
+                                     window=self.window)
+                       if self.chunk_bytes > 0 else None)
+        self.reassembler = (ChunkReassembler(manager, buffer_bytes=buffer_bytes,
+                                             resume=self.resume)
+                            if self.receive_ok else None)
+
+    @classmethod
+    def maybe_create(cls, manager: Any) -> Optional["ChunkingState"]:
+        if manager._link is None:
+            return None
+        return cls(manager)
+
+    # -- negotiation ---------------------------------------------------------
+    def advertise(self, msg: Message) -> None:
+        """Stamped outbound messages carry the additive capability flag."""
+        if self.receive_ok:
+            msg.add_params(CHUNK_OK_KEY, 1)
+
+    def observe(self, msg: Message) -> None:
+        """Record the peer's advertised capability (inbound seam)."""
+        if msg.get(CHUNK_OK_KEY):
+            try:
+                peer = int(msg.get_sender_id())
+            except (TypeError, ValueError):
+                return
+            with self._peer_lock:
+                self._peer_ok.add(peer)
+
+    def peer_supports(self, rank: Any) -> bool:
+        try:
+            rank = int(rank)
+        except (TypeError, ValueError):
+            return False
+        with self._peer_lock:
+            return rank in self._peer_ok
+
+    # -- send seam -----------------------------------------------------------
+    def maybe_send_chunked(self, msg: Message) -> bool:
+        """True when ``msg`` was consumed as a chunk stream.  Negotiates
+        down: non-advertising peers, control traffic, and under-threshold
+        payloads all fall back to the whole-message path."""
+        if self.sender is None:
+            return False
+        mtype = msg.get_type()
+        if mtype in (CHUNK_TYPE, CHUNK_RESET_TYPE):
+            return False
+        params = msg.get_params()
+        if not any(k in params for k in _PAYLOAD_KEYS):
+            return False
+        if not self.peer_supports(msg.get_receiver_id()):
+            return False
+        return self.sender.send(msg)
+
+    # -- dispatch seam -------------------------------------------------------
+    def intercepts(self, msg: Message) -> bool:
+        t = msg.get_type()
+        if t == CHUNK_TYPE:
+            return self.reassembler is not None
+        if t == CHUNK_RESET_TYPE:
+            return self.sender is not None
+        return False
+
+    def dispatch_chunk(self, msg: Message,
+                       dispatch: Callable[[Message], None]) -> None:
+        if is_chunk_reset(msg):
+            assert self.sender is not None
+            self.sender.on_reset(msg)
+            return
+        assert self.reassembler is not None
+        self.reassembler.accept(msg, dispatch)
+
+    # -- durability wiring ---------------------------------------------------
+    def bind_journal(self, fn: Callable[[int, Dict[str, Any]], None]) -> None:
+        if self.reassembler is not None:
+            self.reassembler.bind_journal(fn)
+
+    def restore(self, records: List[Dict[str, Any]]) -> int:
+        if self.reassembler is None:
+            return 0
+        return self.reassembler.restore(records)
+
+    def close(self) -> None:
+        if self.sender is not None:
+            self.sender.close()

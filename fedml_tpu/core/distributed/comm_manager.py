@@ -83,7 +83,8 @@ class _ReliableLink:
 
     def __init__(self, rank: int, stats: CommStats, *, max_retries: int = 0,
                  backoff_base_s: float = 0.2, backoff_max_s: float = 2.0,
-                 jitter: float = 0.25, dedup_window: int = 8192):
+                 jitter: float = 0.25, dedup_window: int = 8192,
+                 backoff_seed: Optional[int] = None):
         self.rank = int(rank)
         self.stats = stats
         self.max_retries = int(max_retries)
@@ -101,12 +102,26 @@ class _ReliableLink:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._send_raw: Optional[Callable[[Message], None]] = None
-        # jitter draws are deterministic per (rank, nonce) but the nonce is
-        # fresh per incarnation — good enough: jitter only de-synchronizes
-        # retransmit storms, correctness never depends on it
+        # ack listeners (chunked-upload window/resume accounting): called
+        # outside the lock with (msg_id, attempts, delivered) for every ack
+        # consumed and every retransmit give-up
+        self._ack_listeners: list = []  # owned-by: main — bound before run()
+        # optional outbound-ack decorator (chunking capability advert):
+        # acks are the ONLY reverse traffic on pure fan-in links (leaf ->
+        # edge -> root), so they must carry the chunk_ok flag or those
+        # links could never negotiate chunking up
+        self.ack_decorator: Optional[Callable[[Message], None]] = None  # owned-by: main — bound before run()
+        # jitter draws are seeded per (seed, rank): deterministic ACROSS
+        # incarnations, so a restarted server's whole cohort doesn't re-draw
+        # identical schedules from fresh nonces and synchronize its retry
+        # storm; distinct per rank so peers still de-correlate.  With no
+        # seed configured the legacy per-(rank, nonce) stream is kept.
         import random
 
-        self._rng = random.Random(f"{self.rank}:{self._nonce}")
+        if backoff_seed is not None:
+            self._rng = random.Random(f"{int(backoff_seed)}:{self.rank}")
+        else:
+            self._rng = random.Random(f"{self.rank}:{self._nonce}")
 
     # -- wiring --------------------------------------------------------------
     def bind(self, send_raw: Callable[[Message], None]) -> None:
@@ -126,6 +141,17 @@ class _ReliableLink:
             self._running = False
             self._pending.clear()
             self._cond.notify_all()
+
+    def add_ack_listener(
+            self, fn: Callable[[str, int, bool], None]) -> None:
+        self._ack_listeners.append(fn)
+
+    def _notify_ack(self, msg_id: str, attempts: int, delivered: bool) -> None:
+        for fn in self._ack_listeners:
+            try:
+                fn(msg_id, attempts, delivered)
+            except Exception:  # listeners must never poison the link
+                logger.exception("rank %s: ack listener failed", self.rank)
 
     # -- send side -----------------------------------------------------------
     def stamp(self, msg: Message) -> str:
@@ -172,6 +198,7 @@ class _ReliableLink:
                     logger.warning(
                         "rank %s: giving up on %s (%s) after %d retransmits",
                         self.rank, mid, p.msg.get_type(), self.max_retries)
+                    self._notify_ack(mid, p.attempts, False)
                     continue
                 self.stats.inc("retransmits")
                 logger.info("rank %s: retransmit #%d of %s (%s)",
@@ -220,7 +247,11 @@ class _ReliableLink:
             self.stats.inc("acks_received")
             if acked is not None:
                 with self._cond:
-                    self._pending.pop(str(acked), None)
+                    popped = self._pending.pop(str(acked), None)
+                    self._cond.notify_all()
+                self._notify_ack(str(acked),
+                                 popped.attempts if popped is not None else 0,
+                                 True)
             return False
         if msg.get_type() in _LOCAL_TYPES or msg.get(Message.MSG_ARG_KEY_MSG_ID) is None:
             # local pseudo-message or legacy peer: no dedup, no ack — still
@@ -271,6 +302,8 @@ class _ReliableLink:
         ack = Message(COMM_ACK_TYPE, self.rank, msg.get_sender_id())
         ack.add_params(Message.MSG_ARG_KEY_MSG_ID,
                        msg.get(Message.MSG_ARG_KEY_MSG_ID))
+        if self.ack_decorator is not None:
+            self.ack_decorator(ack)
         try:
             assert self._send_raw is not None
             self._send_raw(ack)
@@ -442,12 +475,14 @@ class FedMLCommManager(Observer):
         if self._link is not None:
             self._link.bind(self._raw_send)
         self._pipeline = self._init_pipeline()
+        self._chunking = self._init_chunking()
 
     def _init_link(self) -> Optional[_ReliableLink]:
         a = self.args
         if a is not None and not getattr(a, "comm_reliability", True):
             return None
         g = (lambda k, d: getattr(a, k, d) if a is not None else d)
+        seed = g("comm_backoff_seed", g("random_seed", None))
         return _ReliableLink(
             self.rank, self._comm_stats,
             max_retries=int(g("comm_max_retries", 0)),
@@ -455,6 +490,7 @@ class FedMLCommManager(Observer):
             backoff_max_s=float(g("comm_backoff_max_s", 2.0)),
             jitter=float(g("comm_backoff_jitter", 0.25)),
             dedup_window=int(g("comm_dedup_window", 8192)),
+            backoff_seed=int(seed) if seed is not None else None,
         )
 
     def _init_pipeline(self) -> Optional[_IngestPipeline]:
@@ -469,6 +505,22 @@ class FedMLCommManager(Observer):
             return None
         depth = int(getattr(a, "ingest_queue_depth", 64))
         return _IngestPipeline(self, self._link, depth=depth)
+
+    def _init_chunking(self):
+        """Chunked resumable uploads (see ``core/distributed/chunking.py``).
+        Receive capability is on by default (and advertised per link);
+        chunked SENDING activates only with ``upload_chunk_bytes > 0``."""
+        if self._link is None:
+            return None
+        from . import chunking
+
+        state = chunking.ChunkingState.maybe_create(self)
+        if state is not None:
+            # advertise on ack frames too: on pure fan-in links (leaf ->
+            # edge -> root) acks are the only reverse traffic, so without
+            # this the upward direction could never negotiate chunking
+            self._link.ack_decorator = state.advertise
+        return state
 
     # -- lifecycle ----------------------------------------------------------
     def run(self) -> None:
@@ -487,6 +539,8 @@ class FedMLCommManager(Observer):
 
     def finish(self) -> None:
         """Stop the transport (reference ``fedml_comm_manager.py:61-76``)."""
+        if self._chunking is not None:
+            self._chunking.close()
         if self._pipeline is not None:
             self._pipeline.stop()
         if self._link is not None:
@@ -518,12 +572,31 @@ class FedMLCommManager(Observer):
         self.com_manager.send_message(message)
 
     def send_message(self, message: Message) -> None:
+        # chunk seam: payload-bearing messages toward chunk-capable peers
+        # stream as crc-framed chunks, each riding the reliability layer's
+        # per-chunk ack/retransmit (resume-from-last-acked-chunk for free);
+        # control traffic, legacy peers and small payloads fall through to
+        # the whole-message path below
+        if self._chunking is not None and self._chunking.maybe_send_chunked(message):
+            return
+        self._send_one(message)
+
+    def _send_one(self, message: Message,
+                  msg_id: Optional[str] = None) -> Optional[str]:
+        """Stamp/track/send ONE frame (a whole message or a single chunk).
+
+        ``msg_id`` is set when the caller (the chunked sender) already
+        stamped the frame to pre-register it with its ack bookkeeping
+        before the ack can race back on the receive thread."""
         assert self.com_manager is not None
         link = self._link
         if link is None or message.get_type() in _LOCAL_TYPES:
             self._raw_send(message)
-            return
-        msg_id = link.stamp(message)
+            return None
+        if msg_id is None:
+            msg_id = link.stamp(message)
+        if self._chunking is not None:
+            self._chunking.advertise(message)
         attempt = 0
         while True:
             try:
@@ -550,6 +623,7 @@ class FedMLCommManager(Observer):
                             link.max_retries, delay)
                 time.sleep(delay)
         link.track(msg_id, message)
+        return msg_id
 
     def register_message_receive_handler(
         self, msg_type: str, handler_callback_func: Callable[[Message], None]
@@ -561,6 +635,10 @@ class FedMLCommManager(Observer):
 
     # Observer
     def receive_message(self, msg_type: str, msg_params: Message) -> None:
+        if self._chunking is not None:
+            # per-link capability map (chunking negotiates DOWN to whole
+            # messages for peers that never advertise)
+            self._chunking.observe(msg_params)
         if self._link is None:
             self._dispatch(msg_params)
             return
@@ -579,6 +657,13 @@ class FedMLCommManager(Observer):
         self._link.on_receive(msg_params, self._dispatch)
 
     def _dispatch(self, msg_params: Message) -> None:
+        if self._chunking is not None and self._chunking.intercepts(msg_params):
+            # reassembly seam: chunk frames accumulate (journaled before
+            # their acks); only a COMPLETED inner message re-enters here.
+            # A ChunkError raise propagates to the normal failed-dispatch
+            # routing — ack withheld, msg_id forgotten, sender retransmits.
+            self._chunking.dispatch_chunk(msg_params, self._dispatch)
+            return
         handler = self.message_handler_dict.get(str(msg_params.get_type()))
         if handler is None:
             logger.debug("rank %s: no handler for msg_type=%s",

@@ -22,7 +22,8 @@ Fault-plan schema (dict / YAML ``fault_args`` section)::
       rules:
         - kind: drop               # drop|delay|duplicate|reset|partition|
                                    #   server_kill|mesh_shrink|mesh_grow|
-                                   #   device_loss
+                                   #   device_loss|mid_message_disconnect|
+                                   #   truncated_frame
           direction: send          # send (default) or recv
           sender: 1                # int or list; omit = any
           receiver: 0              # int or list; omit = any
@@ -33,7 +34,9 @@ Fault-plan schema (dict / YAML ``fault_args`` section)::
           times: 1                 # then affect the next N (null = forever;
                                    #   partition defaults to forever)
           p: 1.0                   # probability, seeded & per-rule
-          delay_s: 0.05            # kind=delay only
+          delay_s: 0.05            # kind=delay: deferral; kind=
+                                   #   mid_message_disconnect: dead-link
+                                   #   window length
           keep: 2                  # mesh_shrink/mesh_grow only: device count
                                    #   to keep (shrink defaults to half,
                                    #   grow to full visibility)
@@ -57,6 +60,19 @@ Kinds:
   incarnation.  Scope it ``direction: recv, receiver: <server rank>`` to
   kill the server at an exact point mid-round (e.g. between two uploads);
   ``kill_event`` lets a test harness observe the crash.
+* ``mid_message_disconnect`` — the chunked-upload link cut: the triggering
+  frame dies AND the whole link goes dark for ``delay_s`` seconds in both
+  directions (every frame either way is dropped, like a modem losing
+  carrier mid-stream).  Scope it at chunk ``after: K`` to cut an upload at
+  exactly K chunks of progress; once the window passes, the sender's
+  retransmitter resumes the stream from its last acked chunk — the
+  resumability this kind exists to prove.  Flight-recorder dump trigger.
+* ``truncated_frame`` — a torn final frame: a *copy* of the triggering
+  chunk message with its payload slice cut in half (stale crc) is
+  delivered instead of the original, so the receiver's integrity check
+  must reject it, withhold the ack, and take the sender's intact
+  retransmit.  Non-chunk messages pass unchanged (nothing to tear).
+  Flight-recorder dump trigger.
 * ``mesh_shrink`` / ``mesh_grow`` / ``device_loss`` — *topology* faults:
   the triggering message is forwarded unchanged, but the deterministic
   device-visibility shim (:func:`fedml_tpu.parallel.mesh.set_visible_devices`)
@@ -79,6 +95,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from .. import obs
@@ -88,7 +105,8 @@ from .communication.message import Message
 logger = logging.getLogger(__name__)
 
 FAULT_KINDS = ("drop", "delay", "duplicate", "reset", "partition",
-               "server_kill", "mesh_shrink", "mesh_grow", "device_loss")
+               "server_kill", "mesh_shrink", "mesh_grow", "device_loss",
+               "mid_message_disconnect", "truncated_frame")
 
 #: topology fault kinds: they mutate device visibility, never the message
 _TOPOLOGY_KINDS = ("mesh_shrink", "mesh_grow", "device_loss")
@@ -112,10 +130,15 @@ class CommStats:
         "acks_sent", "acks_received", "dup_dropped",
         "faults_dropped", "faults_delayed", "faults_duplicated",
         "faults_reset", "faults_killed", "faults_topology",
+        "faults_disconnects", "faults_truncated",
         "reconnects", "rejoins",
         # server crash-recovery counters (core/checkpoint.ServerRecoveryMixin)
         "server_restores", "journal_replays", "epoch_bumps",
         "dup_uploads_discarded",
+        # chunked resumable uploads (core/distributed/chunking.py)
+        "chunks_sent", "chunks_received", "chunks_dup", "chunks_crc_bad",
+        "chunk_bytes_resent", "resume_bytes_saved",
+        "streams_completed", "streams_shed", "streams_restarted",
     )
 
     def __init__(self, node: Optional[int] = None):
@@ -260,6 +283,12 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
         self._stats = stats if stats is not None else CommStats()
         self._observers: List[Observer] = []
         self._killed = False
+        # mid_message_disconnect: monotonic deadline while the link is dark
+        # in BOTH directions (0.0 = link up); written under the injector's
+        # occurrence lock ordering (one triggering frame), read racily —
+        # worst case a frame slips through at the window edge, which a real
+        # carrier loss also permits
+        self._dead_until = 0.0
         # set when a server_kill rule fires; test supervisors wait on this to
         # distinguish "crashed mid-round" from "finished the run"
         self.kill_event = threading.Event()
@@ -271,9 +300,20 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
         return getattr(self._inner, name)
 
     # -- send path -----------------------------------------------------------
+    def _link_dark(self, msg: Message) -> bool:
+        if self._dead_until <= 0.0 or msg.get_type() in _EXEMPT_TYPES:
+            return False
+        if time.monotonic() < self._dead_until:
+            self._stats.inc("faults_dropped")
+            return True
+        self._dead_until = 0.0  # window passed: carrier back
+        return False
+
     def send_message(self, msg: Message) -> None:
         if self._killed:
             return  # dead process: outbound frames go nowhere
+        if self._link_dark(msg):
+            return
         rule = self._injector.decide("send", msg)
         if rule is None:
             self._inner.send_message(msg)
@@ -284,6 +324,8 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
     def receive_message(self, msg_type: str, msg: Message) -> None:
         if self._killed:
             return  # dead process: inbound frames are never observed
+        if self._link_dark(msg):
+            return
         rule = self._injector.decide("recv", msg)
         if rule is None:
             self._notify(msg)
@@ -356,6 +398,32 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
                 self._inner.stop_receive_message()
             except Exception:
                 logger.exception("server_kill: inner stop raised")
+            return
+        if kind == "mid_message_disconnect":
+            self._stats.inc("faults_disconnects")
+            self._stats.inc("faults_dropped")
+            self._dead_until = time.monotonic() + rule.delay_s
+            self._fault_event("mid_message_disconnect", msg, rule=rule.index,
+                              dark_s=rule.delay_s)
+            logger.warning(
+                "FAULT mid_message_disconnect: link dark %.3fs from %s "
+                "%s->%s (rule %d); triggering frame lost", rule.delay_s,
+                msg.get_type(), msg.get_sender_id(), msg.get_receiver_id(),
+                rule.index)
+            return
+        if kind == "truncated_frame":
+            from . import chunking
+
+            torn = chunking.truncate_for_fault(msg)
+            self._stats.inc("faults_truncated")
+            self._fault_event("truncated_frame", msg, rule=rule.index,
+                              torn=torn is not None)
+            logger.warning(
+                "FAULT truncated_frame: %s %s->%s (rule %d)%s",
+                msg.get_type(), msg.get_sender_id(), msg.get_receiver_id(),
+                rule.index, "" if torn is not None else
+                " — not a chunk, forwarded unchanged")
+            forward(torn if torn is not None else msg)
             return
         if kind in ("drop", "partition") or (kind == "reset" and direction == "recv"):
             self._stats.inc("faults_dropped")

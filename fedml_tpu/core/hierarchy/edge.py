@@ -108,6 +108,11 @@ class EdgeAggregator(FedMLCommManager):
         self._journal = make_edge_journal(args, edge_id)
         super().__init__(args, comm=comm, rank=rank, size=size,
                          backend=backend)
+        if self._chunking is not None and self._journal is not None:
+            # chunked leaf uploads journal-before-ack at chunk granularity
+            # through the same edge journal (sub-message version of the
+            # _journal_record contract below)
+            self._chunking.bind_journal(self._journal_record)
         self._recover()
 
     # -- wiring --------------------------------------------------------------
@@ -449,7 +454,15 @@ class EdgeAggregator(FedMLCommManager):
             if bad_tail:
                 obs.counter_inc("hierarchy.replay_bad_tail")
             restaged = 0
+            chunk_recs = [x for x in records if x.get("kind") == "chunk"]
+            if chunk_recs and self._chunking is not None:
+                # partial chunk streams resume in the reassembler; complete
+                # ones re-dispatch on the sender's retransmit and are then
+                # deduped by _seen like any re-delivered upload
+                self._chunking.restore(chunk_recs)
             for rec in records:
+                if rec.get("kind") == "chunk":
+                    continue
                 blob_field = rec.get("telemetry")
                 blobs = (blob_field if isinstance(blob_field, (list, tuple))
                          else [blob_field])

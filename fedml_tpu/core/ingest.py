@@ -31,6 +31,16 @@ transport nor the durability layer may own directly (circular import):
   arrival order; :class:`ReorderWindow` releases items in index order,
   holding only the out-of-order tail, so in-order traffic streams
   straight into the accumulator with O(1) staging.
+* the **chunk reassembly stage** — chunked resumable uploads
+  (:mod:`~fedml_tpu.core.distributed.chunking`) accumulate crc-framed
+  chunks into per-stream buffers and hand the dispatch worker only
+  COMPLETED inner messages; each accepted chunk is journaled before its
+  transport ack through the same ticket sink above, so "ack implies
+  journaled" holds at sub-message granularity too.  This module and
+  ``core/distributed/chunking.py`` are the only two files allowed to
+  parse chunk headers or touch reassembly buffers (fedlint
+  ``chunk-reassembly-seam``); :class:`ChunkReassembler` is re-exported
+  here as the ingest-facing name of that stage.
 """
 
 from __future__ import annotations
@@ -43,6 +53,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import obs
+from .distributed.chunking import ChunkError, ChunkReassembler  # noqa: F401 — the ingest-facing seam surface
 
 logger = logging.getLogger(__name__)
 

@@ -39,8 +39,11 @@ DEFAULT_FLIGHT_CAPACITY = 2048
 
 # span events that trigger an automatic dump when seen on the emit tap
 # (device_loss: the elastic topology fault — the ring around a lost chip is
-# exactly the forensic window a remesh post-mortem needs)
-DUMP_EVENTS = ("server_kill", "server_restore", "slow_round", "device_loss")
+# exactly the forensic window a remesh post-mortem needs;
+# mid_message_disconnect / truncated_frame: the chunked-upload faults — the
+# ring holds the chunk spans showing where in the stream the link died)
+DUMP_EVENTS = ("server_kill", "server_restore", "slow_round", "device_loss",
+               "mid_message_disconnect", "truncated_frame")
 
 # hard cap on dumps per recorder: a slow-round storm must not turn the
 # flight recorder into a disk-filling firehose

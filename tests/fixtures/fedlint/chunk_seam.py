@@ -1,0 +1,41 @@
+"""Golden fixture: chunk-header parsing outside the chunking seam
+(expected: 3).  The ``chunk_`` basename opts this file into the
+``chunk-reassembly-seam`` scope (real seam files —
+``core/distributed/chunking.py``, ``core/ingest.py`` — are exempt by
+path).
+
+Line 21 — chunk-reassembly-seam: a wire key pulled out of a message by
+literal is a second header-parsing site.
+Line 25 — chunk-reassembly-seam: subscripting a journal record with the
+wire key forks the record shape the replay path depends on.
+Line 31 — chunk-reassembly-seam: hand-rolled framing via ``build_chunks``
+outside the seam picks its own stream identity.
+
+The clean counterparts: ``via_constant`` imports the seam's constant
+instead of spelling the literal, and ``justified`` carries the pragma a
+deliberate out-of-seam probe needs.
+"""
+
+
+def rogue_parse(msg):
+    return msg.get("chunk_idx")
+
+
+def rogue_record(rec):
+    return rec["chunk_stream"]
+
+
+def rogue_frame(stream_id, inner, payload):
+    from fedml_tpu.core.distributed.chunking import build_chunks
+
+    return build_chunks(stream_id, inner, payload, 4096)
+
+
+def via_constant(msg):
+    from fedml_tpu.core.distributed import chunking
+
+    return msg.get_type() == chunking.CHUNK_TYPE
+
+
+def justified(msg):
+    return msg.get("chunk_n")  # fedlint: allow[chunk-reassembly-seam] — wire-compat probe for the seam test itself

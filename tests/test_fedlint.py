@@ -148,6 +148,21 @@ def test_hierarchy_seam_fixture_exact_findings():
     ]
 
 
+def test_chunk_seam_fixture_exact_findings():
+    """The chunked-upload satellite: chunk wire-vocabulary literals
+    (header keys / message types) parsed, subscripted, or compared — and
+    framing entry points (ChunkReassembler / build_chunks / split_payload)
+    invoked — outside core/distributed/chunking.py + core/ingest.py are
+    findings: a second chunk-parsing site forks the resume protocol and
+    the replay exactly-once accounting.  The constant-importing
+    comparison and the pragma'd probe stay clean."""
+    assert _lint_fixture("chunk_seam.py") == [
+        (21, "chunk-reassembly-seam"),
+        (25, "chunk-reassembly-seam"),
+        (31, "chunk-reassembly-seam"),
+    ]
+
+
 def test_legacy_shims_catch_alias_dodges():
     """The four legacy CLIs ride the same AST passes now, so the alias
     dodges are caught through the old entry points too."""
@@ -306,7 +321,7 @@ def test_cli_json_schema_is_stable():
         "suppressed",
         "version",
     ]
-    assert report["counts"]["findings"] == len(report["findings"]) == 22
+    assert report["counts"]["findings"] == len(report["findings"]) == 25
     first = report["findings"][0]
     assert sorted(first.keys()) >= ["analyzer", "line", "message", "path", "rule", "source"]
     assert {f["rule"] for f in report["findings"]} >= {
@@ -316,6 +331,7 @@ def test_cli_json_schema_is_stable():
         "mesh-stale-program",
         "sec-host-fallback",
         "hierarchy-reduce-seam",
+        "chunk-reassembly-seam",
     }
 
 
@@ -337,7 +353,7 @@ def test_cli_select_and_ignore():
 
 
 def test_library_tree_is_fedlint_clean():
-    """The machine-enforced contract: the whole plane — all eight
+    """The machine-enforced contract: the whole plane — all eleven
     analyzers — is clean on fedml_tpu/ with zero baseline entries."""
     proc = _run_cli()
     assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -35,6 +35,12 @@ AND the hierarchy leg (``tests/test_hierarchy.py -k hierarchy`` — 2- and
 3-level edge-aggregator trees under the full drop/dup/delay/reset chaos
 plan, plus an edge kill mid-round, must close the round BIT-IDENTICALLY
 to the flat topology with exactly-once forward accounting at the root)
+AND the chunked-upload leg (``tests/test_chunking.py -k chunk`` — the
+full drop/dup/delay/reset/torn-frame/``mid_message_disconnect`` plan
+over the ``comm_chunk`` vocabulary plus a server kill BETWEEN chunks of
+live streams must converge BIT-IDENTICALLY to the whole-message run,
+resuming interrupted uploads from the last acked chunk with exactly-once
+replay accounting)
 N consecutive times in
 fresh interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
@@ -71,6 +77,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "elastic or mesh_shrink"
     python tools/chaos_check.py --runs 3 -k "secagg_dropout"
     python tools/chaos_check.py --runs 3 -k "hierarchy"
+    python tools/chaos_check.py --runs 3 -k "chunk"
     python tools/chaos_check.py --runs 3 --skip-perf-gate
     python tools/chaos_check.py --runs 3 --skip-fedlint
 """
@@ -137,11 +144,12 @@ def main(argv=None) -> int:
         "-k", dest="keyword",
         default="chaos or server_kill or trace_integrity or agg_plane "
                 "or async_fl or ingest or telemetry or sharded_state "
-                "or elastic or mesh_shrink or secagg_dropout or hierarchy",
+                "or elastic or mesh_shrink or secagg_dropout or hierarchy "
+                "or chunk",
         help='pytest -k selector (default: "chaos or server_kill or '
              'trace_integrity or agg_plane or async_fl or ingest or '
              'telemetry or sharded_state or elastic or mesh_shrink or '
-             'secagg_dropout or hierarchy")')
+             'secagg_dropout or hierarchy or chunk")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     ap.add_argument("--skip-perf-gate", action="store_true",
@@ -171,7 +179,7 @@ def main(argv=None) -> int:
            "tests/test_obs.py", "tests/test_agg_plane.py",
            "tests/test_async_fl.py", "tests/test_ingest.py",
            "tests/test_telemetry.py", "tests/test_security_plane.py",
-           "tests/test_hierarchy.py",
+           "tests/test_hierarchy.py", "tests/test_chunking.py",
            "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
     for i in range(1, args.runs + 1):
         t0 = time.time()

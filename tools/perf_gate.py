@@ -62,17 +62,21 @@ RELATIVE_KEYS = ("vs_baseline", "agg_speedup", "round_update_speedup",
                  "uploads_per_s_host", "uploads_per_s_pipelined",
                  "async_flushes_per_s", "async_deltas_per_s",
                  "telemetry_rounds_per_s", "defended_round_speedup",
-                 "fanin_uploads_per_s_flat", "fanin_uploads_per_s_edge")
+                 "fanin_uploads_per_s_flat", "fanin_uploads_per_s_edge",
+                 "chunked_goodput_frac_lossy")
 # lower-is-better: absolute cap (observability must stay cheap — spans,
 # registry, exposition, and now the telemetry plane all share the budget)
 OVERHEAD_KEYS = ("obs_overhead_frac", "telemetry_overhead_frac",
-                 "dp_overhead_frac")
+                 "dp_overhead_frac", "chunk_overhead_frac")
 # per-key overrides of --obs-overhead-max: the DP stage pays real compute
 # (per-client clip + counter-based noise over the whole update matrix), so
 # against the small synthetic bench round its frac is a few x, not a few %.
 # The wide cap is a runaway backstop (a recompile-per-round or accidentally
 # quadratic stage); creep is caught by the trajectory band below.
-OVERHEAD_BUDGETS = {"dp_overhead_frac": 25.0}
+# Chunk framing is pure wire bookkeeping — at the bench's representative
+# 64 KiB chunks the headers must stay under 5% of the payload or the
+# resumability win is being eaten by the framing itself.
+OVERHEAD_BUDGETS = {"dp_overhead_frac": 25.0, "chunk_overhead_frac": 0.05}
 # lower-is-better relative keys banded against the prior-round median
 # (elastic resize: downtime of an in-place remesh and its recompile slice
 # must not creep — a topology change should stay a sub-round blip; same
