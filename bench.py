@@ -414,6 +414,8 @@ def _main() -> int | None:
     out.update(_measure_fanin())
     out.update(_measure_async_throughput())
     out.update(_measure_chunked())
+    out.update(_measure_health_overhead())
+    out.update(_measure_round_throughput())
     if os.environ.get("BENCH_SP"):
         out["sp_samples_per_sec"] = round(_measure_sp(args, dataset), 2)
     _emit(out, "full")
@@ -1149,6 +1151,8 @@ def _run_degraded(reason: str) -> int:
     out.update(_measure_async_throughput())
     out.update(_measure_chunked())
     out.update(_measure_telemetry_overhead())
+    out.update(_measure_health_overhead())
+    out.update(_measure_round_throughput())
 
     # obs overhead on the measured path: the same compiled agg step with
     # tracing configured (spans to an in-memory sink, parented under a
@@ -1280,6 +1284,126 @@ def _measure_telemetry_overhead() -> dict:
             obs.shutdown()
         except Exception:
             pass
+        return {}
+
+
+def _measure_health_overhead() -> dict:
+    """Health-plane relative key: the telemetry benchmark's synthetic round
+    (compiled agg step + round span + ``maybe_export_metrics``, which is
+    where the health plane ticks) with ``obs_health`` ON vs the identical
+    loop with it off.  The on leg pays the tap (one dict peek per record),
+    the per-tick registry pulls, and the window/watchdog checks — i.e. the
+    whole liveness plane on the round path.  ``health_overhead_frac``
+    rides the shared obs overhead budget.  Emitted on BOTH the full and
+    degraded lines; failures degrade to empty keys."""
+    import numpy as np
+
+    from fedml_tpu.core import obs
+    from fedml_tpu.parallel.agg_plane import CompiledAggPlane
+
+    import jax
+
+    rounds = int(os.environ.get("BENCH_HEALTH_ROUNDS", "15"))
+
+    def _loop(enabled: bool, plane, updates):
+        class _Args:
+            run_id = "bench_health"
+            obs_health = 1 if enabled else 0
+
+        obs.configure(_Args(), lambda topic, rec: None)
+        try:
+            wd = obs.health_watchdog("bench.round_loop")
+            ts = []
+            for r in range(rounds):
+                t0 = time.perf_counter()
+                wd.beat()
+                with obs.round_span(r, mode="bench_health"):
+                    jax.block_until_ready(plane.aggregate(updates))
+                    obs.health_observe("bench.round_seconds",
+                                       time.perf_counter() - t0)
+                obs.maybe_export_metrics()
+                obs.health_tick()
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+        finally:
+            obs.shutdown()
+
+    try:
+        updates = _synthetic_updates(8)
+        plane = CompiledAggPlane()
+        plane.aggregate(updates)  # compile outside the timed legs
+        on_s = _loop(True, plane, updates)
+        off_s = _loop(False, plane, updates)
+        if on_s <= 0 or off_s <= 0:
+            return {}
+        return {
+            "health_round_s_on": round(on_s, 6),
+            "health_round_s_off": round(off_s, 6),
+            "health_overhead_frac": round(max(on_s - off_s, 0.0) / off_s, 4),
+        }
+    except Exception as e:
+        print(f"health overhead measurement failed: {e}", file=sys.stderr)
+        try:
+            obs.shutdown()
+        except Exception:
+            pass
+        return {}
+
+
+def _measure_round_throughput() -> dict:
+    """Round-throughput trajectory keys: a small SYNC sp FedAvg run
+    (synthetic data, lr model) timed per round — full federated rounds
+    per second and clients simulated per second.  Unlike the
+    samples/s/chip headline these are CPU-cheap and emitted on BOTH
+    metric lines, so the round-orchestration trend (sampling, dispatch,
+    aggregate, eval gating) carries signal through a dark chip window.
+    Failures degrade to empty keys."""
+    try:
+        import numpy as np
+
+        import fedml_tpu
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu.simulation.sp.fedavg.fedavg_api import FedAvgAPI
+
+        clients_per_round = 4
+        cfg = {
+            "common_args": {"training_type": "simulation", "random_seed": 0,
+                            "run_id": "bench_rounds"},
+            "data_args": {"dataset": "mnist", "data_cache_dir": "",
+                          "partition_method": "hetero", "partition_alpha": 0.5,
+                          "synthetic_train_size": 480},
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "federated_optimizer": "FedAvg",
+                "client_num_in_total": 8,
+                "client_num_per_round": clients_per_round,
+                "comm_round": 6,
+                "epochs": 1,
+                "batch_size": 32,
+                "client_optimizer": "sgd",
+                "learning_rate": 0.1,
+            },
+            "validation_args": {"frequency_of_the_test": 100},
+            "comm_args": {"backend": "sp"},
+        }
+        args = fedml_tpu.init(Arguments.from_dict(cfg).validate(),
+                              should_init_logs=False)
+        dataset, out_dim = fedml_tpu.data.load(args)
+        model = fedml_tpu.models.create(args, out_dim)
+        api = FedAvgAPI(args, None, dataset, model)
+        api.train()
+        # median over post-compile rounds: round 0 pays jit + first dispatch
+        times = list(api.round_times)
+        times = times[1:] or times
+        round_s = float(np.median(times))
+        rps = 1.0 / max(round_s, 1e-9)
+        return {
+            "rounds_per_s": round(rps, 3),
+            "clients_simulated_per_s": round(rps * clients_per_round, 3),
+            "round_clients": clients_per_round,
+        }
+    except Exception as e:
+        print(f"round throughput measurement failed: {e}", file=sys.stderr)
         return {}
 
 

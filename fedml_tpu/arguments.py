@@ -206,6 +206,21 @@ Observability knobs (``tracking_args`` or ``obs_args``; consumed by
 * ``obs_telemetry_flush_s`` (float seconds >= 0, default 0) — minimum
   interval between standalone ``telemetry`` flush messages in async
   mode; 0 restricts telemetry to piggybacked blobs only.
+* ``obs_health`` (bool, default False) — the live health & SLO plane
+  (``core/obs/health.py``): watchdogs over every long-lived worker,
+  EWMA/z-score anomaly windows over the SLO series, a ``/healthz``
+  status state machine, and health-triggered flight dumps.  Telemetry
+  only: rounds are bit-identical on or off.
+* ``obs_health_watchdog_s`` (float > 0, default 30) — default heartbeat
+  deadline: an armed watchdog with no beat for this long raises
+  ``health.watchdog_expired`` (subsystems may register tighter or looser
+  per-worker deadlines).
+* ``obs_health_z`` (float > 0, default 4.0) — z-score firing threshold
+  for the rolling anomaly windows.
+* ``obs_health_ewma_alpha`` (float in (0, 1], default 0.3) — EWMA decay
+  for the window mean/variance estimates.
+* ``obs_health_warmup`` (int >= 2, default 8) — samples a window must
+  see before it may fire (cold distributions would z-fire on noise).
 
 Async / buffered-FL knobs (``train_args`` or ``async_args``; consumed by
 ``core/async_fl``, execution model in ``docs/ASYNC.md``):
@@ -651,6 +666,50 @@ class Arguments:
             if fs < 0:
                 raise ValueError(
                     f"obs_telemetry_flush_s must be >= 0 (got {fs})")
+        # health-plane knobs (core/obs/health) — a typo'd threshold must
+        # fail here, not silently run with the default
+        wds = getattr(self, "obs_health_watchdog_s", None)
+        if wds is not None:
+            try:
+                wv = float(wds)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_health_watchdog_s must be a number > 0 "
+                    f"(got {wds!r})")
+            if wv <= 0:
+                raise ValueError(
+                    f"obs_health_watchdog_s must be > 0 (got {wv})")
+        hz = getattr(self, "obs_health_z", None)
+        if hz is not None:
+            try:
+                zv = float(hz)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_health_z must be a number > 0 (got {hz!r})")
+            if zv <= 0:
+                raise ValueError(f"obs_health_z must be > 0 (got {zv})")
+        alpha = getattr(self, "obs_health_ewma_alpha", None)
+        if alpha is not None:
+            try:
+                av = float(alpha)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_health_ewma_alpha must be a number in (0, 1] "
+                    f"(got {alpha!r})")
+            if not 0 < av <= 1:
+                raise ValueError(
+                    f"obs_health_ewma_alpha must be in (0, 1] (got {av})")
+        warm = getattr(self, "obs_health_warmup", None)
+        if warm is not None:
+            try:
+                wv = int(warm)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"obs_health_warmup must be an integer >= 2 "
+                    f"(got {warm!r})")
+            if wv < 2:
+                raise ValueError(
+                    f"obs_health_warmup must be >= 2 (got {wv})")
         # async / buffered-FL knobs (core/async_fl) — a typo'd mode or policy
         # must fail here, not silently run the sync state machine
         mode = getattr(self, "fl_mode", None)

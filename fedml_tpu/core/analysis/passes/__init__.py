@@ -8,6 +8,7 @@ from typing import List
 from ..framework import Analyzer
 from .ack_order import AckDurabilityAnalyzer
 from .chunking import ChunkReassemblySeamAnalyzer
+from .health import HealthSeamAnalyzer
 from .hierarchy import HierarchyReduceSeamAnalyzer
 from .legacy import AggAnalyzer, ObsAnalyzer, PerfAnalyzer, RngAnalyzer
 from .meshguard import MeshStaleProgramAnalyzer
@@ -17,7 +18,7 @@ from .security import SecHostFallbackAnalyzer
 
 __all__ = [
     "AckDurabilityAnalyzer", "AggAnalyzer", "ChunkReassemblySeamAnalyzer",
-    "HierarchyReduceSeamAnalyzer",
+    "HealthSeamAnalyzer", "HierarchyReduceSeamAnalyzer",
     "MeshStaleProgramAnalyzer", "ObsAnalyzer", "PerfAnalyzer",
     "PurityAnalyzer", "RngAnalyzer", "SecHostFallbackAnalyzer",
     "ThreadOwnershipAnalyzer", "build_analyzers",
@@ -38,4 +39,5 @@ def build_analyzers() -> List[Analyzer]:
         SecHostFallbackAnalyzer(),
         HierarchyReduceSeamAnalyzer(),
         ChunkReassemblySeamAnalyzer(),
+        HealthSeamAnalyzer(),
     ]

@@ -1,0 +1,142 @@
+"""Liveness-bookkeeping seam guard for the health & SLO plane.
+
+The health plane stays deterministic and exactly-once because ONE seam
+owns liveness state: ``core/obs/health.py`` holds every watchdog's
+``last_beat``, decides expiry against the injectable clock, and is the
+only place allowed to poll ``Thread.is_alive()``.  A second site that
+keeps its own ``last_heartbeat = time.monotonic()`` or polls thread
+liveness directly forks the plane: its deadline arithmetic runs on the
+wall clock instead of the injected one (the chaos legs stop being
+deterministic), its expiry fires zero or twice instead of once, and its
+verdicts never reach the status machine, the ``health.*`` events, or the
+flight dumps.  Subsystems express liveness ONLY through the facade
+handles — ``obs.health_watchdog(...).beat()/idle()`` and
+``obs.health_silence(...).note()``.
+
+* ``health-seam`` — outside ``core/obs/health.py``: ``is_alive()``
+  polled on a receiver assigned from ``threading.Thread(...)`` in the
+  same file, or a timestamp store into a liveness-named attribute /
+  subscript (``last_beat`` / ``last_heartbeat`` / ``last_seen_ts`` /
+  ``heartbeat_ts``-style names) whose RHS is a clock call
+  (``time.time`` / ``monotonic`` / ``perf_counter``).  Scoped tightly on
+  purpose: ``multiprocessing.Process.is_alive()`` (a *process* health
+  check, e.g. the MPI simulator's), round-number bookkeeping like the
+  population registry's ``last_seen_round = int(round_idx)``, and the
+  deploy daemon's on-disk heartbeat dict are all legitimate and stay
+  clean.  Pragmas require a justification
+  (``# fedlint: allow[health-seam] — ...``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Set
+
+from ..framework import Analyzer, Finding, Rule, SourceFile
+
+# the seam: the only module that may keep liveness clocks or poll threads
+_SEAM_FILES = ("core/obs/health.py",)
+
+# attribute / subscript names that smell like hand-rolled liveness clocks
+_LIVENESS_NAME = re.compile(
+    r"(last_(beat|heartbeat|seen|alive)|heartbeat)", re.IGNORECASE)
+
+# clock calls whose result makes a store a liveness timestamp
+_CLOCK_CALLS = frozenset({"time", "monotonic", "perf_counter"})
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _terminal_name(node.func) in _CLOCK_CALLS)
+
+
+def _store_name(target: ast.AST) -> Optional[str]:
+    """The liveness-relevant name of a store target (plain name, attribute,
+    or the container of a subscript)."""
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    if isinstance(target, ast.Subscript):
+        return _terminal_name(target.value)
+    return None
+
+
+class HealthSeamAnalyzer(Analyzer):
+    """Flags liveness bookkeeping outside the health-plane seam."""
+
+    name = "health"
+    rules = (
+        Rule("health-seam",
+             "thread liveness polled or heartbeat clock kept outside the "
+             "health plane",
+             requires_justification=True, order=0),
+    )
+
+    def _exempt(self, path: str) -> bool:
+        # fixtures opt IN by basename, overriding the path exemption
+        if os.path.basename(path).startswith("health_"):
+            return False
+        norm = os.path.normpath(os.path.abspath(path)).replace(os.sep, "/")
+        return any(norm.endswith(f"/{f}") for f in _SEAM_FILES)
+
+    def _flag(self, findings: List[Finding], src: SourceFile, lineno: int,
+              what: str) -> None:
+        findings.append(self.finding(
+            self.rules[0], src, lineno,
+            f"{what} outside the health seam (core/obs/health.py) — a "
+            "second liveness site runs on the wall clock instead of the "
+            "injected one and its expiry never reaches the status machine "
+            "or the flight dumps; use obs.health_watchdog / "
+            "obs.health_silence or justify"))
+
+    def _thread_names(self, tree: ast.AST) -> Set[str]:
+        """Terminal names assigned from ``threading.Thread(...)`` anywhere
+        in the file (plain names and attribute targets alike)."""
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (isinstance(node.value, ast.Call)
+                    and _terminal_name(node.value.func) == "Thread"):
+                continue
+            for target in node.targets:
+                name = _terminal_name(target)
+                if name:
+                    names.add(name)
+        return names
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if src.tree is None or self._exempt(src.path):
+            return []
+        findings: List[Finding] = []
+        thread_names = self._thread_names(src.tree)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "is_alive":
+                receiver = _terminal_name(node.func.value)
+                if receiver in thread_names:
+                    self._flag(findings, src, node.lineno,
+                               f"'{receiver}.is_alive()' polled on a "
+                               "threading.Thread")
+            elif isinstance(node, ast.Assign) \
+                    and _is_clock_call(node.value):
+                for target in node.targets:
+                    name = _store_name(target)
+                    if name and _LIVENESS_NAME.search(name):
+                        self._flag(findings, src, node.lineno,
+                                   f"heartbeat timestamp stored into "
+                                   f"'{name}'")
+        findings.sort(key=Finding.sort_key)
+        return findings

@@ -331,6 +331,18 @@ class AsyncBufferedServerMixin:
     def _arm_flush_timer(self) -> None:
         if self.async_flush_deadline_s <= 0 or self._finished:
             return
+        # the scheduler's liveness contract: armed while a deadline timer
+        # is outstanding, beaten when it fires — a timer thread that dies
+        # (or never fires) expires the watchdog instead of parking the
+        # buffer forever.  Deadline scales with the flush deadline so a
+        # slow-but-legal cycle never false-positives.
+        wd = getattr(self, "_flush_watchdog", None)
+        if wd is None:
+            wd = obs.health_watchdog(
+                "async.flush",
+                deadline_s=max(5.0, 2.0 * self.async_flush_deadline_s + 1.0))
+            self._flush_watchdog = wd
+        wd.beat()
         self._start_phase_timer("_flush_timer", self._on_flush_deadline,
                                 delay=self.async_flush_deadline_s)
 
@@ -339,8 +351,14 @@ class AsyncBufferedServerMixin:
         if t is not None:
             t.cancel()
             self._flush_timer = None
+        wd = getattr(self, "_flush_watchdog", None)
+        if wd is not None:
+            wd.idle()
 
     def _on_flush_deadline(self, gen: int) -> None:
+        wd = getattr(self, "_flush_watchdog", None)
+        if wd is not None:
+            wd.beat()
         with self._round_lock:
             if self._finished or gen != self._gen:
                 return

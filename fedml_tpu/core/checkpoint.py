@@ -413,6 +413,7 @@ class UpdateJournal:
                 ticket._mark(RuntimeError("journal is closed"))
                 return ticket
             if self._gc_thread is None:
+                self._gc_watchdog = obs.health_watchdog("journal.committer")
                 self._gc_thread = threading.Thread(
                     target=self._commit_loop, daemon=True,
                     name="journal-group-commit")
@@ -448,14 +449,22 @@ class UpdateJournal:
             thread = self._gc_thread
         if thread is not None and thread is not threading.current_thread():
             thread.join(timeout=10.0)
+        wd = getattr(self, "_gc_watchdog", None)
+        if wd is not None:
+            wd.close()
 
     def _commit_loop(self) -> None:
+        wd = getattr(self, "_gc_watchdog", obs.NULL_WATCHDOG)
         while True:
             with self._gc_cond:
+                # disarm across the unbounded idle wait (an empty queue is
+                # not a wedge); re-arm the moment there is work to commit
+                wd.idle()
                 while not self._gc_queue and not self._gc_stop:
                     self._gc_cond.wait()
                 if not self._gc_queue and self._gc_stop:
                     return
+                wd.beat()
                 # window: give concurrent appends a chance to coalesce,
                 # bounded by time, batch size, and urgency (blocking append
                 # or explicit flush must not eat the full window)

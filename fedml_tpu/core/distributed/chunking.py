@@ -208,6 +208,15 @@ class ChunkedSender:
         link = manager._link
         patience = (link.max_retries + 1) * link.backoff_max_s + 2.0
         self._patience_s = max(5.0, patience)
+        # liveness contracts: the pump watchdog proves the per-stream pump
+        # threads are making passes; the stall monitor watches the ack
+        # stream itself (a live pump draining into a dead peer is a stall,
+        # not a wedge — different signal, different reaction)
+        self._watchdog = obs.health_watchdog(
+            f"chunk.pump.rank{manager.rank}")
+        self._stall = obs.health_silence(
+            f"chunk.stream_stall.rank{manager.rank}",
+            max_age_s=self._patience_s)
         link.add_ack_listener(self._on_ack)
 
     def _new_stream_id(self) -> str:
@@ -217,6 +226,7 @@ class ChunkedSender:
 
     # -- link callback -------------------------------------------------------
     def _on_ack(self, msg_id: str, attempts: int, delivered: bool) -> None:
+        self._stall.note()
         finished: Optional[_StreamState] = None
         with self._cond:
             entry = self._inflight.pop(msg_id, None)
@@ -237,8 +247,12 @@ class ChunkedSender:
                     self._stats.inc("chunk_bytes_resent", resent)
             if st.all_sent and st.acked >= st.n and not st.failed:
                 finished = self._streams.pop(stream_id)
+            live = bool(self._streams)
         if finished is not None:
             self._finish_stream(finished)
+        if not live:
+            self._watchdog.idle()
+            self._stall.idle()
 
     def _finish_stream(self, st: _StreamState) -> None:
         self._stats.inc("streams_completed")
@@ -291,6 +305,8 @@ class ChunkedSender:
             self._inflight.clear()
             self._streams.clear()
             self._cond.notify_all()
+        self._watchdog.close()
+        self._stall.close()
 
     # -- stream send ---------------------------------------------------------
     def serialize(self, message: Message) -> bytes:
@@ -320,6 +336,11 @@ class ChunkedSender:
                        node=self._manager.rank, stream=stream_id,
                        n_chunks=len(chunks), total_bytes=len(payload),
                        inner_type=str(message.get_type()), restart=restarts)
+        # arm the contracts from the CALLING thread: a pump that dies
+        # before its first pass still expires, and an ack that never
+        # arrives still reads as a stall
+        self._watchdog.beat()
+        self._stall.note()
         threading.Thread(
             target=self._pump, args=(st, chunks), daemon=True,
             name=f"chunk-pump-rank{self._manager.rank}").start()
@@ -331,10 +352,14 @@ class ChunkedSender:
         stream_id = st.stream_id
         deadline = time.monotonic() + self._patience_s
         for chunk in chunks:
+            self._watchdog.beat()
             with self._cond:
                 while (len([1 for sid, _ in self._inflight.values()
                             if sid == stream_id]) >= self.window
                        and not st.aborted and not self._closed):
+                    # a window-throttled pump is alive (the stall monitor
+                    # owns missing-ack detection); keep the liveness beat
+                    self._watchdog.beat()
                     if time.monotonic() > deadline:
                         # a wedged window (dead peer past retransmit
                         # give-up) must not wedge the round thread forever
@@ -364,8 +389,12 @@ class ChunkedSender:
             st.all_sent = True
             finished = (st.acked >= st.n and not st.failed
                         and self._streams.pop(stream_id, None) is not None)
+            live = bool(self._streams)
         if finished:
             self._finish_stream(st)
+        if not live:
+            self._watchdog.idle()
+            self._stall.idle()
 
 
 # ---------------------------------------------------------------------------

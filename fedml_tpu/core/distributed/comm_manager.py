@@ -348,6 +348,7 @@ class _IngestPipeline:
         self._link = link
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
         self._stop_flag = False
+        self._watchdog = obs.health_watchdog(f"ingest.worker.rank{manager.rank}")
         self._thread = threading.Thread(
             target=self._worker, daemon=True,
             name=f"ingest-rank{manager.rank}")
@@ -362,6 +363,7 @@ class _IngestPipeline:
         self._stop_flag = True  # owned-by: main
         if threading.current_thread() is not self._thread:
             self._thread.join(timeout=10.0)
+        self._watchdog.close()
 
     def _worker(self) -> None:
         # a dying dispatch worker is exactly the crash whose last records
@@ -376,6 +378,10 @@ class _IngestPipeline:
 
     def _worker_loop(self) -> None:
         while True:
+            # the dequeue timeout bounds beat latency, so the watchdog
+            # proves liveness even across idle stretches; a wedged
+            # _dispatch (the hang this guards against) stops the beats
+            self._watchdog.beat()
             try:
                 msg, needs_ack, t_enq = self._queue.get(timeout=0.25)
             except queue.Empty:

@@ -102,6 +102,10 @@ class EdgeAggregator(FedMLCommManager):
         self._totals: Dict[int, Tuple[float, str]] = {}
         self._forwarded: Dict[int, Message] = {}
         self._flush_timers: Dict[int, threading.Timer] = {}
+        # armed while any round is staged: a wedged flush/forward path
+        # (dead timer thread, stuck parent send) expires instead of the
+        # root waiting forever on a mute edge
+        self._watchdog = obs.health_watchdog(f"edge.flush.{edge_id}")
         self.relay = TelemetryRelay()
         self.dup_uploads = 0
         self.dup_forwards = 0
@@ -222,6 +226,7 @@ class EdgeAggregator(FedMLCommManager):
                 t.daemon = True
                 self._flush_timers[r] = t
                 t.start()
+        self._watchdog.beat()
         self._maybe_send_counts(r)
 
     # -- phase A: counts up --------------------------------------------------
@@ -392,6 +397,7 @@ class EdgeAggregator(FedMLCommManager):
                 self._forwarded[r] = msg
         self.send_message(msg)
         obs.counter_inc("hierarchy.forwards")
+        self._watchdog.beat()
 
     def _build_forward(self, r: int) -> Message:
         total, codec = self._totals[r]
@@ -508,8 +514,13 @@ class EdgeAggregator(FedMLCommManager):
                       self._forwarded):
                 d.pop(r, None)
             timer = self._flush_timers.pop(r, None)
+            live = bool(self._staged)
         if timer is not None:
             timer.cancel()
+        if live:
+            self._watchdog.beat()
+        else:
+            self._watchdog.idle()
         if self._journal is not None:
             self._journal.prune_before(r + 1)
 
@@ -519,6 +530,7 @@ class EdgeAggregator(FedMLCommManager):
             self._flush_timers.clear()
         for t in timers:
             t.cancel()
+        self._watchdog.close()
         if self._journal is not None:
             try:
                 self._journal.flush(timeout=10.0)

@@ -66,6 +66,11 @@ class HierarchyRoot:
         self._closed: Dict[int, threading.Event] = {}
         self.dup_forwards = 0
         self.rounds_closed = 0
+        # armed from the first edge message of an open round until every
+        # open round closes: an edge that counted in but never forwards
+        # (killed, wedged, partitioned) surfaces as a health.anomaly
+        # instead of an indefinitely-parked wait_round
+        self._edge_silence = obs.health_silence("hierarchy.edge_silence")
         manager.register_message_receive_handler(
             protocol.HIER_COUNTS, self._handle_counts)
         manager.register_message_receive_handler(
@@ -91,6 +96,7 @@ class HierarchyRoot:
     def _handle_counts(self, msg: Message) -> None:
         r = int(msg.get(protocol.KEY_ROUND))
         child = int(msg.get(protocol.KEY_EDGE))
+        self._edge_silence.note()
         with self._lock:
             counts = self._counts.setdefault(r, {})
             counts[child] = (float(msg.get(protocol.KEY_TOTAL_WEIGHT, 0.0)),
@@ -142,6 +148,7 @@ class HierarchyRoot:
         r = int(msg.get(protocol.KEY_ROUND))
         child = int(msg.get(protocol.KEY_EDGE))
         fwd = str(msg.get(protocol.KEY_FORWARD_ID))
+        self._edge_silence.note()
         with self._lock:
             seen = self._seen_fwd.setdefault(r, set())
             if fwd in seen:
@@ -180,7 +187,10 @@ class HierarchyRoot:
             self._results[r] = (tree, weight, n_clients)
             self.rounds_closed += 1
             ev = self._closed.setdefault(r, threading.Event())
+            open_rounds = any(rr not in self._results for rr in self._counts)
         obs.counter_inc("hierarchy.rounds_closed")
+        if not open_rounds:
+            self._edge_silence.idle()
         if self.on_round is not None:
             try:
                 self.on_round(r, tree, weight, n_clients)

@@ -29,6 +29,17 @@ from typing import Any, Callable, Dict, Optional
 
 from .exposition import MetricsExporter
 from .flight import DEFAULT_FLIGHT_CAPACITY, FlightRecorder
+from .health import (
+    DEFAULT_EWMA_ALPHA,
+    DEFAULT_WATCHDOG_DEADLINE_S,
+    DEFAULT_Z_THRESHOLD,
+    HEALTH_STATUS_GAUGE,
+    NULL_SILENCE,
+    NULL_WATCHDOG,
+    HealthPlane,
+    SilenceMonitor,
+    Watchdog,
+)
 from .metrics import DEFAULT_TIME_BUCKETS, MetricsRegistry
 from .telemetry import (
     DEFAULT_FLUSH_S,
@@ -61,6 +72,10 @@ __all__ = [
     "ClientTelemetry", "TelemetryMerger", "TOPIC_TELEMETRY",
     "telemetry_enabled", "telemetry_flush_s",
     "make_client_telemetry", "make_telemetry_merger",
+    "HealthPlane", "Watchdog", "SilenceMonitor",
+    "NULL_WATCHDOG", "NULL_SILENCE", "HEALTH_STATUS_GAUGE",
+    "health_plane", "health_enabled", "health_watchdog", "health_silence",
+    "health_observe", "health_tick", "health_status",
 ]
 
 _lock = threading.Lock()
@@ -91,10 +106,48 @@ def _tapped_emit(flight: FlightRecorder,
     return tapped
 
 
+def _health_event_emitter(name: str, attrs: Dict[str, Any]) -> None:
+    """The health plane's event sink: a span event anchored on the last
+    round the emit stream saw, so dumps and reports land inside the round
+    tree the incident belongs to."""
+    t = _ctx.get("tracer")
+    if t is None:
+        return
+    plane = _ctx.get("health")
+    ridx = int(getattr(plane, "last_round_idx", 0) or 0) if plane else 0
+    try:
+        t.span_event(name, None, round_idx=ridx, **attrs)
+    except Exception:  # telemetry never raises into the round path
+        pass
+
+
 def configure(args: Any, emit: Callable[[str, Dict[str, Any]], None]) -> None:
     """Enable tracing for this process.  ``emit`` is sink-shaped
     (``(topic, record)``) — ``mlops.init`` passes its fan's emit."""
     run = str(getattr(args, "run_id", "0"))
+    health_obj: Optional[HealthPlane] = None
+    if bool(int(getattr(args, "obs_health", 0) or 0)):
+        try:
+            health_obj = HealthPlane(
+                registry=_registry,
+                clock=getattr(args, "obs_health_clock", None),
+                z_threshold=float(
+                    getattr(args, "obs_health_z", DEFAULT_Z_THRESHOLD)
+                    or DEFAULT_Z_THRESHOLD),
+                ewma_alpha=float(
+                    getattr(args, "obs_health_ewma_alpha", DEFAULT_EWMA_ALPHA)
+                    or DEFAULT_EWMA_ALPHA),
+                watchdog_deadline_s=float(
+                    getattr(args, "obs_health_watchdog_s",
+                            DEFAULT_WATCHDOG_DEADLINE_S)
+                    or DEFAULT_WATCHDOG_DEADLINE_S),
+                warmup=int(getattr(args, "obs_health_warmup", 8) or 8))
+            # health tap wrapped FIRST so the flight tap stays outermost:
+            # flight records (and dump-triggers on) every record,
+            # including the plane's own events
+            emit = health_obj.tap(emit)
+        except Exception:  # health misconfig must not take the run down
+            health_obj = None
     flight: Optional[FlightRecorder] = None
     cap = int(getattr(args, "obs_flight_capacity", DEFAULT_FLIGHT_CAPACITY)
               or 0)
@@ -104,6 +157,10 @@ def configure(args: Any, emit: Callable[[str, Dict[str, Any]], None]) -> None:
             directory=getattr(args, "obs_flight_dir", None) or None,
             run_id=run)
         emit = _tapped_emit(flight, emit)
+        if health_obj is not None:
+            plane = health_obj
+            flight.add_meta_provider(
+                lambda: {"health": plane.snapshot_compact()})
     exporter_obj: Optional[MetricsExporter] = None
     port = getattr(args, "obs_export_port", None)
     path = getattr(args, "obs_export_path", None) or None
@@ -112,11 +169,19 @@ def configure(args: Any, emit: Callable[[str, Dict[str, Any]], None]) -> None:
         try:
             exporter_obj = MetricsExporter(
                 _registry, port=port if port > 0 else None,
-                snapshot_path=path).start()
+                snapshot_path=path,
+                health_provider=(health_obj.snapshot
+                                 if health_obj is not None else None),
+            ).start()
         except Exception:  # a taken port must not take the run down
             exporter_obj = None
+    if (health_obj is not None and exporter_obj is not None
+            and exporter_obj.serve_thread is not None):
+        health_obj.register("obs.exporter",
+                            thread=exporter_obj.serve_thread)
     with _lock:
         _ctx.update(
+            health=health_obj,
             enabled=True,
             run_id=run,
             emit=emit,
@@ -135,6 +200,8 @@ def configure(args: Any, emit: Callable[[str, Dict[str, Any]], None]) -> None:
                 getattr(args, "obs_telemetry_flush_s", DEFAULT_FLUSH_S)
                 or DEFAULT_FLUSH_S),
         )
+    if health_obj is not None:
+        health_obj.emitter = _health_event_emitter
     _register_compile_listener()
 
 
@@ -193,6 +260,70 @@ def flight_dump(reason: str) -> Optional[str]:
 
 def exporter() -> Optional[MetricsExporter]:
     return _ctx.get("exporter")
+
+
+# -- live health & SLO plane -------------------------------------------------
+
+def health_plane() -> Optional[HealthPlane]:
+    return _ctx.get("health")
+
+
+def health_enabled() -> bool:
+    return _ctx.get("health") is not None
+
+
+def health_status() -> str:
+    plane = _ctx.get("health")
+    return plane.status if plane is not None else "ok"
+
+
+def health_watchdog(name: str, deadline_s: Optional[float] = None,
+                    thread: Any = None):
+    """Register a named liveness watchdog for a long-lived worker; returns
+    a handle whose ``beat`` / ``idle`` / ``close`` are no-ops when the
+    health plane is off, so worker loops call them unconditionally."""
+    plane = _ctx.get("health")
+    if plane is None:
+        return NULL_WATCHDOG
+    try:
+        return plane.register(name, deadline_s=deadline_s, thread=thread)
+    except Exception:
+        return NULL_WATCHDOG
+
+
+def health_silence(series: str, max_age_s: Optional[float] = None):
+    """The silence monitor for an expected activity stream (chunk acks,
+    edge forwards); ``note()`` marks activity, a tick finds the stall."""
+    plane = _ctx.get("health")
+    if plane is None:
+        return NULL_SILENCE
+    try:
+        return plane.silence(series, max_age_s=max_age_s)
+    except Exception:
+        return NULL_SILENCE
+
+
+def health_observe(series: str, value: float) -> None:
+    """Push one sample into a rolling SLO window (no-op with health off)."""
+    plane = _ctx.get("health")
+    if plane is not None:
+        try:
+            plane.observe(series, value)
+        except Exception:
+            pass
+
+
+def health_tick() -> Optional[str]:
+    """Run the health checks now; returns the status, or None when the
+    plane is off.  Round-close paths get this for free via
+    :func:`maybe_export_metrics`."""
+    plane = _ctx.get("health")
+    if plane is None:
+        return None
+    try:
+        return plane.tick()
+    except Exception:
+        return None
 
 
 # -- cross-host telemetry plane ---------------------------------------------
@@ -378,6 +509,7 @@ def maybe_export_metrics() -> bool:
     if emit is None:
         return False
     sample_resource_gauges()
+    health_tick()
     did = _registry.maybe_export(emit, float(_ctx.get("export_interval_s", 0)))
     if did:
         exporter_obj = _ctx.get("exporter")

@@ -28,6 +28,7 @@ stays accounted by bench.py's obs-overhead keys.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import threading
@@ -212,16 +213,24 @@ class MetricsExporter:
     ``port``: None disables HTTP; 0 binds an ephemeral localhost port
     (tests); >0 binds that port.  ``snapshot_path``: None disables file
     snapshots.  Both render the *live* registry at request/snapshot time.
-    ``shutdown`` is idempotent and safe to call without ``start``.
+    ``health_provider``: optional zero-arg callable returning the health
+    plane's snapshot dict; when set, ``GET /healthz`` serves it as JSON
+    with 200 for ok/degraded and 503 for critical (external probes key on
+    the code, dashboards on the body), and every file snapshot — including
+    the final one ``shutdown`` writes — gets a ``<snapshot_path>.health.json``
+    sibling.  ``shutdown`` is idempotent and safe to call without
+    ``start``.
     """
 
     def __init__(self, registry: MetricsRegistry,
                  port: Optional[int] = None,
                  snapshot_path: Optional[str] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 health_provider: Optional[Any] = None):
         self._registry = registry
         self._requested_port = port
         self.snapshot_path = str(snapshot_path) if snapshot_path else None
+        self.health_provider = health_provider
         self.host = host
         self.port: Optional[int] = None
         self._server: Any = None
@@ -235,10 +244,15 @@ class MetricsExporter:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         registry = self._registry
+        exporter = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 - stdlib API
-                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                route = self.path.split("?", 1)[0]
+                if route == "/healthz":
+                    self._serve_healthz()
+                    return
+                if route not in ("/", "/metrics"):
                     self.send_error(404)
                     return
                 try:
@@ -248,6 +262,26 @@ class MetricsExporter:
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _serve_healthz(self) -> None:
+                provider = exporter.health_provider
+                if provider is None:
+                    self.send_error(404, "no health plane configured")
+                    return
+                try:
+                    snap = provider()
+                except Exception as e:
+                    self.send_error(500, str(e))
+                    return
+                status = str(snap.get("status", "ok"))
+                code = 503 if status == "critical" else 200
+                body = json.dumps(snap, sort_keys=True,
+                                  default=str).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -271,13 +305,35 @@ class MetricsExporter:
             return None
         return f"http://{self.host}:{self.port}/metrics"
 
+    @property
+    def serve_thread(self) -> Optional[threading.Thread]:
+        """The HTTP serve thread (the health plane registers a thread-mode
+        watchdog on it), or None when HTTP is off."""
+        return self._thread
+
+    @property
+    def health_snapshot_path(self) -> Optional[str]:
+        if self.snapshot_path is None or self.health_provider is None:
+            return None
+        return self.snapshot_path + ".health.json"
+
     def snapshot(self) -> Optional[str]:
         """Atomic file snapshot of the current rendering (or None when file
-        snapshots are off)."""
+        snapshots are off); with a health provider attached, also refreshes
+        the sibling health-snapshot JSON."""
         if self.snapshot_path is None:
             return None
         _atomic_write_text(self.snapshot_path,
                            render_openmetrics(self._registry))
+        hpath = self.health_snapshot_path
+        if hpath is not None:
+            try:
+                snap = self.health_provider()
+                _atomic_write_text(
+                    hpath, json.dumps(snap, sort_keys=True, default=str,
+                                      indent=1) + "\n")
+            except Exception:  # health snapshot is best-effort telemetry
+                pass
         return self.snapshot_path
 
     def shutdown(self) -> None:

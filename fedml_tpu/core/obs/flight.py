@@ -41,9 +41,13 @@ DEFAULT_FLIGHT_CAPACITY = 2048
 # (device_loss: the elastic topology fault — the ring around a lost chip is
 # exactly the forensic window a remesh post-mortem needs;
 # mid_message_disconnect / truncated_frame: the chunked-upload faults — the
-# ring holds the chunk spans showing where in the stream the link died)
+# ring holds the chunk spans showing where in the stream the link died;
+# health.watchdog_expired / health.anomaly: the health plane's reactions —
+# a wedged worker or an out-of-band SLO series dumps the window that led
+# up to it, with the health snapshot riding the dump meta)
 DUMP_EVENTS = ("server_kill", "server_restore", "slow_round", "device_loss",
-               "mid_message_disconnect", "truncated_frame")
+               "mid_message_disconnect", "truncated_frame",
+               "health.watchdog_expired", "health.anomaly")
 
 # hard cap on dumps per recorder: a slow-round storm must not turn the
 # flight recorder into a disk-filling firehose
@@ -100,10 +104,19 @@ class FlightRecorder:
         self._dropped = 0      # records aged out of the ring
         self._n_dumps = 0
         self._last_dump_path: Optional[str] = None
-        # optional zero-arg callable returning extra dict keys for the dump
-        # meta line (the telemetry merger hangs its merge counters here);
-        # failures are swallowed — meta enrichment must not cost a dump
+        # optional zero-arg callables returning extra dict keys for the dump
+        # meta line (the telemetry merger hangs its merge counters on the
+        # legacy single-slot attribute; the health plane adds its snapshot
+        # via add_meta_provider); failures are swallowed — meta enrichment
+        # must not cost a dump
         self.meta_provider = None
+        self._meta_providers: List[Any] = []
+
+    def add_meta_provider(self, provider: Any) -> None:
+        """Register an additional dump-meta provider (zero-arg callable
+        returning a dict); composes with the legacy single-slot
+        ``meta_provider`` attribute, earlier keys winning ties."""
+        self._meta_providers.append(provider)
 
     # -- recording -----------------------------------------------------------
     def record(self, topic: str, rec: Dict[str, Any]) -> Optional[str]:
@@ -157,8 +170,9 @@ class FlightRecorder:
             "run_id": self.run_id, "seq": seq, "n_records": len(records),
             "capacity": self.capacity, "dropped": dropped,
         }
-        provider = self.meta_provider
-        if provider is not None:
+        for provider in [self.meta_provider] + list(self._meta_providers):
+            if provider is None:
+                continue
             try:
                 extra = provider()
                 if isinstance(extra, dict):

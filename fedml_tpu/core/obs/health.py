@@ -1,0 +1,604 @@
+"""Live health & SLO plane: watchdogs, rolling anomaly windows, reactions.
+
+Everything under ``core/obs`` so far is post-hoc: traces, metrics, and the
+flight recorder tell you what happened after the round closes — or never,
+if a long-lived worker wedges.  This module is the real-time half: a
+:class:`HealthPlane` that rides the existing emit tap and MetricsRegistry
+and maintains three kinds of live state:
+
+* **Watchdogs** — every long-lived worker (ingest dispatch worker, journal
+  group-commit committer, chunk pump threads, edge flush loop, async flush
+  scheduler, metrics exporter thread) registers a named :class:`Watchdog`
+  and calls ``beat()`` from its loop.  A heartbeat-mode watchdog expires
+  when it is *armed* and no beat has landed within ``deadline_s`` on the
+  plane's clock; a thread-mode watchdog (for workers that legitimately
+  block forever, like the exporter's ``serve_forever``) expires the moment
+  its thread is no longer alive.  Expiry raises a
+  ``health.watchdog_expired`` span event — a dump trigger — instead of the
+  round silently hanging.  ``idle()`` disarms (a committer waiting on an
+  empty queue is not wedged); a beat after expiry emits
+  ``health.watchdog_recovered``.
+* **Rolling SLO windows** — EWMA mean/variance per series with z-score
+  firing (``|x - μ| / σ > z`` after ``warmup`` samples).  Feeds come from
+  the emit tap (round span durations), explicit ``observe()`` calls, and
+  per-tick registry pulls (``ingest.queue_depth`` gauge,
+  ``journal.fsync_seconds`` / ``round.seconds`` histogram delta means,
+  straggler fraction from the population counters).  A window fires a
+  structured ``health.anomaly`` event ONCE on the transition out of band
+  and re-arms only after ``recover_ticks`` consecutive in-band samples —
+  one flight dump per incident, not one per sample.
+* **Silence monitors** — the inverse of a heartbeat: ``note()`` marks
+  activity (a chunk ack, an edge forward) and a tick finds the age past
+  ``max_age_s`` while armed, firing a ``health.anomaly`` with
+  ``kind="silence"`` (chunk-stream stall, mute edge aggregator).
+
+A tick folds all three into a :data:`STATUS_OK` / :data:`STATUS_DEGRADED`
+/ :data:`STATUS_CRITICAL` state machine (critical = any expired watchdog;
+degraded = any firing window or silence; recovery requires
+``recover_ticks`` clean ticks), mirrored to the ``fedml_health_status``
+gauge and the exporter's ``/healthz`` endpoint.  Status transitions emit
+``health.status`` events.
+
+Determinism: the plane holds NO thread of its own.  All checks run inside
+``tick()`` on whatever thread calls it (the round-close
+``maybe_export_metrics`` path in production, the test body under a
+:class:`~fedml_tpu.core.async_fl.clock.ManualClock` in chaos legs), and
+all time arithmetic uses the injected clock — so every expiry and anomaly
+in the chaos plan fires on an exact schedule.  Everything here is
+telemetry: events are annotations, emission failures are swallowed, and
+with ``obs_health`` off the facade hands out :data:`NULL_WATCHDOG` /
+:data:`NULL_SILENCE` so call sites stay branch-free and the run is
+bit-identical.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..async_fl.clock import MonotonicClock
+
+STATUS_OK = "ok"
+STATUS_DEGRADED = "degraded"
+STATUS_CRITICAL = "critical"
+STATUS_CODE = {STATUS_OK: 0, STATUS_DEGRADED: 1, STATUS_CRITICAL: 2}
+
+# the exposition gauge name (already exposition-legal: no sanitizing drift
+# between the registry name and the scrape name)
+HEALTH_STATUS_GAUGE = "fedml_health_status"
+
+# span-event names; the first two are flight-dump triggers (DUMP_EVENTS)
+EVENT_WATCHDOG_EXPIRED = "health.watchdog_expired"
+EVENT_ANOMALY = "health.anomaly"
+EVENT_WATCHDOG_RECOVERED = "health.watchdog_recovered"
+EVENT_RECOVERED = "health.recovered"
+EVENT_STATUS = "health.status"
+
+DEFAULT_WATCHDOG_DEADLINE_S = 30.0
+DEFAULT_Z_THRESHOLD = 4.0
+DEFAULT_EWMA_ALPHA = 0.3
+DEFAULT_WARMUP_SAMPLES = 8
+DEFAULT_RECOVER_TICKS = 3
+
+# keep an unemittable backlog bounded when no emitter is attached yet
+# (standalone plane in tests, configure() mid-flight)
+_MAX_PENDING = 256
+
+
+class _NullHandle:
+    """The disabled fast path: ``beat`` / ``idle`` / ``note`` / ``close``
+    are all no-ops, so wired subsystems never branch on whether the health
+    plane is configured."""
+
+    name = ""
+
+    def beat(self) -> None:
+        pass
+
+    def idle(self) -> None:
+        pass
+
+    def note(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+NULL_WATCHDOG = _NullHandle()
+NULL_SILENCE = _NullHandle()
+
+
+class Watchdog:
+    """One registered liveness contract.  Heartbeat mode (``thread`` is
+    None): expired iff armed and the last beat is older than
+    ``deadline_s``.  Thread mode: expired iff the registered thread is no
+    longer alive — for workers whose loop legitimately blocks forever.
+    All mutation goes through the owning plane (one lock, events drained
+    outside it)."""
+
+    __slots__ = ("name", "deadline_s", "thread", "armed", "last_beat",
+                 "expired", "expirations", "closed", "_plane")
+
+    def __init__(self, plane: "HealthPlane", name: str, deadline_s: float,
+                 thread: Optional[threading.Thread] = None):
+        self._plane = plane
+        self.name = str(name)
+        self.deadline_s = float(deadline_s)
+        self.thread = thread
+        self.armed = thread is not None  # thread mode is always armed
+        self.last_beat: Optional[float] = None
+        self.expired = False
+        self.expirations = 0
+        self.closed = False
+
+    def beat(self) -> None:
+        self._plane._beat(self)
+
+    def idle(self) -> None:
+        self._plane._idle(self)
+
+    def close(self) -> None:
+        self._plane._close_watchdog(self)
+
+    @property
+    def mode(self) -> str:
+        return "thread" if self.thread is not None else "heartbeat"
+
+
+class SilenceMonitor:
+    """Fires a ``health.anomaly`` (``kind="silence"``) when an expected
+    activity stream goes quiet for more than ``max_age_s`` while armed."""
+
+    __slots__ = ("series", "max_age_s", "armed", "firing", "last_note",
+                 "fired", "closed", "_plane")
+
+    def __init__(self, plane: "HealthPlane", series: str, max_age_s: float):
+        self._plane = plane
+        self.series = str(series)
+        self.max_age_s = float(max_age_s)
+        self.armed = False
+        self.firing = False
+        self.last_note: Optional[float] = None
+        self.fired = 0
+        self.closed = False
+
+    def note(self) -> None:
+        self._plane._note(self)
+
+    def idle(self) -> None:
+        self._plane._silence_idle(self)
+
+    def close(self) -> None:
+        self._plane._close_silence(self)
+
+
+class _Window:
+    """EWMA mean/variance over one series with z-score firing."""
+
+    __slots__ = ("series", "n", "mean", "var", "last", "firing", "clean",
+                 "fired")
+
+    def __init__(self, series: str):
+        self.series = str(series)
+        self.n = 0
+        self.mean = 0.0
+        self.var = 0.0
+        self.last = 0.0
+        self.firing = False
+        self.clean = 0
+        self.fired = 0
+
+    def std(self) -> float:
+        return math.sqrt(self.var) if self.var > 0 else 0.0
+
+
+class HealthPlane:
+    """The live health state machine.  Passive: no threads, no timers —
+    ``tick()`` (round-close cadence in production, explicit in tests) is
+    the only place watchdogs/silences are checked and status recomputed,
+    which is what makes the chaos legs deterministic under a ManualClock.
+
+    ``emitter`` is a ``(event_name, attrs_dict) -> None`` callable the obs
+    facade points at the tracer; events raised while it is unset queue (up
+    to a bound) and drain on the next call."""
+
+    def __init__(self, registry: Any = None, clock: Any = None, *,
+                 z_threshold: float = DEFAULT_Z_THRESHOLD,
+                 ewma_alpha: float = DEFAULT_EWMA_ALPHA,
+                 watchdog_deadline_s: float = DEFAULT_WATCHDOG_DEADLINE_S,
+                 warmup: int = DEFAULT_WARMUP_SAMPLES,
+                 recover_ticks: int = DEFAULT_RECOVER_TICKS):
+        if not (z_threshold > 0):
+            raise ValueError(f"z_threshold must be > 0, got {z_threshold}")
+        if not (0 < ewma_alpha <= 1):
+            raise ValueError(
+                f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        if not (watchdog_deadline_s > 0):
+            raise ValueError(
+                f"watchdog_deadline_s must be > 0, got {watchdog_deadline_s}")
+        self._registry = registry
+        self.clock = clock if clock is not None else MonotonicClock()
+        self.z_threshold = float(z_threshold)
+        self.ewma_alpha = float(ewma_alpha)
+        self.watchdog_deadline_s = float(watchdog_deadline_s)
+        self.warmup = max(2, int(warmup))
+        self.recover_ticks = max(1, int(recover_ticks))
+        self.emitter: Optional[Callable[[str, Dict[str, Any]], None]] = None
+        self._lock = threading.Lock()
+        self._watchdogs: Dict[str, Watchdog] = {}
+        self._silences: Dict[str, SilenceMonitor] = {}
+        self._windows: Dict[str, _Window] = {}
+        self._pending: List[Tuple[str, Dict[str, Any]]] = []
+        self._status = STATUS_OK
+        self._clean_streak = 0
+        self._ticks = 0
+        self.events_emitted = 0
+        self.last_round_idx = 0
+        # per-tick delta cursors for the registry feeds
+        self._hist_cursor: Dict[str, Tuple[float, float]] = {}
+        self._pop_cursor = (0.0, 0.0)  # (invited, reported)
+
+    # -- registration --------------------------------------------------------
+    def register(self, name: str, deadline_s: Optional[float] = None,
+                 thread: Optional[threading.Thread] = None) -> Watchdog:
+        """Register (or re-register) the named watchdog.  Re-registration
+        replaces the old handle — a restarted worker gets a fresh,
+        unexpired contract."""
+        wd = Watchdog(self, str(name),
+                      self.watchdog_deadline_s if deadline_s is None
+                      else float(deadline_s),
+                      thread=thread)
+        now = self.clock.now()
+        with self._lock:
+            wd.last_beat = now
+            self._watchdogs[wd.name] = wd
+        return wd
+
+    def silence(self, series: str,
+                max_age_s: Optional[float] = None) -> SilenceMonitor:
+        """The silence monitor for ``series`` (created on first use, shared
+        after — multiple producers may ``note()`` the same stream)."""
+        key = str(series)
+        with self._lock:
+            mon = self._silences.get(key)
+            if mon is None or mon.closed:
+                mon = SilenceMonitor(
+                    self, key,
+                    self.watchdog_deadline_s if max_age_s is None
+                    else float(max_age_s))
+                self._silences[key] = mon
+            return mon
+
+    # -- watchdog mutations (called via the handle) --------------------------
+    def _beat(self, wd: Watchdog) -> None:
+        now = self.clock.now()
+        with self._lock:
+            if wd.closed:
+                return
+            wd.last_beat = now
+            if wd.thread is None:
+                wd.armed = True
+            if wd.expired:
+                wd.expired = False
+                self._queue(EVENT_WATCHDOG_RECOVERED,
+                            {"watchdog": wd.name, "mode": wd.mode})
+        self._drain()
+
+    def _idle(self, wd: Watchdog) -> None:
+        with self._lock:
+            if wd.closed or wd.thread is not None:
+                return  # thread mode has no idle state
+            wd.armed = False
+            wd.expired = False
+
+    def _close_watchdog(self, wd: Watchdog) -> None:
+        with self._lock:
+            wd.closed = True
+            wd.armed = False
+            wd.expired = False
+            if self._watchdogs.get(wd.name) is wd:
+                del self._watchdogs[wd.name]
+
+    # -- silence mutations ---------------------------------------------------
+    def _note(self, mon: SilenceMonitor) -> None:
+        now = self.clock.now()
+        with self._lock:
+            if mon.closed:
+                return
+            mon.last_note = now
+            mon.armed = True
+            if mon.firing:
+                mon.firing = False
+                self._queue(EVENT_RECOVERED,
+                            {"series": mon.series, "kind": "silence"})
+        self._drain()
+
+    def _silence_idle(self, mon: SilenceMonitor) -> None:
+        with self._lock:
+            mon.armed = False
+            mon.firing = False
+
+    def _close_silence(self, mon: SilenceMonitor) -> None:
+        with self._lock:
+            mon.closed = True
+            mon.armed = False
+            mon.firing = False
+            if self._silences.get(mon.series) is mon:
+                del self._silences[mon.series]
+
+    # -- rolling windows -----------------------------------------------------
+    def observe(self, series: str, value: float) -> None:
+        """Push one sample into ``series``'s EWMA window (creating it on
+        first sight); may fire a ``health.anomaly`` on the out-of-band
+        transition."""
+        with self._lock:
+            self._observe_locked(str(series), float(value))
+        self._drain()
+
+    def _observe_locked(self, series: str, value: float) -> None:
+        w = self._windows.get(series)
+        if w is None:
+            w = _Window(series)
+            self._windows[series] = w
+        w.last = value
+        out = False
+        if w.n >= self.warmup:
+            std = w.std()
+            z = (value - w.mean) / std if std > 0 else 0.0
+            out = abs(z) > self.z_threshold
+            if out and not w.firing:
+                w.firing = True
+                w.clean = 0
+                w.fired += 1
+                self._queue(EVENT_ANOMALY, {
+                    "series": series, "kind": "zscore",
+                    "value": round(value, 6), "z": round(z, 3),
+                    "mean": round(w.mean, 6), "std": round(std, 6),
+                    "n": w.n, "threshold": self.z_threshold,
+                })
+            elif w.firing:
+                if out:
+                    w.clean = 0
+                else:
+                    w.clean += 1
+                    if w.clean >= self.recover_ticks:
+                        w.firing = False
+                        self._queue(EVENT_RECOVERED,
+                                    {"series": series, "kind": "zscore"})
+        # EWMA update AFTER the test: the anomalous sample still folds in,
+        # so a sustained level shift becomes the new normal and recovers
+        d = value - w.mean
+        w.mean += self.ewma_alpha * d
+        w.var = (1.0 - self.ewma_alpha) * (w.var + self.ewma_alpha * d * d)
+        w.n += 1
+
+    # -- the emit tap --------------------------------------------------------
+    def tap(self, emit: Callable[[str, Dict[str, Any]], None]
+            ) -> Callable[[str, Dict[str, Any]], None]:
+        """Wrap a sink emit so the plane sees every record (round-span
+        durations feed the latency window; round_idx anchors health
+        events).  The plane's OWN events pass through unobserved —
+        that, plus atomic pending-drains, is what keeps the tap
+        reentrancy-safe when a drain fires mid-emit."""
+        def health_tapped(topic: str, rec: Dict[str, Any]) -> None:
+            try:
+                self.observe_record(topic, rec)
+            except Exception:  # telemetry never blocks the sink
+                pass
+            emit(topic, rec)
+        return health_tapped
+
+    def observe_record(self, topic: str, rec: Dict[str, Any]) -> None:
+        """One emit-stream record: feed the windows it maps to."""
+        if topic == "span_event" and str(rec.get("event", "")).startswith(
+                "health."):
+            return
+        ridx = rec.get("round_idx")
+        if ridx is not None:
+            try:
+                self.last_round_idx = int(ridx)
+            except (TypeError, ValueError):
+                pass
+        if topic == "span_end" and rec.get("name") == "round":
+            dur = rec.get("duration_s")
+            if dur is not None:
+                self.observe("round.seconds", float(dur))
+
+    # -- registry feeds (pulled per tick) ------------------------------------
+    def _pull_registry_feeds(self) -> List[Tuple[str, float]]:
+        reg = self._registry
+        if reg is None:
+            return []
+        out: List[Tuple[str, float]] = []
+        try:
+            if reg.series_count("ingest.queue_depth"):
+                out.append(("ingest.queue_depth",
+                            float(reg.get_gauge("ingest.queue_depth"))))
+            for hist in ("journal.fsync_seconds", "round.seconds"):
+                h = reg.get_histogram(hist)
+                if h is None:
+                    continue
+                prev_sum, prev_count = self._hist_cursor.get(hist, (0.0, 0.0))
+                d_count = h["count"] - prev_count
+                if d_count > 0:
+                    mean = (h["sum"] - prev_sum) / d_count
+                    # the tap already feeds round.seconds from span ends;
+                    # the histogram delta covers the sims that only
+                    # observe the metric — same series, same unit
+                    out.append((hist, float(mean)))
+                self._hist_cursor[hist] = (h["sum"], h["count"])
+            invited = float(reg.get_counter("population.invited"))
+            reported = float(reg.get_counter("population.reported"))
+            p_inv, p_rep = self._pop_cursor
+            d_inv, d_rep = invited - p_inv, reported - p_rep
+            if d_inv > 0:
+                out.append(("straggler.fraction",
+                            max(0.0, (d_inv - d_rep) / d_inv)))
+                self._pop_cursor = (invited, reported)
+        except Exception:  # a torn registry read must not kill the tick
+            pass
+        return out
+
+    # -- the tick ------------------------------------------------------------
+    def tick(self) -> str:
+        """Run every check against ``clock.now()``: registry feeds,
+        watchdog deadlines, silence ages, then the status fold.  Returns
+        the (possibly new) status."""
+        feeds = self._pull_registry_feeds()
+        now = self.clock.now()
+        with self._lock:
+            for series, value in feeds:
+                self._observe_locked(series, value)
+            for wd in list(self._watchdogs.values()):
+                if wd.thread is not None:
+                    if wd.thread.is_alive():
+                        wd.last_beat = now
+                    elif not wd.expired:
+                        wd.expired = True
+                        wd.expirations += 1
+                        self._queue(EVENT_WATCHDOG_EXPIRED, {
+                            "watchdog": wd.name, "mode": "thread",
+                            "deadline_s": wd.deadline_s,
+                        })
+                elif (wd.armed and not wd.expired
+                        and wd.last_beat is not None
+                        and now - wd.last_beat > wd.deadline_s):
+                    wd.expired = True
+                    wd.expirations += 1
+                    self._queue(EVENT_WATCHDOG_EXPIRED, {
+                        "watchdog": wd.name, "mode": "heartbeat",
+                        "age_s": round(now - wd.last_beat, 6),
+                        "deadline_s": wd.deadline_s,
+                    })
+            for mon in list(self._silences.values()):
+                if (mon.armed and not mon.firing
+                        and mon.last_note is not None
+                        and now - mon.last_note > mon.max_age_s):
+                    mon.firing = True
+                    mon.fired += 1
+                    self._queue(EVENT_ANOMALY, {
+                        "series": mon.series, "kind": "silence",
+                        "age_s": round(now - mon.last_note, 6),
+                        "max_age_s": mon.max_age_s,
+                    })
+            self._ticks += 1
+            status = self._fold_status_locked()
+        if self._registry is not None:
+            try:
+                self._registry.gauge_set(HEALTH_STATUS_GAUGE,
+                                         float(STATUS_CODE[status]))
+            except Exception:
+                pass
+        self._drain()
+        return status
+
+    def _fold_status_locked(self) -> str:
+        if any(wd.expired for wd in self._watchdogs.values()):
+            target = STATUS_CRITICAL
+        elif (any(w.firing for w in self._windows.values())
+                or any(m.firing for m in self._silences.values())):
+            target = STATUS_DEGRADED
+        else:
+            target = STATUS_OK
+        cur = self._status
+        if STATUS_CODE[target] >= STATUS_CODE[cur]:
+            self._clean_streak = 0
+            new = target
+        else:
+            # recovery hysteresis: hold the worse status until
+            # recover_ticks consecutive clean ticks
+            self._clean_streak += 1
+            new = target if self._clean_streak >= self.recover_ticks else cur
+            if new != cur:
+                self._clean_streak = 0
+        if new != cur:
+            self._queue(EVENT_STATUS, {
+                "from": cur, "to": new, "code": STATUS_CODE[new]})
+            self._status = new
+        return self._status
+
+    # -- event plumbing ------------------------------------------------------
+    def _queue(self, name: str, attrs: Dict[str, Any]) -> None:
+        # caller holds self._lock
+        if len(self._pending) >= _MAX_PENDING:
+            del self._pending[0]
+        self._pending.append((name, attrs))
+
+    def _drain(self) -> None:
+        emitter = self.emitter
+        if emitter is None:
+            return
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                batch, self._pending = self._pending, []
+            for name, attrs in batch:
+                try:
+                    emitter(name, attrs)
+                except Exception:
+                    pass
+                self.events_emitted += 1
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def status(self) -> str:
+        return self._status
+
+    @property
+    def status_code(self) -> int:
+        return STATUS_CODE[self._status]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The full health state (the report tool's input and the
+        exporter's ``/healthz`` + final-snapshot body)."""
+        now = self.clock.now()
+        with self._lock:
+            watchdogs = {
+                wd.name: {
+                    "mode": wd.mode, "armed": wd.armed,
+                    "expired": wd.expired, "expirations": wd.expirations,
+                    "deadline_s": wd.deadline_s,
+                    "last_beat_age_s": (None if wd.last_beat is None
+                                        else round(now - wd.last_beat, 6)),
+                } for wd in self._watchdogs.values()}
+            silences = {
+                m.series: {
+                    "armed": m.armed, "firing": m.firing, "fired": m.fired,
+                    "max_age_s": m.max_age_s,
+                    "age_s": (None if m.last_note is None
+                              else round(now - m.last_note, 6)),
+                } for m in self._silences.values()}
+            windows = {
+                w.series: {
+                    "n": w.n, "mean": round(w.mean, 6),
+                    "std": round(w.std(), 6), "last": round(w.last, 6),
+                    "firing": w.firing, "fired": w.fired,
+                } for w in self._windows.values()}
+            return {
+                "schema": "fedml-health-1",
+                "status": self._status,
+                "status_code": STATUS_CODE[self._status],
+                "ticks": self._ticks,
+                "events_emitted": self.events_emitted,
+                "watchdogs": watchdogs,
+                "silences": silences,
+                "windows": windows,
+            }
+
+    def snapshot_compact(self) -> Dict[str, Any]:
+        """The few keys worth spending flight-dump meta bytes on."""
+        with self._lock:
+            return {
+                "status": self._status,
+                "status_code": STATUS_CODE[self._status],
+                "ticks": self._ticks,
+                "expired_watchdogs": sorted(
+                    wd.name for wd in self._watchdogs.values() if wd.expired),
+                "firing_series": sorted(
+                    [w.series for w in self._windows.values() if w.firing]
+                    + [m.series for m in self._silences.values()
+                       if m.firing]),
+            }

@@ -1,0 +1,44 @@
+"""health-seam fixture: hand-rolled liveness bookkeeping outside the seam.
+
+Expected findings (pinned exactly by tests/test_fedlint.py):
+
+* line 17 — health-seam: ``heartbeat timestamp stored into 'last_beat'``
+* line 22 — health-seam: ``heartbeat timestamp stored into 'last_heartbeat'``
+* line 27 — health-seam: ``'_worker.is_alive()' polled on a threading.Thread``
+* line 30 — health-seam (subscript store through a clock call)
+
+and the NON-findings that pin the scoping: a non-Thread ``is_alive()``
+(a *process* health check), a round-number ``last_seen_round`` store,
+and a justified pragma.
+"""
+import threading
+import time
+
+last_beat = time.monotonic()  # plain-name clock store: hand-rolled liveness
+
+
+class _Pump:
+    def __init__(self):
+        self.last_heartbeat = time.time()  # attribute clock store
+        self._worker = threading.Thread(target=lambda: None)
+        self._proc = FakeProcess()
+
+    def wedged(self, table):
+        alive = self._worker.is_alive()  # thread liveness poll
+        # subscript clock store into a liveness-named table
+        heartbeat = table
+        heartbeat["pump"] = time.perf_counter()
+        return alive
+
+    def fine(self, registry, round_idx):
+        ok = self._proc.is_alive()  # Process, not Thread: NOT a finding
+        # round-number bookkeeping, no clock on the RHS: NOT a finding
+        registry.last_seen_round = int(round_idx)
+        # justified escape hatch: NOT a finding
+        self.last_heartbeat = time.time()  # fedlint: allow[health-seam] — fixture demonstrates the pragma
+        return ok
+
+
+class FakeProcess:
+    def is_alive(self):
+        return True

@@ -163,6 +163,24 @@ def test_chunk_seam_fixture_exact_findings():
     ]
 
 
+def test_health_seam_fixture_exact_findings():
+    """The health-plane satellite: hand-rolled liveness bookkeeping —
+    a heartbeat timestamp stored through a clock call (plain name,
+    attribute, or subscript) or ``is_alive()`` polled on a
+    ``threading.Thread`` — outside core/obs/health.py is a finding: a
+    second liveness site runs on the wall clock instead of the injected
+    one and its expiry never reaches the status machine or the flight
+    dumps.  The non-Thread ``is_alive()`` (a process health check), the
+    round-number ``last_seen_round`` store, and the justified pragma
+    stay clean."""
+    assert _lint_fixture("health_seam.py") == [
+        (17, "health-seam"),
+        (22, "health-seam"),
+        (27, "health-seam"),
+        (30, "health-seam"),
+    ]
+
+
 def test_legacy_shims_catch_alias_dodges():
     """The four legacy CLIs ride the same AST passes now, so the alias
     dodges are caught through the old entry points too."""
@@ -321,7 +339,7 @@ def test_cli_json_schema_is_stable():
         "suppressed",
         "version",
     ]
-    assert report["counts"]["findings"] == len(report["findings"]) == 25
+    assert report["counts"]["findings"] == len(report["findings"]) == 29
     first = report["findings"][0]
     assert sorted(first.keys()) >= ["analyzer", "line", "message", "path", "rule", "source"]
     assert {f["rule"] for f in report["findings"]} >= {
@@ -332,6 +350,7 @@ def test_cli_json_schema_is_stable():
         "sec-host-fallback",
         "hierarchy-reduce-seam",
         "chunk-reassembly-seam",
+        "health-seam",
     }
 
 

@@ -1,0 +1,574 @@
+"""The live health & SLO plane (``fedml_tpu.core.obs.health``).
+
+Four strata, mirroring the plane's contract:
+
+* **Unit** — watchdog arm/beat/idle/expire-once/recover semantics under a
+  ManualClock (heartbeat AND thread mode), z-score windows firing exactly
+  once per incident and re-arming after clean samples, silence monitors,
+  and the ok/degraded/critical status machine's recovery hysteresis.
+* **Chaos** — the acceptance claim, wired into ``tools/chaos_check.py``'s
+  ``health`` leg: an injected ingest-queue stall, a killed chunk-pump
+  thread, and a silent edge aggregator each fire the RIGHT detector on an
+  exact deterministic schedule (the injected clock decides, never the
+  wall clock), each incident triggers EXACTLY ONE flight dump carrying
+  the health snapshot in its meta, and enabling ``obs_health`` leaves a
+  fault-free run's final model bit-identical with every round's span
+  tree still closed.
+* **Exposition** — ``/healthz`` returns 200/ok and 503/critical, the
+  exporter's (idempotent) shutdown writes a final health snapshot next
+  to the metrics snapshot, and ``fedml_health_status`` lands in the
+  registry.
+* **Report** — ``tools/health_report.py`` renders live snapshots and
+  health-triggered flight dumps, and ``--assert-healthy`` gates on the
+  status.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import health_report
+
+import test_fault_tolerance as _ft
+from fedml_tpu.core import mlops, obs
+from fedml_tpu.core.async_fl.clock import ManualClock
+from fedml_tpu.core.distributed.communication.loopback import LoopbackHub
+from fedml_tpu.core.distributed.communication.message import Message
+from fedml_tpu.core.hierarchy import protocol as hier_protocol
+from fedml_tpu.core.hierarchy.root import HierarchyRoot
+from fedml_tpu.core.mlops import FanoutSink, InMemorySink
+from fedml_tpu.core.obs import MetricsRegistry
+from fedml_tpu.core.obs.exposition import MetricsExporter
+from fedml_tpu.core.obs.health import (
+    EVENT_ANOMALY,
+    EVENT_RECOVERED,
+    EVENT_STATUS,
+    EVENT_WATCHDOG_EXPIRED,
+    EVENT_WATCHDOG_RECOVERED,
+    HEALTH_STATUS_GAUGE,
+    HealthPlane,
+)
+
+
+@pytest.fixture(autouse=True)
+def _obs_hygiene():
+    """obs state is process-global: every test leaves it disabled and the
+    registry empty so no other module inherits a live health plane."""
+    yield
+    obs.shutdown()
+    obs.registry().reset()
+
+
+def _plane(clock=None, registry=None, **kw):
+    """A standalone plane with a collecting emitter: (plane, clock, events)."""
+    clock = clock or ManualClock()
+    kw.setdefault("watchdog_deadline_s", 5.0)
+    kw.setdefault("warmup", 4)
+    p = HealthPlane(registry=registry, clock=clock, **kw)
+    events = []
+    p.emitter = lambda name, attrs: events.append((name, dict(attrs)))
+    return p, clock, events
+
+
+def _names(events):
+    return [name for name, _ in events]
+
+
+# ---------------------------------------------------------------------------
+# Unit: watchdogs
+# ---------------------------------------------------------------------------
+
+class TestHealthWatchdog:
+    def test_health_watchdog_expires_once_at_the_deadline_and_recovers(self):
+        p, clk, events = _plane()
+        wd = p.register("worker", deadline_s=3.0)
+        wd.beat()
+        clk.advance(2.9)
+        assert p.tick() == "ok"          # inside the deadline: quiet
+        clk.advance(0.2)                 # now 3.1s since the beat
+        assert p.tick() == "critical"
+        clk.advance(50.0)
+        p.tick()
+        p.tick()                         # stays expired, fires NO second event
+        assert _names(events).count(EVENT_WATCHDOG_EXPIRED) == 1
+        assert wd.expirations == 1
+        wd.beat()                        # the worker comes back
+        assert EVENT_WATCHDOG_RECOVERED in _names(events)
+        assert not wd.expired
+
+    def test_health_watchdog_idle_disarms_the_contract(self):
+        # the journal committer parks on an empty queue for unbounded time:
+        # idle() means "not wedged, just nothing to do"
+        p, clk, events = _plane()
+        wd = p.register("journal.committer", deadline_s=2.0)
+        wd.beat()
+        wd.idle()
+        clk.advance(1000.0)
+        assert p.tick() == "ok"
+        assert events == []
+        wd.beat()                        # work arrived: re-armed
+        clk.advance(3.0)
+        assert p.tick() == "critical"
+
+    def test_health_thread_mode_watchdog_fires_when_thread_dies(self):
+        import threading
+
+        p, clk, events = _plane()
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        wd = p.register("obs.exporter", thread=t)
+        assert wd.mode == "thread"
+        assert p.tick() == "critical"
+        p.tick()
+        assert _names(events).count(EVENT_WATCHDOG_EXPIRED) == 1
+        expired = dict(events[_names(events).index(EVENT_WATCHDOG_EXPIRED)][1])
+        assert expired["watchdog"] == "obs.exporter"
+        assert expired["mode"] == "thread"
+
+    def test_health_reregistration_resets_the_contract(self):
+        p, clk, events = _plane()
+        wd = p.register("pump", deadline_s=1.0)
+        wd.beat()
+        clk.advance(2.0)
+        assert p.tick() == "critical"
+        p.register("pump", deadline_s=1.0)  # a restarted worker re-registers
+        assert p.snapshot()["watchdogs"]["pump"]["expired"] is False
+
+
+# ---------------------------------------------------------------------------
+# Unit: rolling windows + silences
+# ---------------------------------------------------------------------------
+
+class TestHealthWindows:
+    def test_health_zscore_fires_once_per_incident_and_rearms(self):
+        p, clk, events = _plane(z_threshold=4.0, ewma_alpha=0.3,
+                                warmup=4, recover_ticks=2)
+        for _ in range(6):
+            p.observe("round.seconds", 1.0)
+        assert events == []              # a flat series never fires
+        p.observe("round.seconds", 100.0)
+        anomalies = [a for n, a in events if n == EVENT_ANOMALY]
+        assert len(anomalies) == 1
+        a = anomalies[0]
+        assert a["series"] == "round.seconds" and a["kind"] == "zscore"
+        assert abs(a["z"]) > 4.0
+        p.observe("round.seconds", 100.0)  # still out of band: no re-fire
+        assert _names(events).count(EVENT_ANOMALY) == 1
+        # recover_ticks in-band samples re-arm the window...
+        # (the EWMA folded the spike in, so "in band" is near the new mean)
+        snap = p.snapshot()["windows"]["round.seconds"]
+        for _ in range(4):
+            p.observe("round.seconds", snap["mean"])
+            snap = p.snapshot()["windows"]["round.seconds"]
+        assert [n for n, _ in events].count(EVENT_RECOVERED) == 1
+        assert not p.snapshot()["windows"]["round.seconds"]["firing"]
+
+    def test_health_level_shift_becomes_the_new_normal(self):
+        # anomalous samples still fold into the EWMA: a sustained shift
+        # fires once, then converges instead of alarming forever
+        p, clk, events = _plane(warmup=4, recover_ticks=3)
+        for _ in range(6):
+            p.observe("s", 1.0)
+        for _ in range(40):
+            p.observe("s", 10.0)
+        assert _names(events).count(EVENT_ANOMALY) == 1
+        assert _names(events).count(EVENT_RECOVERED) == 1
+
+    def test_health_silence_monitor_fires_on_stall_and_recovers(self):
+        p, clk, events = _plane()
+        mon = p.silence("chunk.stream_stall", max_age_s=4.0)
+        clk.advance(100.0)
+        assert p.tick() == "ok"          # never noted: not armed, no alarm
+        mon.note()
+        clk.advance(3.9)
+        assert p.tick() == "ok"
+        clk.advance(0.2)
+        assert p.tick() == "degraded"
+        p.tick()
+        anomalies = [a for n, a in events if n == EVENT_ANOMALY]
+        assert len(anomalies) == 1 and anomalies[0]["kind"] == "silence"
+        mon.note()                       # activity resumes
+        assert EVENT_RECOVERED in _names(events)
+        mon.idle()                       # stream closed: disarm entirely
+        clk.advance(100.0)
+        p.tick()
+        assert _names(events).count(EVENT_ANOMALY) == 1
+
+
+# ---------------------------------------------------------------------------
+# Unit: the status machine
+# ---------------------------------------------------------------------------
+
+class TestHealthStatus:
+    def test_health_status_hysteresis_and_transition_events(self):
+        p, clk, events = _plane(recover_ticks=3)
+        wd = p.register("w", deadline_s=1.0)
+        wd.beat()
+        clk.advance(2.0)
+        assert p.tick() == "critical"
+        wd.beat()                        # recovered, but the status holds
+        assert p.tick() == "critical"
+        assert p.tick() == "critical"
+        assert p.tick() == "ok"          # third clean tick releases it
+        statuses = [a for n, a in events if n == EVENT_STATUS]
+        assert [(s["from"], s["to"]) for s in statuses] == [
+            ("ok", "critical"), ("critical", "ok")]
+
+    def test_health_status_gauge_mirrors_the_code(self):
+        reg = MetricsRegistry()
+        p, clk, _ = _plane(registry=reg)
+        wd = p.register("w", deadline_s=1.0)
+        wd.beat()
+        p.tick()
+        assert reg.get_gauge(HEALTH_STATUS_GAUGE) == 0.0
+        clk.advance(2.0)
+        p.tick()
+        assert reg.get_gauge(HEALTH_STATUS_GAUGE) == 2.0
+
+    def test_health_snapshot_shapes(self):
+        p, clk, _ = _plane()
+        p.register("w").beat()
+        p.silence("s", max_age_s=2.0).note()
+        p.observe("x", 1.0)
+        p.tick()
+        snap = p.snapshot()
+        assert snap["schema"] == "fedml-health-1"
+        assert snap["status"] in ("ok", "degraded", "critical")
+        assert snap["watchdogs"]["w"]["mode"] == "heartbeat"
+        assert snap["silences"]["s"]["armed"] is True
+        assert snap["windows"]["x"]["n"] == 1
+        compact = p.snapshot_compact()
+        assert set(compact) == {"status", "status_code", "ticks",
+                                "expired_watchdogs", "firing_series"}
+
+
+# ---------------------------------------------------------------------------
+# Facade: off = null handles, bit-identical; knobs validated
+# ---------------------------------------------------------------------------
+
+class TestHealthFacade:
+    def test_health_off_hands_out_null_handles(self):
+        assert obs.health_enabled() is False
+        assert obs.health_status() == "ok"
+        assert obs.health_tick() is None
+        wd = obs.health_watchdog("anything")
+        mon = obs.health_silence("anything")
+        assert wd is obs.NULL_WATCHDOG and mon is obs.NULL_SILENCE
+        wd.beat(); wd.idle(); wd.close()     # all free no-ops
+        mon.note(); mon.idle(); mon.close()
+        obs.health_observe("x", 1.0)
+
+    def test_health_configured_from_args_with_injected_clock(self):
+        clk = ManualClock()
+        args = types.SimpleNamespace(
+            run_id="h", obs_health=1, obs_health_clock=clk,
+            obs_health_watchdog_s=2.0, obs_health_warmup=3)
+        obs.configure(args, lambda t, rec: None)
+        try:
+            plane = obs.health_plane()
+            assert plane is not None and plane.clock is clk
+            assert plane.watchdog_deadline_s == 2.0
+            wd = obs.health_watchdog("w")
+            wd.beat()
+            clk.advance(3.0)
+            assert obs.health_tick() == "critical"
+            assert obs.health_status() == "critical"
+        finally:
+            obs.shutdown()
+        assert obs.health_enabled() is False
+
+    def test_health_knobs_validated(self):
+        from test_obs import _knob_args
+
+        _knob_args(obs_health=True, obs_health_watchdog_s=10.0,
+                   obs_health_z=3.0, obs_health_ewma_alpha=0.2,
+                   obs_health_warmup=4).validate()
+        for bad in (dict(obs_health_watchdog_s=0),
+                    dict(obs_health_watchdog_s="soon"),
+                    dict(obs_health_z=-1),
+                    dict(obs_health_ewma_alpha=0.0),
+                    dict(obs_health_ewma_alpha=1.5),
+                    dict(obs_health_warmup=1)):
+            with pytest.raises(ValueError):
+                _knob_args(**bad).validate()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: each injected failure fires the right detector, exactly one dump
+# ---------------------------------------------------------------------------
+
+def _health_obs(tmp_path, clk, **over):
+    """Configure the full facade: health plane on the injected clock, flight
+    recorder dumping into ``tmp_path``, records collected in-memory."""
+    recs = []
+    kw = dict(run_id="h-chaos", obs_health=1, obs_health_clock=clk,
+              obs_health_warmup=4, obs_flight_dir=str(tmp_path))
+    kw.update(over)
+    obs.configure(types.SimpleNamespace(**kw),
+                  lambda t, rec: recs.append((t, dict(rec))))
+    return recs
+
+
+def _dumps(tmp_path):
+    return sorted(p for p in os.listdir(tmp_path) if p.startswith("flight-"))
+
+
+def test_health_chaos_ingest_queue_stall_fires_anomaly_and_one_dump(tmp_path):
+    """An ingest dispatch stall: the io→dispatch queue depth (normally ~0,
+    drained as fast as it fills) climbs without bound.  The rolling window
+    over the ``ingest.queue_depth`` gauge fires ONE ``health.anomaly``,
+    which triggers ONE flight dump carrying the health snapshot."""
+    clk = ManualClock()
+    _health_obs(tmp_path, clk)
+    try:
+        for _ in range(6):               # steady state: queue near-empty
+            obs.gauge_set("ingest.queue_depth", 1.0)
+            clk.advance(1.0)
+            assert obs.health_tick() == "ok"
+        obs.gauge_set("ingest.queue_depth", 500.0)   # the stall
+        clk.advance(1.0)
+        assert obs.health_tick() == "degraded"
+        assert len(_dumps(tmp_path)) == 1
+        for _ in range(3):               # still stalled: no dump storm
+            clk.advance(1.0)
+            obs.health_tick()
+        assert len(_dumps(tmp_path)) == 1
+        dump = os.path.join(tmp_path, _dumps(tmp_path)[0])
+        assert "health.anomaly" in dump
+        view = health_report.load_input(dump)
+        assert view["snapshot"]["status"] == "degraded"
+        assert "ingest.queue_depth" in view["snapshot"]["firing_series"]
+        assert any(e.get("event") == EVENT_ANOMALY for e in view["events"])
+    finally:
+        obs.shutdown()
+
+
+def test_health_chaos_killed_pump_thread_expires_watchdog_one_dump(
+        tmp_path, monkeypatch):
+    """A chunk pump thread killed before its first pass: ``send()`` armed
+    the watchdog from the calling thread, so the dead pump expires at its
+    exact deadline on the injected clock — one ``health.watchdog_expired``,
+    one flight dump — while the ack-stall monitor (a different detector on
+    a longer fuse) stays quiet."""
+    from fedml_tpu.core.distributed.chunking import ChunkedSender
+    from test_chunking import _FakeTxManager, _inner_msg
+
+    clk = ManualClock()
+    _health_obs(tmp_path, clk, obs_health_watchdog_s=3.0)
+    try:
+        monkeypatch.setattr(ChunkedSender, "_pump",
+                            lambda self, st, chunks: None)  # killed at birth
+        tx = ChunkedSender(_FakeTxManager(), chunk_bytes=64, window=2)
+        assert tx.send(_inner_msg(payload=b"x" * 400)) is True
+        clk.advance(2.9)
+        assert obs.health_tick() == "ok"     # inside the deadline
+        clk.advance(1.0)                     # 3.9s: past 3.0, before the
+        assert obs.health_tick() == "critical"   # 5.0s stall fuse
+        for _ in range(3):
+            obs.health_tick()
+        dumps = _dumps(tmp_path)
+        assert len(dumps) == 1 and "health.watchdog_expired" in dumps[0]
+        view = health_report.load_input(os.path.join(tmp_path, dumps[0]))
+        assert view["snapshot"]["expired_watchdogs"] == ["chunk.pump.rank7"]
+        assert view["snapshot"]["firing_series"] == []   # stall stayed quiet
+    finally:
+        obs.shutdown()
+
+
+def test_health_chaos_silent_edge_fires_silence_anomaly_one_dump(tmp_path):
+    """An edge that counted into the round but never forwards (killed,
+    wedged, partitioned): the root's ``hierarchy.edge_silence`` monitor
+    fires ONE silence anomaly at the deterministic max-age instead of
+    ``wait_round`` parking forever — and ONE flight dump records it."""
+    clk = ManualClock()
+    _health_obs(tmp_path, clk, obs_health_watchdog_s=6.0)
+    try:
+        mgr = types.SimpleNamespace(
+            args=types.SimpleNamespace(federated_optimizer="FedAvg"),
+            register_message_receive_handler=lambda t, fn: None,
+            get_sender_id=lambda: 0,
+            send_message=lambda m: None)
+        root = HierarchyRoot(mgr, plan=None, child_ranks={0: 1, 1: 2})
+        counts = Message(hier_protocol.HIER_COUNTS, 1, 0)
+        counts.add_params(hier_protocol.KEY_ROUND, 0)
+        counts.add_params(hier_protocol.KEY_EDGE, 0)
+        counts.add_params(hier_protocol.KEY_TOTAL_WEIGHT, 10.0)
+        counts.add_params(hier_protocol.KEY_N_CLIENTS, 2)
+        counts.add_params(hier_protocol.KEY_OFFERS, "none")
+        root._handle_counts(counts)      # edge 0 checks in... then silence
+        clk.advance(5.9)
+        assert obs.health_tick() == "ok"
+        clk.advance(0.2)
+        assert obs.health_tick() == "degraded"
+        for _ in range(3):
+            clk.advance(1.0)
+            obs.health_tick()
+        dumps = _dumps(tmp_path)
+        assert len(dumps) == 1 and "health.anomaly" in dumps[0]
+        view = health_report.load_input(os.path.join(tmp_path, dumps[0]))
+        assert "hierarchy.edge_silence" in view["snapshot"]["firing_series"]
+    finally:
+        obs.shutdown()
+
+
+@contextlib.contextmanager
+def _traced_health(run_id):
+    mem = InMemorySink()
+    args = types.SimpleNamespace(run_id=run_id, obs_trace=True, obs_health=1,
+                                 rank=0)
+    mlops.init(args, FanoutSink([mem]))
+    try:
+        yield mem
+    finally:
+        mlops.finish()
+
+
+def test_health_convergence_bit_identical_on_off_and_traces_closed():
+    """Correctness half of the overhead budget: a fault-free topology run
+    with the health plane ON converges to the BIT-IDENTICAL final model of
+    a plane-off run, and every round still closes as one span tree
+    (``trace_report --assert-closed`` semantics stay green)."""
+    from test_obs import _assert_rounds_closed
+
+    LoopbackHub.reset()
+    _, final_off, _ = _ft._run_chaos_topology("health-off", knobs={})
+    assert obs.enabled() is False
+    with _traced_health("health-on") as mem:
+        history, final_on, _ = _ft._run_chaos_topology("health-on", knobs={})
+        assert len(history) == 2
+        assert obs.health_enabled() is True
+        assert obs.health_status() == "ok"
+    assert _ft._trees_bit_identical(final_off, final_on)
+    _assert_rounds_closed(mem, "health-on", 2)
+
+
+# ---------------------------------------------------------------------------
+# Exposition: /healthz + the final health snapshot
+# ---------------------------------------------------------------------------
+
+class TestHealthz:
+    def test_healthz_200_ok_then_503_critical(self):
+        import urllib.error
+        import urllib.request
+
+        p, clk, _ = _plane()
+        exp = MetricsExporter(MetricsRegistry(), port=0,
+                              health_provider=p.snapshot).start()
+        try:
+            url = exp.url.replace("/metrics", "/healthz")
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200
+                body = json.loads(resp.read().decode("utf-8"))
+            assert body["status"] == "ok"
+            wd = p.register("w", deadline_s=1.0)
+            wd.beat()
+            clk.advance(2.0)
+            p.tick()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(url, timeout=5)
+            assert exc.value.code == 503
+            assert json.loads(exc.value.read().decode("utf-8"))[
+                "status"] == "critical"
+        finally:
+            exp.shutdown()
+
+    def test_healthz_404_without_a_plane(self):
+        import urllib.error
+        import urllib.request
+
+        exp = MetricsExporter(MetricsRegistry(), port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    exp.url.replace("/metrics", "/healthz"), timeout=5)
+            assert exc.value.code == 404
+        finally:
+            exp.shutdown()
+
+    def test_health_final_snapshot_on_idempotent_shutdown(self, tmp_path):
+        snap = tmp_path / "metrics.prom"
+        p, clk, _ = _plane()
+        p.register("w").beat()
+        p.tick()
+        exp = MetricsExporter(MetricsRegistry(), snapshot_path=str(snap),
+                              health_provider=p.snapshot).start()
+        assert exp.health_snapshot_path == str(snap) + ".health.json"
+        exp.shutdown()
+        exp.shutdown()                    # second shutdown: no-op, no raise
+        health = json.loads((tmp_path / "metrics.prom.health.json")
+                            .read_text())
+        assert health["schema"] == "fedml-health-1"
+        assert "w" in health["watchdogs"]
+
+
+# ---------------------------------------------------------------------------
+# Report: tools/health_report.py
+# ---------------------------------------------------------------------------
+
+class TestHealthReport:
+    def _snap_file(self, tmp_path, plane):
+        path = tmp_path / "snap.health.json"
+        path.write_text(json.dumps(plane.snapshot()))
+        return str(path)
+
+    def test_health_report_renders_snapshot(self, tmp_path, capsys):
+        p, clk, _ = _plane()
+        wd = p.register("ingest.worker.rank0", deadline_s=2.0)
+        wd.beat()
+        clk.advance(3.0)
+        p.tick()
+        rc = health_report.main([self._snap_file(tmp_path, p)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "health status: CRITICAL" in out
+        assert "ingest.worker.rank0" in out and "EXPIRED" in out
+
+    def test_health_report_assert_healthy_gates(self, tmp_path, capsys):
+        p, clk, _ = _plane()
+        assert health_report.main(
+            [self._snap_file(tmp_path, p), "--assert-healthy"]) == 0
+        wd = p.register("w", deadline_s=1.0)
+        wd.beat()
+        clk.advance(2.0)
+        p.tick()
+        assert health_report.main(
+            [self._snap_file(tmp_path, p), "--assert-healthy"]) == 1
+        capsys.readouterr()
+
+    def test_health_report_json_mode(self, tmp_path, capsys):
+        p, clk, _ = _plane()
+        p.tick()
+        rc = health_report.main([self._snap_file(tmp_path, p), "--json"])
+        assert rc == 0
+        view = json.loads(capsys.readouterr().out)
+        assert view["status"] == "ok" and view["source"] == "snapshot"
+
+    def test_health_report_reads_health_triggered_dump(self, tmp_path,
+                                                       capsys):
+        clk = ManualClock()
+        _health_obs(tmp_path, clk, obs_health_watchdog_s=2.0)
+        try:
+            wd = obs.health_watchdog("edge.flush.3")
+            wd.beat()
+            clk.advance(3.0)
+            obs.health_tick()
+        finally:
+            obs.shutdown()
+        dump = os.path.join(tmp_path, _dumps(tmp_path)[0])
+        rc = health_report.main([dump])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "health status: CRITICAL" in out
+        assert "health.watchdog_expired" in out
+        assert health_report.main([dump, "--assert-healthy"]) == 1
+        capsys.readouterr()

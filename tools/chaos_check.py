@@ -40,7 +40,12 @@ full drop/dup/delay/reset/torn-frame/``mid_message_disconnect`` plan
 over the ``comm_chunk`` vocabulary plus a server kill BETWEEN chunks of
 live streams must converge BIT-IDENTICALLY to the whole-message run,
 resuming interrupted uploads from the last acked chunk with exactly-once
-replay accounting)
+replay accounting) AND the health leg (``tests/test_health.py -k health``
+— an injected ingest-queue stall, a killed chunk-pump thread, and a
+silent edge aggregator must each fire the RIGHT detector at its exact
+deadline on the injected clock with EXACTLY ONE flight dump per
+incident, and a fault-free run with ``obs_health=1`` must converge
+bit-identical to the plane-off run with every round's span tree closed)
 N consecutive times in
 fresh interpreter processes and fails on the FIRST non-green run.
 A fault-injection suite that only mostly passes is worse than none —
@@ -78,6 +83,7 @@ Usage::
     python tools/chaos_check.py --runs 3 -k "secagg_dropout"
     python tools/chaos_check.py --runs 3 -k "hierarchy"
     python tools/chaos_check.py --runs 3 -k "chunk"
+    python tools/chaos_check.py --runs 3 -k "health"
     python tools/chaos_check.py --runs 3 --skip-perf-gate
     python tools/chaos_check.py --runs 3 --skip-fedlint
 """
@@ -145,11 +151,11 @@ def main(argv=None) -> int:
         default="chaos or server_kill or trace_integrity or agg_plane "
                 "or async_fl or ingest or telemetry or sharded_state "
                 "or elastic or mesh_shrink or secagg_dropout or hierarchy "
-                "or chunk",
+                "or chunk or health",
         help='pytest -k selector (default: "chaos or server_kill or '
              'trace_integrity or agg_plane or async_fl or ingest or '
              'telemetry or sharded_state or elastic or mesh_shrink or '
-             'secagg_dropout or hierarchy or chunk")')
+             'secagg_dropout or hierarchy or chunk or health")')
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run wall-clock bound in seconds")
     ap.add_argument("--skip-perf-gate", action="store_true",
@@ -180,6 +186,7 @@ def main(argv=None) -> int:
            "tests/test_async_fl.py", "tests/test_ingest.py",
            "tests/test_telemetry.py", "tests/test_security_plane.py",
            "tests/test_hierarchy.py", "tests/test_chunking.py",
+           "tests/test_health.py",
            "-q", "-k", args.keyword, "-p", "no:cacheprovider"]
     for i in range(1, args.runs + 1):
         t0 = time.time()

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Render the live health & SLO plane's state — snapshot or flight dump.
+
+Two input shapes, sniffed automatically:
+
+* a **health snapshot JSON** — what the MetricsExporter writes next to its
+  OpenMetrics snapshot (``<obs_export_path>.health.json``, refreshed on
+  every export and finalized at shutdown) and what ``GET /healthz``
+  serves;
+* a **flight dump** — a crc-framed JSONL ring written by the flight
+  recorder.  Health-triggered dumps carry the plane's compact snapshot on
+  the ``flight_meta`` line, and the ring itself holds the ``health.*``
+  span events (anomalies, expirations, status transitions) leading up to
+  the trigger.  Torn/corrupt lines are dropped, never fatal — same
+  tolerance as ``FlightRecorder.load``.
+
+The report shows the current status, every firing anomaly (z-score
+windows and silence monitors), and the watchdog table with per-component
+last-heartbeat age.  ``--assert-healthy`` is the CI gate: exit 1 unless
+the status is ``ok``.  ``--json`` emits the merged machine-readable view
+instead of text.
+
+Usage::
+
+    python tools/health_report.py metrics.prom.health.json
+    python tools/health_report.py flight-run-001-health.anomaly.jsonl
+    python tools/health_report.py snap.health.json --assert-healthy
+    python tools/health_report.py snap.health.json --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from fedml_tpu.core.obs.flight import parse_line  # noqa: E402
+
+
+def load_input(path: str) -> Dict[str, Any]:
+    """``{"snapshot": {...} | None, "events": [...], "source": ...}`` from
+    either input shape."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        try:
+            snap = json.loads(text)
+        except ValueError as e:
+            raise SystemExit(f"error: {path}: not valid JSON ({e})")
+        if not isinstance(snap, dict):
+            raise SystemExit(f"error: {path}: expected a JSON object")
+        return {"snapshot": snap, "events": [], "source": "snapshot",
+                "n_bad_lines": 0}
+    # crc-framed flight dump: meta line first, ring records after
+    snap: Optional[Dict[str, Any]] = None
+    events: List[Dict[str, Any]] = []
+    n_bad = 0
+    reason = None
+    for line in text.splitlines():
+        line = line.rstrip("\n")
+        if not line:
+            continue
+        rec = parse_line(line)
+        if rec is None:
+            n_bad += 1
+            continue
+        topic = rec.get("topic")
+        if topic == "flight_meta":
+            reason = rec.get("reason")
+            health = rec.get("health")
+            if isinstance(health, dict):
+                snap = health
+        elif (topic == "span_event"
+                and str(rec.get("event", "")).startswith("health.")):
+            events.append(rec)
+    return {"snapshot": snap, "events": events, "source": "flight_dump",
+            "reason": reason, "n_bad_lines": n_bad}
+
+
+def _status_of(view: Dict[str, Any]) -> str:
+    snap = view.get("snapshot") or {}
+    status = snap.get("status")
+    if status is not None:
+        return str(status)
+    # dump without a health meta (pre-health build, or non-health trigger):
+    # infer the worst status the ring's events describe
+    worst = "ok"
+    for ev in view.get("events", ()):
+        name = str(ev.get("event", ""))
+        if name == "health.watchdog_expired":
+            worst = "critical"
+        elif name == "health.anomaly" and worst == "ok":
+            worst = "degraded"
+        elif name == "health.status":
+            worst = str(ev.get("to", worst))
+    return worst
+
+
+def _fmt_age(age: Any) -> str:
+    if age is None:
+        return "-"
+    return f"{float(age):8.2f}s"
+
+
+def render_text(view: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    status = _status_of(view)
+    lines.append(f"health status: {status.upper()}")
+    if view["source"] == "flight_dump":
+        lines.append(f"source: flight dump (reason={view.get('reason')!r}, "
+                     f"{view['n_bad_lines']} torn lines dropped)")
+    snap = view.get("snapshot") or {}
+    watchdogs = snap.get("watchdogs") or {}
+    if watchdogs:
+        lines.append("")
+        lines.append("watchdogs (component · mode · last-beat age · "
+                     "deadline · state):")
+        for name in sorted(watchdogs):
+            wd = watchdogs[name]
+            state = ("EXPIRED" if wd.get("expired")
+                     else ("armed" if wd.get("armed") else "idle"))
+            lines.append(
+                f"  {name:<28} {wd.get('mode', '?'):<9} "
+                f"{_fmt_age(wd.get('last_beat_age_s'))} "
+                f"{float(wd.get('deadline_s', 0)):7.1f}s  {state}")
+    firing: List[str] = []
+    for series, w in sorted((snap.get("windows") or {}).items()):
+        if w.get("firing"):
+            firing.append(
+                f"  {series:<28} zscore   last={w.get('last')} "
+                f"mean={w.get('mean')} std={w.get('std')} n={w.get('n')}")
+    for series, m in sorted((snap.get("silences") or {}).items()):
+        if m.get("firing"):
+            firing.append(
+                f"  {series:<28} silence  age={_fmt_age(m.get('age_s'))} "
+                f"max={float(m.get('max_age_s', 0)):.1f}s")
+    lines.append("")
+    if firing:
+        lines.append("firing anomalies:")
+        lines.extend(firing)
+    else:
+        lines.append("firing anomalies: none")
+    events = view.get("events") or []
+    if events:
+        lines.append("")
+        lines.append(f"health events in the ring ({len(events)}):")
+        for ev in events[-20:]:
+            name = ev.get("event")
+            detail = {k: v for k, v in ev.items()
+                      if k not in ("topic", "event", "trace_id", "span_id",
+                                   "node")}
+            lines.append(f"  {name}: {json.dumps(detail, sort_keys=True)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="health snapshot JSON or flight dump")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged machine-readable view")
+    ap.add_argument("--assert-healthy", action="store_true",
+                    help="exit 1 unless the status is 'ok' (CI gate)")
+    args = ap.parse_args(argv)
+    view = load_input(args.path)
+    status = _status_of(view)
+    if args.json:
+        out = dict(view)
+        out["status"] = status
+        print(json.dumps(out, sort_keys=True, default=str))
+    else:
+        print(render_text(view))
+    if args.assert_healthy and status != "ok":
+        print(f"assert-healthy: status is {status!r}, not 'ok'",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

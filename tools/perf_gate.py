@@ -63,11 +63,13 @@ RELATIVE_KEYS = ("vs_baseline", "agg_speedup", "round_update_speedup",
                  "async_flushes_per_s", "async_deltas_per_s",
                  "telemetry_rounds_per_s", "defended_round_speedup",
                  "fanin_uploads_per_s_flat", "fanin_uploads_per_s_edge",
-                 "chunked_goodput_frac_lossy")
+                 "chunked_goodput_frac_lossy",
+                 "rounds_per_s", "clients_simulated_per_s")
 # lower-is-better: absolute cap (observability must stay cheap — spans,
 # registry, exposition, and now the telemetry plane all share the budget)
 OVERHEAD_KEYS = ("obs_overhead_frac", "telemetry_overhead_frac",
-                 "dp_overhead_frac", "chunk_overhead_frac")
+                 "dp_overhead_frac", "chunk_overhead_frac",
+                 "health_overhead_frac")
 # per-key overrides of --obs-overhead-max: the DP stage pays real compute
 # (per-client clip + counter-based noise over the whole update matrix), so
 # against the small synthetic bench round its frac is a few x, not a few %.
